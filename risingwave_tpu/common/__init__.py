@@ -1,0 +1,19 @@
+"""Data-plane primitives: types, chunks, hashing, epochs.
+
+Reference counterpart: ``src/common`` (see SURVEY.md §2.2).
+"""
+
+from risingwave_tpu.common.types import (  # noqa: F401
+    DataType,
+    Field,
+    Schema,
+)
+from risingwave_tpu.common.chunk import (  # noqa: F401
+    Chunk,
+    StrCol,
+    OP_INSERT,
+    OP_DELETE,
+    OP_UPDATE_DELETE,
+    OP_UPDATE_INSERT,
+)
+from risingwave_tpu.common.epoch import Epoch, EpochPair  # noqa: F401

@@ -1,0 +1,331 @@
+"""Fixed-capacity columnar stream chunks.
+
+Reference counterparts:
+- ``DataChunk``   — src/common/src/array/data_chunk.rs:65 (columns + visibility Bitmap)
+- ``StreamChunk`` — src/common/src/array/stream_chunk.rs:45 (DataChunk + per-row Op)
+
+TPU-first design
+----------------
+The reference's visibility ``Bitmap`` ("mask rows without copying") is
+adopted as the *universal* mechanism: a ``Chunk`` always has a static
+``capacity`` (its leading array dimension) and a boolean ``valid`` mask.
+Every kernel is therefore shape-static and jit-friendly — filtering,
+dispatch partitioning and selective emission all just rewrite the mask.
+
+A chunk is a JAX pytree whose leaves are device arrays:
+
+- ``columns``: one leaf per column — a plain ``[cap]`` (or ``[cap, w]``
+  u8 for strings) array;
+- ``ops``: ``int8 [cap]`` changelog op per row (Insert/Delete/UpdateDelete/
+  UpdateInsert, ref stream_chunk.rs Op enum);
+- ``valid``: ``bool [cap]`` visibility.
+
+The ``schema`` travels as static pytree aux data, so tracing specializes
+on it (this mirrors how the reference's executors know their schema at
+build time).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from risingwave_tpu.common.types import DataType, Field, Schema
+
+# Changelog ops. Sign: +1 for *Insert, -1 for *Delete — all retraction
+# arithmetic (counts, sums) works uniformly on the sign vector.
+# (ref: src/common/src/array/stream_chunk.rs:45 `Op`)
+OP_INSERT = np.int8(0)
+OP_DELETE = np.int8(1)
+OP_UPDATE_DELETE = np.int8(2)
+OP_UPDATE_INSERT = np.int8(3)
+
+_OP_PRETTY = {0: "+", 1: "-", 2: "U-", 3: "U+"}
+_PRETTY_OP = {"+": 0, "-": 1, "u-": 2, "u+": 3}
+
+
+class StrCol(NamedTuple):
+    """A fixed-width device string column: utf-8 bytes + logical lengths."""
+
+    data: jnp.ndarray  # [cap, width] uint8, zero-padded
+    lens: jnp.ndarray  # [cap] int32
+
+
+def _leaf_shape_cap(col) -> int:
+    return (col.data if isinstance(col, StrCol) else col).shape[0]
+
+
+@jax.tree_util.register_pytree_node_class
+class Chunk:
+    """A fixed-capacity changelog batch of rows (SoA layout)."""
+
+    __slots__ = ("columns", "ops", "valid", "schema")
+
+    def __init__(
+        self,
+        columns: Sequence[Any],
+        ops: jnp.ndarray,
+        valid: jnp.ndarray,
+        schema: Schema,
+    ):
+        self.columns = tuple(columns)
+        self.ops = ops
+        self.valid = valid
+        self.schema = schema
+
+    # -- pytree protocol ------------------------------------------------
+    def tree_flatten(self):
+        return (self.columns, self.ops, self.valid), self.schema
+
+    @classmethod
+    def tree_unflatten(cls, schema, children):
+        columns, ops, valid = children
+        return cls(columns, ops, valid, schema)
+
+    # -- basic properties ----------------------------------------------
+    @property
+    def capacity(self) -> int:
+        return _leaf_shape_cap(self.ops if len(self.columns) == 0 else self.columns[0])
+
+    def cardinality(self) -> jnp.ndarray:
+        """Number of visible rows (traced value)."""
+        return jnp.sum(self.valid.astype(jnp.int32))
+
+    def signs(self) -> jnp.ndarray:
+        """Per-row +1/-1/0 changelog sign (0 for invisible rows)."""
+        insert_like = (self.ops == OP_INSERT) | (self.ops == OP_UPDATE_INSERT)
+        s = jnp.where(insert_like, jnp.int32(1), jnp.int32(-1))
+        return jnp.where(self.valid, s, jnp.int32(0))
+
+    def column(self, i: int):
+        return self.columns[i]
+
+    def column_by_name(self, name: str):
+        return self.columns[self.schema.index_of(name)]
+
+    # -- functional updates ---------------------------------------------
+    def with_valid(self, valid: jnp.ndarray) -> "Chunk":
+        return Chunk(self.columns, self.ops, valid, self.schema)
+
+    def mask(self, keep: jnp.ndarray) -> "Chunk":
+        """Narrow visibility (ref DataChunk::with_visibility)."""
+        return self.with_valid(self.valid & keep)
+
+    def with_columns(self, columns: Sequence[Any], schema: Schema) -> "Chunk":
+        return Chunk(columns, self.ops, self.valid, schema)
+
+    def project(self, indices: Sequence[int]) -> "Chunk":
+        """Column projection without copying (ref DataChunk::project)."""
+        return Chunk(
+            [self.columns[i] for i in indices],
+            self.ops,
+            self.valid,
+            self.schema.select(list(indices)),
+        )
+
+    # -- host-side conversion (test / serving surface) -------------------
+    @staticmethod
+    def from_numpy(
+        schema: Schema,
+        arrays: Sequence[np.ndarray],
+        ops: np.ndarray | None = None,
+        capacity: int | None = None,
+    ) -> "Chunk":
+        """Build a chunk from host arrays, padding to ``capacity``.
+
+        String columns are passed as 1-D object/str arrays and encoded to
+        fixed-width bytes here (the host↔device boundary).
+        """
+        if len(arrays) != len(schema.fields):
+            raise ValueError(
+                f"{len(arrays)} arrays for {len(schema.fields)}-field schema"
+            )
+        n = len(arrays[0]) if arrays else (len(ops) if ops is not None else 0)
+        cap = capacity or max(n, 1)
+        if n > cap:
+            raise ValueError(f"{n} rows > capacity {cap}")
+        if ops is None:
+            ops = np.full(n, OP_INSERT, np.int8)
+        cols = []
+        for f, arr in zip(schema.fields, arrays):
+            cols.append(_encode_column(f, np.asarray(arr), cap))
+        ops_full = np.zeros(cap, np.int8)
+        ops_full[:n] = ops
+        valid = np.zeros(cap, np.bool_)
+        valid[:n] = True
+        return Chunk(
+            cols, jnp.asarray(ops_full), jnp.asarray(valid), schema
+        )
+
+    def to_host(self) -> tuple[np.ndarray, list[np.ndarray], np.ndarray]:
+        """Return (ops, columns-as-python-values, valid) compacted to visible rows."""
+        valid = np.asarray(self.valid)
+        ops = np.asarray(self.ops)[valid]
+        out_cols: list[np.ndarray] = []
+        for f, col in zip(self.schema.fields, self.columns):
+            out_cols.append(_decode_column(f, col, valid))
+        return ops, out_cols, valid
+
+    def to_rows(self) -> list[tuple]:
+        """Visible rows as ((op, values...)) tuples — test helper."""
+        ops, cols, _ = self.to_host()
+        return [
+            (int(ops[i]), *(c[i] for c in cols)) for i in range(len(ops))
+        ]
+
+    # -- pretty DSL (test enabler; ref StreamChunk::from_pretty) ---------
+    @staticmethod
+    def from_pretty(text: str, capacity: int | None = None) -> "Chunk":
+        """Parse the reference's chunk text DSL.
+
+        Example::
+
+            i I F
+            +  1 10 1.5
+            -  2 20 2.5
+            U- 3 30 0.0
+            U+ 3 31 0.0
+
+        Header letters: ``b`` bool, ``s`` int16, ``i`` int32, ``I`` int64,
+        ``f`` float32, ``F`` float64, ``d`` decimal, ``D`` date,
+        ``t`` timestamp, ``T`` varchar, ``S`` serial.
+        """
+        lines = [ln for ln in (l.strip() for l in text.splitlines()) if ln]
+        header = lines[0].split()
+        fields = tuple(
+            Field(f"c{idx}", _PRETTY_TYPES[tok]) for idx, tok in enumerate(header)
+        )
+        schema = Schema(fields)
+        ops_l: list[int] = []
+        rows: list[list[str]] = []
+        for ln in lines[1:]:
+            parts = ln.split()
+            ops_l.append(_PRETTY_OP[parts[0].lower()])
+            if len(parts) - 1 != len(fields):
+                raise ValueError(f"row {ln!r} arity != {len(fields)}")
+            rows.append(parts[1:])
+        arrays: list[np.ndarray] = []
+        for ci, f in enumerate(fields):
+            raw = [r[ci] for r in rows]
+            arrays.append(_parse_pretty_col(f, raw))
+        return Chunk.from_numpy(
+            schema, arrays, np.asarray(ops_l, np.int8), capacity=capacity
+        )
+
+    def to_pretty(self) -> str:
+        ops, cols, _ = self.to_host()
+        out = []
+        for i in range(len(ops)):
+            vals = " ".join(str(c[i]) for c in cols)
+            out.append(f"{_OP_PRETTY[int(ops[i])]:>2} {vals}")
+        return "\n".join(out)
+
+    def __repr__(self) -> str:
+        return (
+            f"Chunk(cap={self.capacity}, schema={list(self.schema.fields)})"
+        )
+
+
+_PRETTY_TYPES = {
+    "b": DataType.BOOLEAN,
+    "s": DataType.INT16,
+    "i": DataType.INT32,
+    "I": DataType.INT64,
+    "f": DataType.FLOAT32,
+    "F": DataType.FLOAT64,
+    "d": DataType.DECIMAL,
+    "D": DataType.DATE,
+    "t": DataType.TIMESTAMP,
+    "T": DataType.VARCHAR,
+    "S": DataType.SERIAL,
+}
+
+
+def _parse_pretty_col(f: Field, raw: list[str]) -> np.ndarray:
+    t = f.data_type
+    if t.is_string:
+        return np.asarray(raw, object)
+    if t == DataType.BOOLEAN:
+        return np.asarray([v in ("t", "true", "1") for v in raw])
+    if t == DataType.DECIMAL:
+        return np.asarray([float(v) for v in raw])
+    if t in (DataType.FLOAT32, DataType.FLOAT64):
+        return np.asarray([float(v) for v in raw])
+    return np.asarray([int(v) for v in raw])
+
+
+def encode_strings(values: Sequence, width: int) -> tuple[np.ndarray, np.ndarray]:
+    """Encode python strings/bytes to fixed-width (bytes, lens) arrays."""
+    n = len(values)
+    data = np.zeros((n, width), np.uint8)
+    lens = np.zeros(n, np.int32)
+    for i, v in enumerate(values):
+        b = v if isinstance(v, (bytes, bytearray)) else str(v).encode("utf-8")
+        b = b[:width]
+        data[i, : len(b)] = np.frombuffer(b, np.uint8)
+        lens[i] = len(b)
+    return data, lens
+
+
+def decode_strings(data: np.ndarray, lens: np.ndarray) -> np.ndarray:
+    out = np.empty(len(lens), object)
+    for i in range(len(lens)):
+        out[i] = bytes(data[i, : lens[i]]).decode("utf-8", "replace")
+    return out
+
+
+def _encode_column(f: Field, arr: np.ndarray, cap: int):
+    t = f.data_type
+    if t.is_string:
+        data, lens = encode_strings(list(arr), f.str_width)
+        full = np.zeros((cap, f.str_width), np.uint8)
+        full[: len(arr)] = data
+        lfull = np.zeros(cap, np.int32)
+        lfull[: len(arr)] = lens
+        return StrCol(jnp.asarray(full), jnp.asarray(lfull))
+    dtype = np.dtype(t.physical_dtype)
+    if t == DataType.DECIMAL:
+        # inputs are logical values; the device representation is scaled int64
+        arr = np.round(arr.astype(np.float64) * 10**f.decimal_scale).astype(np.int64)
+    full = np.zeros(cap, dtype)
+    full[: len(arr)] = arr.astype(dtype)
+    return jnp.asarray(full)
+
+
+def _decode_column(f: Field, col, valid: np.ndarray) -> np.ndarray:
+    t = f.data_type
+    if isinstance(col, StrCol):
+        data = np.asarray(col.data)[valid]
+        lens = np.asarray(col.lens)[valid]
+        return decode_strings(data, lens)
+    arr = np.asarray(col)[valid]
+    if t == DataType.DECIMAL:
+        return arr.astype(np.float64) / 10**f.decimal_scale
+    if t == DataType.BOOLEAN:
+        return arr.astype(bool)
+    return arr
+
+
+def concat_chunks(chunks: Sequence[Chunk], capacity: int) -> list[Chunk]:
+    """Host-side re-batching of visible rows into fixed-capacity chunks."""
+    if not chunks:
+        return []
+    schema = chunks[0].schema
+    all_rows: list[tuple] = []
+    for c in chunks:
+        ops, cols, _ = c.to_host()
+        for i in range(len(ops)):
+            all_rows.append((ops[i], tuple(col[i] for col in cols)))
+    out = []
+    for start in range(0, len(all_rows), capacity):
+        batch = all_rows[start : start + capacity]
+        ops = np.asarray([r[0] for r in batch], np.int8)
+        arrays = [
+            np.asarray([r[1][ci] for r in batch])
+            for ci in range(len(schema))
+        ]
+        out.append(Chunk.from_numpy(schema, arrays, ops, capacity=capacity))
+    return out

@@ -1,0 +1,205 @@
+"""Logical data types and schemas.
+
+Reference counterpart: ``src/common/src/types/mod.rs:154-219`` (the
+``DataType`` enum) and ``src/common/src/catalog/`` (``Field``/``Schema``).
+
+TPU-first design notes
+----------------------
+Every logical type maps to a *fixed-width* physical representation so that
+chunks are shape-static XLA values:
+
+- integers/floats/bool map 1:1 onto jnp dtypes;
+- ``DECIMAL`` is a scaled ``int64`` (value * 10^scale).  The reference uses
+  a 128-bit decimal; 64-bit scaled covers the benchmark surface (prices,
+  amounts) and overflow is checked host-side on ingest;
+- temporal types are integer epochs (days / micros);
+- ``VARCHAR`` is a (bytes[cap, max_len] u8, len[cap] i32) pair — fixed
+  max width on device.  Comparisons/equality/hashing are vectorized over
+  the byte dimension; unbounded string ops fall back to host;
+- composite types (STRUCT/LIST/MAP) exist at the planner level and are
+  flattened to multiple physical columns before reaching the device.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class DataType(enum.Enum):
+    """Logical column types (subset of reference types/mod.rs:154)."""
+
+    BOOLEAN = "boolean"
+    INT16 = "smallint"
+    INT32 = "int"
+    INT64 = "bigint"
+    FLOAT32 = "real"
+    FLOAT64 = "double precision"
+    DECIMAL = "numeric"          # scaled int64, scale fixed per column
+    DATE = "date"                # i32 days since unix epoch
+    TIME = "time"                # i64 microseconds since midnight
+    TIMESTAMP = "timestamp"      # i64 microseconds since unix epoch (naive)
+    TIMESTAMPTZ = "timestamptz"  # i64 microseconds since unix epoch (UTC)
+    INTERVAL = "interval"        # i64 microseconds (simplified; ref has months/days/usecs)
+    VARCHAR = "character varying"
+    BYTEA = "bytea"
+    SERIAL = "serial"            # i64 row-id
+
+    # ------------------------------------------------------------------
+    @property
+    def physical_dtype(self) -> jnp.dtype:
+        """The jnp dtype of the device column (bytes column for strings)."""
+        return _PHYSICAL[self]
+
+    @property
+    def is_string(self) -> bool:
+        return self in (DataType.VARCHAR, DataType.BYTEA)
+
+    @property
+    def is_numeric(self) -> bool:
+        return self in (
+            DataType.INT16,
+            DataType.INT32,
+            DataType.INT64,
+            DataType.FLOAT32,
+            DataType.FLOAT64,
+            DataType.DECIMAL,
+        )
+
+    @property
+    def is_integral(self) -> bool:
+        return self in (
+            DataType.INT16,
+            DataType.INT32,
+            DataType.INT64,
+            DataType.SERIAL,
+            DataType.DATE,
+            DataType.TIME,
+            DataType.TIMESTAMP,
+            DataType.TIMESTAMPTZ,
+            DataType.INTERVAL,
+            DataType.DECIMAL,
+        )
+
+    @property
+    def byte_width(self) -> int:
+        """Width of the memcomparable/hash key encoding of one value."""
+        if self.is_string:
+            raise ValueError("strings have no fixed byte width")
+        return np.dtype(self.physical_dtype).itemsize
+
+    @classmethod
+    def from_sql(cls, name: str) -> "DataType":
+        return _SQL_NAMES[name.strip().lower()]
+
+
+_PHYSICAL: dict[DataType, jnp.dtype] = {
+    DataType.BOOLEAN: jnp.bool_,
+    DataType.INT16: jnp.int16,
+    DataType.INT32: jnp.int32,
+    DataType.INT64: jnp.int64,
+    DataType.FLOAT32: jnp.float32,
+    DataType.FLOAT64: jnp.float64,
+    DataType.DECIMAL: jnp.int64,
+    DataType.DATE: jnp.int32,
+    DataType.TIME: jnp.int64,
+    DataType.TIMESTAMP: jnp.int64,
+    DataType.TIMESTAMPTZ: jnp.int64,
+    DataType.INTERVAL: jnp.int64,
+    DataType.VARCHAR: jnp.uint8,
+    DataType.BYTEA: jnp.uint8,
+    DataType.SERIAL: jnp.int64,
+}
+
+_SQL_NAMES: dict[str, DataType] = {}
+for _t in DataType:
+    _SQL_NAMES[_t.value] = _t
+_SQL_NAMES.update(
+    {
+        "bool": DataType.BOOLEAN,
+        "int2": DataType.INT16,
+        "smallint": DataType.INT16,
+        "int4": DataType.INT32,
+        "integer": DataType.INT32,
+        "int8": DataType.INT64,
+        "bigint": DataType.INT64,
+        "float4": DataType.FLOAT32,
+        "real": DataType.FLOAT32,
+        "float8": DataType.FLOAT64,
+        "double": DataType.FLOAT64,
+        "decimal": DataType.DECIMAL,
+        "varchar": DataType.VARCHAR,
+        "string": DataType.VARCHAR,
+        "text": DataType.VARCHAR,
+        "timestamp without time zone": DataType.TIMESTAMP,
+        "timestamp with time zone": DataType.TIMESTAMPTZ,
+    }
+)
+
+# Default device width (bytes) for VARCHAR columns unless the schema
+# declares one.  Nexmark's longest generated strings (extra/url) fit well
+# within this.
+DEFAULT_STR_WIDTH = 64
+
+# Default decimal scale: micro-units, enough for currency math in the
+# benchmark suite (ref nexmark uses f64-backed "price" semantics).
+DEFAULT_DECIMAL_SCALE = 6
+
+
+@dataclass(frozen=True)
+class Field:
+    """A named, typed column (ref: src/common/src/catalog/schema.rs Field)."""
+
+    name: str
+    data_type: DataType
+    #: device byte width for string columns
+    str_width: int = DEFAULT_STR_WIDTH
+    #: power-of-ten scale for DECIMAL columns
+    decimal_scale: int = DEFAULT_DECIMAL_SCALE
+
+    def __repr__(self) -> str:  # compact for plan display
+        return f"{self.name}:{self.data_type.name.lower()}"
+
+
+@dataclass(frozen=True)
+class Schema:
+    """An ordered list of fields (ref: src/common/src/catalog/schema.rs)."""
+
+    fields: tuple[Field, ...] = field(default_factory=tuple)
+
+    def __post_init__(self) -> None:
+        object.__setattr__(self, "fields", tuple(self.fields))
+
+    def __len__(self) -> int:
+        return len(self.fields)
+
+    def __iter__(self):
+        return iter(self.fields)
+
+    def __getitem__(self, i: int) -> Field:
+        return self.fields[i]
+
+    def index_of(self, name: str) -> int:
+        for i, f in enumerate(self.fields):
+            if f.name == name:
+                return i
+        raise KeyError(name)
+
+    def names(self) -> list[str]:
+        return [f.name for f in self.fields]
+
+    def data_types(self) -> list[DataType]:
+        return [f.data_type for f in self.fields]
+
+    def select(self, indices: list[int]) -> "Schema":
+        return Schema(tuple(self.fields[i] for i in indices))
+
+    def concat(self, other: "Schema") -> "Schema":
+        return Schema(self.fields + other.fields)
+
+    @staticmethod
+    def of(*cols: tuple[str, DataType]) -> "Schema":
+        return Schema(tuple(Field(n, t) for n, t in cols))

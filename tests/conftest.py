@@ -1,0 +1,20 @@
+"""Test harness configuration.
+
+Multi-chip behaviour is tested on a virtual 8-device CPU mesh (the
+driver's dryrun does the same), mirroring how the reference tests
+multi-node behaviour in a single process with madsim (SURVEY.md §4.4).
+Must run before jax initializes.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)

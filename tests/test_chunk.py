@@ -1,0 +1,106 @@
+"""Chunk / type-system tests (ref: data_chunk.rs, stream_chunk.rs tests)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from risingwave_tpu.common import (
+    Chunk,
+    DataType,
+    Field,
+    Schema,
+    OP_INSERT,
+    OP_DELETE,
+)
+from risingwave_tpu.common.chunk import StrCol, concat_chunks
+
+
+def test_from_pretty_roundtrip():
+    c = Chunk.from_pretty(
+        """
+        i I F
+        +  1 10 1.5
+        -  2 20 2.5
+        U- 3 30 0.5
+        U+ 3 31 0.5
+        """
+    )
+    assert c.capacity == 4
+    assert int(c.cardinality()) == 4
+    rows = c.to_rows()
+    assert rows[0] == (0, 1, 10, 1.5)
+    assert rows[1] == (1, 2, 20, 2.5)
+    assert rows[2][0] == 2 and rows[3][0] == 3
+    signs = np.asarray(c.signs())
+    assert signs.tolist() == [1, -1, -1, 1]
+
+
+def test_padding_and_mask():
+    c = Chunk.from_pretty(
+        """
+        i
+        + 1
+        + 2
+        + 3
+        """,
+        capacity=8,
+    )
+    assert c.capacity == 8
+    assert int(c.cardinality()) == 3
+    keep = jnp.asarray([True, False, True, True, True, True, True, True])
+    c2 = c.mask(keep)
+    assert int(c2.cardinality()) == 2
+    assert [r[1] for r in c2.to_rows()] == [1, 3]
+    # signs are zero for invisible rows
+    assert np.asarray(c2.signs()).tolist()[:3] == [1, 0, 1]
+
+
+def test_string_columns():
+    schema = Schema.of(("name", DataType.VARCHAR), ("v", DataType.INT64))
+    c = Chunk.from_numpy(
+        schema,
+        [np.asarray(["alice", "bob", "charlie"], object), np.asarray([1, 2, 3])],
+        capacity=4,
+    )
+    col = c.column_by_name("name")
+    assert isinstance(col, StrCol)
+    _, cols, _ = c.to_host()
+    assert cols[0].tolist() == ["alice", "bob", "charlie"]
+    assert cols[1].tolist() == [1, 2, 3]
+
+
+def test_decimal_scaling():
+    schema = Schema(
+        (Field("price", DataType.DECIMAL, decimal_scale=2),)
+    )
+    c = Chunk.from_numpy(schema, [np.asarray([1.25, 3.5])])
+    # stored as scaled ints on device
+    assert c.column(0).dtype == jnp.int64
+    assert np.asarray(c.column(0)).tolist() == [125, 350]
+    _, cols, _ = c.to_host()
+    assert cols[0].tolist() == [1.25, 3.5]
+
+
+def test_project():
+    c = Chunk.from_pretty(
+        """
+        i I F
+        + 1 2 3.0
+        """
+    )
+    p = c.project([2, 0])
+    assert p.schema.data_types() == [DataType.FLOAT64, DataType.INT32]
+    assert p.to_rows() == [(0, 3.0, 1)]
+
+
+def test_concat_chunks_rebatch():
+    a = Chunk.from_pretty("i\n+ 1\n+ 2", capacity=4)
+    b = Chunk.from_pretty("i\n- 3\n+ 4\n+ 5", capacity=4)
+    out = concat_chunks([a, b], capacity=2)
+    assert [len(c.to_rows()) for c in out] == [2, 2, 1]
+    flat = [r for c in out for r in c.to_rows()]
+    assert flat == [(0, 1), (0, 2), (1, 3), (0, 4), (0, 5)]
+
+
+def test_ops_constants():
+    assert OP_INSERT == 0 and OP_DELETE == 1
