@@ -28,12 +28,22 @@ Layer map (mirrors reference layers, see SURVEY.md §1):
 - ``meta``     — catalog, barrier scheduler, checkpoint manager (ref: src/meta)
 """
 
+import os as _os
+
 import jax as _jax
 
 # int64/timestamp/decimal columns are first-class in a SQL engine; enable
 # 64-bit types before any tracing happens.  Device kernels prefer int64 /
 # float32 paths (float64 is emulated on TPU and avoided in hot loops).
 _jax.config.update("jax_enable_x64", True)
+
+# Some environments install a PJRT plugin whose registration hook rewrites
+# ``jax_platforms`` (e.g. to "axon,cpu"), silently overriding the
+# JAX_PLATFORMS env var.  A SQL engine must honor the operator's explicit
+# platform choice (tests/dryruns pin cpu; benches pin the accelerator), so
+# re-assert the env var over any plugin override.
+if _os.environ.get("JAX_PLATFORMS"):
+    _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
 
 __version__ = "0.1.0"
 

@@ -178,7 +178,11 @@ class Chunk:
 
     # -- pretty DSL (test enabler; ref StreamChunk::from_pretty) ---------
     @staticmethod
-    def from_pretty(text: str, capacity: int | None = None) -> "Chunk":
+    def from_pretty(
+        text: str,
+        capacity: int | None = None,
+        names: Sequence[str] | None = None,
+    ) -> "Chunk":
         """Parse the reference's chunk text DSL.
 
         Example::
@@ -196,7 +200,10 @@ class Chunk:
         lines = [ln for ln in (l.strip() for l in text.splitlines()) if ln]
         header = lines[0].split()
         fields = tuple(
-            Field(f"c{idx}", _PRETTY_TYPES[tok]) for idx, tok in enumerate(header)
+            Field(
+                names[idx] if names else f"c{idx}", _PRETTY_TYPES[tok]
+            )
+            for idx, tok in enumerate(header)
         )
         schema = Schema(fields)
         ops_l: list[int] = []

@@ -1,0 +1,7 @@
+"""Connectors: sources and sinks.
+
+Reference counterpart: ``src/connector`` (SURVEY.md §2.6).  Round 1
+ships the benchmark-critical native generators (nexmark, datagen); the
+external-system surface (kafka etc.) lands behind the same
+``SplitEnumerator``/``SplitReader`` abstractions in later rounds.
+"""

@@ -1,0 +1,29 @@
+"""Vectorized expression + aggregate engine.
+
+Reference counterpart: ``src/expr`` — ``Expression::eval(&DataChunk)``
+(src/expr/core/src/expr/mod.rs:88), the ``FUNCTION_REGISTRY``
+(src/expr/core/src/sig/mod.rs:39) and ``AggregateFunction``
+(src/expr/core/src/aggregate/mod.rs:49).
+
+TPU-first design: an expression tree evaluates to a whole device column
+per chunk in one traced program — there is no per-row interpreter.  The
+executor jits the *fragment* step, so expression trees fuse with their
+consumers (filter masks, agg updates) into a single XLA computation.
+"""
+
+from risingwave_tpu.expr.node import (  # noqa: F401
+    Expr,
+    InputRef,
+    Literal,
+    FuncCall,
+    col,
+    lit,
+    input_ref,
+)
+from risingwave_tpu.expr.registry import FUNCTION_REGISTRY, function  # noqa: F401
+from risingwave_tpu.expr import scalar  # noqa: F401  (populates the registry)
+from risingwave_tpu.expr.agg import (  # noqa: F401
+    AGG_REGISTRY,
+    AggCall,
+    AggSpec,
+)

@@ -1,0 +1,196 @@
+"""Aggregate functions with retraction semantics.
+
+Reference counterpart: ``AggregateFunction`` (src/expr/core/src/aggregate/
+mod.rs:49) and impls in src/expr/impl/src/aggregate/.
+
+TPU-first design
+----------------
+An aggregate is decomposed into one or more *primitive scatter states*,
+each updatable with a single vectorized scatter op over a slot index
+vector — this is what lets a whole chunk's worth of updates for
+thousands of groups land in one XLA scatter instead of a per-group loop
+(the reference's ``AggGroup::apply_chunk`` per-group path, hash_agg.rs:332,
+becomes a ``state.at[slots].add/min/max(contrib)``):
+
+- ``add`` states: count / sum / sum0 / avg-numerator — fully retractable
+  via the changelog sign vector (insert=+1, delete=-1).
+- ``min``/``max`` states: monotone monoids — exact for append-only
+  inputs.  Retractable min/max requires a materialized-input state (the
+  reference's ``minput.rs``); until that lands, executors flag deletes
+  hitting a min/max state (consistency check, like the reference's
+  consistency_error!).
+
+``output`` combines the primitive states into the SQL result (e.g.
+avg = sum / count) and is evaluated only at barrier emit time.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dfield
+from typing import Callable, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from risingwave_tpu.common.types import DataType, Field
+from risingwave_tpu.expr.node import Expr
+from risingwave_tpu.expr.registry import promote_numeric
+
+
+@dataclass(frozen=True)
+class PrimState:
+    """One scatter-updatable state array of a (possibly composite) agg."""
+
+    mode: str  # "add" | "min" | "max"
+    #: dtype of the state array given the input column dtype
+    dtype: Callable[[jnp.dtype], jnp.dtype]
+    #: identity element
+    init: Callable[[jnp.dtype], jnp.ndarray]
+    #: (value_col, signs) -> per-row contribution (same len as chunk)
+    lift: Callable
+
+
+def _i64(_):
+    return jnp.int64
+
+
+def _same(d):
+    return d
+
+
+_ADD_COUNT = PrimState(
+    "add", _i64, lambda d: jnp.zeros((), jnp.int64),
+    lambda col, signs: signs.astype(jnp.int64),
+)
+
+
+def _sum_dtype(d):
+    # sum of int16/int32 widens to int64 (SQL sum semantics)
+    if jnp.issubdtype(d, jnp.integer):
+        return jnp.int64
+    return d
+
+
+_ADD_SUM = PrimState(
+    "add", _sum_dtype, lambda d: jnp.zeros((), d),
+    lambda col, signs: col.astype(_sum_dtype(col.dtype)) * signs.astype(_sum_dtype(col.dtype)),
+)
+
+
+def _minmax_init(mode):
+    def init(d):
+        if jnp.issubdtype(d, jnp.floating):
+            v = jnp.inf if mode == "min" else -jnp.inf
+            return jnp.asarray(v, d)
+        info = jnp.iinfo(d)
+        return jnp.asarray(info.max if mode == "min" else info.min, d)
+
+    return init
+
+
+def _minmax_lift(mode):
+    def lift(col, signs):
+        # deletes must not feed min/max; executor checks this invariant
+        neutral = _minmax_init(mode)(col.dtype)
+        return jnp.where(signs > 0, col, neutral)
+
+    return lift
+
+
+_MIN = PrimState("min", _same, _minmax_init("min"), _minmax_lift("min"))
+_MAX = PrimState("max", _same, _minmax_init("max"), _minmax_lift("max"))
+
+
+@dataclass(frozen=True)
+class AggSpec:
+    """A SQL aggregate = primitive states + an output combiner."""
+
+    name: str
+    states: tuple[PrimState, ...]
+    #: (state_cols, group_count, out_field) -> output column
+    output: Callable
+    #: whether deletes are handled exactly
+    retractable: bool
+    #: return type given input type (None input for count(*))
+    return_type: Callable[[DataType | None], DataType]
+
+    def needs_input(self) -> bool:
+        return self.name != "count_star"
+
+
+def _out_first(states, count, out_field):
+    return states[0]
+
+
+def _out_count(states, count, out_field):
+    return states[0]
+
+
+def _out_avg(states, count, out_field):
+    s, c = states
+    if out_field.data_type == DataType.DECIMAL:
+        return jnp.where(c != 0, s // jnp.where(c == 0, 1, c), 0)
+    return jnp.where(
+        c != 0, s / jnp.where(c == 0, 1, c).astype(jnp.float64), 0.0
+    )
+
+
+def _avg_type(t):
+    if t == DataType.DECIMAL:
+        return DataType.DECIMAL
+    return DataType.FLOAT64
+
+
+AGG_REGISTRY: dict[str, AggSpec] = {
+    "count": AggSpec("count", (_ADD_COUNT,), _out_count, True, lambda t: DataType.INT64),
+    "count_star": AggSpec(
+        "count_star", (_ADD_COUNT,), _out_count, True, lambda t: DataType.INT64
+    ),
+    "sum": AggSpec(
+        "sum", (_ADD_SUM,), _out_first, True,
+        lambda t: DataType.INT64 if t in (DataType.INT16, DataType.INT32) else t,
+    ),
+    "sum0": AggSpec(  # sum that starts at 0 instead of NULL (internal, 2-phase)
+        "sum0", (_ADD_SUM,), _out_first, True,
+        lambda t: DataType.INT64 if t in (DataType.INT16, DataType.INT32) else t,
+    ),
+    "avg": AggSpec("avg", (_ADD_SUM, _ADD_COUNT), _out_avg, True, _avg_type),
+    "min": AggSpec("min", (_MIN,), _out_first, False, lambda t: t),
+    "max": AggSpec("max", (_MAX,), _out_first, False, lambda t: t),
+}
+
+
+@dataclass(frozen=True)
+class AggCall:
+    """One aggregate call in a plan: kind + input expression.
+
+    Ref: ``AggCall`` (src/expr/core/src/aggregate/mod.rs) — distinct and
+    filter clauses are planner-level rewrites (distinct dedup tables),
+    not yet implemented.
+    """
+
+    kind: str
+    arg: Expr | None = None
+    alias: str | None = None
+
+    def spec(self) -> AggSpec:
+        return AGG_REGISTRY[self.kind]
+
+    def out_field(self, input_schema) -> Field:
+        spec = self.spec()
+        if self.arg is None:
+            in_t = None
+            scale = 6
+        else:
+            f = self.arg.return_field(input_schema)
+            in_t, scale = f.data_type, f.decimal_scale
+        t = spec.return_type(in_t)
+        return Field(self.alias or self.kind, t, decimal_scale=scale)
+
+
+def count_star(alias: str = "count") -> AggCall:
+    return AggCall("count_star", None, alias)
+
+
+def agg(kind: str, arg: Expr, alias: str | None = None) -> AggCall:
+    return AggCall(kind, arg, alias)

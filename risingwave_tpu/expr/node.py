@@ -1,0 +1,230 @@
+"""Expression AST.
+
+Reference counterpart: ``src/expr/core/src/expr/mod.rs`` (``Expression``
+trait, ``InputRefExpression``, ``LiteralExpression``,
+``FuncCallExpression``).  Unlike the reference's boxed-trait interpreter,
+evaluation here *traces*: ``Expr.eval(chunk)`` returns a jnp column and
+the whole tree collapses into the surrounding jitted program.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from risingwave_tpu.common.chunk import Chunk, StrCol, encode_strings
+from risingwave_tpu.common.types import (
+    DEFAULT_DECIMAL_SCALE,
+    DataType,
+    Field,
+    Schema,
+)
+
+
+class Expr:
+    """Base expression node; subclasses are immutable."""
+
+    # -- interface ------------------------------------------------------
+    def return_field(self, schema: Schema) -> Field:
+        raise NotImplementedError
+
+    def eval(self, chunk: Chunk):
+        """Evaluate to a device column ([cap] array or StrCol)."""
+        raise NotImplementedError
+
+    def return_type(self, schema: Schema) -> DataType:
+        return self.return_field(schema).data_type
+
+    # -- builder sugar --------------------------------------------------
+    def _f(self, name: str, *others: "Expr | Any") -> "FuncCall":
+        return FuncCall(name, (self, *[as_expr(o) for o in others]))
+
+    def __add__(self, o):
+        return self._f("add", o)
+
+    def __sub__(self, o):
+        return self._f("subtract", o)
+
+    def __mul__(self, o):
+        return self._f("multiply", o)
+
+    def __truediv__(self, o):
+        return self._f("divide", o)
+
+    def __mod__(self, o):
+        return self._f("modulus", o)
+
+    def __neg__(self):
+        return self._f("neg")
+
+    def __eq__(self, o):  # type: ignore[override]
+        return self._f("equal", o)
+
+    def __ne__(self, o):  # type: ignore[override]
+        return self._f("not_equal", o)
+
+    def __lt__(self, o):
+        return self._f("less_than", o)
+
+    def __le__(self, o):
+        return self._f("less_than_or_equal", o)
+
+    def __gt__(self, o):
+        return self._f("greater_than", o)
+
+    def __ge__(self, o):
+        return self._f("greater_than_or_equal", o)
+
+    def __and__(self, o):
+        return self._f("and", o)
+
+    def __or__(self, o):
+        return self._f("or", o)
+
+    def __invert__(self):
+        return self._f("not")
+
+    def __hash__(self):
+        return object.__hash__(self)
+
+    def cast(self, t: DataType) -> "FuncCall":
+        return FuncCall(f"cast_{t.name.lower()}", (self,))
+
+    def is_in(self, values: Sequence[Any]) -> "Expr":
+        """`x IN (v1, v2, ...)` — or-chain of equalities (small lists)."""
+        out: Expr | None = None
+        for v in values:
+            eq = self._f("equal", v)
+            out = eq if out is None else out | eq
+        if out is None:
+            raise ValueError("empty IN list")
+        return out
+
+
+@dataclass(frozen=True, eq=False)
+class InputRef(Expr):
+    """Column reference by position (ref InputRefExpression)."""
+
+    index: int
+
+    def return_field(self, schema: Schema) -> Field:
+        return schema[self.index]
+
+    def eval(self, chunk: Chunk):
+        return chunk.column(self.index)
+
+    def __repr__(self):
+        return f"${self.index}"
+
+
+@dataclass(frozen=True, eq=False)
+class NamedRef(Expr):
+    """Column reference by name, resolved against the chunk's schema."""
+
+    name: str
+
+    def return_field(self, schema: Schema) -> Field:
+        return schema[schema.index_of(self.name)]
+
+    def eval(self, chunk: Chunk):
+        return chunk.column_by_name(self.name)
+
+    def __repr__(self):
+        return self.name
+
+
+@dataclass(frozen=True, eq=False)
+class Literal(Expr):
+    """Constant (ref LiteralExpression). Broadcast to the chunk capacity."""
+
+    value: Any
+    data_type: DataType
+
+    def return_field(self, schema: Schema) -> Field:
+        return Field("?const", self.data_type)
+
+    def eval(self, chunk: Chunk):
+        cap = chunk.capacity
+        t = self.data_type
+        if t.is_string:
+            data, lens = encode_strings([self.value], 64)
+            return StrCol(
+                jnp.broadcast_to(jnp.asarray(data[0]), (cap, data.shape[1])),
+                jnp.broadcast_to(jnp.asarray(lens[0]), (cap,)),
+            )
+        if t == DataType.DECIMAL:
+            v = int(round(float(self.value) * 10**DEFAULT_DECIMAL_SCALE))
+            return jnp.full((cap,), v, jnp.int64)
+        return jnp.full((cap,), self.value, t.physical_dtype)
+
+    def __repr__(self):
+        return f"{self.value}:{self.data_type.name.lower()}"
+
+
+@dataclass(frozen=True, eq=False)
+class FuncCall(Expr):
+    """Scalar function application, resolved via FUNCTION_REGISTRY."""
+
+    name: str
+    args: tuple[Expr, ...]
+
+    def _resolve(self, schema: Schema):
+        from risingwave_tpu.expr.registry import FUNCTION_REGISTRY
+
+        arg_fields = [a.return_field(schema) for a in self.args]
+        return FUNCTION_REGISTRY.resolve(self.name, arg_fields), arg_fields
+
+    def return_field(self, schema: Schema) -> Field:
+        sig, arg_fields = self._resolve(schema)
+        return sig.return_field(arg_fields)
+
+    def eval(self, chunk: Chunk):
+        sig, arg_fields = self._resolve(chunk.schema)
+        cols = [a.eval(chunk) for a in self.args]
+        return sig.call(cols, arg_fields)
+
+    def __repr__(self):
+        return f"{self.name}({', '.join(map(repr, self.args))})"
+
+
+def as_expr(v: Any) -> Expr:
+    """Coerce python values to Literal exprs (builder convenience)."""
+    if isinstance(v, Expr):
+        return v
+    if isinstance(v, bool):
+        return Literal(v, DataType.BOOLEAN)
+    if isinstance(v, int):
+        return Literal(v, DataType.INT64 if abs(v) > 2**31 - 1 else DataType.INT32)
+    if isinstance(v, float):
+        return Literal(v, DataType.FLOAT64)
+    if isinstance(v, str):
+        return Literal(v, DataType.VARCHAR)
+    if isinstance(v, (np.integer,)):
+        return as_expr(int(v))
+    if isinstance(v, (np.floating,)):
+        return as_expr(float(v))
+    raise TypeError(f"cannot coerce {v!r} to Expr")
+
+
+def col(name: str) -> NamedRef:
+    return NamedRef(name)
+
+
+def input_ref(i: int) -> InputRef:
+    return InputRef(i)
+
+
+def lit(v: Any, t: DataType | None = None) -> Literal:
+    e = as_expr(v)
+    if t is not None:
+        return Literal(v, t)
+    assert isinstance(e, Literal)
+    return e
+
+
+def case(cond: Expr, then: Expr | Any, otherwise: Expr | Any) -> FuncCall:
+    """CASE WHEN cond THEN a ELSE b END."""
+    return FuncCall("case", (cond, as_expr(then), as_expr(otherwise)))

@@ -1,0 +1,182 @@
+"""Scalar function registry with signature dispatch.
+
+Reference counterpart: ``FUNCTION_REGISTRY`` (src/expr/core/src/sig/mod.rs:39)
+populated by the ``#[function("add(int,int)->int")]`` proc-macro
+(src/expr/macro/src/lib.rs).  Here the same idea is a decorator::
+
+    @function("add(int64, int64) -> int64")
+    def add_i64(a, b): return a + b
+
+Signatures use SQL type names plus the families ``intlike`` (int16/32/64,
+serial), ``floatlike`` (float32/64), ``numeric`` (ints+floats+decimal),
+``timelike`` (date/time/timestamp/timestamptz/interval), ``any``.
+Resolution prefers exact matches over family matches and, like the
+reference's casting rules, auto-promotes mixed numeric widths.
+"""
+
+from __future__ import annotations
+
+import inspect
+import re
+from dataclasses import dataclass
+from typing import Callable, Sequence
+
+from risingwave_tpu.common.types import DataType, Field
+
+_FAMILIES: dict[str, tuple[DataType, ...]] = {
+    "intlike": (DataType.INT16, DataType.INT32, DataType.INT64, DataType.SERIAL),
+    "floatlike": (DataType.FLOAT32, DataType.FLOAT64),
+    "numeric": (
+        DataType.INT16,
+        DataType.INT32,
+        DataType.INT64,
+        DataType.SERIAL,
+        DataType.FLOAT32,
+        DataType.FLOAT64,
+        DataType.DECIMAL,
+    ),
+    "timelike": (
+        DataType.DATE,
+        DataType.TIME,
+        DataType.TIMESTAMP,
+        DataType.TIMESTAMPTZ,
+        DataType.INTERVAL,
+    ),
+    "stringlike": (DataType.VARCHAR, DataType.BYTEA),
+    "any": tuple(DataType),
+}
+
+#: pseudo return types computed from the argument types
+_AUTO_RETURNS = ("auto", "same")
+
+
+def _parse_type(tok: str) -> tuple[str, tuple[DataType, ...]]:
+    tok = tok.strip().lower()
+    if tok in _FAMILIES:
+        return tok, _FAMILIES[tok]
+    t = DataType.from_sql(tok) if tok not in ("auto", "same", "boolean") else None
+    if tok == "boolean":
+        t = DataType.BOOLEAN
+    if t is None:
+        raise ValueError(f"unknown type {tok!r}")
+    return tok, (t,)
+
+
+_NUMERIC_ORDER = [
+    DataType.INT16,
+    DataType.INT32,
+    DataType.INT64,
+    DataType.SERIAL,
+    DataType.DECIMAL,
+    DataType.FLOAT32,
+    DataType.FLOAT64,
+]
+
+
+def promote_numeric(types: Sequence[DataType]) -> DataType:
+    """SQL-ish numeric promotion: widest wins; decimal beats ints,
+    floats beat decimal (matching the reference's cast lattice)."""
+    best = -1
+    for t in types:
+        if t not in _NUMERIC_ORDER:
+            return types[0]
+        best = max(best, _NUMERIC_ORDER.index(t))
+    return _NUMERIC_ORDER[best]
+
+
+@dataclass(frozen=True)
+class FuncSig:
+    name: str
+    arg_matchers: tuple[tuple[str, tuple[DataType, ...]], ...]
+    ret: str  # sql type name or "auto"/"same"/"same_branch"
+    impl: Callable
+    #: impl declares a trailing ``fields`` kwarg for logical-type context
+    takes_fields: bool = False
+
+    def call(self, cols: Sequence, arg_fields: Sequence[Field]):
+        if self.takes_fields:
+            return self.impl(*cols, fields=list(arg_fields))
+        return self.impl(*cols)
+
+    def matches(self, arg_fields: Sequence[Field]) -> int:
+        """Score the match: -1 no match; higher = more specific."""
+        if len(arg_fields) != len(self.arg_matchers):
+            return -1
+        score = 0
+        for f, (tok, accepted) in zip(arg_fields, self.arg_matchers):
+            if f.data_type not in accepted:
+                return -1
+            score += 2 if len(accepted) == 1 else (1 if tok != "any" else 0)
+        return score
+
+    def return_field(self, arg_fields: Sequence[Field]) -> Field:
+        if self.ret == "same":
+            return Field("?expr", arg_fields[0].data_type,
+                         str_width=arg_fields[0].str_width,
+                         decimal_scale=arg_fields[0].decimal_scale)
+        if self.ret == "same_branch":  # CASE: type of the THEN/ELSE branches
+            b = arg_fields[1:]
+            if all(f.data_type == b[0].data_type for f in b):
+                return Field("?expr", b[0].data_type,
+                             str_width=max(f.str_width for f in b),
+                             decimal_scale=b[0].decimal_scale)
+            return Field("?expr", promote_numeric([f.data_type for f in b]))
+        if self.ret == "auto":
+            return Field("?expr", promote_numeric([f.data_type for f in arg_fields]))
+        _, accepted = _parse_type(self.ret)
+        return Field("?expr", accepted[0])
+
+
+_SIG_RE = re.compile(r"^\s*(\w+)\s*\(([^)]*)\)\s*->\s*([\w ]+)\s*$")
+
+
+class _Registry:
+    def __init__(self):
+        self._by_name: dict[str, list[FuncSig]] = {}
+
+    def register(self, spec: str, impl: Callable) -> FuncSig:
+        m = _SIG_RE.match(spec)
+        if not m:
+            raise ValueError(f"bad signature {spec!r}")
+        name, args, ret = m.group(1), m.group(2), m.group(3)
+        matchers = tuple(
+            _parse_type(tok) for tok in args.split(",") if tok.strip()
+        )
+        takes_fields = "fields" in inspect.signature(impl).parameters
+        sig = FuncSig(name, matchers, ret.strip().lower(), impl, takes_fields)
+        self._by_name.setdefault(name, []).append(sig)
+        return sig
+
+    def resolve(self, name: str, arg_fields: Sequence[Field]) -> FuncSig:
+        cands = self._by_name.get(name)
+        if not cands:
+            raise KeyError(f"no function named {name!r}")
+        best: FuncSig | None = None
+        best_score = -1
+        for sig in cands:
+            s = sig.matches(arg_fields)
+            if s > best_score:
+                best, best_score = sig, s
+        if best is None or best_score < 0:
+            types = [f.data_type.name for f in arg_fields]
+            raise KeyError(f"no overload {name}({', '.join(types)})")
+        return best
+
+    def names(self) -> list[str]:
+        return sorted(self._by_name)
+
+    def __len__(self) -> int:
+        return sum(len(v) for v in self._by_name.values())
+
+
+FUNCTION_REGISTRY = _Registry()
+
+
+def function(spec: str):
+    """Decorator mirroring the reference's ``#[function(...)]`` macro."""
+
+    def deco(fn: Callable) -> Callable:
+        FUNCTION_REGISTRY.register(spec, fn)
+        return fn
+
+    return deco

@@ -1,0 +1,333 @@
+"""Scalar function implementations (vectorized, trace-friendly).
+
+Reference counterpart: ``src/expr/impl/src/scalar/`` (90 files of
+``#[function]`` impls).  Coverage here targets the benchmark SQL surface
+(Nexmark q0-q10, TPC-H arithmetic/predicates) and grows with the planner.
+
+All impls take and return whole device columns.  Mixed numeric arg types
+are promoted via implicit casts inserted at resolution time (the impls
+that need logical-type context declare a trailing ``fields`` kwarg).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from risingwave_tpu.common.chunk import StrCol
+from risingwave_tpu.common.types import (
+    DEFAULT_DECIMAL_SCALE,
+    DataType,
+    Field,
+)
+from risingwave_tpu.expr.registry import function, promote_numeric
+
+_SCALE = 10**DEFAULT_DECIMAL_SCALE
+
+# ---------------------------------------------------------------------------
+# casts / coercion
+
+
+def coerce(col, field: Field, target: DataType):
+    """Cast a device column from its logical type to ``target``."""
+    t = field.data_type
+    if t == target and not (
+        t == DataType.DECIMAL and field.decimal_scale != DEFAULT_DECIMAL_SCALE
+    ):
+        return col
+    if isinstance(col, StrCol):
+        raise TypeError(f"cannot cast string column to {target}")
+    if t == DataType.DECIMAL:
+        if target == DataType.DECIMAL:
+            # rescale a non-default-scale column to the engine scale so
+            # downstream arithmetic (which assumes _SCALE) is correct
+            diff = DEFAULT_DECIMAL_SCALE - field.decimal_scale
+            if diff > 0:
+                return col * (10**diff)
+            return col // (10 ** (-diff))
+        if target in (DataType.FLOAT32, DataType.FLOAT64):
+            return (col.astype(target.physical_dtype)) / np.float64(
+                10**field.decimal_scale
+            ).astype(target.physical_dtype)
+        if target.is_integral and target != DataType.DECIMAL:
+            return (col // (10**field.decimal_scale)).astype(target.physical_dtype)
+        raise TypeError(f"decimal -> {target}?")
+    if target == DataType.DECIMAL:
+        if t.is_integral:
+            return col.astype(jnp.int64) * _SCALE
+        # float -> decimal: round at the default scale
+        return jnp.round(col.astype(jnp.float64) * _SCALE).astype(jnp.int64)
+    if target == DataType.BOOLEAN:
+        return col != 0
+    return col.astype(target.physical_dtype)
+
+
+for _t in (
+    DataType.INT16,
+    DataType.INT32,
+    DataType.INT64,
+    DataType.FLOAT32,
+    DataType.FLOAT64,
+    DataType.DECIMAL,
+    DataType.BOOLEAN,
+    DataType.TIMESTAMP,
+    DataType.TIMESTAMPTZ,
+    DataType.DATE,
+):
+
+    def _mk_cast(target: DataType):
+        def _cast(a, fields: Sequence[Field]):
+            return coerce(a, fields[0], target)
+
+        return _cast
+
+    function(f"cast_{_t.name.lower()}(any) -> {_t.value}")(_mk_cast(_t))
+
+
+def _promote_args(cols, fields: Sequence[Field]) -> tuple[list, DataType]:
+    target = promote_numeric([f.data_type for f in fields])
+    return [coerce(c, f, target) for c, f in zip(cols, fields)], target
+
+
+# ---------------------------------------------------------------------------
+# arithmetic (decimal-aware)
+
+
+@function("add(numeric, numeric) -> auto")
+def _add(a, b, fields: Sequence[Field]):
+    (a, b), _ = _promote_args((a, b), fields)
+    return a + b
+
+
+@function("subtract(numeric, numeric) -> auto")
+def _sub(a, b, fields: Sequence[Field]):
+    (a, b), _ = _promote_args((a, b), fields)
+    return a - b
+
+
+@function("subtract(timelike, timelike) -> interval")
+def _sub_time(a, b):
+    return (a - b).astype(jnp.int64)
+
+
+@function("add(timestamp, interval) -> timestamp")
+@function("add(timestamptz, interval) -> timestamptz")
+def _add_ts_iv(a, b):
+    return a + b
+
+
+@function("subtract(timestamp, interval) -> timestamp")
+@function("subtract(timestamptz, interval) -> timestamptz")
+def _sub_ts_iv(a, b):
+    return a - b
+
+
+@function("multiply(numeric, numeric) -> auto")
+def _mul(a, b, fields: Sequence[Field]):
+    (a, b), t = _promote_args((a, b), fields)
+    if t == DataType.DECIMAL:
+        # via float64: raw int64 products overflow for realistic
+        # magnitudes (scaled 10^6 operands); float64 keeps ~15-16
+        # significant digits, which covers the SQL numeric surface here
+        prod = a.astype(jnp.float64) * b.astype(jnp.float64) / _SCALE
+        return jnp.round(prod).astype(jnp.int64)
+    return a * b
+
+
+@function("divide(numeric, numeric) -> auto")
+def _div(a, b, fields: Sequence[Field]):
+    (a, b), t = _promote_args((a, b), fields)
+    if t == DataType.DECIMAL:
+        q = a.astype(jnp.float64) / jnp.where(b == 0, 1, b).astype(jnp.float64)
+        return jnp.where(
+            b != 0, jnp.round(q * _SCALE).astype(jnp.int64), 0
+        )
+    if jnp.issubdtype(a.dtype, jnp.integer):
+        return jnp.where(b != 0, a // jnp.where(b == 0, 1, b), 0)
+    return a / b
+
+
+@function("modulus(numeric, numeric) -> auto")
+def _mod(a, b, fields: Sequence[Field]):
+    (a, b), _ = _promote_args((a, b), fields)
+    return jnp.where(b != 0, a % jnp.where(b == 0, 1, b), 0)
+
+
+@function("neg(numeric) -> same")
+def _neg(a):
+    return -a
+
+
+@function("abs(numeric) -> same")
+def _abs(a):
+    return jnp.abs(a)
+
+
+@function("round(floatlike) -> same")
+def _round(a):
+    return jnp.round(a)
+
+
+@function("round(numeric) -> same")
+def _round_dec(a, fields: Sequence[Field]):
+    if fields[0].data_type == DataType.DECIMAL:
+        s = 10**fields[0].decimal_scale
+        return (a + s // 2) // s * s
+    return jnp.round(a)
+
+
+# ---------------------------------------------------------------------------
+# comparison
+
+
+def _cmp_strs(a: StrCol, b: StrCol):
+    """Return (first-diff a byte, first-diff b byte) as int16 with -1 EOS."""
+    wa, wb = a.data.shape[1], b.data.shape[1]
+    w = max(wa, wb)
+    ad = jnp.pad(a.data, ((0, 0), (0, w - wa))).astype(jnp.int16)
+    bd = jnp.pad(b.data, ((0, 0), (0, w - wb))).astype(jnp.int16)
+    idx = jnp.arange(w, dtype=jnp.int32)[None, :]
+    av = jnp.where(idx < a.lens[:, None], ad, jnp.int16(-1))
+    bv = jnp.where(idx < b.lens[:, None], bd, jnp.int16(-1))
+    return av, bv
+
+
+def _make_cmp(name: str, op, str_op):
+    @function(f"{name}(numeric, numeric) -> boolean")
+    def _cmp(a, b, fields: Sequence[Field]):
+        (a, b), _ = _promote_args((a, b), fields)
+        return op(a, b)
+
+    @function(f"{name}(timelike, timelike) -> boolean")
+    @function(f"{name}(boolean, boolean) -> boolean")
+    def _cmp_t(a, b):
+        return op(a, b)
+
+    @function(f"{name}(stringlike, stringlike) -> boolean")
+    def _cmp_s(a: StrCol, b: StrCol):
+        av, bv = _cmp_strs(a, b)
+        if str_op == "eq":
+            return jnp.all(av == bv, axis=1)
+        if str_op == "ne":
+            return jnp.any(av != bv, axis=1)
+        neq = av != bv
+        any_neq = jnp.any(neq, axis=1)
+        first = jnp.argmax(neq, axis=1)
+        fa = jnp.take_along_axis(av, first[:, None], axis=1)[:, 0]
+        fb = jnp.take_along_axis(bv, first[:, None], axis=1)[:, 0]
+        lt = fa < fb
+        if str_op == "lt":
+            return any_neq & lt
+        if str_op == "le":
+            return ~any_neq | lt
+        if str_op == "gt":
+            return any_neq & ~lt
+        return ~any_neq | ~lt  # ge
+
+    return _cmp
+
+
+_make_cmp("equal", lambda a, b: a == b, "eq")
+_make_cmp("not_equal", lambda a, b: a != b, "ne")
+_make_cmp("less_than", lambda a, b: a < b, "lt")
+_make_cmp("less_than_or_equal", lambda a, b: a <= b, "le")
+_make_cmp("greater_than", lambda a, b: a > b, "gt")
+_make_cmp("greater_than_or_equal", lambda a, b: a >= b, "ge")
+
+
+# ---------------------------------------------------------------------------
+# logical
+
+
+@function("and(boolean, boolean) -> boolean")
+def _and(a, b):
+    return a & b
+
+
+@function("or(boolean, boolean) -> boolean")
+def _or(a, b):
+    return a | b
+
+
+@function("not(boolean) -> boolean")
+def _not(a):
+    return ~a
+
+
+@function("case(boolean, any, any) -> same_branch")  # CASE WHEN c THEN t ELSE e
+def _case(c, t, e, fields: Sequence[Field]):
+    if isinstance(t, StrCol):
+        w = max(t.data.shape[1], e.data.shape[1])
+        td = jnp.pad(t.data, ((0, 0), (0, w - t.data.shape[1])))
+        ed = jnp.pad(e.data, ((0, 0), (0, w - e.data.shape[1])))
+        return StrCol(
+            jnp.where(c[:, None], td, ed), jnp.where(c, t.lens, e.lens)
+        )
+    if fields[1].data_type != fields[2].data_type:
+        target = promote_numeric([fields[1].data_type, fields[2].data_type])
+        t = coerce(t, fields[1], target)
+        e = coerce(e, fields[2], target)
+    return jnp.where(c, t, e)
+
+
+# ---------------------------------------------------------------------------
+# temporal
+
+_US = {"second": 1_000_000, "minute": 60_000_000, "hour": 3_600_000_000,
+       "day": 86_400_000_000}
+
+
+# microsecond-based temporal fns: registered for the microsecond-backed
+# types only (DATE is i32 days and must not match these overloads)
+@function("extract_epoch(timestamp) -> bigint")
+@function("extract_epoch(timestamptz) -> bigint")
+def _extract_epoch(a):
+    return a // 1_000_000
+
+
+@function("extract_epoch(date) -> bigint")
+def _extract_epoch_date(a):
+    return a.astype(jnp.int64) * 86_400
+
+
+def _us_trunc(unit: str):
+    def impl(a):
+        return a - a % _US[unit]
+
+    return impl
+
+
+for _unit in ("second", "minute", "hour", "day"):
+    _impl = _us_trunc(_unit)
+    function(f"date_trunc_{_unit}(timestamp) -> same")(_impl)
+    function(f"date_trunc_{_unit}(timestamptz) -> same")(_impl)
+
+
+@function("tumble_start(timestamp, interval) -> same")
+@function("tumble_start(timestamptz, interval) -> same")
+def _tumble_start(ts, size):
+    return ts - ts % size
+
+
+# ---------------------------------------------------------------------------
+# string
+
+
+@function("char_length(stringlike) -> int")
+def _char_length(a: StrCol):
+    # note: byte length; full utf-8 codepoint counting is a host fallback
+    return a.lens
+
+
+@function("lower(stringlike) -> same")
+def _lower(a: StrCol):
+    up = (a.data >= ord("A")) & (a.data <= ord("Z"))
+    return StrCol(jnp.where(up, a.data + 32, a.data), a.lens)
+
+
+@function("upper(stringlike) -> same")
+def _upper(a: StrCol):
+    lo = (a.data >= ord("a")) & (a.data <= ord("z"))
+    return StrCol(jnp.where(lo, a.data - 32, a.data), a.lens)

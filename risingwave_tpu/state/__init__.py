@@ -1,0 +1,12 @@
+"""Device-resident state: hash tables and epoch-checkpointed stores.
+
+Reference counterpart: ``StateTable`` (src/stream/src/common/table/
+state_table.rs:187) over ``LocalStateStore`` (src/storage).  The TPU
+restructuring keeps hot state as preallocated dense arrays in HBM
+(open-addressing hash tables), snapshotted host-side at checkpoint
+barriers (SURVEY.md §7.1 "State = device-resident preallocated tables").
+"""
+
+from risingwave_tpu.state.hash_table import HashTable
+
+__all__ = ["HashTable"]

@@ -1,0 +1,280 @@
+"""Open-addressing device hash table, fully vectorized.
+
+Reference counterpart: the per-key state maps inside stateful executors —
+``AggGroup`` cache (src/stream/src/executor/aggregate/hash_agg.rs:64) and
+``JoinHashMap`` (src/stream/src/executor/join/hash_join.rs:169) — which
+on CPU are per-row HashMap probes behind an LRU.
+
+TPU-first design
+----------------
+State is a *dense, preallocated* table in HBM:
+
+- ``key_cols``: one ``[size]`` array per key column (``StrCol`` for
+  strings) — the slot's group key;
+- ``occupied``: ``bool [size]``.
+
+``lookup_or_insert`` resolves a whole chunk of keys in one pass of a
+``lax.while_loop``: every pending row probes its candidate slot
+simultaneously; rows hitting an empty slot *claim* it with a
+scatter-min of their row index (first-writer-wins, deterministic), and
+losers simply re-check the slot on the next iteration (where they will
+either match the winner's key or move on with linear probing).  The loop
+runs until all rows resolve — worst case bounded, typical case 2-4
+iterations — and every iteration is a handful of gathers/scatters over
+the chunk, so a 4k-row chunk against a 256k-slot table is a few fused
+XLA kernels rather than 4k pointer chases.
+
+Deletion uses tombstones: a cleared slot stops matching but keeps the
+probe chain intact (``~occupied & tombstone`` ⇒ keep probing, never
+claim).  Bulk eviction is a vectorized mask sweep (``clear_where``) —
+this is how watermark state-cleaning works (the reference cleans per-key
+on commit, state_table.rs:223) — and ``needs_rehash``/``rehashed``
+rebuild the table once tombstones accumulate.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from risingwave_tpu.common.chunk import StrCol
+from risingwave_tpu.common.hash import hash64_columns
+
+
+def _gather_key(col, idx):
+    if isinstance(col, StrCol):
+        return StrCol(col.data[idx], col.lens[idx])
+    return col[idx]
+
+
+def _scatter_key(col, pos, values, size):
+    """Write values at pos (mode=drop for sentinel positions)."""
+    if isinstance(col, StrCol):
+        return StrCol(
+            col.data.at[pos].set(values.data, mode="drop"),
+            col.lens.at[pos].set(values.lens, mode="drop"),
+        )
+    return col.at[pos].set(values, mode="drop")
+
+
+def _keys_equal(a, b) -> jnp.ndarray:
+    """Rowwise equality of two same-width key column values."""
+    if isinstance(a, StrCol):
+        return jnp.all(a.data == b.data, axis=-1) & (a.lens == b.lens)
+    return a == b
+
+
+def permute_dense(arr, moved: jnp.ndarray, init=None):
+    """Move dense per-slot values ``arr[[old]] -> out[[moved[old]]]``.
+
+    ``moved`` comes from ``HashTable.rehashed``; dead slots carry the
+    drop sentinel.  ``init`` fills untouched slots (monoid identity for
+    min/max states; zero otherwise).
+    """
+    if isinstance(arr, StrCol):
+        return StrCol(
+            permute_dense(arr.data, moved), permute_dense(arr.lens, moved)
+        )
+    if init is None:
+        out = jnp.zeros_like(arr)
+    else:
+        out = jnp.full_like(arr, init)
+    return out.at[moved].set(arr, mode="drop")
+
+
+def _empty_key_col(col_proto, size: int):
+    if isinstance(col_proto, StrCol):
+        return StrCol(
+            jnp.zeros((size, col_proto.data.shape[1]), jnp.uint8),
+            jnp.zeros((size,), jnp.int32),
+        )
+    return jnp.zeros((size,), col_proto.dtype)
+
+
+@jax.tree_util.register_pytree_node_class
+class HashTable:
+    """Keys + occupancy; value arrays live beside it in the executor state."""
+
+    __slots__ = ("key_cols", "occupied", "tombstone", "size")
+
+    def __init__(
+        self,
+        key_cols: tuple,
+        occupied: jnp.ndarray,
+        tombstone: jnp.ndarray,
+        size: int,
+    ):
+        self.key_cols = tuple(key_cols)
+        self.occupied = occupied
+        self.tombstone = tombstone
+        self.size = size
+
+    def tree_flatten(self):
+        return (self.key_cols, self.occupied, self.tombstone), self.size
+
+    @classmethod
+    def tree_unflatten(cls, size, children):
+        key_cols, occupied, tombstone = children
+        return cls(key_cols, occupied, tombstone, size)
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def create(key_protos: Sequence, size: int) -> "HashTable":
+        """Empty table; ``key_protos`` supply per-column dtype/width."""
+        if size & (size - 1):
+            raise ValueError(f"size {size} must be a power of two")
+        cols = tuple(_empty_key_col(p, size) for p in key_protos)
+        return HashTable(
+            cols,
+            jnp.zeros((size,), jnp.bool_),
+            jnp.zeros((size,), jnp.bool_),
+            size,
+        )
+
+    def count(self) -> jnp.ndarray:
+        return jnp.sum(self.occupied.astype(jnp.int32))
+
+    # ------------------------------------------------------------------
+    def lookup(self, key_cols: Sequence, valid: jnp.ndarray):
+        """Find slots without inserting.
+
+        Returns ``(slots int32 [cap], found bool [cap])``; unfound/invalid
+        rows get slot == size (a drop sentinel for downstream gathers).
+        """
+        table, slots, found, _ = self._probe(key_cols, valid, insert=False)
+        return slots, found
+
+    def lookup_or_insert(self, key_cols: Sequence, valid: jnp.ndarray):
+        """Find-or-claim slots for a chunk of keys.
+
+        Returns ``(table', slots, inserted, overflow)``:
+        - ``slots int32 [cap]`` — resolved slot per row (size if overflow
+          or invalid);
+        - ``inserted bool [cap]`` — row claimed a fresh slot;
+        - ``overflow bool [cap]`` — table was full for this row.
+        """
+        return self._probe(key_cols, valid, insert=True)
+
+    # ------------------------------------------------------------------
+    def _probe(self, key_cols: Sequence, valid: jnp.ndarray, insert: bool):
+        size = self.size
+        cap = valid.shape[0]
+        h = (hash64_columns(key_cols) % np.uint64(size)).astype(jnp.int32)
+        row_idx = jnp.arange(cap, dtype=jnp.int32)
+        sentinel = jnp.int32(size)
+
+        def cond(carry):
+            _, _, _, done, _, _, iters = carry
+            return jnp.any(~done) & (iters < size + 2)
+
+        def body(carry):
+            occupied, key_store, slots, done, inserted, off, iters = carry
+            cand = (h + off) % size
+            occ = occupied[cand]
+            tomb = self.tombstone[cand] & ~occ
+            stored = tuple(_gather_key(c, cand) for c in key_store)
+            match = occ
+            for s, k in zip(stored, key_cols):
+                match = match & _keys_equal(s, k)
+            hit = ~done & match
+            slots = jnp.where(hit, cand, slots)
+            done = done | hit
+            if insert:
+                # only a *true-empty* slot (no tombstone) is claimable:
+                # claiming a tombstone could shadow the same key further
+                # along a probe chain
+                want = ~done & ~occ & ~tomb
+                claim = jnp.full((size,), cap, jnp.int32).at[
+                    jnp.where(want, cand, sentinel)
+                ].min(jnp.where(want, row_idx, cap), mode="drop")
+                won = want & (claim[cand] == row_idx)
+                pos = jnp.where(won, cand, sentinel)
+                occupied = occupied.at[pos].set(True, mode="drop")
+                key_store = tuple(
+                    _scatter_key(c, pos, k, size)
+                    for c, k in zip(key_store, key_cols)
+                )
+                slots = jnp.where(won, cand, slots)
+                inserted = inserted | won
+                done = done | won
+                # losers of the claim re-check cand next iteration (it is
+                # now occupied — match if same key, else advance);
+                # tombstones are skipped, keeping probe chains intact
+                advance = (~done & occ & ~match) | (~done & tomb)
+            else:
+                # probe-only: true-empty slot ⇒ key absent ⇒ miss
+                miss = ~done & ~occ & ~tomb
+                done = done | miss
+                advance = (~done & occ & ~match) | (~done & tomb)
+            off = jnp.where(advance, off + 1, off)
+            return occupied, key_store, slots, done, inserted, off, iters + 1
+
+        init = (
+            self.occupied,
+            self.key_cols,
+            jnp.full((cap,), sentinel, jnp.int32),
+            ~valid,
+            jnp.zeros((cap,), jnp.bool_),
+            jnp.zeros((cap,), jnp.int32),
+            jnp.int32(0),
+        )
+        occupied, key_store, slots, done, inserted, _, _ = jax.lax.while_loop(
+            cond, body, init
+        )
+        overflow = ~done
+        found = valid & done & ~inserted & (slots < size)
+        if insert:
+            table = HashTable(key_store, occupied, self.tombstone, size)
+            return table, slots, inserted, overflow
+        return self, slots, found, overflow
+
+    # ------------------------------------------------------------------
+    def clear_where(self, pred: jnp.ndarray) -> "HashTable":
+        """Bulk-evict slots where ``pred [size]`` is True (state cleaning).
+
+        Cleared slots become tombstones so probe chains stay intact;
+        call ``rehashed()`` periodically to reclaim them.
+        """
+        dead = pred & self.occupied
+        return HashTable(
+            self.key_cols,
+            self.occupied & ~dead,
+            self.tombstone | dead,
+            self.size,
+        )
+
+    def clear_slots(self, slots: jnp.ndarray, mask: jnp.ndarray) -> "HashTable":
+        """Tombstone specific slots (per-row deletes, e.g. MV conflict ops)."""
+        pos = jnp.where(mask, slots, jnp.int32(self.size))
+        return HashTable(
+            self.key_cols,
+            self.occupied.at[pos].set(False, mode="drop"),
+            self.tombstone.at[pos].set(True, mode="drop"),
+            self.size,
+        )
+
+    def tombstone_count(self) -> jnp.ndarray:
+        return jnp.sum((self.tombstone & ~self.occupied).astype(jnp.int32))
+
+    def rehashed(self) -> tuple["HashTable", jnp.ndarray]:
+        """Rebuild without tombstones.
+
+        Returns ``(fresh_table, moved)`` where ``moved int32 [size]`` maps
+        old slot -> new slot (size for dead slots), so callers can
+        permute their value arrays alongside.
+        """
+        fresh = HashTable.create(
+            tuple(_gather_key(c, jnp.arange(1)) for c in self.key_cols),
+            self.size,
+        )
+        live = self.occupied
+        fresh, new_slots, _, _ = fresh.lookup_or_insert(self.key_cols, live)
+        return fresh, new_slots
+
+    def gather_keys(self, slots: jnp.ndarray) -> tuple:
+        """Key column values at ``slots`` (drop-sentinel aware gathers)."""
+        return tuple(_gather_key(c, jnp.minimum(slots, self.size - 1))
+                     for c in self.key_cols)

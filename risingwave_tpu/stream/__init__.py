@@ -1,0 +1,23 @@
+"""Streaming runtime: executors, fragments, barriers.
+
+Reference counterpart: ``src/stream`` (SURVEY.md §2.3). The TPU
+restructuring collapses "one actor = one tokio task" into "one fragment =
+one jitted SPMD step function"; barriers are host-side control flow
+between steps (SURVEY.md §7.1).
+"""
+
+from risingwave_tpu.stream.message import (
+    Barrier,
+    BarrierKind,
+    Watermark,
+)
+from risingwave_tpu.stream.executor import Executor
+from risingwave_tpu.stream.fragment import Fragment
+
+__all__ = [
+    "Barrier",
+    "BarrierKind",
+    "Watermark",
+    "Executor",
+    "Fragment",
+]

@@ -1,0 +1,129 @@
+"""Executor protocol + stateless executors.
+
+Reference counterpart: the ``Execute`` trait (src/stream/src/executor/
+mod.rs:243) and the stateless row operators (project/, filter.rs, …).
+
+TPU-first design
+----------------
+The reference models an executor as an async stream of messages.  Here an
+executor is a pair of *pure, traceable* transition functions so that an
+entire executor chain (a fragment) collapses into ONE jitted XLA program
+per chunk (SURVEY.md §7.1 "Fragment = jitted SPMD step function"):
+
+- ``init_state() -> pytree``                         device-resident state
+- ``apply(state, chunk) -> (state, chunk | None)``   per-chunk transform
+- ``flush(state, epoch) -> (state, chunk | None)``   barrier-time emission
+
+``apply``/``flush`` must make a *static* choice of whether they return a
+chunk (so the jitted step has a fixed pytree structure).  Stateless
+operators return the transformed chunk from ``apply`` and nothing from
+``flush``; aggregations buffer in ``apply`` and emit from ``flush``
+(emit-on-barrier, ref hash_agg.rs flush_data).
+
+Filtering never compacts: it narrows the validity mask (the reference's
+visibility ``Bitmap``), keeping every kernel shape-static.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+
+from risingwave_tpu.common.chunk import (
+    Chunk,
+    OP_DELETE,
+    OP_INSERT,
+    OP_UPDATE_DELETE,
+    OP_UPDATE_INSERT,
+)
+from risingwave_tpu.common.types import Field, Schema
+from risingwave_tpu.expr.node import Expr
+
+
+class Executor:
+    """Base executor. Subclasses override the three transition fns."""
+
+    #: static: does apply() return a chunk?
+    emits_on_apply: bool = True
+    #: static: does flush() return a chunk?
+    emits_on_flush: bool = False
+
+    def __init__(self, in_schema: Schema):
+        self.in_schema = in_schema
+
+    @property
+    def out_schema(self) -> Schema:
+        return self.in_schema
+
+    # -- pure/traceable ------------------------------------------------
+    def init_state(self) -> Any:
+        return ()
+
+    def apply(self, state, chunk: Chunk):
+        raise NotImplementedError
+
+    def flush(self, state, epoch):
+        """Barrier-time emission; epoch is a traced int64 scalar."""
+        return state, None
+
+    # -- host-side hooks ----------------------------------------------
+    def on_watermark(self, state, watermark):
+        """Host hook for watermark-driven state cleaning; default no-op."""
+        return state
+
+    def __repr__(self) -> str:
+        return type(self).__name__
+
+
+class ProjectExecutor(Executor):
+    """Evaluate expressions into a new chunk (ref executor/project/)."""
+
+    def __init__(self, in_schema: Schema, exprs: Sequence[tuple[str, Expr]]):
+        super().__init__(in_schema)
+        self.exprs = tuple(exprs)
+        self._out_schema = Schema(
+            tuple(
+                Field(
+                    name,
+                    e.return_field(in_schema).data_type,
+                    str_width=e.return_field(in_schema).str_width,
+                    decimal_scale=e.return_field(in_schema).decimal_scale,
+                )
+                for name, e in self.exprs
+            )
+        )
+
+    @property
+    def out_schema(self) -> Schema:
+        return self._out_schema
+
+    def apply(self, state, chunk: Chunk):
+        cols = [e.eval(chunk) for _, e in self.exprs]
+        return state, chunk.with_columns(cols, self._out_schema)
+
+
+class FilterExecutor(Executor):
+    """Narrow visibility by a predicate (ref executor/filter.rs).
+
+    Op rewriting mirrors the reference (filter.rs): an Update pair whose
+    sides land on different sides of the predicate degrades to a plain
+    Insert/Delete of the surviving side.
+    """
+
+    def __init__(self, in_schema: Schema, predicate: Expr):
+        super().__init__(in_schema)
+        self.predicate = predicate
+
+    def apply(self, state, chunk: Chunk):
+        keep = self.predicate.eval(chunk)
+        keep = keep & chunk.valid
+        # Update-pair degradation: U- at i pairs with U+ at i+1.
+        is_ud = chunk.ops == OP_UPDATE_DELETE
+        is_ui = chunk.ops == OP_UPDATE_INSERT
+        partner_keep_for_ud = jnp.roll(keep, -1)  # the U+ after a U-
+        partner_keep_for_ui = jnp.roll(keep, 1)   # the U- before a U+
+        ops = chunk.ops
+        ops = jnp.where(is_ud & keep & ~partner_keep_for_ud, OP_DELETE, ops)
+        ops = jnp.where(is_ui & keep & ~partner_keep_for_ui, OP_INSERT, ops)
+        return state, Chunk(chunk.columns, ops, keep, chunk.schema)

@@ -1,0 +1,92 @@
+"""Fragment: a chain of executors compiled to one jitted step.
+
+Reference counterpart: a plan *fragment* (cut at exchange boundaries,
+src/frontend/src/stream_fragmenter/mod.rs:388) whose actors each run an
+executor chain.  Here the chain is composed into a single pure function
+``step(states, chunk) -> (states, out_chunk)`` and jitted once — XLA
+fuses the per-executor kernels (SURVEY.md §7.1).
+
+Barrier-time flushing (``flush``) is a second jitted function: executors
+that emit on barrier (aggs) produce their changelog, and that changelog
+flows through the *remaining* executors in the chain.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+
+from risingwave_tpu.common.chunk import Chunk
+from risingwave_tpu.common.types import Schema
+from risingwave_tpu.stream.executor import Executor
+
+
+class Fragment:
+    """An executor chain with jit-compiled chunk/barrier paths."""
+
+    def __init__(self, executors: Sequence[Executor], name: str = "fragment"):
+        if not executors:
+            raise ValueError("fragment needs at least one executor")
+        self.executors = list(executors)
+        self.name = name
+        self._step = jax.jit(self._step_impl)
+        # epoch is passed as a traced scalar so barriers never retrace
+        self._flush = jax.jit(self._flush_impl)
+
+    # ------------------------------------------------------------------
+    @property
+    def out_schema(self) -> Schema:
+        return self.executors[-1].out_schema
+
+    def init_states(self) -> tuple:
+        return tuple(e.init_state() for e in self.executors)
+
+    # -- chunk path -----------------------------------------------------
+    def _step_impl(self, states: tuple, chunk: Chunk):
+        new_states = list(states)
+        cur = chunk
+        for i, ex in enumerate(self.executors):
+            if cur is None:
+                break
+            new_states[i], cur = ex.apply(states[i], cur)
+        return tuple(new_states), cur
+
+    def step(self, states: tuple, chunk: Chunk):
+        """Process one chunk; returns (states, out_chunk_or_None)."""
+        return self._step(states, chunk)
+
+    # -- barrier path ---------------------------------------------------
+    def _flush_impl(self, states: tuple, epoch):
+        new_states = list(states)
+        outs: list[Chunk] = []
+        for i, ex in enumerate(self.executors):
+            if not ex.emits_on_flush:
+                new_states[i], _ = ex.flush(new_states[i], epoch)
+                continue
+            new_states[i], emitted = ex.flush(new_states[i], epoch)
+            if emitted is None:
+                continue
+            # emitted changelog flows through the rest of the chain
+            cur = emitted
+            for j in range(i + 1, len(self.executors)):
+                if cur is None:
+                    break
+                new_states[j], cur = self.executors[j].apply(new_states[j], cur)
+            if cur is not None:
+                outs.append(cur)
+        return tuple(new_states), outs
+
+    def flush(self, states: tuple, epoch: int):
+        """Barrier crossing: flush executors; returns (states, [chunks])."""
+        return self._flush(states, epoch)
+
+    def on_watermark(self, states: tuple, watermark):
+        new_states = list(states)
+        for i, ex in enumerate(self.executors):
+            new_states[i] = ex.on_watermark(states[i], watermark)
+        return tuple(new_states)
+
+    def __repr__(self) -> str:
+        chain = " -> ".join(map(repr, self.executors))
+        return f"Fragment({self.name}: {chain})"

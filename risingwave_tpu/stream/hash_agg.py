@@ -1,0 +1,335 @@
+"""Hash aggregation executor (device-resident groups, emit-on-barrier).
+
+Reference counterpart: ``HashAggExecutor`` (src/stream/src/executor/
+aggregate/hash_agg.rs:64) — LRU AggGroup cache keyed by HashKey, dirty
+set, ``apply_chunk`` at :332, flush at :412.
+
+TPU-first design
+----------------
+Groups live in a dense ``HashTable`` + per-aggregate state arrays in
+HBM.  A chunk's worth of updates for thousands of groups lands as ONE
+vectorized lookup_or_insert + one scatter per primitive state (vs the
+reference's per-group HashMap walk):
+
+    slots = table.lookup_or_insert(keys)
+    state = state.at[slots].add(signs * value)     # retractable adds
+    state = state.at[slots].min/max(value)         # append-only monoids
+
+Changelog emission happens at barrier flush, exactly like the
+reference's emit-on-barrier: dirty slots are compacted with a
+fixed-size ``nonzero`` and emitted as an interleaved U-/U+ chunk, with
+previous outputs reconstructed from a `prev` copy of the state arrays.
+Retraction semantics (Insert if group appears, Update pair if it
+changes, Delete if its row count reaches zero) mirror
+``AggGroup::build_change``.
+
+min/max here are monotone monoids (exact for append-only inputs — the
+windowed Nexmark aggregations); retractable min/max needs the
+materialized-input state (ref minput.rs), queued for a later round.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from risingwave_tpu.common.chunk import (
+    Chunk,
+    OP_DELETE,
+    OP_INSERT,
+    OP_UPDATE_DELETE,
+    OP_UPDATE_INSERT,
+    StrCol,
+)
+from risingwave_tpu.common.types import Field, Schema
+from risingwave_tpu.expr.node import Expr
+from risingwave_tpu.expr.agg import AggCall
+from risingwave_tpu.state.hash_table import HashTable
+from risingwave_tpu.stream.executor import Executor
+
+
+class AggState(NamedTuple):
+    table: HashTable
+    #: flattened per-primitive state arrays, each [size]
+    prims: tuple
+    row_count: jnp.ndarray      # int64 [size]
+    dirty: jnp.ndarray          # bool [size]
+    prev_prims: tuple           # snapshot at last flush
+    prev_row_count: jnp.ndarray
+    emitted: jnp.ndarray        # bool [size] — group present downstream
+    overflow: jnp.ndarray       # int64 scalar — rows lost to full table
+    #: deletes that hit a non-retractable (min/max) state — the
+    #: consistency_error! analog (ref src/stream/src/lib.rs:93); the
+    #: runtime surfaces this at barrier time
+    inconsistency: jnp.ndarray  # int64 scalar
+
+
+def _interleave(old, new):
+    """[n] + [n] -> [2n] with old at even, new at odd positions."""
+    if isinstance(old, StrCol):
+        return StrCol(
+            _interleave(old.data, new.data), _interleave(old.lens, new.lens)
+        )
+    return jnp.stack([old, new], axis=1).reshape(
+        (old.shape[0] * 2,) + old.shape[2:]
+    )
+
+
+class HashAggExecutor(Executor):
+    """GROUP BY aggregation over a device hash table."""
+
+    emits_on_apply = False
+    emits_on_flush = True
+
+    def __init__(
+        self,
+        in_schema: Schema,
+        group_by: Sequence[tuple[str, Expr]],
+        aggs: Sequence[AggCall],
+        table_size: int = 1 << 16,
+        emit_capacity: int = 4096,
+    ):
+        super().__init__(in_schema)
+        self.group_by = tuple(group_by)
+        self.aggs = tuple(aggs)
+        self.table_size = table_size
+        self.emit_capacity = emit_capacity
+        key_fields = tuple(
+            Field(name, e.return_field(in_schema).data_type,
+                  str_width=e.return_field(in_schema).str_width,
+                  decimal_scale=e.return_field(in_schema).decimal_scale)
+            for name, e in self.group_by
+        )
+        agg_fields = tuple(a.out_field(in_schema) for a in self.aggs)
+        self._out_schema = Schema(key_fields + agg_fields)
+        # primitive-state layout: per agg, its PrimStates flattened
+        self._prim_specs = []  # (agg_idx, PrimState)
+        for ai, a in enumerate(self.aggs):
+            for ps in a.spec().states:
+                self._prim_specs.append((ai, ps))
+
+    @property
+    def out_schema(self) -> Schema:
+        return self._out_schema
+
+    # ------------------------------------------------------------------
+    def _key_protos(self):
+        """Zero-row prototypes of the key columns for table creation."""
+        protos = []
+        for _, e in self.group_by:
+            f = e.return_field(self.in_schema)
+            if f.data_type.is_string:
+                protos.append(StrCol(
+                    jnp.zeros((1, f.str_width), jnp.uint8),
+                    jnp.zeros((1,), jnp.int32),
+                ))
+            else:
+                protos.append(jnp.zeros((1,), f.data_type.physical_dtype))
+        return protos
+
+    def _input_dtype(self, agg_idx: int):
+        a = self.aggs[agg_idx]
+        if a.arg is None:
+            return jnp.int64
+        return a.arg.return_field(self.in_schema).data_type.physical_dtype
+
+    def init_state(self) -> AggState:
+        size = self.table_size
+        table = HashTable.create(self._key_protos(), size)
+        prims = []
+        for agg_idx, ps in self._prim_specs:
+            in_dt = self._input_dtype(agg_idx)
+            st_dt = ps.dtype(in_dt)
+            prims.append(jnp.full((size,), ps.init(st_dt), st_dt))
+        return AggState(
+            table=table,
+            prims=tuple(prims),
+            row_count=jnp.zeros((size,), jnp.int64),
+            dirty=jnp.zeros((size,), jnp.bool_),
+            prev_prims=tuple(prims),
+            prev_row_count=jnp.zeros((size,), jnp.int64),
+            emitted=jnp.zeros((size,), jnp.bool_),
+            overflow=jnp.zeros((), jnp.int64),
+            inconsistency=jnp.zeros((), jnp.int64),
+        )
+
+    # ------------------------------------------------------------------
+    def apply(self, state: AggState, chunk: Chunk):
+        key_cols = [e.eval(chunk) for _, e in self.group_by]
+        signs = chunk.signs()
+        valid = chunk.valid
+        table, slots, inserted, overflow = state.table.lookup_or_insert(
+            key_cols, valid
+        )
+        # overflowed rows are dropped from slots (sentinel) — count them
+        n_over = jnp.sum((overflow & valid).astype(jnp.int64))
+        # freshly claimed slots may be reclaimed after state cleaning —
+        # reset their (stale) primitive state before applying updates
+        ins_pos = jnp.where(inserted, slots, jnp.int32(self.table_size))
+
+        prims = list(state.prims)
+        arg_cache: dict[int, jnp.ndarray] = {}
+        for pi, (agg_idx, ps) in enumerate(self._prim_specs):
+            a = self.aggs[agg_idx]
+            if a.arg is None:
+                col = jnp.ones_like(signs, jnp.int64)
+            else:
+                if agg_idx not in arg_cache:
+                    arg_cache[agg_idx] = a.arg.eval(chunk)
+                col = arg_cache[agg_idx]
+            st_dt = prims[pi].dtype
+            prims[pi] = prims[pi].at[ins_pos].set(
+                ps.init(st_dt), mode="drop"
+            )
+            contrib = ps.lift(col, signs)
+            if ps.mode == "add":
+                # invalid rows have sign 0 ⇒ contribute nothing
+                prims[pi] = prims[pi].at[slots].add(contrib, mode="drop")
+            elif ps.mode == "min":
+                prims[pi] = prims[pi].at[slots].min(contrib, mode="drop")
+            else:
+                prims[pi] = prims[pi].at[slots].max(contrib, mode="drop")
+        row_count = state.row_count.at[ins_pos].set(0, mode="drop")
+        row_count = row_count.at[slots].add(
+            signs.astype(jnp.int64), mode="drop"
+        )
+        dirty = state.dirty.at[slots].set(True, mode="drop")
+        n_bad = jnp.zeros((), jnp.int64)
+        if any(not a.spec().retractable for a in self.aggs):
+            n_bad = jnp.sum((valid & (signs < 0)).astype(jnp.int64))
+        return AggState(
+            table=table,
+            prims=tuple(prims),
+            row_count=row_count,
+            dirty=dirty,
+            prev_prims=state.prev_prims,
+            prev_row_count=state.prev_row_count,
+            emitted=state.emitted,
+            overflow=state.overflow + n_over,
+            inconsistency=state.inconsistency + n_bad,
+        ), None
+
+    # ------------------------------------------------------------------
+    def _outputs(self, prims: tuple, row_count, slots):
+        """Per-emitted-slot output columns from the state arrays."""
+        size = self.table_size
+        safe = jnp.minimum(slots, size - 1)
+        cols = []
+        pi = 0
+        for ai, a in enumerate(self.aggs):
+            spec = a.spec()
+            n = len(spec.states)
+            st = tuple(prims[pi + k][safe] for k in range(n))
+            pi += n
+            out_f = self._out_schema[len(self.group_by) + ai]
+            cols.append(spec.output(st, row_count[safe], out_f))
+        return cols
+
+    def flush(self, state: AggState, epoch):
+        cap = self.emit_capacity
+        size = self.table_size
+        (slots,) = jnp.nonzero(state.dirty, size=cap, fill_value=size)
+        slot_live = slots < size
+        safe = jnp.minimum(slots, size - 1)
+
+        old_nonempty = state.prev_row_count[safe] > 0
+        new_nonempty = state.row_count[safe] > 0
+        del_side = slot_live & state.emitted[safe] & old_nonempty
+        ins_side = slot_live & new_nonempty
+
+        key_vals = state.table.gather_keys(slots)
+        old_cols = self._outputs(state.prev_prims, state.prev_row_count, slots)
+        new_cols = self._outputs(state.prims, state.row_count, slots)
+
+        out_cols = []
+        for k in key_vals:
+            out_cols.append(_interleave(k, k))
+        for o, n in zip(old_cols, new_cols):
+            out_cols.append(_interleave(o, n))
+
+        both = del_side & ins_side
+        op_even = jnp.where(both, OP_UPDATE_DELETE, OP_DELETE).astype(jnp.int8)
+        op_odd = jnp.where(both, OP_UPDATE_INSERT, OP_INSERT).astype(jnp.int8)
+        ops = _interleave(op_even, op_odd)
+        valid = _interleave(del_side, ins_side)
+
+        out = Chunk(out_cols, ops, valid, self._out_schema)
+
+        # persist current as prev for emitted slots; clear their dirty bit.
+        # un-emitted dirty slots (overflow beyond emit_capacity) stay dirty
+        # and are drained by the runtime calling flush() again.
+        prev_prims = tuple(
+            p.at[slots].set(c[safe], mode="drop")
+            for p, c in zip(state.prev_prims, state.prims)
+        )
+        prev_row_count = state.prev_row_count.at[slots].set(
+            state.row_count[safe], mode="drop"
+        )
+        emitted = state.emitted.at[slots].set(new_nonempty, mode="drop")
+        dirty = state.dirty.at[slots].set(False, mode="drop")
+        return AggState(
+            table=state.table,
+            prims=state.prims,
+            row_count=state.row_count,
+            dirty=dirty,
+            prev_prims=prev_prims,
+            prev_row_count=prev_row_count,
+            emitted=emitted,
+            overflow=state.overflow,
+            inconsistency=state.inconsistency,
+        ), out
+
+    def pending_dirty(self, state: AggState) -> jnp.ndarray:
+        return jnp.sum(state.dirty.astype(jnp.int32))
+
+    def maybe_rehash(self, state: AggState) -> AggState:
+        """Rebuild the group table once tombstones dominate (called by
+        the runtime at checkpoint barriers after state cleaning)."""
+        if int(state.table.tombstone_count()) <= self.table_size // 4:
+            return state
+        from risingwave_tpu.state.hash_table import permute_dense
+
+        fresh, moved = state.table.rehashed()
+        prims = []
+        prev_prims = []
+        for pi, (agg_idx, ps) in enumerate(self._prim_specs):
+            st_dt = state.prims[pi].dtype
+            init = ps.init(st_dt)
+            prims.append(permute_dense(state.prims[pi], moved, init))
+            prev_prims.append(permute_dense(state.prev_prims[pi], moved, init))
+        return AggState(
+            table=fresh,
+            prims=tuple(prims),
+            row_count=permute_dense(state.row_count, moved),
+            dirty=permute_dense(state.dirty, moved),
+            prev_prims=tuple(prev_prims),
+            prev_row_count=permute_dense(state.prev_row_count, moved),
+            emitted=permute_dense(state.emitted, moved),
+            overflow=state.overflow,
+            inconsistency=state.inconsistency,
+        )
+
+    # ------------------------------------------------------------------
+    def clean_below(self, state: AggState, key_col_idx: int, threshold):
+        """Drop groups whose ``key_col_idx`` group-key < threshold.
+
+        Watermark-driven state cleaning (ref state_table.rs:223): used by
+        windowed aggregations once a window can no longer change.
+        """
+        key = state.table.key_cols[key_col_idx]
+        stale = state.table.occupied & (key < threshold)
+        table = state.table.clear_where(stale)
+        return AggState(
+            table=table,
+            prims=state.prims,
+            row_count=jnp.where(stale, 0, state.row_count),
+            dirty=state.dirty & ~stale,
+            prev_prims=state.prev_prims,
+            prev_row_count=jnp.where(stale, 0, state.prev_row_count),
+            emitted=state.emitted & ~stale,
+            overflow=state.overflow,
+            inconsistency=state.inconsistency,
+        )

@@ -1,0 +1,188 @@
+"""Streaming job runtime: the host-side barrier/epoch control loop.
+
+Reference counterparts:
+- meta's ``PeriodicBarriers`` + ``GlobalBarrierWorker::run`` loop
+  (src/meta/src/barrier/{schedule.rs:508,worker.rs:378})
+- CN's ``LocalBarrierWorker`` + actor event loop
+  (src/stream/src/task/barrier_worker/mod.rs:303)
+
+TPU-first design (SURVEY.md §7.1): barriers are host control flow.  The
+runtime ticks epochs, runs K jitted fragment steps per epoch (each step
+processes one source chunk), then crosses the barrier: flush
+emit-on-barrier state, commit the epoch, snapshot on checkpoint
+barriers.  "One actor = one tokio task" collapses into "one fragment =
+one jitted program", so barrier alignment inside a single fragment is
+trivial (sequential steps) and multi-fragment alignment is the loop
+order.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Sequence
+
+import jax
+
+from risingwave_tpu.common.epoch import EpochPair
+from risingwave_tpu.stream.fragment import Fragment
+from risingwave_tpu.stream.message import Barrier, BarrierKind
+from risingwave_tpu.stream.hash_agg import HashAggExecutor
+
+
+@dataclass
+class CheckpointSnapshot:
+    """A committed epoch: host copies of all state + source offsets.
+
+    ref: Hummock ``commit_epoch`` (src/meta/src/hummock/manager/
+    commit_epoch.rs:73) — here the "SST upload" is a device→host state
+    fetch; the persistent-store spill lands with the storage layer.
+    """
+
+    epoch: int
+    states: Any
+    source_state: dict
+
+
+class StreamingJob:
+    """A linear source → fragment pipeline driven by the barrier loop.
+
+    The fragment typically ends in a Materialize executor (the MV).
+    ``source.next_chunk()`` must return a device ``Chunk``.
+    """
+
+    def __init__(
+        self,
+        source,
+        fragment: Fragment,
+        name: str = "job",
+        checkpoint_frequency: int = 1,
+    ):
+        self.source = source
+        self.fragment = fragment
+        self.name = name
+        self.checkpoint_frequency = checkpoint_frequency
+        self.states = fragment.init_states()
+        self.epoch = EpochPair.first()
+        self.barriers_seen = 0
+        self.checkpoints: list[CheckpointSnapshot] = []
+        #: committed epoch visible to batch reads (ref pinned snapshots)
+        self.committed_epoch: int = 0
+        self.paused = False
+
+    # ------------------------------------------------------------------
+    def run_chunk(self) -> None:
+        """Pull one chunk from the source through the fragment."""
+        if self.paused:
+            return
+        chunk = self.source.next_chunk()
+        self.states, _ = self.fragment.step(self.states, chunk)
+
+    def inject_barrier(self, barrier: Barrier | None = None) -> list:
+        """Cross a barrier: flush, (maybe) checkpoint, bump the epoch.
+
+        Returns the chunks emitted by flush (they have already flowed
+        through the downstream executors inside the fragment — e.g. into
+        a trailing Materialize — so callers usually ignore them).
+        """
+        if barrier is None:
+            self.barriers_seen += 1
+            kind = (
+                BarrierKind.CHECKPOINT
+                if self.barriers_seen % self.checkpoint_frequency == 0
+                else BarrierKind.BARRIER
+            )
+            barrier = Barrier(self.epoch, kind)
+        if barrier.mutation is not None:
+            self._apply_mutation(barrier.mutation)
+
+        epoch_val = barrier.epoch.prev.value
+        outs = []
+        self.states, emitted = self.fragment.flush(self.states, epoch_val)
+        outs.extend(emitted)
+        # drain aggregations whose dirty set exceeded one emit chunk
+        outs.extend(self._drain_pending(epoch_val))
+
+        if barrier.is_checkpoint:
+            self._maintain()
+            self._commit_checkpoint(barrier)
+        self.epoch = self.epoch.bump()
+        return outs
+
+    def _maintain(self) -> None:
+        """Checkpoint-time housekeeping: rehash tombstone-heavy tables,
+        surface consistency violations (ref consistency_error!)."""
+        states = list(self.states)
+        for i, ex in enumerate(self.fragment.executors):
+            if hasattr(ex, "maybe_rehash"):
+                states[i] = ex.maybe_rehash(states[i])
+            st = states[i]
+            if hasattr(st, "inconsistency") and int(st.inconsistency) > 0:
+                raise RuntimeError(
+                    f"{ex}: {int(st.inconsistency)} deletes hit a "
+                    "non-retractable (min/max) aggregate state"
+                )
+            if hasattr(st, "overflow") and int(st.overflow) > 0:
+                raise RuntimeError(
+                    f"{ex}: state table overflow ({int(st.overflow)} rows "
+                    "dropped) — increase table_size"
+                )
+        self.states = tuple(states)
+
+    def _drain_pending(self, epoch_val) -> list:
+        outs = []
+        for i, ex in enumerate(self.fragment.executors):
+            if isinstance(ex, HashAggExecutor):
+                # one scalar readback per barrier; loops only under
+                # extreme dirty-set sizes
+                while int(ex.pending_dirty(self.states[i])) > 0:
+                    self.states, emitted = self.fragment.flush(
+                        self.states, epoch_val
+                    )
+                    outs.extend(emitted)
+        return outs
+
+    def _commit_checkpoint(self, barrier: Barrier) -> None:
+        epoch_val = barrier.epoch.prev.value
+        snap = CheckpointSnapshot(
+            epoch=epoch_val,
+            states=jax.device_get(self.states),
+            source_state=self.source.state() if hasattr(self.source, "state")
+            else {},
+        )
+        # retain only the latest committed snapshot (ref: Hummock keeps
+        # versions; version history arrives with the storage layer)
+        self.checkpoints = [snap]
+        self.committed_epoch = epoch_val
+
+    def _apply_mutation(self, mutation) -> None:
+        if mutation.kind == "pause":
+            self.paused = True
+        elif mutation.kind == "resume":
+            self.paused = False
+        elif mutation.kind == "stop":
+            self.paused = True
+
+    # -- recovery -------------------------------------------------------
+    def recover(self) -> None:
+        """Reset to the last committed checkpoint (ref §3.5 recovery:
+        rebuild actors + resume from last committed epoch)."""
+        if not self.checkpoints:
+            self.states = self.fragment.init_states()
+            if hasattr(self.source, "offset"):
+                self.source.offset = 0
+            return
+        snap = self.checkpoints[-1]
+        self.states = jax.device_put(snap.states)
+        if hasattr(self.source, "offset") and "offset" in snap.source_state:
+            self.source.offset = snap.source_state["offset"]
+
+    # ------------------------------------------------------------------
+    def run(self, barriers: int, chunks_per_barrier: int) -> None:
+        """The steady-state loop (ref §3.3)."""
+        for _ in range(barriers):
+            for _ in range(chunks_per_barrier):
+                self.run_chunk()
+            self.inject_barrier()
+
+    def executor_state(self, idx: int):
+        return self.states[idx]

@@ -8,7 +8,9 @@ Must run before jax initializes.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force CPU: the ambient environment pins JAX_PLATFORMS=axon (remote TPU
+# tunnel), which would send every test compile over the wire
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
@@ -17,4 +19,7 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 
 import jax  # noqa: E402
 
+# the ambient TPU-tunnel plugin overrides jax_platforms to "axon,cpu" at
+# interpreter start; force pure-CPU here so tests never touch the tunnel
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,106 @@
+"""Device hash-table tests (the state backbone of agg/join/mview)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from risingwave_tpu.state.hash_table import HashTable
+
+
+def _i64(vals):
+    return jnp.asarray(np.asarray(vals, np.int64))
+
+
+def _valid(n, cap=None):
+    cap = cap or n
+    v = np.zeros(cap, np.bool_)
+    v[:n] = True
+    return jnp.asarray(v)
+
+
+def test_insert_and_lookup():
+    t = HashTable.create([jnp.zeros((1,), jnp.int64)], 64)
+    keys = [_i64([10, 20, 30, 10])]
+    t, slots, inserted, overflow = t.lookup_or_insert(keys, _valid(4))
+    s = np.asarray(slots)
+    assert not np.asarray(overflow).any()
+    # duplicate key resolves to the same slot
+    assert s[0] == s[3]
+    assert len({s[0], s[1], s[2]}) == 3
+    # exactly one insert for the duplicated key
+    assert np.asarray(inserted).sum() == 3
+    assert int(t.count()) == 3
+
+    slots2, found = t.lookup([_i64([20, 99, 10, 0])], _valid(3, 4))
+    f = np.asarray(found)
+    assert list(f) == [True, False, True, False]
+    assert np.asarray(slots2)[0] == s[1]
+
+
+def test_collision_heavy_small_table():
+    # 16 slots, 12 keys — forced probing
+    t = HashTable.create([jnp.zeros((1,), jnp.int64)], 16)
+    keys = np.arange(12, dtype=np.int64) * 1000
+    t, slots, _, overflow = t.lookup_or_insert([_i64(keys)], _valid(12))
+    assert not np.asarray(overflow).any()
+    assert int(t.count()) == 12
+    # every key findable, distinct slots
+    slots2, found = t.lookup([_i64(keys)], _valid(12))
+    assert np.asarray(found).all()
+    assert len(set(np.asarray(slots2).tolist())) == 12
+    assert (np.asarray(slots2) == np.asarray(slots)).all()
+
+
+def test_overflow_reported():
+    t = HashTable.create([jnp.zeros((1,), jnp.int64)], 4)
+    keys = np.arange(8, dtype=np.int64)
+    t, _, _, overflow = t.lookup_or_insert([_i64(keys)], _valid(8))
+    assert np.asarray(overflow).sum() == 4
+    assert int(t.count()) == 4
+
+
+def test_tombstone_preserves_probe_chain():
+    t = HashTable.create([jnp.zeros((1,), jnp.int64)], 8)
+    # insert keys until some collide, then delete an early chain member
+    keys = np.asarray([1, 9, 17, 25], np.int64)  # likely same bucket mod 8
+    t, slots, _, _ = t.lookup_or_insert([_i64(keys)], _valid(4))
+    s = np.asarray(slots)
+    # delete the first key's slot
+    t = t.clear_slots(jnp.asarray([s[0]], jnp.int32), jnp.asarray([True]))
+    # the rest must still be findable (chain not broken)
+    slots2, found = t.lookup([_i64(keys)], _valid(4))
+    f = np.asarray(found)
+    assert list(f) == [False, True, True, True]
+    # re-insert the deleted key: must not duplicate others
+    t, slots3, ins, _ = t.lookup_or_insert([_i64([1])], _valid(1))
+    assert np.asarray(ins)[0]
+    slots4, found4 = t.lookup([_i64(keys)], _valid(4))
+    assert np.asarray(found4).all()
+
+
+def test_multi_column_and_string_keys():
+    from risingwave_tpu.common.chunk import encode_strings, StrCol
+
+    data, lens = encode_strings(["abc", "abd", "abc"], 8)
+    scol = StrCol(jnp.asarray(data), jnp.asarray(lens))
+    icol = _i64([1, 1, 1])
+    t = HashTable.create(
+        [jnp.zeros((1,), jnp.int64),
+         StrCol(jnp.zeros((1, 8), jnp.uint8), jnp.zeros((1,), jnp.int32))],
+        32,
+    )
+    t, slots, _, _ = t.lookup_or_insert([icol, scol], _valid(3))
+    s = np.asarray(slots)
+    assert s[0] == s[2] and s[0] != s[1]
+
+
+def test_rehash_reclaims_tombstones():
+    t = HashTable.create([jnp.zeros((1,), jnp.int64)], 16)
+    keys = np.arange(10, dtype=np.int64)
+    t, slots, _, _ = t.lookup_or_insert([_i64(keys)], _valid(10))
+    t = t.clear_slots(slots, jnp.asarray([True] * 5 + [False] * 5))
+    assert int(t.tombstone_count()) == 5
+    fresh, moved = t.rehashed()
+    assert int(fresh.tombstone_count()) == 0
+    assert int(fresh.count()) == 5
+    slots2, found = fresh.lookup([_i64(keys)], _valid(10))
+    assert list(np.asarray(found)) == [False] * 5 + [True] * 5

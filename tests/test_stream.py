@@ -1,0 +1,257 @@
+"""Streaming executor tests, modeled on the reference's executor tests
+(chunk DSL in, snapshot of emitted changelog out — SURVEY.md §4)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from risingwave_tpu.common.chunk import Chunk
+from risingwave_tpu.common.types import DataType, Field, Schema
+from risingwave_tpu.expr.node import col
+from risingwave_tpu.expr.agg import AggCall, count_star
+from risingwave_tpu.stream.executor import FilterExecutor, ProjectExecutor
+from risingwave_tpu.stream.fragment import Fragment
+from risingwave_tpu.stream.hash_agg import HashAggExecutor
+from risingwave_tpu.stream.materialize import (
+    AppendOnlyMaterialize,
+    MaterializeExecutor,
+)
+
+
+def _rows(chunk):
+    return sorted(chunk.to_rows())
+
+
+def test_project_filter_fragment():
+    schema = Schema.of(("a", DataType.INT64), ("b", DataType.INT64))
+    proj = ProjectExecutor(schema, [("a", col("a")), ("c", col("b") * 2)])
+    filt = FilterExecutor(proj.out_schema, col("c") > 10)
+    frag = Fragment([proj, filt])
+    states = frag.init_states()
+    chunk = Chunk.from_pretty(
+        """
+        I I
+        +  1 2
+        +  2 6
+        -  3 10
+        """,
+        names=["a", "b"],
+    )
+    states, out = frag.step(states, chunk)
+    assert _rows(out) == [(0, 2, 12), (1, 3, 20)]
+
+
+def test_filter_update_pair_degradation():
+    # U- stays, U+ filtered out => U- becomes plain delete (ref filter.rs)
+    schema = Schema.of(("a", DataType.INT64))
+    filt = FilterExecutor(schema, col("a") < 10)
+    frag = Fragment([filt])
+    chunk = Chunk.from_pretty(
+        """
+        I
+        U- 5
+        U+ 15
+        """,
+        names=["a"],
+    )
+    _, out = frag.step(frag.init_states(), chunk)
+    assert out.to_rows() == [(1, 5)]  # OP_DELETE
+
+    chunk2 = Chunk.from_pretty(
+        """
+        I
+        U- 15
+        U+ 5
+        """,
+        names=["a"],
+    )
+    _, out2 = frag.step(frag.init_states(), chunk2)
+    assert out2.to_rows() == [(0, 5)]  # OP_INSERT
+
+
+def _agg_fragment(table_size=64, emit_capacity=8):
+    schema = Schema.of(("g", DataType.INT64), ("v", DataType.INT64))
+    agg = HashAggExecutor(
+        schema,
+        group_by=[("g", col("g"))],
+        aggs=[count_star(), AggCall("sum", col("v"), "s")],
+        table_size=table_size,
+        emit_capacity=emit_capacity,
+    )
+    return Fragment([agg]), agg
+
+
+def test_hash_agg_insert_then_update():
+    frag, agg = _agg_fragment()
+    states = frag.init_states()
+    states, _ = frag.step(states, Chunk.from_pretty(
+        """
+        I I
+        + 1 10
+        + 1 5
+        + 2 7
+        """,
+    names=["g", "v"],
+    ))
+    states, outs = frag.flush(states, 1)
+    assert len(outs) == 1
+    assert _rows(outs[0]) == [(0, 1, 2, 15), (0, 2, 1, 7)]
+
+    # second epoch: one more row for group 1 -> U-/U+ pair; group 2 silent
+    states, _ = frag.step(states, Chunk.from_pretty(
+        """
+        I I
+        + 1 1
+        """,
+    names=["g", "v"],
+    ))
+    states, outs = frag.flush(states, 2)
+    rows = outs[0].to_rows()
+    assert rows == [(2, 1, 2, 15), (3, 1, 3, 16)]  # U- old, U+ new
+
+
+def test_hash_agg_retraction_to_empty():
+    frag, agg = _agg_fragment()
+    states = frag.init_states()
+    states, _ = frag.step(states, Chunk.from_pretty(
+        """
+        I I
+        + 1 10
+        """,
+    names=["g", "v"],
+    ))
+    states, outs = frag.flush(states, 1)
+    assert outs[0].to_rows() == [(0, 1, 1, 10)]
+    states, _ = frag.step(states, Chunk.from_pretty(
+        """
+        I I
+        - 1 10
+        """,
+    names=["g", "v"],
+    ))
+    states, outs = frag.flush(states, 2)
+    assert outs[0].to_rows() == [(1, 1, 1, 10)]  # Delete of the old row
+
+    # re-insert => plain Insert again (emitted flag was cleared)
+    states, _ = frag.step(states, Chunk.from_pretty(
+        """
+        I I
+        + 1 3
+        """,
+    names=["g", "v"],
+    ))
+    states, outs = frag.flush(states, 3)
+    assert outs[0].to_rows() == [(0, 1, 1, 3)]
+
+
+def test_hash_agg_emit_overflow_drains():
+    # 12 dirty groups, emit capacity 8 -> runtime drains in 2 flushes
+    frag, agg = _agg_fragment(table_size=64, emit_capacity=8)
+    states = frag.init_states()
+    arrays = [np.arange(12, dtype=np.int64), np.ones(12, np.int64)]
+    schema = Schema.of(("g", DataType.INT64), ("v", DataType.INT64))
+    states, _ = frag.step(states, Chunk.from_numpy(schema, arrays))
+    states, outs = frag.flush(states, 1)
+    n1 = sum(len(o.to_rows()) for o in outs)
+    assert n1 == 8
+    assert int(agg.pending_dirty(states[0])) == 4
+    states, outs2 = frag.flush(states, 1)
+    assert sum(len(o.to_rows()) for o in outs2) == 4
+    assert int(agg.pending_dirty(states[0])) == 0
+
+
+def test_hash_agg_min_max_append_only():
+    schema = Schema.of(("g", DataType.INT64), ("v", DataType.INT64))
+    agg = HashAggExecutor(
+        schema,
+        group_by=[("g", col("g"))],
+        aggs=[AggCall("min", col("v"), "lo"), AggCall("max", col("v"), "hi")],
+        table_size=64,
+        emit_capacity=8,
+    )
+    frag = Fragment([agg])
+    states = frag.init_states()
+    states, _ = frag.step(states, Chunk.from_pretty(
+        """
+        I I
+        + 1 5
+        + 1 9
+        + 1 2
+        """,
+    names=["g", "v"],
+    ))
+    states, outs = frag.flush(states, 1)
+    assert outs[0].to_rows() == [(0, 1, 2, 9)]
+
+
+def test_materialize_upsert():
+    schema = Schema.of(("k", DataType.INT64), ("v", DataType.INT64))
+    mv = MaterializeExecutor(schema, pk_indices=[0], table_size=64)
+    frag = Fragment([mv])
+    states = frag.init_states()
+    states, _ = frag.step(states, Chunk.from_pretty(
+        """
+        I I
+        + 1 10
+        + 2 20
+        """,
+    names=["g", "v"],
+    ))
+    states, _ = frag.step(states, Chunk.from_pretty(
+        """
+        I I
+        U- 1 10
+        U+ 1 11
+        -  2 20
+        + 3 30
+        """,
+    names=["g", "v"],
+    ))
+    rows = sorted(mv.to_host(states[0]))
+    assert rows == [(1, 11), (3, 30)]
+
+
+def test_append_only_materialize_ring():
+    schema = Schema.of(("v", DataType.INT64))
+    mv = AppendOnlyMaterialize(schema, ring_size=16)
+    frag = Fragment([mv])
+    states = frag.init_states()
+    arrays = [np.arange(5, dtype=np.int64)]
+    states, _ = frag.step(states, Chunk.from_numpy(schema, arrays, capacity=8))
+    states, _ = frag.step(
+        states, Chunk.from_numpy(schema, [np.arange(5, 10, dtype=np.int64)],
+                                 capacity=8)
+    )
+    rows = mv.to_host(states[0])
+    assert [r[0] for r in rows] == list(range(10))
+
+
+def test_agg_into_materialize_chain():
+    """agg flush output flows through trailing materialize in one fragment."""
+    schema = Schema.of(("g", DataType.INT64), ("v", DataType.INT64))
+    agg = HashAggExecutor(
+        schema, [("g", col("g"))], [count_star("n")],
+        table_size=64, emit_capacity=8,
+    )
+    mv = MaterializeExecutor(agg.out_schema, pk_indices=[0], table_size=64)
+    frag = Fragment([agg, mv])
+    states = frag.init_states()
+    states, _ = frag.step(states, Chunk.from_pretty(
+        """
+        I I
+        + 1 0
+        + 1 0
+        + 2 0
+        """,
+    names=["g", "v"],
+    ))
+    states, _ = frag.flush(states, 1)
+    assert sorted(mv.to_host(states[1])) == [(1, 2), (2, 1)]
+    states, _ = frag.step(states, Chunk.from_pretty(
+        """
+        I I
+        - 1 0
+        """,
+    names=["g", "v"],
+    ))
+    states, _ = frag.flush(states, 2)
+    assert sorted(mv.to_host(states[1])) == [(1, 1), (2, 1)]
