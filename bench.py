@@ -1,0 +1,104 @@
+"""Benchmark driver: Nexmark q7-shaped streaming throughput per chip.
+
+Pipeline: on-device bid generation → window projection → hash
+aggregation (max price + count per 10s tumble), with a barrier flush
+every ``CHUNKS_PER_BARRIER`` chunks — the BASELINE.md q5/q7 windowed-agg
+configuration at the reference's default freshness envelope
+(barrier_interval work-equivalent; see BASELINE.md).
+
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+``vs_baseline`` is measured-TPU / measured-CPU-single-thread-equivalent
+(the reference publishes no absolute numbers — BASELINE.md; the north
+star is >=5x vs CPU rows/sec at equal freshness).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import risingwave_tpu  # noqa: F401  (platform/x64 config before backend init)
+
+import jax
+import jax.numpy as jnp
+
+from __graft_entry__ import _q7_executors
+from risingwave_tpu.stream.fragment import Fragment
+
+CHUNK_CAP = 8192
+CHUNKS = 64
+CHUNKS_PER_BARRIER = 8
+TABLE_SIZE = 1 << 16
+EMIT_CAP = 4096
+
+
+def measure_rows_per_sec() -> float:
+    gen, project, agg = _q7_executors(TABLE_SIZE, EMIT_CAP)
+    frag = Fragment([project, agg], name="nexmark_q7_bench")
+    states = frag.init_states()
+
+    # one fused program: generate + project + aggregate
+    @jax.jit
+    def fused_step(states, k0):
+        chunk = gen._bids_impl(k0, CHUNK_CAP)
+        states, _ = frag._step_impl(states, chunk)
+        return states
+
+    # warmup / compile
+    states = fused_step(states, jnp.int64(0))
+    states, _ = frag.flush(states, 0)
+    jax.block_until_ready(states)
+
+    t0 = time.perf_counter()
+    k = 0
+    for b in range(CHUNKS // CHUNKS_PER_BARRIER):
+        for _ in range(CHUNKS_PER_BARRIER):
+            states = fused_step(states, jnp.int64((k + 1) * CHUNK_CAP))
+            k += 1
+        states, _ = frag.flush(states, b)
+    jax.block_until_ready(states)
+    dt = time.perf_counter() - t0
+    return CHUNKS * CHUNK_CAP / dt
+
+
+def _cpu_baseline() -> float:
+    """Same workload on one CPU device, in a subprocess."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["RWT_BENCH_RAW"] = "1"
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__)],
+        env=env, capture_output=True, text=True, timeout=900,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    for line in out.stdout.splitlines():
+        if line.startswith("RAW "):
+            return float(line.split()[1])
+    raise RuntimeError(f"cpu baseline failed: {out.stderr[-500:]}")
+
+
+def main() -> None:
+    rows_per_sec = measure_rows_per_sec()
+    if os.environ.get("RWT_BENCH_RAW"):
+        print(f"RAW {rows_per_sec}")
+        return
+    try:
+        cpu = _cpu_baseline()
+        vs = rows_per_sec / cpu
+    except Exception as e:
+        print(f"warning: cpu baseline failed, vs_baseline=0: {e}",
+              file=sys.stderr)
+        vs = 0.0
+    print(json.dumps({
+        "metric": "nexmark_q7_windowed_agg_throughput",
+        "value": round(rows_per_sec, 1),
+        "unit": "rows/s/chip",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,18 @@
+"""Multi-chip parallelism: vnode sharding over a device mesh.
+
+Reference counterpart: the dispatch/exchange layer (SURVEY.md §2.3
+"Parallelism & distribution model") — hash dispatchers computing vnodes
+(dispatch.rs:949), permit-based gRPC exchange, and merge alignment.
+
+TPU restructuring (SURVEY.md §5.8): the vnode axis maps onto a mesh
+axis; the hash shuffle is an ``all_to_all`` collective over ICI *inside*
+the jitted step; barrier alignment degenerates to the host loop ticking
+every shard in lockstep (SPMD).
+"""
+
+from risingwave_tpu.parallel.exchange import (
+    shard_of_vnode,
+    shuffle_chunk,
+)
+
+__all__ = ["shard_of_vnode", "shuffle_chunk"]

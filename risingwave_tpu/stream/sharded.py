@@ -1,0 +1,181 @@
+"""Sharded streaming jobs: one fragment chain SPMD over a vnode mesh.
+
+Reference counterpart: fragment data parallelism — N parallel actors per
+fragment, each owning a disjoint vnode bitmap, connected by hash
+dispatchers (SURVEY.md §2.3 parallelism items 1-2).
+
+TPU restructuring: the N actors of the reference become ONE
+``shard_map``-ed step function over a mesh axis (``"shard"``).  Each
+shard holds its own executor states (leading mesh-sharded axis); the
+hash exchange between the stateless prefix and the keyed suffix is an
+``all_to_all`` inside the same jitted program, riding ICI.  The barrier
+loop drives all shards in lockstep, so merge alignment is structural.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.8
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from risingwave_tpu.common.chunk import Chunk
+from risingwave_tpu.parallel.exchange import shuffle_chunk
+from risingwave_tpu.stream.executor import Executor
+from risingwave_tpu.stream.fragment import Fragment
+
+
+def make_mesh(n_devices: int | None = None, axis: str = "shard") -> Mesh:
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    return Mesh(devs[:n], (axis,))
+
+
+class ShardedJob:
+    """source → [local executors] → hash exchange → [keyed executors].
+
+    ``source_fn(k0, cap) -> Chunk`` must be traceable (e.g. the nexmark
+    generator impl): each shard generates/reads its own ordinal range, so
+    ingestion is embarrassingly parallel like the reference's source
+    splits.  ``exchange_keys(chunk) -> [key cols]`` routes rows to the
+    shard owning their vnode.
+    """
+
+    AXIS = "shard"
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        source_fn: Callable,
+        chunk_capacity: int,
+        local_executors: Sequence[Executor],
+        exchange_key_fn: Callable,
+        keyed_executors: Sequence[Executor],
+    ):
+        self.mesh = mesh
+        self.n_shards = mesh.devices.size
+        self.source_fn = source_fn
+        self.cap = chunk_capacity
+        # the two halves of the chain are real Fragments, so chain
+        # semantics (None-break, flush cascade) stay single-sourced
+        self.local_frag = (
+            Fragment(local_executors, "local") if local_executors else None
+        )
+        self.keyed_frag = Fragment(keyed_executors, "keyed")
+        self.exchange_key_fn = exchange_key_fn
+        self.executors = list(local_executors) + list(keyed_executors)
+
+        spec = P(self.AXIS)
+        self._step = jax.jit(
+            shard_map(
+                self._local_step,
+                mesh=self.mesh,
+                in_specs=(spec, spec),
+                out_specs=spec,
+                check_vma=False,
+            )
+        )
+        self._flush = jax.jit(
+            shard_map(
+                self._local_flush,
+                mesh=self.mesh,
+                in_specs=(spec, spec),
+                out_specs=(spec, spec),
+                check_vma=False,
+            )
+        )
+
+    # ------------------------------------------------------------------
+    def init_states(self):
+        """Per-shard states stacked on a leading mesh-sharded axis."""
+        def one_shard(_):
+            return tuple(ex.init_state() for ex in self.executors)
+
+        stacked = jax.vmap(one_shard)(jnp.arange(self.n_shards))
+        sharding = jax.NamedSharding(self.mesh, P(self.AXIS))
+        return jax.device_put(stacked, sharding)
+
+    # -- traced per-shard bodies ----------------------------------------
+    def _split(self, states):
+        n_local = len(self.local_frag.executors) if self.local_frag else 0
+        return tuple(states[:n_local]), tuple(states[n_local:])
+
+    def _local_step(self, states, k0):
+        states = jax.tree.map(lambda x: x[0], states)
+        local_states, keyed_states = self._split(states)
+        chunk = self.source_fn(k0[0], self.cap)
+        if self.local_frag is not None:
+            local_states, chunk = self.local_frag._step_impl(
+                local_states, chunk
+            )
+        if chunk is not None:
+            chunk = shuffle_chunk(
+                chunk, self.exchange_key_fn(chunk), self.AXIS, self.n_shards
+            )
+            keyed_states, _ = self.keyed_frag._step_impl(keyed_states, chunk)
+        return jax.tree.map(
+            lambda x: x[None], tuple(local_states) + tuple(keyed_states)
+        )
+
+    def _local_flush(self, states, epoch):
+        states = jax.tree.map(lambda x: x[0], states)
+        local_states, keyed_states = self._split(states)
+        outs = []
+        if self.local_frag is not None:
+            local_states, local_outs = self.local_frag._flush_impl(
+                local_states, epoch[0]
+            )
+            # barrier emissions from the local half cross the exchange
+            for emitted in local_outs:
+                shuffled = shuffle_chunk(
+                    emitted, self.exchange_key_fn(emitted), self.AXIS,
+                    self.n_shards,
+                )
+                keyed_states, out = self.keyed_frag._step_impl(
+                    keyed_states, shuffled
+                )
+                if out is not None:
+                    outs.append(out)
+        keyed_states, keyed_outs = self.keyed_frag._flush_impl(
+            keyed_states, epoch[0]
+        )
+        outs.extend(keyed_outs)
+        out_tree = jax.tree.map(lambda x: x[None], tuple(outs))
+        new_states = tuple(local_states) + tuple(keyed_states)
+        return jax.tree.map(lambda x: x[None], new_states), out_tree
+
+    # -- host API --------------------------------------------------------
+    def step(self, states, k0_per_shard: jnp.ndarray):
+        """One chunk per shard; ``k0_per_shard`` int64 [n_shards]."""
+        return self._step(states, k0_per_shard)
+
+    def flush(self, states, epoch: int):
+        epochs = jnp.full((self.n_shards,), epoch, jnp.int64)
+        return self._flush(states, epochs)
+
+    def run_epochs(
+        self,
+        states,
+        barriers: int,
+        chunks_per_barrier: int,
+        start_ordinal: int = 0,
+    ):
+        """Drive the barrier loop; returns (states, emitted-per-flush)."""
+        ordinal = start_ordinal
+        all_outs = []
+        for _ in range(barriers):
+            for _ in range(chunks_per_barrier):
+                k0 = ordinal + jnp.arange(self.n_shards, dtype=jnp.int64) \
+                    * self.cap
+                states = self.step(states, k0)
+                ordinal += self.n_shards * self.cap
+            states, outs = self.flush(states, 0)
+            all_outs.append(outs)
+        return states, all_outs
