@@ -1,0 +1,434 @@
+"""Streaming hash join (inner), device-resident two-sided state.
+
+Reference counterpart: ``HashJoinExecutor`` (src/stream/src/executor/
+hash_join.rs:158) with ``JoinHashMap`` state+degree tables
+(join/hash_join.rs:169) and the probe loop ``eq_join_oneside``
+(hash_join.rs:949).
+
+TPU-first design
+----------------
+Each side's state is a *bucketed multi-map* in HBM:
+
+- ``key_table``: HashTable over the join key — one slot per distinct key;
+- ``rows``:     per-column ``[size, bucket_cap]`` dense stores;
+- ``occupied``: ``bool [size, bucket_cap]``;
+- ``count``:    ``int32 [size]`` live rows per key.
+
+A chunk applies as a handful of gathers/scatters over the whole chunk
+(vs the reference's per-row HashMap + Vec walk):
+
+- inserts claim free bucket positions by rank-among-equal-keys
+  (cumsum-of-free one-hot), deletes match value-equal entries by rank
+  (row-hash disambiguated) and clear them;
+- probe gathers the *entire* opposite bucket per row — every entry in a
+  bucket shares the join key, so the match mask is just occupancy — and
+  compacts all (probe-row × bucket-entry) pairs into a fixed-capacity
+  output chunk via prefix sums.
+
+Emitted ops: +/- matching the probe row's changelog sign (the
+reference's U-pair reconstruction is a planner nicety, deferred).
+Outer joins need degree-tracking NULL rows (ref degree table) — next
+round.  State cleaning for window joins (Nexmark q8) is the same
+vectorized sweep as hash_agg's ``clean_below``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from risingwave_tpu.common.chunk import Chunk, StrCol
+from risingwave_tpu.common.hash import hash64_columns
+from risingwave_tpu.common.types import Field, Schema
+from risingwave_tpu.expr.node import Expr
+from risingwave_tpu.state.hash_table import HashTable
+
+
+def _empty_store(f: Field, size: int, bucket: int):
+    if f.data_type.is_string:
+        return StrCol(
+            jnp.zeros((size, bucket, f.str_width), jnp.uint8),
+            jnp.zeros((size, bucket), jnp.int32),
+        )
+    return jnp.zeros((size, bucket), f.data_type.physical_dtype)
+
+
+def _gather_bucket(store, slots):
+    """[size, B, ...] gathered at [cap] slots -> [cap, B, ...]."""
+    if isinstance(store, StrCol):
+        return StrCol(store.data[slots], store.lens[slots])
+    return store[slots]
+
+
+def _scatter_rows(store, pos, col):
+    """Write row values col[[cap]] at flat positions pos[[cap]] into the
+    flattened [size*B, ...] view of the store."""
+    if isinstance(store, StrCol):
+        flat_d = store.data.reshape((-1,) + store.data.shape[2:])
+        flat_l = store.lens.reshape((-1,))
+        flat_d = flat_d.at[pos].set(col.data, mode="drop")
+        flat_l = flat_l.at[pos].set(col.lens, mode="drop")
+        return StrCol(
+            flat_d.reshape(store.data.shape), flat_l.reshape(store.lens.shape)
+        )
+    flat = store.reshape((-1,) + store.shape[2:])
+    flat = flat.at[pos].set(col, mode="drop")
+    return flat.reshape(store.shape)
+
+
+def _rank_by(group: jnp.ndarray, active: jnp.ndarray) -> jnp.ndarray:
+    """Stable rank of each active row among rows with equal ``group``."""
+    cap = group.shape[0]
+    key = jnp.where(active, group, jnp.uint64(0xFFFFFFFFFFFFFFFF))
+    order = jnp.argsort(key, stable=True)
+    sorted_key = key[order]
+    is_new = jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), sorted_key[1:] != sorted_key[:-1]]
+    )
+    start = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(is_new, jnp.arange(cap, dtype=jnp.int32), 0)
+    )
+    rank_sorted = jnp.arange(cap, dtype=jnp.int32) - start
+    return jnp.zeros((cap,), jnp.int32).at[order].set(rank_sorted)
+
+
+def _group_totals(group: jnp.ndarray, values: jnp.ndarray) -> jnp.ndarray:
+    """Per-row sum of ``values`` over rows sharing the same ``group``."""
+    cap = group.shape[0]
+    order = jnp.argsort(group, stable=True)
+    sorted_g = group[order]
+    is_new = jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), sorted_g[1:] != sorted_g[:-1]]
+    )
+    seg_id = jnp.cumsum(is_new) - 1
+    sums = jax.ops.segment_sum(
+        values[order].astype(jnp.int32), seg_id, num_segments=cap
+    )
+    totals_sorted = sums[seg_id]
+    return jnp.zeros((cap,), jnp.int32).at[order].set(totals_sorted)
+
+
+class SideState(NamedTuple):
+    key_table: HashTable
+    rows: tuple          # [size, B] stores, one per input column
+    occupied: jnp.ndarray  # bool [size, B]
+    count: jnp.ndarray     # int32 [size]
+    overflow: jnp.ndarray  # int64 — rows that found no bucket space
+    #: deletes with no matching stored row (ref consistency_error!)
+    inconsistency: jnp.ndarray
+
+
+class JoinState(NamedTuple):
+    left: SideState
+    right: SideState
+    emit_overflow: jnp.ndarray  # int64 — matches dropped by out capacity
+
+
+class HashJoinExecutor:
+    """Inner equi-join of two changelog streams.
+
+    Not a linear-``Fragment`` executor: it has two inputs.  The runtime
+    (``BinaryJob``) or a graph scheduler calls ``apply(state, chunk,
+    side)``; output schema is left columns ++ right columns.
+    """
+
+    def __init__(
+        self,
+        left_schema: Schema,
+        right_schema: Schema,
+        left_keys: Sequence[Expr],
+        right_keys: Sequence[Expr],
+        table_size: int = 1 << 14,
+        bucket_cap: int = 16,
+        out_capacity: int = 16384,
+        left_bucket_cap: int | None = None,
+        right_bucket_cap: int | None = None,
+    ):
+        self.left_schema = left_schema
+        self.right_schema = right_schema
+        self.left_keys = tuple(left_keys)
+        self.right_keys = tuple(right_keys)
+        self.table_size = table_size
+        # per-side bucket depth: size for the max rows per join key on
+        # that side (hot-key skew, e.g. nexmark's hot sellers, needs a
+        # deep build side while a unique-keyed side stays shallow)
+        self.left_bucket_cap = left_bucket_cap or bucket_cap
+        self.right_bucket_cap = right_bucket_cap or bucket_cap
+        self.out_capacity = out_capacity
+        self._out_schema = left_schema.concat(right_schema)
+
+    @property
+    def out_schema(self) -> Schema:
+        return self._out_schema
+
+    # ------------------------------------------------------------------
+    def _key_protos(self, schema: Schema, keys: Sequence[Expr]):
+        protos = []
+        for e in keys:
+            f = e.return_field(schema)
+            if f.data_type.is_string:
+                protos.append(StrCol(
+                    jnp.zeros((1, f.str_width), jnp.uint8),
+                    jnp.zeros((1,), jnp.int32),
+                ))
+            else:
+                protos.append(jnp.zeros((1,), f.data_type.physical_dtype))
+        return protos
+
+    def _side_state(self, schema: Schema, keys: Sequence[Expr],
+                    bucket: int) -> SideState:
+        return SideState(
+            key_table=HashTable.create(
+                self._key_protos(schema, keys), self.table_size
+            ),
+            rows=tuple(
+                _empty_store(f, self.table_size, bucket) for f in schema
+            ),
+            occupied=jnp.zeros((self.table_size, bucket), jnp.bool_),
+            count=jnp.zeros((self.table_size,), jnp.int32),
+            overflow=jnp.zeros((), jnp.int64),
+            inconsistency=jnp.zeros((), jnp.int64),
+        )
+
+    def init_state(self) -> JoinState:
+        return JoinState(
+            left=self._side_state(
+                self.left_schema, self.left_keys, self.left_bucket_cap
+            ),
+            right=self._side_state(
+                self.right_schema, self.right_keys, self.right_bucket_cap
+            ),
+            emit_overflow=jnp.zeros((), jnp.int64),
+        )
+
+    # ------------------------------------------------------------------
+    def _update_side(self, side: SideState, chunk: Chunk,
+                     keys: Sequence[Expr]):
+        """Apply the chunk's inserts/deletes to this side's multi-map.
+
+        Returns the updated side.
+        """
+        B = side.occupied.shape[1]
+        size = self.table_size
+        key_cols = [e.eval(chunk) for e in keys]
+        signs = chunk.signs()
+        is_ins = chunk.valid & (signs > 0)
+        is_del = chunk.valid & (signs < 0)
+
+        # ---- in-chunk annihilation ------------------------------------
+        # a +row and a -row of the same value inside one chunk cancel:
+        # the delete pass below only sees *pre-chunk* state, so without
+        # this a [-after-+] pair would ghost-insert.  Rows still take
+        # part in probing (their +/- matches cancel downstream too).
+        row_hash = hash64_columns(list(chunk.columns))
+        ins_rank_h = _rank_by(row_hash, is_ins)
+        del_rank_h = _rank_by(row_hash, is_del)
+        n_ins_h = _group_totals(row_hash, is_ins)
+        n_del_h = _group_totals(row_hash, is_del)
+        cancelled_ins = is_ins & (ins_rank_h < n_del_h)
+        cancelled_del = is_del & (del_rank_h < n_ins_h)
+        is_ins = is_ins & ~cancelled_ins
+        is_del = is_del & ~cancelled_del
+
+        # ---- key slots: inserts may create, deletes only look up ------
+        key_table, slots_ins, _, overflow = side.key_table.lookup_or_insert(
+            key_cols, is_ins
+        )
+        is_ins = is_ins & ~overflow
+        slots_del, found_del = key_table.lookup(key_cols, is_del)
+        n_missing = jnp.sum((is_del & ~found_del).astype(jnp.int64))
+        is_del = is_del & found_del
+        safe_ins = jnp.minimum(slots_ins, size - 1)
+        safe_del = jnp.minimum(slots_del, size - 1)
+
+        # ---- deletes: clear the rank-th value-equal entry -------------
+        # rank among value-equal delete rows: the full row hash is the
+        # group key (equal rows share slot AND hash; unequal rows differ
+        # in hash w.h.p., and a collision only reorders which duplicate
+        # is cleared — harmless for multiset semantics)
+        del_rank = _rank_by(row_hash, is_del)
+        occ = side.occupied[safe_del]                     # [cap, B]
+        bucket_hash = self._bucket_row_hash(side, safe_del)    # [cap, B]
+        val_match = occ & (bucket_hash == row_hash[:, None])
+        match_rank = jnp.cumsum(val_match, axis=1) - 1    # rank per entry
+        clear_onehot = val_match & (match_rank == del_rank[:, None]) & \
+            is_del[:, None]
+        any_clear = jnp.any(clear_onehot, axis=1)
+        n_missing = n_missing + jnp.sum(
+            (is_del & ~any_clear).astype(jnp.int64)
+        )
+        j_clear = jnp.argmax(clear_onehot, axis=1).astype(jnp.int32)
+        flat_clear = jnp.where(
+            any_clear, safe_del * B + j_clear, jnp.int32(size * B)
+        )
+        occupied = side.occupied.reshape(-1).at[flat_clear].set(
+            False, mode="drop"
+        ).reshape(size, B)
+        count = side.count.at[
+            jnp.where(any_clear, safe_del, jnp.int32(size))
+        ].add(-1, mode="drop")
+
+        # ---- inserts: claim rank-th free position ---------------------
+        ins_rank = _rank_by(slots_ins.astype(jnp.uint64), is_ins)
+        free = ~occupied[safe_ins]                        # [cap, B]
+        free_rank = jnp.cumsum(free, axis=1) - 1
+        take_onehot = free & (free_rank == ins_rank[:, None]) & \
+            is_ins[:, None]
+        got = jnp.any(take_onehot, axis=1)
+        j_take = jnp.argmax(take_onehot, axis=1).astype(jnp.int32)
+        flat_take = jnp.where(
+            got, safe_ins * B + j_take, jnp.int32(size * B)
+        )
+        occupied = occupied.reshape(-1).at[flat_take].set(
+            True, mode="drop"
+        ).reshape(size, B)
+        rows = tuple(
+            _scatter_rows(store, flat_take, col)
+            for store, col in zip(side.rows, chunk.columns)
+        )
+        count = count.at[
+            jnp.where(got, safe_ins, jnp.int32(size))
+        ].add(1, mode="drop")
+        n_over = jnp.sum((is_ins & ~got).astype(jnp.int64)) + \
+            jnp.sum(overflow.astype(jnp.int64))
+
+        return SideState(
+            key_table=key_table,
+            rows=rows,
+            occupied=occupied,
+            count=count,
+            overflow=side.overflow + n_over,
+            inconsistency=side.inconsistency + n_missing,
+        )
+
+    def _bucket_row_hash(self, side: SideState, safe_slots) -> jnp.ndarray:
+        """Row hashes of a side's buckets gathered at [cap] slots."""
+        cols = []
+        for store in side.rows:
+            g = _gather_bucket(store, safe_slots)  # [cap, B, ...]
+            if isinstance(g, StrCol):
+                cap, B, w = g.data.shape
+                cols.append(StrCol(
+                    g.data.reshape(cap * B, w), g.lens.reshape(cap * B)
+                ))
+            else:
+                cols.append(g.reshape(-1))
+        h = hash64_columns(cols)
+        cap = safe_slots.shape[0]
+        return h.reshape(cap, side.occupied.shape[1])
+
+    # ------------------------------------------------------------------
+    def _probe(self, probe_chunk: Chunk, build: SideState,
+               probe_is_left: bool, probe_keys: Sequence[Expr]):
+        """Emit (probe row × build bucket entry) pairs, compacted."""
+        B = build.occupied.shape[1]
+        size = self.table_size
+        out_cap = self.out_capacity
+        key_cols = [e.eval(probe_chunk) for e in probe_keys]
+        slots, found = build.key_table.lookup(key_cols, probe_chunk.valid)
+        safe_slots = jnp.minimum(slots, size - 1)
+        occ = build.occupied[safe_slots] & found[:, None]  # [cap, B]
+
+        matches_per_row = jnp.sum(occ, axis=1).astype(jnp.int32)
+        row_start = jnp.cumsum(matches_per_row) - matches_per_row
+        within = jnp.cumsum(occ, axis=1) - 1               # [cap, B]
+        out_pos = row_start[:, None] + within              # [cap, B]
+        emit = occ & (out_pos < out_cap)
+        flat_pos = jnp.where(emit, out_pos, out_cap).reshape(-1)
+        total = row_start[-1] + matches_per_row[-1]
+        n_drop = jnp.maximum(total - out_cap, 0).astype(jnp.int64)
+
+        def scatter_probe_col(col):
+            # broadcast probe value across its bucket row then compact
+            if isinstance(col, StrCol):
+                cap, w = col.data.shape
+                d = jnp.broadcast_to(col.data[:, None, :], (cap, B, w))
+                l = jnp.broadcast_to(col.lens[:, None], (cap, B))
+                return StrCol(
+                    jnp.zeros((out_cap + 1, w), jnp.uint8).at[flat_pos].set(
+                        d.reshape(cap * B, w), mode="drop")[:out_cap],
+                    jnp.zeros((out_cap + 1,), jnp.int32).at[flat_pos].set(
+                        l.reshape(-1), mode="drop")[:out_cap],
+                )
+            cap = col.shape[0]
+            v = jnp.broadcast_to(col[:, None], (cap, B))
+            return jnp.zeros((out_cap + 1,), col.dtype).at[flat_pos].set(
+                v.reshape(-1), mode="drop"
+            )[:out_cap]
+
+        def scatter_build_col(store):
+            g = _gather_bucket(store, safe_slots)  # [cap, B, ...]
+            if isinstance(g, StrCol):
+                cap, Bb, w = g.data.shape
+                return StrCol(
+                    jnp.zeros((out_cap + 1, w), jnp.uint8).at[flat_pos].set(
+                        g.data.reshape(cap * Bb, w), mode="drop")[:out_cap],
+                    jnp.zeros((out_cap + 1,), jnp.int32).at[flat_pos].set(
+                        g.lens.reshape(-1), mode="drop")[:out_cap],
+                )
+            cap = g.shape[0]
+            return jnp.zeros((out_cap + 1,), g.dtype).at[flat_pos].set(
+                g.reshape(-1), mode="drop"
+            )[:out_cap]
+
+        probe_cols = [scatter_probe_col(c) for c in probe_chunk.columns]
+        build_cols = [scatter_build_col(s) for s in build.rows]
+        out_cols = probe_cols + build_cols if probe_is_left \
+            else build_cols + probe_cols
+
+        signs = probe_chunk.signs()
+        sign_b = jnp.broadcast_to(signs[:, None], signs.shape + (B,))
+        out_sign = jnp.zeros((out_cap + 1,), jnp.int32).at[flat_pos].set(
+            sign_b.reshape(-1), mode="drop"
+        )[:out_cap]
+        ops = jnp.where(out_sign > 0, jnp.int8(0), jnp.int8(1))
+        valid = jnp.zeros((out_cap + 1,), jnp.bool_).at[flat_pos].set(
+            True, mode="drop"
+        )[:out_cap]
+        out = Chunk(out_cols, ops, valid, self._out_schema)
+        return out, n_drop
+
+    # ------------------------------------------------------------------
+    def apply(self, state: JoinState, chunk: Chunk, side: str):
+        """Process one chunk from ``side`` ("left"|"right").
+
+        Order (matching the reference's update-then-probe for correct
+        self-consistency): update own side, then probe the other side.
+        """
+        if side == "left":
+            left = self._update_side(state.left, chunk, self.left_keys)
+            out, dropped = self._probe(
+                chunk, state.right, True, self.left_keys
+            )
+            return JoinState(
+                left, state.right, state.emit_overflow + dropped
+            ), out
+        right = self._update_side(state.right, chunk, self.right_keys)
+        out, dropped = self._probe(
+            chunk, state.left, False, self.right_keys
+        )
+        return JoinState(
+            state.left, right, state.emit_overflow + dropped
+        ), out
+
+    # ------------------------------------------------------------------
+    def clean_below(self, state: JoinState, side: str, key_col_idx: int,
+                    threshold) -> JoinState:
+        """Watermark state cleaning on a window key column (q8 pattern)."""
+        s: SideState = getattr(state, side)
+        key = s.key_table.key_cols[key_col_idx]
+        stale = s.key_table.occupied & (key < threshold)
+        cleaned = SideState(
+            key_table=s.key_table.clear_where(stale),
+            rows=s.rows,
+            occupied=s.occupied & ~stale[:, None],
+            count=jnp.where(stale, 0, s.count),
+            overflow=s.overflow,
+            inconsistency=s.inconsistency,
+        )
+        if side == "left":
+            return JoinState(cleaned, state.right, state.emit_overflow)
+        return JoinState(state.left, cleaned, state.emit_overflow)

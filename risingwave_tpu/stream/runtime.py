@@ -43,6 +43,43 @@ class CheckpointSnapshot:
     source_state: dict
 
 
+def drain_agg_pending(fragment: Fragment, states, epoch_val):
+    """Re-flush until no agg dirty groups remain (emit-capacity spill)."""
+    outs = []
+    for i, ex in enumerate(fragment.executors):
+        if isinstance(ex, HashAggExecutor):
+            # one scalar readback per barrier; loops only under extreme
+            # dirty-set sizes
+            while int(ex.pending_dirty(states[i])) > 0:
+                states, emitted = fragment.flush(states, epoch_val)
+                outs.extend(emitted)
+    return states, outs
+
+
+def maintain_fragment(fragment: Fragment, states, name: str):
+    """Checkpoint-time housekeeping: rehash tombstone-heavy tables and
+    surface consistency violations (ref consistency_error!)."""
+    states = list(states)
+    for i, ex in enumerate(fragment.executors):
+        if hasattr(ex, "maybe_rehash"):
+            states[i] = ex.maybe_rehash(states[i])
+        check_state_counters(f"{name}/{ex}", states[i])
+    return tuple(states)
+
+
+def check_state_counters(name: str, st) -> None:
+    if hasattr(st, "inconsistency") and int(st.inconsistency) > 0:
+        raise RuntimeError(
+            f"{name}: {int(st.inconsistency)} inconsistent changelog rows "
+            "(deletes with no matching state)"
+        )
+    if hasattr(st, "overflow") and int(st.overflow) > 0:
+        raise RuntimeError(
+            f"{name}: state table overflow ({int(st.overflow)} rows "
+            "dropped) — increase table/bucket capacity"
+        )
+
+
 class StreamingJob:
     """A linear source → fragment pipeline driven by the barrier loop.
 
@@ -91,7 +128,11 @@ class StreamingJob:
                 if self.barriers_seen % self.checkpoint_frequency == 0
                 else BarrierKind.BARRIER
             )
-            barrier = Barrier(self.epoch, kind)
+            # the barrier SEALS the epoch data has been flowing in
+            # (epoch.curr) and opens the next one (ref EpochPair)
+            barrier = Barrier(
+                EpochPair(self.epoch.curr.next(), self.epoch.curr), kind
+            )
         if barrier.mutation is not None:
             self._apply_mutation(barrier.mutation)
 
@@ -105,40 +146,16 @@ class StreamingJob:
         if barrier.is_checkpoint:
             self._maintain()
             self._commit_checkpoint(barrier)
-        self.epoch = self.epoch.bump()
+        self.epoch = barrier.epoch
         return outs
 
     def _maintain(self) -> None:
-        """Checkpoint-time housekeeping: rehash tombstone-heavy tables,
-        surface consistency violations (ref consistency_error!)."""
-        states = list(self.states)
-        for i, ex in enumerate(self.fragment.executors):
-            if hasattr(ex, "maybe_rehash"):
-                states[i] = ex.maybe_rehash(states[i])
-            st = states[i]
-            if hasattr(st, "inconsistency") and int(st.inconsistency) > 0:
-                raise RuntimeError(
-                    f"{ex}: {int(st.inconsistency)} deletes hit a "
-                    "non-retractable (min/max) aggregate state"
-                )
-            if hasattr(st, "overflow") and int(st.overflow) > 0:
-                raise RuntimeError(
-                    f"{ex}: state table overflow ({int(st.overflow)} rows "
-                    "dropped) — increase table_size"
-                )
-        self.states = tuple(states)
+        self.states = maintain_fragment(self.fragment, self.states, self.name)
 
     def _drain_pending(self, epoch_val) -> list:
-        outs = []
-        for i, ex in enumerate(self.fragment.executors):
-            if isinstance(ex, HashAggExecutor):
-                # one scalar readback per barrier; loops only under
-                # extreme dirty-set sizes
-                while int(ex.pending_dirty(self.states[i])) > 0:
-                    self.states, emitted = self.fragment.flush(
-                        self.states, epoch_val
-                    )
-                    outs.extend(emitted)
+        self.states, outs = drain_agg_pending(
+            self.fragment, self.states, epoch_val
+        )
         return outs
 
     def _commit_checkpoint(self, barrier: Barrier) -> None:
@@ -186,3 +203,171 @@ class StreamingJob:
 
     def executor_state(self, idx: int):
         return self.states[idx]
+
+
+class BinaryJob:
+    """Two sources → per-side fragments → join → post fragment.
+
+    The reference runs a join as one actor whose two upstream inputs are
+    barrier-aligned by ``barrier_align.rs:44``; here alignment is the
+    host loop pulling both sides before each barrier, and the whole
+    per-chunk path (side fragment + join update/probe + post fragment)
+    is one jitted program per side.
+    """
+
+    def __init__(
+        self,
+        left_source,
+        right_source,
+        join,
+        post_fragment: Fragment,
+        left_fragment: Fragment | None = None,
+        right_fragment: Fragment | None = None,
+        checkpoint_frequency: int = 1,
+        name: str = "join_job",
+    ):
+        self.left_source = left_source
+        self.right_source = right_source
+        self.join = join
+        self.post = post_fragment
+        self.left_frag = left_fragment
+        self.right_frag = right_fragment
+        self.name = name
+        self.checkpoint_frequency = checkpoint_frequency
+        self.states = (
+            left_fragment.init_states() if left_fragment else (),
+            right_fragment.init_states() if right_fragment else (),
+            join.init_state(),
+            post_fragment.init_states(),
+        )
+        self.epoch = EpochPair.first()
+        self.barriers_seen = 0
+        self.checkpoints: list[CheckpointSnapshot] = []
+        self.committed_epoch = 0
+        self._step = {
+            "left": jax.jit(lambda st, ch: self._side_step(st, ch, "left")),
+            "right": jax.jit(lambda st, ch: self._side_step(st, ch, "right")),
+        }
+        # barrier-time feed: a side fragment's flush emissions cross the
+        # join and the post fragment exactly like steady-state chunks
+        self._feed = {
+            "left": jax.jit(lambda j, p, ch: self._feed_impl(j, p, ch, "left")),
+            "right": jax.jit(
+                lambda j, p, ch: self._feed_impl(j, p, ch, "right")
+            ),
+        }
+
+    def _side_step(self, states, chunk, side: str):
+        lstate, rstate, jstate, pstate = states
+        frag = self.left_frag if side == "left" else self.right_frag
+        if frag is not None:
+            if side == "left":
+                lstate, chunk = frag._step_impl(lstate, chunk)
+            else:
+                rstate, chunk = frag._step_impl(rstate, chunk)
+        if chunk is not None:
+            jstate, out = self.join.apply(jstate, chunk, side)
+            if out is not None:
+                pstate, _ = self.post._step_impl(pstate, out)
+        return (lstate, rstate, jstate, pstate)
+
+    def _feed_impl(self, jstate, pstate, chunk, side: str):
+        jstate, out = self.join.apply(jstate, chunk, side)
+        if out is not None:
+            pstate, _ = self.post._step_impl(pstate, out)
+        return jstate, pstate
+
+    def run_chunk(self, side: str) -> None:
+        source = self.left_source if side == "left" else self.right_source
+        chunk = source.next_chunk()
+        self.states = self._step[side](self.states, chunk)
+
+    def inject_barrier(self) -> None:
+        self.barriers_seen += 1
+        sealed = self.epoch.curr.value
+        lstate, rstate, jstate, pstate = self.states
+
+        # side fragments flush first; their emissions cross the join
+        for side, frag in (("left", self.left_frag),
+                           ("right", self.right_frag)):
+            if frag is None:
+                continue
+            st = lstate if side == "left" else rstate
+            st, outs = frag.flush(st, sealed)
+            st, more = drain_agg_pending(frag, st, sealed)
+            for out in list(outs) + list(more):
+                jstate, pstate = self._feed[side](jstate, pstate, out)
+            if side == "left":
+                lstate = st
+            else:
+                rstate = st
+
+        pstate, _ = self.post.flush(pstate, sealed)
+        pstate, _ = drain_agg_pending(self.post, pstate, sealed)
+        self.states = (lstate, rstate, jstate, pstate)
+
+        if self.barriers_seen % self.checkpoint_frequency == 0:
+            self._maintain()
+            lstate, rstate, jstate, pstate = self.states
+            snap = CheckpointSnapshot(
+                epoch=sealed,
+                states=jax.device_get(self.states),
+                source_state={
+                    "left": self.left_source.state()
+                    if hasattr(self.left_source, "state") else {},
+                    "right": self.right_source.state()
+                    if hasattr(self.right_source, "state") else {},
+                },
+            )
+            self.checkpoints = [snap]
+            self.committed_epoch = sealed
+        self.epoch = self.epoch.bump()
+
+    def _maintain(self) -> None:
+        lstate, rstate, jstate, pstate = self.states
+        if self.left_frag is not None:
+            lstate = maintain_fragment(
+                self.left_frag, lstate, f"{self.name}/left"
+            )
+        if self.right_frag is not None:
+            rstate = maintain_fragment(
+                self.right_frag, rstate, f"{self.name}/right"
+            )
+        check_state_counters(f"{self.name}/join.left", jstate.left)
+        check_state_counters(f"{self.name}/join.right", jstate.right)
+        if int(jstate.emit_overflow) > 0:
+            raise RuntimeError(
+                f"{self.name}: join emit overflow "
+                f"({int(jstate.emit_overflow)} matches dropped) — "
+                "increase out_capacity"
+            )
+        pstate = maintain_fragment(self.post, pstate, f"{self.name}/post")
+        self.states = (lstate, rstate, jstate, pstate)
+
+    def recover(self) -> None:
+        """Reset to the last committed checkpoint (ref §3.5)."""
+        if not self.checkpoints:
+            self.states = (
+                self.left_frag.init_states() if self.left_frag else (),
+                self.right_frag.init_states() if self.right_frag else (),
+                self.join.init_state(),
+                self.post.init_states(),
+            )
+            for src in (self.left_source, self.right_source):
+                if hasattr(src, "offset"):
+                    src.offset = 0
+            return
+        snap = self.checkpoints[-1]
+        self.states = jax.device_put(snap.states)
+        for side, src in (("left", self.left_source),
+                          ("right", self.right_source)):
+            st = snap.source_state.get(side, {})
+            if hasattr(src, "offset") and "offset" in st:
+                src.offset = st["offset"]
+
+    def run(self, barriers: int, chunks_per_barrier: int) -> None:
+        for _ in range(barriers):
+            for _ in range(chunks_per_barrier):
+                self.run_chunk("left")
+                self.run_chunk("right")
+            self.inject_barrier()

@@ -1,0 +1,255 @@
+"""Streaming hash-join tests (inner join, retraction, multiset)."""
+
+import numpy as np
+
+from risingwave_tpu.common.chunk import Chunk
+from risingwave_tpu.common.types import DataType, Schema
+from risingwave_tpu.expr.node import col
+from risingwave_tpu.stream.fragment import Fragment
+from risingwave_tpu.stream.hash_join import HashJoinExecutor
+from risingwave_tpu.stream.materialize import AppendOnlyMaterialize
+from risingwave_tpu.stream.runtime import BinaryJob
+
+L = Schema.of(("k", DataType.INT64), ("a", DataType.INT64))
+R = Schema.of(("k", DataType.INT64), ("b", DataType.INT64))
+
+
+def _join(**kw):
+    return HashJoinExecutor(
+        L, R, [col("k")], [col("k")],
+        table_size=64, bucket_cap=4, out_capacity=64, **kw,
+    )
+
+
+def _lc(text):
+    return Chunk.from_pretty(text, names=["k", "a"])
+
+
+def _rc(text):
+    return Chunk.from_pretty(text, names=["k", "b"])
+
+
+def _apply(j, st, chunk, side):
+    st, out = j.apply(st, chunk, side)
+    return st, sorted(out.to_rows())
+
+
+def test_inner_join_basic():
+    j = _join()
+    st = j.init_state()
+    st, rows = _apply(j, st, _lc("""
+        I I
+        + 1 10
+        + 2 20
+    """), "left")
+    assert rows == []  # right empty
+
+    st, rows = _apply(j, st, _rc("""
+        I I
+        + 1 100
+        + 1 101
+        + 3 300
+    """), "right")
+    # right rows probe left: k=1 matches once each
+    assert rows == [(0, 1, 10, 1, 100), (0, 1, 10, 1, 101)]
+
+    st, rows = _apply(j, st, _lc("""
+        I I
+        + 1 11
+    """), "left")
+    # new left row matches both right k=1 rows
+    assert rows == [(0, 1, 11, 1, 100), (0, 1, 11, 1, 101)]
+
+
+def test_join_retraction():
+    j = _join()
+    st = j.init_state()
+    st, _ = _apply(j, st, _lc("""
+        I I
+        + 1 10
+    """), "left")
+    st, _ = _apply(j, st, _rc("""
+        I I
+        + 1 100
+    """), "right")
+    # delete the left row: must retract the joined row
+    st, rows = _apply(j, st, _lc("""
+        I I
+        - 1 10
+    """), "left")
+    assert rows == [(1, 1, 10, 1, 100)]
+    # left side now empty: new right row matches nothing
+    st, rows = _apply(j, st, _rc("""
+        I I
+        + 1 101
+    """), "right")
+    assert rows == []
+
+
+def test_join_multiset_duplicates():
+    j = _join()
+    st = j.init_state()
+    # two identical left rows — multiset semantics
+    st, _ = _apply(j, st, _lc("""
+        I I
+        + 1 10
+        + 1 10
+    """), "left")
+    st, rows = _apply(j, st, _rc("""
+        I I
+        + 1 100
+    """), "right")
+    assert rows == [(0, 1, 10, 1, 100), (0, 1, 10, 1, 100)]
+    # delete ONE copy
+    st, rows = _apply(j, st, _lc("""
+        I I
+        - 1 10
+    """), "left")
+    assert rows == [(1, 1, 10, 1, 100)]
+    # one copy left
+    st, rows = _apply(j, st, _rc("""
+        I I
+        + 1 101
+    """), "right")
+    assert rows == [(0, 1, 10, 1, 101)]
+
+
+def test_join_delete_then_insert_same_chunk_reuses_hole():
+    j = _join()
+    st = j.init_state()
+    st, _ = _apply(j, st, _lc("""
+        I I
+        + 1 10
+        + 1 11
+        + 1 12
+        + 1 13
+    """), "left")  # bucket_cap=4: full
+    st, rows = _apply(j, st, _lc("""
+        I I
+        - 1 10
+        + 1 14
+    """), "left")
+    assert int(st.left.overflow) == 0  # hole reused, no overflow
+    assert int(st.left.count[np.argmax(st.left.count)]) == 4
+
+
+def test_join_state_cleaning():
+    j = _join()
+    st = j.init_state()
+    st, _ = _apply(j, st, _lc("""
+        I I
+        + 1 10
+        + 5 50
+    """), "left")
+    st = j.clean_below(st, "left", 0, 3)  # drop keys < 3
+    st, rows = _apply(j, st, _rc("""
+        I I
+        + 1 100
+        + 5 500
+    """), "right")
+    assert rows == [(0, 5, 50, 5, 500)]
+
+
+def test_binary_job_end_to_end():
+    class ListSource:
+        def __init__(self, chunks):
+            self.chunks = list(chunks)
+            self.i = 0
+
+        def next_chunk(self):
+            c = self.chunks[self.i % len(self.chunks)]
+            self.i += 1
+            return c
+
+    j = _join()
+    mv = AppendOnlyMaterialize(j.out_schema, ring_size=256)
+    job = BinaryJob(
+        ListSource([_lc("""
+            I I
+            + 1 10
+        """), _lc("""
+            I I
+            + 2 20
+        """)]),
+        ListSource([_rc("""
+            I I
+            + 1 100
+        """), _rc("""
+            I I
+            + 2 200
+        """)]),
+        j,
+        Fragment([mv]),
+    )
+    job.run(barriers=1, chunks_per_barrier=2)
+    rows = mv.to_host(job.states[3][0])
+    assert sorted(rows) == [(1, 10, 1, 100), (2, 20, 2, 200)]
+    assert job.committed_epoch > 0
+
+
+def test_join_insert_then_delete_same_chunk_annihilates():
+    """Regression: [+row, -row] in ONE chunk must not ghost-insert."""
+    j = _join()
+    st = j.init_state()
+    st, _ = _apply(j, st, _lc("""
+        I I
+        + 1 10
+        - 1 10
+    """), "left")
+    # left state must be empty: a new right row matches nothing
+    st, rows = _apply(j, st, _rc("""
+        I I
+        + 1 100
+    """), "right")
+    assert rows == []
+    assert int(st.left.inconsistency) == 0
+
+
+def test_join_delete_of_absent_key_no_ghost():
+    """Regression: deletes must not insert ghost keys into the table."""
+    j = _join()
+    st = j.init_state()
+    st, _ = _apply(j, st, _lc("""
+        I I
+        - 7 70
+    """), "left")
+    assert int(st.left.key_table.count()) == 0  # no ghost key slot
+    assert int(st.left.inconsistency) == 1      # surfaced, not silent
+
+
+def test_binary_job_recover():
+    class ReplaySource:
+        def __init__(self, chunks):
+            self.chunks = list(chunks)
+            self.offset = 0
+
+        def next_chunk(self):
+            c = self.chunks[self.offset % len(self.chunks)]
+            self.offset += 1
+            return c
+
+        def state(self):
+            return {"offset": self.offset}
+
+    j = _join()
+    mv = AppendOnlyMaterialize(j.out_schema, ring_size=256)
+    job = BinaryJob(
+        ReplaySource([_lc("""
+            I I
+            + 1 10
+        """)]),
+        ReplaySource([_rc("""
+            I I
+            + 1 100
+        """)]),
+        j, Fragment([mv]),
+    )
+    job.run(barriers=1, chunks_per_barrier=1)
+    committed = job.committed_epoch
+    n_rows = len(mv.to_host(job.states[3][0]))
+    # process more, then crash before the barrier
+    job.run_chunk("left")
+    job.recover()
+    assert job.left_source.offset == 1
+    assert len(mv.to_host(job.states[3][0])) == n_rows
+    assert job.committed_epoch == committed
