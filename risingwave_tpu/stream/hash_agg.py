@@ -91,10 +91,21 @@ class HashAggExecutor(Executor):
         aggs: Sequence[AggCall],
         table_size: int = 1 << 16,
         emit_capacity: int = 4096,
+        watermark_group_idx: int | None = None,
+        watermark_lag: int = 0,
+        watermark_src_col: int | None = None,
     ):
         super().__init__(in_schema)
         self.group_by = tuple(group_by)
         self.aggs = tuple(aggs)
+        #: when set, watermarks clean groups whose key[idx] < wm - lag
+        #: (lag = window size for tumble windows: a window closes when
+        #: the watermark passes window_start + size)
+        self.watermark_group_idx = watermark_group_idx
+        self.watermark_lag = watermark_lag
+        #: only react to Watermark messages with this source col_idx
+        #: (None = any — single-watermark fragments)
+        self.watermark_src_col = watermark_src_col
         self.table_size = table_size
         self.emit_capacity = emit_capacity
         key_fields = tuple(
@@ -284,6 +295,20 @@ class HashAggExecutor(Executor):
 
     def pending_dirty(self, state: AggState) -> jnp.ndarray:
         return jnp.sum(state.dirty.astype(jnp.int32))
+
+    # runtime drain protocol
+    pending_flush = pending_dirty
+
+    def on_watermark(self, state: AggState, watermark):
+        if self.watermark_group_idx is None:
+            return state
+        if (self.watermark_src_col is not None
+                and watermark.col_idx != self.watermark_src_col):
+            return state
+        return self.clean_below(
+            state, self.watermark_group_idx,
+            watermark.value - self.watermark_lag,
+        )
 
     def maybe_rehash(self, state: AggState) -> AggState:
         """Rebuild the group table once tombstones dominate (called by

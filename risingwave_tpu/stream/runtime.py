@@ -26,7 +26,6 @@ import jax
 from risingwave_tpu.common.epoch import EpochPair
 from risingwave_tpu.stream.fragment import Fragment
 from risingwave_tpu.stream.message import Barrier, BarrierKind
-from risingwave_tpu.stream.hash_agg import HashAggExecutor
 
 
 @dataclass
@@ -44,16 +43,36 @@ class CheckpointSnapshot:
 
 
 def drain_agg_pending(fragment: Fragment, states, epoch_val):
-    """Re-flush until no agg dirty groups remain (emit-capacity spill)."""
+    """Re-flush until nothing pending remains (emit-capacity spill).
+
+    Any executor exposing ``pending_flush(state) -> count`` participates
+    (hash agg dirty groups, EOWC closed rows, ...).
+    """
     outs = []
     for i, ex in enumerate(fragment.executors):
-        if isinstance(ex, HashAggExecutor):
+        if hasattr(ex, "pending_flush"):
             # one scalar readback per barrier; loops only under extreme
-            # dirty-set sizes
-            while int(ex.pending_dirty(states[i])) > 0:
+            # pending-set sizes
+            while int(ex.pending_flush(states[i])) > 0:
                 states, emitted = fragment.flush(states, epoch_val)
                 outs.extend(emitted)
     return states, outs
+
+
+def propagate_watermarks(fragment: Fragment, states):
+    """Read watermark generators (one scalar each), push the control
+    message through the fragment (ref watermark_filter.rs emission)."""
+    from risingwave_tpu.stream.message import Watermark
+    from risingwave_tpu.stream.watermark import WatermarkFilterExecutor
+
+    for i, ex in enumerate(fragment.executors):
+        if isinstance(ex, WatermarkFilterExecutor):
+            wm = ex.current_watermark(states[i])
+            if wm is not None:
+                states = fragment.on_watermark(
+                    states, Watermark(ex.ts_col, wm)
+                )
+    return states
 
 
 def maintain_fragment(fragment: Fragment, states, name: str):
@@ -143,11 +162,16 @@ class StreamingJob:
         # drain aggregations whose dirty set exceeded one emit chunk
         outs.extend(self._drain_pending(epoch_val))
 
+        # propagate watermarks, then re-drain: EOWC rows closed by THIS
+        # barrier's watermark must emit at this barrier, not the next
+        self.states = propagate_watermarks(self.fragment, self.states)
+        outs.extend(self._drain_pending(epoch_val))
         if barrier.is_checkpoint:
             self._maintain()
             self._commit_checkpoint(barrier)
         self.epoch = barrier.epoch
         return outs
+
 
     def _maintain(self) -> None:
         self.states = maintain_fragment(self.fragment, self.states, self.name)
@@ -303,6 +327,20 @@ class BinaryJob:
                 rstate = st
 
         pstate, _ = self.post.flush(pstate, sealed)
+        pstate, _ = drain_agg_pending(self.post, pstate, sealed)
+        # watermarks propagate within each fragment (cross-fragment /
+        # through-join propagation arrives with the graph scheduler)
+        if self.left_frag is not None:
+            lstate = propagate_watermarks(self.left_frag, lstate)
+            lstate, more = drain_agg_pending(self.left_frag, lstate, sealed)
+            for out in more:
+                jstate, pstate = self._feed["left"](jstate, pstate, out)
+        if self.right_frag is not None:
+            rstate = propagate_watermarks(self.right_frag, rstate)
+            rstate, more = drain_agg_pending(self.right_frag, rstate, sealed)
+            for out in more:
+                jstate, pstate = self._feed["right"](jstate, pstate, out)
+        pstate = propagate_watermarks(self.post, pstate)
         pstate, _ = drain_agg_pending(self.post, pstate, sealed)
         self.states = (lstate, rstate, jstate, pstate)
 

@@ -1,0 +1,180 @@
+"""Event-time machinery: watermark generation and emit-on-window-close.
+
+Reference counterparts:
+- ``WatermarkFilterExecutor`` — src/stream/src/executor/watermark_filter.rs
+  (generates watermarks from WATERMARK FOR definitions, drops late rows,
+  persists the low-watermark)
+- EOWC sort — src/stream/src/executor/eowc/sort.rs + sort_buffer.rs
+  (buffer until the watermark passes, emit append-only, clean state)
+- state cleaning — StateTable watermark hooks (state_table.rs:223)
+
+TPU-first design: the watermark itself is a device scalar updated inside
+the jitted step (a max-reduce fused into the chunk program); the host
+reads it once per barrier and propagates a ``Watermark`` control message
+through the fragment, which executors translate into vectorized
+``clean_below`` sweeps — per-key cleaning becomes one masked store.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from risingwave_tpu.common.chunk import Chunk, StrCol
+from risingwave_tpu.common.types import Schema
+from risingwave_tpu.stream.executor import Executor
+from risingwave_tpu.stream.message import Watermark
+from risingwave_tpu.stream.top_n import _empty_like_col, _gather, _scatter
+
+
+class WmState(NamedTuple):
+    max_ts: jnp.ndarray   # int64 scalar — highest event time seen
+    late_rows: jnp.ndarray  # int64 — rows dropped as late
+
+
+class WatermarkFilterExecutor(Executor):
+    """Generate watermarks from an event-time column; drop late rows.
+
+    ``delay_us`` is the out-of-orderness allowance (the reference's
+    WATERMARK FOR ts AS ts - INTERVAL ...).
+    """
+
+    emits_on_apply = True
+    emits_on_flush = False
+
+    def __init__(self, in_schema: Schema, ts_col: int, delay_us: int):
+        super().__init__(in_schema)
+        self.ts_col = ts_col
+        self.delay_us = delay_us
+
+    def init_state(self) -> WmState:
+        return WmState(
+            max_ts=jnp.asarray(np.iinfo(np.int64).min, jnp.int64),
+            late_rows=jnp.zeros((), jnp.int64),
+        )
+
+    def apply(self, state: WmState, chunk: Chunk):
+        ts = chunk.column(self.ts_col)
+        no_wm = state.max_ts == np.iinfo(np.int64).min
+        # guard the initial state: INT64_MIN - delay would wrap positive
+        wm = jnp.where(no_wm, state.max_ts, state.max_ts - self.delay_us)
+        late = chunk.valid & (ts < wm)
+        new_max = jnp.maximum(
+            state.max_ts,
+            jnp.max(jnp.where(chunk.valid, ts, np.iinfo(np.int64).min)),
+        )
+        return WmState(
+            max_ts=new_max,
+            late_rows=state.late_rows + jnp.sum(late.astype(jnp.int64)),
+        ), chunk.mask(~late)
+
+    # -- host API (read once per barrier) -------------------------------
+    def current_watermark(self, state: WmState) -> int | None:
+        v = int(state.max_ts)
+        if v == np.iinfo(np.int64).min:
+            return None
+        return v - self.delay_us
+
+
+class EowcSortState(NamedTuple):
+    rows: tuple
+    valid: jnp.ndarray
+    wm: jnp.ndarray  # int64 — latest watermark received
+    overflow: jnp.ndarray  # int64 — rows dropped with the pool full
+
+
+class EowcSortExecutor(Executor):
+    """Buffer rows, emit them in order once the watermark passes.
+
+    ref eowc/sort.rs: turns an out-of-order append-only stream into an
+    in-order append-only stream (the basis of EOWC aggregations).
+    """
+
+    emits_on_apply = False
+    emits_on_flush = True
+
+    def __init__(self, in_schema: Schema, ts_col: int,
+                 pool_size: int = 8192, emit_capacity: int = 4096):
+        super().__init__(in_schema)
+        self.ts_col = ts_col
+        self.pool_size = pool_size
+        self.emit_capacity = emit_capacity
+
+    def init_state(self) -> EowcSortState:
+        protos = []
+        for f in self.in_schema:
+            if f.data_type.is_string:
+                protos.append(StrCol(
+                    jnp.zeros((1, f.str_width), jnp.uint8),
+                    jnp.zeros((1,), jnp.int32),
+                ))
+            else:
+                protos.append(jnp.zeros((1,), f.data_type.physical_dtype))
+        S = self.pool_size
+        return EowcSortState(
+            rows=tuple(_empty_like_col(p, S) for p in protos),
+            valid=jnp.zeros((S,), jnp.bool_),
+            wm=jnp.asarray(np.iinfo(np.int64).min, jnp.int64),
+            overflow=jnp.zeros((), jnp.int64),
+        )
+
+    def apply(self, state: EowcSortState, chunk: Chunk):
+        S = self.pool_size
+        cap = chunk.capacity
+        from risingwave_tpu.stream.hash_join import _rank_by
+        is_ins = chunk.valid  # append-only input
+        free = ~state.valid
+        free_pos = jnp.cumsum(free) - 1
+        slot_of_rank = jnp.full((S,), S, jnp.int32).at[
+            jnp.where(free, free_pos.astype(jnp.int32), S)
+        ].min(jnp.arange(S, dtype=jnp.int32), mode="drop")
+        ins_rank = _rank_by(jnp.zeros((cap,), jnp.uint64), is_ins)
+        tgt = jnp.where(
+            is_ins & (ins_rank < S),
+            slot_of_rank[jnp.minimum(ins_rank, S - 1)],
+            jnp.int32(S),
+        )
+        got = is_ins & (tgt < S)
+        valid = state.valid.at[jnp.where(got, tgt, S)].set(True, mode="drop")
+        rows = tuple(
+            _scatter(store, jnp.where(got, tgt, S), col)
+            for store, col in zip(state.rows, chunk.columns)
+        )
+        n_over = jnp.sum((is_ins & ~got).astype(jnp.int64))
+        return EowcSortState(
+            rows, valid, state.wm, state.overflow + n_over
+        ), None
+
+    def on_watermark(self, state: EowcSortState, watermark: Watermark):
+        if watermark.col_idx != self.ts_col:
+            return state
+        return EowcSortState(
+            state.rows, state.valid,
+            jnp.maximum(state.wm, jnp.int64(watermark.value)),
+            state.overflow,
+        )
+
+    def flush(self, state: EowcSortState, epoch):
+        S, E = self.pool_size, self.emit_capacity
+        ts = state.rows[self.ts_col]
+        closed = state.valid & (ts < state.wm)
+        # emit in timestamp order: sort closed rows by ts
+        sort_key = jnp.where(closed, ts, np.iinfo(np.int64).max)
+        order = jnp.argsort(sort_key, stable=True)
+        take = order[:E]
+        live = closed[take]
+        out_cols = tuple(_gather(c, take) for c in state.rows)
+        out = Chunk(
+            out_cols, jnp.zeros((E,), jnp.int8), live, self.in_schema
+        )
+        emitted = jnp.zeros((S,), jnp.bool_).at[take].set(live)
+        return EowcSortState(
+            state.rows, state.valid & ~emitted, state.wm, state.overflow
+        ), out
+
+    def pending_flush(self, state: EowcSortState) -> jnp.ndarray:
+        ts = state.rows[self.ts_col]
+        return jnp.sum((state.valid & (ts < state.wm)).astype(jnp.int32))
