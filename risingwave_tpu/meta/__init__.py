@@ -1,0 +1,11 @@
+"""Control plane: catalog, job management.
+
+Reference counterpart: ``src/meta`` (SURVEY.md §2.4) — collapsed to a
+single-process control plane in round 1: the catalog is in-memory, the
+barrier scheduler is the engine's run loop, and recovery restores jobs
+from their checkpoint snapshots.
+"""
+
+from risingwave_tpu.meta.catalog import Catalog, CatalogEntry
+
+__all__ = ["Catalog", "CatalogEntry"]

@@ -1,0 +1,12 @@
+"""SQL frontend: parser → binder/planner → streaming jobs.
+
+Reference counterparts: ``src/sqlparser`` (hand-written recursive-descent
+Postgres-dialect parser), ``src/frontend`` (binder, planner, optimizer,
+stream fragmenter).  This frontend targets the streaming-SQL surface the
+benchmarks exercise (CREATE SOURCE/MV, windowed aggregation, joins,
+TopN) and widens round over round.
+"""
+
+from risingwave_tpu.sql.engine import Engine
+
+__all__ = ["Engine"]

@@ -1,0 +1,178 @@
+"""SQL AST nodes.
+
+Reference counterpart: ``src/sqlparser/src/ast/`` — pared down to the
+streaming surface this frontend implements.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+
+# -- expressions ------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ColumnRef:
+    name: str
+    table: str | None = None
+
+
+@dataclass(frozen=True)
+class Literal:
+    value: Any
+    type_name: str  # "int" | "float" | "string" | "bool" | "interval"
+
+
+@dataclass(frozen=True)
+class IntervalLit:
+    micros: int
+
+
+@dataclass(frozen=True)
+class BinaryOp:
+    op: str
+    left: Any
+    right: Any
+
+
+@dataclass(frozen=True)
+class UnaryOp:
+    op: str
+    operand: Any
+
+
+@dataclass(frozen=True)
+class FuncCall:
+    name: str
+    args: tuple
+    distinct: bool = False
+
+
+@dataclass(frozen=True)
+class Cast:
+    operand: Any
+    type_name: str
+
+
+@dataclass(frozen=True)
+class Case:
+    conditions: tuple  # (cond, result) pairs
+    else_result: Any
+
+
+@dataclass(frozen=True)
+class Star:
+    pass
+
+
+# -- query ------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class SelectItem:
+    expr: Any
+    alias: str | None
+
+
+@dataclass(frozen=True)
+class TableRef:
+    name: str
+    alias: str | None = None
+
+
+@dataclass(frozen=True)
+class Tumble:
+    """TUMBLE(table, time_col, interval) table function in FROM."""
+
+    table: TableRef
+    time_col: str
+    size: IntervalLit
+    alias: str | None = None
+
+
+@dataclass(frozen=True)
+class Hop:
+    """HOP(table, time_col, slide, size)."""
+
+    table: TableRef
+    time_col: str
+    slide: IntervalLit
+    size: IntervalLit
+    alias: str | None = None
+
+
+@dataclass(frozen=True)
+class Join:
+    left: Any
+    right: Any
+    on: Any
+    kind: str = "inner"
+
+
+@dataclass(frozen=True)
+class OrderItem:
+    expr: Any
+    descending: bool
+
+
+@dataclass(frozen=True)
+class Select:
+    items: tuple[SelectItem, ...]
+    from_: Any  # TableRef | Tumble | Hop | Join | None
+    where: Any = None
+    group_by: tuple = ()
+    having: Any = None
+    order_by: tuple[OrderItem, ...] = ()
+    limit: int | None = None
+    offset: int | None = None
+
+
+# -- statements -------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ColumnDef:
+    name: str
+    type_name: str
+
+
+@dataclass(frozen=True)
+class WatermarkDef:
+    column: str
+    delay: IntervalLit
+
+
+@dataclass(frozen=True)
+class CreateSource:
+    name: str
+    columns: tuple[ColumnDef, ...]
+    watermark: WatermarkDef | None
+    with_options: dict
+    if_not_exists: bool = False
+
+
+@dataclass(frozen=True)
+class CreateMaterializedView:
+    name: str
+    query: Select
+    if_not_exists: bool = False
+    emit_on_window_close: bool = False
+
+
+@dataclass(frozen=True)
+class DropStatement:
+    kind: str  # "source" | "materialized view" | "table"
+    name: str
+    if_exists: bool = False
+
+
+@dataclass(frozen=True)
+class ShowStatement:
+    kind: str  # "sources" | "materialized views" | "tables"
+
+
+@dataclass(frozen=True)
+class FlushStatement:
+    pass

@@ -1,0 +1,134 @@
+"""Binder: SQL AST expressions → typed engine expressions.
+
+Reference counterpart: ``src/frontend/src/binder/`` — name resolution
+against the in-scope schema, type derivation, agg-call extraction.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from risingwave_tpu.common.types import DataType, Schema
+from risingwave_tpu.expr import agg as agg_mod
+from risingwave_tpu.expr.node import (
+    Expr,
+    FuncCall as EFuncCall,
+    InputRef,
+    Literal as ELiteral,
+    as_expr,
+)
+from risingwave_tpu.sql import ast
+
+AGG_NAMES = {"count", "sum", "avg", "min", "max"}
+
+
+class BindError(ValueError):
+    pass
+
+
+@dataclass
+class Scope:
+    """Visible columns: (qualifier, name) -> input position."""
+
+    schema: Schema
+    qualifiers: tuple  # per-column table qualifier (or None)
+
+    @staticmethod
+    def of(schema: Schema, qualifier: str | None = None) -> "Scope":
+        return Scope(schema, tuple(qualifier for _ in schema))
+
+    def concat(self, other: "Scope") -> "Scope":
+        return Scope(
+            self.schema.concat(other.schema),
+            self.qualifiers + other.qualifiers,
+        )
+
+    def resolve(self, name: str, table: str | None) -> int:
+        hits = [
+            i for i, (f, q) in enumerate(zip(self.schema, self.qualifiers))
+            if f.name == name and (table is None or q == table)
+        ]
+        if not hits:
+            raise BindError(f"column {table + '.' if table else ''}{name} "
+                            "not found")
+        if len(hits) > 1:
+            raise BindError(f"column {name} is ambiguous")
+        return hits[0]
+
+
+class Binder:
+    """Binds scalar expressions; collects aggregate calls when allowed."""
+
+    def __init__(self, scope: Scope, allow_aggs: bool = False):
+        self.scope = scope
+        self.allow_aggs = allow_aggs
+        self.agg_calls: list[agg_mod.AggCall] = []
+
+    def bind(self, e) -> Expr:
+        if isinstance(e, ast.ColumnRef):
+            return InputRef(self.scope.resolve(e.name, e.table))
+        if isinstance(e, ast.Literal):
+            if e.type_name == "string":
+                return ELiteral(e.value, DataType.VARCHAR)
+            if e.type_name == "bool":
+                return ELiteral(e.value, DataType.BOOLEAN)
+            if e.type_name == "float":
+                return ELiteral(e.value, DataType.FLOAT64)
+            if e.type_name == "int":
+                return as_expr(e.value)
+            raise BindError(f"unsupported literal {e}")
+        if isinstance(e, ast.IntervalLit):
+            return ELiteral(e.micros, DataType.INTERVAL)
+        if isinstance(e, ast.UnaryOp):
+            return EFuncCall(e.op, (self.bind(e.operand),))
+        if isinstance(e, ast.BinaryOp):
+            return EFuncCall(e.op, (self.bind(e.left), self.bind(e.right)))
+        if isinstance(e, ast.Cast):
+            t = DataType.from_sql(e.type_name)
+            return EFuncCall(f"cast_{t.name.lower()}", (self.bind(e.operand),))
+        if isinstance(e, ast.Case):
+            if e.else_result is None:
+                raise BindError(
+                    "CASE without ELSE yields NULL; NULL columns land "
+                    "with the validity-bitmap round — add an ELSE branch"
+                )
+            out = self.bind(e.else_result)
+            for c, r in reversed(e.conditions):
+                out = EFuncCall("case", (self.bind(c), self.bind(r), out))
+            return out
+        if isinstance(e, ast.FuncCall):
+            if e.name in AGG_NAMES:
+                return self._bind_agg(e)
+            args = tuple(self.bind(a) for a in e.args)
+            return EFuncCall(e.name, args)
+        raise BindError(f"cannot bind {e!r}")
+
+    def _bind_agg(self, e: ast.FuncCall) -> Expr:
+        if not self.allow_aggs:
+            raise BindError(f"aggregate {e.name} not allowed here")
+        if e.distinct:
+            raise BindError("DISTINCT aggregates not yet supported")
+        if e.name == "count" and (not e.args or
+                                  isinstance(e.args[0], ast.Star)):
+            call = agg_mod.AggCall("count_star", None)
+        else:
+            arg = self.bind(e.args[0])
+            call = agg_mod.AggCall(e.name, arg)
+        self.agg_calls.append(call)
+        # placeholder referencing the agg output (resolved by the planner:
+        # agg outputs are appended after the group keys)
+        return AggRef(len(self.agg_calls) - 1, call)
+
+
+@dataclass(frozen=True, eq=False)
+class AggRef(Expr):
+    """A reference to the i-th aggregate output (planner placeholder)."""
+
+    index: int
+    call: agg_mod.AggCall
+
+    def return_field(self, schema):
+        return self.call.out_field(schema)
+
+    def eval(self, chunk):  # pragma: no cover - replaced by planner
+        raise RuntimeError("AggRef must be rewritten by the planner")

@@ -1,0 +1,345 @@
+"""The single-process SQL engine: DDL, streaming jobs, serving reads.
+
+Reference counterparts: the frontend ``handler`` dispatch
+(src/frontend/src/handler/mod.rs:278), meta's DDL controller + barrier
+scheduler (SURVEY.md §2.4), and the batch local-execution mode
+(src/frontend/src/scheduler/local.rs:60) — collapsed into one object:
+
+    eng = Engine()
+    eng.execute("CREATE SOURCE bid (...) WITH (connector='nexmark', ...)")
+    eng.execute("CREATE MATERIALIZED VIEW v AS SELECT ...")
+    eng.tick(barriers=5)          # the global barrier loop
+    eng.execute("SELECT * FROM v ORDER BY x LIMIT 10")   # serving read
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import numpy as np
+
+from risingwave_tpu.common.chunk import Chunk
+from risingwave_tpu.common.types import DataType, Field, Schema
+from risingwave_tpu.connector.nexmark import (
+    AUCTION_SCHEMA,
+    BID_SCHEMA,
+    PERSON_SCHEMA,
+    NexmarkConfig,
+    NexmarkGenerator,
+    NexmarkSplitReader,
+)
+from risingwave_tpu.meta.catalog import Catalog, CatalogEntry
+from risingwave_tpu.sql import ast
+from risingwave_tpu.sql.binder import Binder, Scope
+from risingwave_tpu.sql.parser import parse
+from risingwave_tpu.sql.planner import (
+    JoinPlan,
+    PlanError,
+    Planner,
+    PlannerConfig,
+    UnaryPlan,
+)
+from risingwave_tpu.stream.runtime import BinaryJob, StreamingJob
+
+
+class Engine:
+    def __init__(self, config: PlannerConfig | None = None):
+        self.catalog = Catalog()
+        self.config = config or PlannerConfig()
+        self.planner = Planner(self.catalog, self.config)
+        self.jobs: list[Any] = []
+
+    # ------------------------------------------------------------------
+    def execute(self, sql: str):
+        """Run one or more statements; returns the last result."""
+        result = None
+        for stmt in parse(sql):
+            result = self._execute_one(stmt)
+        return result
+
+    def _execute_one(self, stmt):
+        if isinstance(stmt, ast.CreateSource):
+            return self._create_source(stmt)
+        if isinstance(stmt, ast.CreateMaterializedView):
+            return self._create_mview(stmt)
+        if isinstance(stmt, ast.DropStatement):
+            entry = self.catalog.get(stmt.name) \
+                if stmt.name in self.catalog else None
+            if entry is not None:
+                want = {"source": "source", "table": "source",
+                        "materialized view": "mview"}[stmt.kind]
+                if entry.kind != want:
+                    raise ValueError(
+                        f"{stmt.name} is a {entry.kind}, not a {want}"
+                    )
+                if entry.job is not None:
+                    self.jobs.remove(entry.job)
+            self.catalog.drop(stmt.name, stmt.if_exists)
+            return None
+        if isinstance(stmt, ast.ShowStatement):
+            kind = {"sources": "source", "tables": "source",
+                    "materialized views": "mview"}.get(stmt.kind)
+            return [(e.name,) for e in self.catalog.list(kind)]
+        if isinstance(stmt, ast.FlushStatement):
+            self.tick(barriers=1, chunks_per_barrier=0)
+            return None
+        if isinstance(stmt, ast.Select):
+            return self._serve(stmt)
+        raise ValueError(f"unhandled statement {stmt!r}")
+
+    # -- DDL -------------------------------------------------------------
+    def _create_source(self, stmt: ast.CreateSource):
+        connector = stmt.with_options.get("connector")
+        if connector == "nexmark":
+            entry = self._nexmark_source(stmt)
+        elif connector == "datagen":
+            entry = self._datagen_source(stmt)
+        else:
+            raise ValueError(f"unsupported connector {connector!r} "
+                             "(nexmark, datagen available this round)")
+        self.catalog.create(entry, stmt.if_not_exists)
+        return None
+
+    def _nexmark_source(self, stmt: ast.CreateSource) -> CatalogEntry:
+        opts = stmt.with_options
+        table = opts.get("nexmark.table", stmt.name)
+        base = {"bid": BID_SCHEMA, "auction": AUCTION_SCHEMA,
+                "person": PERSON_SCHEMA}[table]
+        # declared columns select/reorder the generator's columns
+        if stmt.columns:
+            idxs = []
+            fields = []
+            for c in stmt.columns:
+                i = base.index_of(c.name)
+                idxs.append(i)
+                fields.append(base[i])
+            schema = Schema(tuple(fields))
+        else:
+            idxs = list(range(len(base)))
+            schema = base
+        rate = int(opts.get("nexmark.event.rate", "100000"))
+        inter_us = max(1_000_000 // max(rate, 1), 1)
+        gen_config = NexmarkConfig(inter_event_us=inter_us)
+        cap = self.config.chunk_capacity
+
+        def factory(split_id: int = 0, num_splits: int = 1):
+            reader = NexmarkSplitReader(
+                table, NexmarkGenerator(gen_config), chunk_capacity=cap,
+                split_id=split_id, num_splits=num_splits,
+            )
+            if idxs == list(range(len(base))):
+                return reader
+            return _ProjectingReader(reader, idxs, schema)
+
+        wm = None
+        if stmt.watermark is not None:
+            wm = (schema.index_of(stmt.watermark.column),
+                  stmt.watermark.delay.micros)
+        return CatalogEntry(
+            stmt.name, "source", schema, reader_factory=factory,
+            watermark=wm, append_only=True, definition=str(stmt),
+        )
+
+    def _datagen_source(self, stmt: ast.CreateSource) -> CatalogEntry:
+        fields = tuple(
+            Field(c.name, DataType.from_sql(c.type_name))
+            for c in stmt.columns
+        )
+        schema = Schema(fields)
+        cap = self.config.chunk_capacity
+
+        def factory(split_id: int = 0, num_splits: int = 1):
+            return _DatagenReader(schema, cap, split_id, num_splits)
+
+        wm = None
+        if stmt.watermark is not None:
+            wm = (schema.index_of(stmt.watermark.column),
+                  stmt.watermark.delay.micros)
+        return CatalogEntry(
+            stmt.name, "source", schema, reader_factory=factory,
+            watermark=wm, append_only=True, definition=str(stmt),
+        )
+
+    def _create_mview(self, stmt: ast.CreateMaterializedView):
+        plan = self.planner.plan(stmt.query)
+        if isinstance(plan, UnaryPlan):
+            job = StreamingJob(plan.reader, plan.fragment, stmt.name)
+            mv_exec = plan.fragment.executors[plan.mv_index]
+            state_index = (plan.mv_index,)
+        else:
+            job = BinaryJob(
+                plan.left_reader, plan.right_reader, plan.join,
+                plan.post_fragment,
+                left_fragment=plan.left_fragment,
+                right_fragment=plan.right_fragment,
+                name=stmt.name,
+            )
+            mv_exec = plan.post_fragment.executors[plan.mv_index]
+            state_index = (3, plan.mv_index)
+        entry = CatalogEntry(
+            stmt.name, "mview", mv_exec.in_schema,
+            job=job, mv_executor=mv_exec, mv_state_index=state_index,
+            definition=str(stmt),
+        )
+        created = self.catalog.create(entry, stmt.if_not_exists)
+        if created:
+            self.jobs.append(job)
+        return None
+
+    # -- the global barrier loop ----------------------------------------
+    def tick(self, barriers: int = 1, chunks_per_barrier: int = 1) -> None:
+        """Advance every streaming job (meta's PeriodicBarriers analog)."""
+        for _ in range(barriers):
+            for job in self.jobs:
+                if isinstance(job, BinaryJob):
+                    for _ in range(chunks_per_barrier):
+                        job.run_chunk("left")
+                        job.run_chunk("right")
+                else:
+                    for _ in range(chunks_per_barrier):
+                        job.run_chunk()
+                job.inject_barrier()
+
+    # -- serving reads ---------------------------------------------------
+    def _mv_rows(self, entry: CatalogEntry):
+        idx = entry.mv_state_index
+        state = entry.job.states
+        for i in idx:
+            state = state[i]
+        return entry.mv_executor.to_host(state)
+
+    def _serve(self, select: ast.Select):
+        """Batch read over a materialized view (local execution mode)."""
+        if not isinstance(select.from_, ast.TableRef):
+            raise PlanError("serving reads support SELECT ... FROM <mv>")
+        entry = self.catalog.get(select.from_.name)
+        if entry.kind != "mview":
+            raise PlanError("serving reads are over materialized views; "
+                            "streaming queries use CREATE MATERIALIZED VIEW")
+        rows = self._mv_rows(entry)
+        schema = entry.schema
+        # rebuild a host chunk and evaluate the residual query eagerly
+        if rows:
+            arrays = [np.asarray([r[i] for r in rows])
+                      for i in range(len(schema))]
+        else:
+            arrays = [np.zeros((0,), np.int64) for _ in range(len(schema))]
+        chunk = Chunk.from_numpy(schema, arrays, capacity=max(len(rows), 1))
+        scope = Scope.of(schema, select.from_.alias or select.from_.name)
+        if select.where is not None:
+            keep = Binder(scope).bind(select.where).eval(chunk)
+            chunk = chunk.mask(keep)
+        items = self.planner._expand_items(select.items, scope)
+        b = Binder(scope)
+        out_cols = []
+        bound_fields = []
+        for name, e in items:
+            be = b.bind(e)
+            out_cols.append(be.eval(chunk))
+            f = be.return_field(schema)
+            bound_fields.append(Field(
+                name, f.data_type, str_width=f.str_width,
+                decimal_scale=f.decimal_scale,
+            ))
+        out_chunk = chunk.with_columns(out_cols, Schema(tuple(bound_fields)))
+        _, cols, _ = out_chunk.to_host()
+        result = [tuple(c[i] for c in cols) for i in range(len(cols[0]))] \
+            if cols else []
+        # ORDER BY / LIMIT / OFFSET host-side (python sort: handles
+        # strings and any comparable type, stable for multi-key)
+        if select.order_by:
+            out_scope = Scope.of(out_chunk.schema)
+            ob = Binder(out_scope)
+            for oi in reversed(select.order_by):
+                key = self.planner._bind_order_key(
+                    oi.expr, ob, out_chunk.schema
+                )
+                kchunk = out_chunk  # keys evaluate over the output rows
+                vals = key.eval(kchunk)
+                from risingwave_tpu.common.chunk import StrCol, decode_strings
+                vis = np.asarray(kchunk.valid)
+                if isinstance(vals, StrCol):
+                    host = decode_strings(
+                        np.asarray(vals.data)[vis], np.asarray(vals.lens)[vis]
+                    ).tolist()
+                else:
+                    host = np.asarray(vals)[vis].tolist()
+                order = sorted(
+                    range(len(result)), key=lambda i: host[i],
+                    reverse=oi.descending,
+                )
+                result = [result[i] for i in order]
+                # keep key/rows aligned for the next (outer) key pass
+                host_sorted = [host[i] for i in order]
+                host = host_sorted
+        if select.offset:
+            result = result[select.offset:]
+        if select.limit is not None:
+            result = result[:select.limit]
+        return result
+
+
+class _ProjectingReader:
+    """Column-projecting wrapper over a source reader."""
+
+    def __init__(self, inner, idxs: Sequence[int], schema: Schema):
+        self.inner = inner
+        self.idxs = list(idxs)
+        self.schema = schema
+
+    def next_chunk(self) -> Chunk:
+        return self.inner.next_chunk().project(self.idxs)
+
+    @property
+    def offset(self):
+        return self.inner.offset
+
+    @offset.setter
+    def offset(self, v):
+        self.inner.offset = v
+
+    def state(self):
+        return self.inner.state()
+
+
+class _DatagenReader:
+    """Deterministic generator for declared columns (ref datagen source)."""
+
+    def __init__(self, schema: Schema, cap: int, split_id: int,
+                 num_splits: int):
+        self.schema = schema
+        self.cap = cap
+        self.split_id = split_id
+        self.num_splits = num_splits
+        self.offset = 0
+
+    def next_chunk(self) -> Chunk:
+        import jax.numpy as jnp
+
+        base = self.offset * self.num_splits + self.split_id * self.cap
+        k = base + np.arange(self.cap, dtype=np.int64)
+        cols = []
+        for f in self.schema:
+            t = f.data_type
+            if t.is_string:
+                from risingwave_tpu.common.chunk import StrCol, encode_strings
+                data, lens = encode_strings(
+                    [f"{f.name}_{int(v) % 1000}" for v in k], f.str_width
+                )
+                cols.append(StrCol(jnp.asarray(data), jnp.asarray(lens)))
+            elif t in (DataType.FLOAT32, DataType.FLOAT64):
+                cols.append(jnp.asarray(
+                    (k % 1000).astype(np.float64) / 10.0, t.physical_dtype
+                ))
+            else:
+                cols.append(jnp.asarray(k, t.physical_dtype))
+        self.offset += self.cap
+        return Chunk(
+            tuple(cols),
+            jnp.zeros((self.cap,), jnp.int8),
+            jnp.ones((self.cap,), jnp.bool_),
+            self.schema,
+        )
+
+    def state(self):
+        return {"offset": self.offset, "split_id": self.split_id}

@@ -1,0 +1,514 @@
+"""Hand-written recursive-descent SQL parser (Postgres dialect subset).
+
+Reference counterpart: ``src/sqlparser/src/parser.rs`` — same approach
+(tokenizer + recursive descent with precedence climbing), scoped to the
+streaming benchmark surface: CREATE SOURCE / CREATE MATERIALIZED VIEW /
+SELECT with windows (TUMBLE/HOP), joins, aggregation, TopN, casts,
+CASE, intervals.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+from risingwave_tpu.sql import ast
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+)
+  | (?P<comment>--[^\n]*)
+  | (?P<number>\d+\.\d+|\.\d+|\d+)
+  | (?P<string>'(?:[^']|'')*')
+  | (?P<cast>::)
+  | (?P<op><=|>=|<>|!=|\|\||[-+*/%<>=(),.;])
+  | (?P<ident>[A-Za-z_][A-Za-z0-9_]*|"[^"]+")
+    """,
+    re.VERBOSE,
+)
+
+_INTERVAL_UNITS = {
+    "second": 1_000_000, "seconds": 1_000_000,
+    "minute": 60_000_000, "minutes": 60_000_000,
+    "hour": 3_600_000_000, "hours": 3_600_000_000,
+    "day": 86_400_000_000, "days": 86_400_000_000,
+    "millisecond": 1_000, "milliseconds": 1_000,
+}
+
+
+class Token:
+    __slots__ = ("kind", "value")
+
+    def __init__(self, kind: str, value: str):
+        self.kind = kind
+        self.value = value
+
+    def __repr__(self):
+        return f"{self.kind}:{self.value}"
+
+
+def tokenize(sql: str) -> list[Token]:
+    out = []
+    pos = 0
+    while pos < len(sql):
+        m = _TOKEN_RE.match(sql, pos)
+        if not m:
+            raise ParseError(f"unexpected character {sql[pos]!r} at {pos}")
+        pos = m.end()
+        kind = m.lastgroup
+        if kind in ("ws", "comment"):
+            continue
+        text = m.group()
+        if kind == "ident" and not text.startswith('"'):
+            out.append(Token("word", text.lower()))
+        elif kind == "ident":
+            out.append(Token("word", text[1:-1]))
+        else:
+            out.append(Token(kind, text))
+    return out
+
+
+class ParseError(ValueError):
+    pass
+
+
+# operator precedence (higher binds tighter)
+_PRECEDENCE = {
+    "or": 1, "and": 2,
+    "=": 4, "<>": 4, "!=": 4, "<": 4, "<=": 4, ">": 4, ">=": 4,
+    "+": 6, "-": 6, "||": 6,
+    "*": 7, "/": 7, "%": 7,
+}
+
+_BIN_NAMES = {
+    "=": "equal", "<>": "not_equal", "!=": "not_equal",
+    "<": "less_than", "<=": "less_than_or_equal",
+    ">": "greater_than", ">=": "greater_than_or_equal",
+    "+": "add", "-": "subtract", "*": "multiply", "/": "divide",
+    "%": "modulus", "and": "and", "or": "or", "||": "concat",
+}
+
+
+class Parser:
+    def __init__(self, sql: str):
+        self.tokens = tokenize(sql)
+        self.i = 0
+
+    # -- token helpers --------------------------------------------------
+    def peek(self, offset: int = 0) -> Token | None:
+        j = self.i + offset
+        return self.tokens[j] if j < len(self.tokens) else None
+
+    def next(self) -> Token:
+        t = self.peek()
+        if t is None:
+            raise ParseError("unexpected end of input")
+        self.i += 1
+        return t
+
+    def accept_word(self, *words: str) -> bool:
+        t = self.peek()
+        if t and t.kind == "word" and t.value in words:
+            self.i += 1
+            return True
+        return False
+
+    def expect_word(self, word: str) -> None:
+        t = self.next()
+        if t.kind != "word" or t.value != word:
+            raise ParseError(f"expected {word.upper()}, got {t.value!r}")
+
+    def accept_op(self, op: str) -> bool:
+        t = self.peek()
+        if t and t.kind in ("op", "cast") and t.value == op:
+            self.i += 1
+            return True
+        return False
+
+    def expect_op(self, op: str) -> None:
+        t = self.next()
+        if t.value != op:
+            raise ParseError(f"expected {op!r}, got {t.value!r}")
+
+    def ident(self) -> str:
+        t = self.next()
+        if t.kind != "word":
+            raise ParseError(f"expected identifier, got {t.value!r}")
+        return t.value
+
+    # -- entry ----------------------------------------------------------
+    def parse_statement(self):
+        if self.accept_word("create"):
+            return self._create()
+        if self.accept_word("drop"):
+            return self._drop()
+        if self.accept_word("show"):
+            kind = self.ident()
+            if kind == "materialized":
+                self.expect_word("views")
+                kind = "materialized views"
+            return ast.ShowStatement(kind)
+        if self.accept_word("flush"):
+            return ast.FlushStatement()
+        if self.peek() and self.peek().value == "select":
+            return self._select()
+        raise ParseError(f"unsupported statement at {self.peek()}")
+
+    # -- DDL ------------------------------------------------------------
+    def _if_not_exists(self) -> bool:
+        if self.accept_word("if"):
+            self.expect_word("not")
+            self.expect_word("exists")
+            return True
+        return False
+
+    def _create(self):
+        if self.accept_word("source") or self.accept_word("table"):
+            ine = self._if_not_exists()
+            name = self.ident()
+            columns: list[ast.ColumnDef] = []
+            watermark = None
+            if self.accept_op("("):
+                while True:
+                    if self.accept_word("watermark"):
+                        self.expect_word("for")
+                        wcol = self.ident()
+                        self.expect_word("as")
+                        expr = self._expr()
+                        watermark = ast.WatermarkDef(
+                            wcol, self._watermark_delay(expr, wcol)
+                        )
+                    else:
+                        cname = self.ident()
+                        ctype = self._type_name()
+                        columns.append(ast.ColumnDef(cname, ctype))
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+            options = {}
+            if self.accept_word("with"):
+                self.expect_op("(")
+                while True:
+                    k = self.ident()
+                    while self.accept_op("."):  # dotted option keys
+                        k += "." + self.ident()
+                    self.expect_op("=")
+                    v = self.next()
+                    options[k] = v.value.strip("'") if v.kind == "string" \
+                        else v.value
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+            return ast.CreateSource(name, tuple(columns), watermark, options,
+                                    ine)
+        if self.accept_word("materialized"):
+            self.expect_word("view")
+            ine = self._if_not_exists()
+            name = self.ident()
+            self.expect_word("as")
+            query = self._select()
+            eowc = False
+            if self.accept_word("emit"):
+                self.expect_word("on")
+                self.expect_word("window")
+                self.expect_word("close")
+                eowc = True
+            return ast.CreateMaterializedView(name, query, ine, eowc)
+        raise ParseError("expected SOURCE, TABLE or MATERIALIZED VIEW")
+
+    def _watermark_delay(self, expr, wcol: str) -> ast.IntervalLit:
+        """WATERMARK FOR c AS c - INTERVAL 'x' => the delay interval."""
+        if isinstance(expr, ast.ColumnRef) and expr.name == wcol:
+            return ast.IntervalLit(0)
+        if (isinstance(expr, ast.BinaryOp) and expr.op == "subtract"
+                and isinstance(expr.left, ast.ColumnRef)
+                and expr.left.name == wcol
+                and isinstance(expr.right, ast.IntervalLit)):
+            return expr.right
+        raise ParseError("watermark must be `col` or `col - INTERVAL '...'`")
+
+    def _type_name(self) -> str:
+        parts = [self.ident()]
+        # multi-word types: double precision, timestamp with time zone, …
+        while True:
+            t = self.peek()
+            if t and t.kind == "word" and t.value in (
+                "precision", "varying", "with", "without", "time", "zone",
+            ):
+                parts.append(self.next().value)
+            else:
+                break
+        return " ".join(parts)
+
+    def _drop(self):
+        kind = self.ident()
+        if kind == "materialized":
+            self.expect_word("view")
+            kind = "materialized view"
+        if_exists = False
+        if self.accept_word("if"):
+            self.expect_word("exists")
+            if_exists = True
+        return ast.DropStatement(kind, self.ident(), if_exists)
+
+    # -- SELECT ---------------------------------------------------------
+    def _select(self) -> ast.Select:
+        self.expect_word("select")
+        items = []
+        while True:
+            if self.accept_op("*"):
+                items.append(ast.SelectItem(ast.Star(), None))
+            else:
+                e = self._expr()
+                alias = None
+                if self.accept_word("as"):
+                    alias = self.ident()
+                elif (self.peek() and self.peek().kind == "word"
+                      and self.peek().value not in (
+                          "from", "where", "group", "having", "order",
+                          "limit", "offset", "emit",
+                      )):
+                    alias = self.ident()
+                items.append(ast.SelectItem(e, alias))
+            if not self.accept_op(","):
+                break
+        from_ = None
+        if self.accept_word("from"):
+            from_ = self._table_expr()
+        where = self._expr() if self.accept_word("where") else None
+        group_by: list = []
+        if self.accept_word("group"):
+            self.expect_word("by")
+            while True:
+                group_by.append(self._expr())
+                if not self.accept_op(","):
+                    break
+        having = self._expr() if self.accept_word("having") else None
+        order_by: list[ast.OrderItem] = []
+        if self.accept_word("order"):
+            self.expect_word("by")
+            while True:
+                e = self._expr()
+                desc = False
+                if self.accept_word("desc"):
+                    desc = True
+                elif self.accept_word("asc"):
+                    pass
+                order_by.append(ast.OrderItem(e, desc))
+                if not self.accept_op(","):
+                    break
+        limit = offset = None
+        if self.accept_word("limit"):
+            limit = int(self.next().value)
+        if self.accept_word("offset"):
+            offset = int(self.next().value)
+        return ast.Select(
+            tuple(items), from_, where, tuple(group_by), having,
+            tuple(order_by), limit, offset,
+        )
+
+    def _table_expr(self):
+        left = self._table_factor()
+        while True:
+            kind = None
+            if self.accept_word("join") or self.accept_word("inner"):
+                if self.peek() and self.peek().value == "join":
+                    self.next()
+                kind = "inner"
+            elif self.accept_word("left"):
+                self.accept_word("outer")
+                self.expect_word("join")
+                kind = "left"
+            else:
+                break
+            right = self._table_factor()
+            self.expect_word("on")
+            on = self._expr()
+            left = ast.Join(left, right, on, kind)
+        return left
+
+    def _table_factor(self):
+        t = self.peek()
+        if t and t.value in ("tumble", "hop"):
+            fn = self.next().value
+            self.expect_op("(")
+            table = ast.TableRef(self.ident())
+            self.expect_op(",")
+            col = self.ident()
+            self.expect_op(",")
+            iv1 = self._expr()
+            iv2 = None
+            if fn == "hop":
+                self.expect_op(",")
+                iv2 = self._expr()
+            self.expect_op(")")
+            alias = None
+            if self.accept_word("as"):
+                alias = self.ident()
+            if fn == "tumble":
+                return ast.Tumble(table, col, iv1, alias)
+            return ast.Hop(table, col, iv1, iv2, alias)
+        name = self.ident()
+        alias = None
+        if self.accept_word("as"):
+            alias = self.ident()
+        elif (self.peek() and self.peek().kind == "word"
+              and self.peek().value not in (
+                  "join", "inner", "left", "on", "where", "group", "having",
+                  "order", "limit", "offset", "emit",
+              )):
+            alias = self.ident()
+        return ast.TableRef(name, alias)
+
+    # -- expressions ----------------------------------------------------
+    def _expr(self, min_prec: int = 0):
+        left = self._unary()
+        while True:
+            t = self.peek()
+            if t is None:
+                break
+            op = t.value if t.kind == "op" else (
+                t.value if t.kind == "word" and t.value in ("and", "or")
+                else None
+            )
+            if op is None or op not in _PRECEDENCE:
+                break
+            prec = _PRECEDENCE[op]
+            if prec < min_prec:
+                break
+            self.next()
+            right = self._expr(prec + 1)
+            left = ast.BinaryOp(_BIN_NAMES[op], left, right)
+        return left
+
+    def _unary(self):
+        if self.accept_op("-"):
+            return ast.UnaryOp("neg", self._unary())
+        if self.accept_word("not"):
+            return ast.UnaryOp("not", self._unary())
+        return self._postfix(self._primary())
+
+    def _postfix(self, e):
+        while self.accept_op("::"):
+            e = ast.Cast(e, self._type_name())
+        return e
+
+    def _primary(self):
+        t = self.next()
+        if t.kind == "number":
+            if "." in t.value:
+                return ast.Literal(float(t.value), "float")
+            return ast.Literal(int(t.value), "int")
+        if t.kind == "string":
+            return ast.Literal(t.value[1:-1].replace("''", "'"), "string")
+        if t.kind == "op" and t.value == "(":
+            e = self._expr()
+            self.expect_op(")")
+            return e
+        if t.kind != "word":
+            raise ParseError(f"unexpected token {t.value!r}")
+        w = t.value
+        if w == "interval":
+            s = self.next()
+            if s.kind != "string":
+                raise ParseError("expected INTERVAL 'value'")
+            return self._interval(s.value[1:-1])
+        if w in ("true", "false"):
+            return ast.Literal(w == "true", "bool")
+        if w == "null":
+            return ast.Literal(None, "null")
+        if w == "case":
+            conds = []
+            while self.accept_word("when"):
+                c = self._expr()
+                self.expect_word("then")
+                r = self._expr()
+                conds.append((c, r))
+            els = None
+            if self.accept_word("else"):
+                els = self._expr()
+            self.expect_word("end")
+            return ast.Case(tuple(conds), els)
+        if w == "cast":
+            self.expect_op("(")
+            e = self._expr()
+            self.expect_word("as")
+            tn = self._type_name()
+            self.expect_op(")")
+            return ast.Cast(e, tn)
+        if self.accept_op("("):
+            distinct = bool(self.accept_word("distinct"))
+            args: list = []
+            if self.accept_op("*"):
+                args.append(ast.Star())
+            elif not (self.peek() and self.peek().value == ")"):
+                while True:
+                    args.append(self._expr())
+                    if not self.accept_op(","):
+                        break
+            self.expect_op(")")
+            return ast.FuncCall(w, tuple(args), distinct)
+        if self.accept_op("."):
+            return ast.ColumnRef(self.ident(), table=w)
+        return ast.ColumnRef(w)
+
+    def _interval(self, text: str) -> ast.IntervalLit:
+        m = re.match(r"^\s*(\d+)\s*([a-zA-Z]+)?\s*$", text)
+        if not m:
+            raise ParseError(f"bad interval {text!r}")
+        n = int(m.group(1))
+        unit = (m.group(2) or "second").lower()
+        # also accept the unit as the next word: INTERVAL '10' SECOND
+        if m.group(2) is None and self.peek() and self.peek().kind == "word" \
+                and self.peek().value in _INTERVAL_UNITS:
+            unit = self.next().value
+        if unit not in _INTERVAL_UNITS:
+            raise ParseError(f"unsupported interval unit {unit!r}")
+        return ast.IntervalLit(n * _INTERVAL_UNITS[unit])
+
+
+def parse(sql: str):
+    """Parse one or more ;-separated statements."""
+    stmts = []
+    for part in _split_statements(sql):
+        p = Parser(part)
+        stmts.append(p.parse_statement())
+        if p.peek() is not None:
+            raise ParseError(f"trailing tokens at {p.peek()}")
+    return stmts
+
+
+def _split_statements(sql: str) -> list[str]:
+    # split on ; outside string literals and -- comments
+    out: list[str] = []
+    cur: list[str] = []
+    i, n = 0, len(sql)
+    in_str = in_comment = False
+    while i < n:
+        ch = sql[i]
+        if in_comment:
+            if ch == "\n":
+                in_comment = False
+            cur.append(ch)
+        elif in_str:
+            if ch == "'":
+                in_str = False
+            cur.append(ch)
+        elif ch == "'":
+            in_str = True
+            cur.append(ch)
+        elif ch == "-" and i + 1 < n and sql[i + 1] == "-":
+            in_comment = True
+            cur.append(ch)
+        elif ch == ";":
+            stmt = "".join(cur).strip()
+            if stmt:
+                out.append(stmt)
+            cur = []
+        else:
+            cur.append(ch)
+        i += 1
+    stmt = "".join(cur).strip()
+    if stmt:
+        out.append(stmt)
+    return out

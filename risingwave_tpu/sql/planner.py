@@ -1,0 +1,465 @@
+"""Planner: bound SELECT → streaming executor pipelines.
+
+Reference counterpart: ``src/frontend/src/planner`` + ``optimizer`` +
+``stream_fragmenter`` — collapsed into direct executor-pipeline
+construction for the supported plan shapes:
+
+- stateless:   source → [wm filter] → project/filter → ring MV
+- aggregation: source → [wm filter] → [window] → hash agg → project → MV
+- TopN:        ... → group/plain TopN → MV
+- join:        two sources → per-side prep → hash join → project → MV
+
+The reference's Distribution property (distribution.rs:68) maps to the
+vnode/shard axis; this planner emits single-mesh pipelines and the
+sharded runtime applies the hash exchange at the agg/join boundary.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+from risingwave_tpu.common.types import DataType, Field, Schema
+from risingwave_tpu.expr.node import Expr, FuncCall as EFuncCall, InputRef, lit
+from risingwave_tpu.meta.catalog import Catalog, CatalogEntry
+from risingwave_tpu.sql import ast
+from risingwave_tpu.sql.binder import AGG_NAMES, AggRef, BindError, Binder, Scope
+from risingwave_tpu.stream.executor import (
+    Executor,
+    FilterExecutor,
+    HopWindowExecutor,
+    ProjectExecutor,
+)
+from risingwave_tpu.stream.fragment import Fragment
+from risingwave_tpu.stream.hash_agg import HashAggExecutor
+from risingwave_tpu.stream.hash_join import HashJoinExecutor
+from risingwave_tpu.stream.materialize import (
+    AppendOnlyMaterialize,
+    MaterializeExecutor,
+)
+from risingwave_tpu.stream.top_n import GroupTopNExecutor
+from risingwave_tpu.stream.watermark import WatermarkFilterExecutor
+
+
+class PlanError(ValueError):
+    pass
+
+
+@dataclass
+class PlannedInput:
+    """One stream input after FROM resolution."""
+
+    reader: Any                  # source reader (next_chunk())
+    executors: list[Executor]    # prep chain (wm filter, window, ...)
+    scope: Scope
+    schema: Schema
+    watermark_col: int | None    # col idx in `schema` carrying event time
+    window_size: int | None      # tumble/hop size (for cleaning lag)
+    append_only: bool
+
+
+@dataclass
+class UnaryPlan:
+    reader: Any
+    fragment: Fragment
+    mv_index: int                # executor index of the MV in the fragment
+
+
+@dataclass
+class JoinPlan:
+    left_reader: Any
+    right_reader: Any
+    left_fragment: Fragment | None
+    right_fragment: Fragment | None
+    join: HashJoinExecutor
+    post_fragment: Fragment
+    mv_index: int                # index in post fragment
+
+
+@dataclass
+class PlannerConfig:
+    agg_table_size: int = 1 << 16
+    agg_emit_capacity: int = 4096
+    join_table_size: int = 1 << 14
+    join_bucket_cap: int = 64
+    join_out_capacity: int = 1 << 15
+    topn_pool_size: int = 4096
+    topn_emit_capacity: int = 1024
+    mv_table_size: int = 1 << 16
+    mv_ring_size: int = 1 << 20
+    chunk_capacity: int = 4096
+
+
+class Planner:
+    def __init__(self, catalog: Catalog,
+                 config: PlannerConfig | None = None):
+        self.catalog = catalog
+        self.config = config or PlannerConfig()
+
+    # ------------------------------------------------------------------
+    def plan(self, select: ast.Select) -> UnaryPlan | JoinPlan:
+        if isinstance(select.from_, ast.Join):
+            return self._plan_join(select)
+        return self._plan_unary(select)
+
+    # -- FROM resolution ------------------------------------------------
+    def _resolve_input(self, from_) -> PlannedInput:
+        if isinstance(from_, ast.TableRef):
+            entry = self.catalog.get(from_.name)
+            if entry.kind != "source":
+                raise PlanError(
+                    f"{from_.name} is not a streaming source (MV-on-MV "
+                    "cascades land with the graph scheduler)"
+                )
+            reader = entry.reader_factory()
+            qual = from_.alias or from_.name
+            execs: list[Executor] = []
+            wm_col = None
+            if entry.watermark is not None:
+                col, delay = entry.watermark
+                execs.append(
+                    WatermarkFilterExecutor(entry.schema, col, delay)
+                )
+                wm_col = col
+            return PlannedInput(
+                reader, execs, Scope.of(entry.schema, qual), entry.schema,
+                wm_col, None, entry.append_only,
+            )
+        if isinstance(from_, (ast.Tumble, ast.Hop)):
+            inner = self._resolve_input(from_.table)
+            ts_idx = inner.scope.resolve(from_.time_col, None)
+            if isinstance(from_, ast.Tumble):
+                size = from_.size.micros
+                slide = size
+            else:
+                size = from_.size.micros
+                slide = from_.slide.micros
+            hop = HopWindowExecutor(inner.schema, ts_idx, slide, size)
+            qual = from_.alias or from_.table.name
+            scope = Scope(
+                hop.out_schema,
+                tuple(inner.scope.qualifiers) + (qual,),
+            )
+            # window_start is addressable by the window alias OR the
+            # underlying table name (postgres-ish leniency)
+            return PlannedInput(
+                inner.reader, inner.executors + [hop], scope,
+                hop.out_schema, inner.watermark_col, size,
+                inner.append_only,
+            )
+        raise PlanError(f"unsupported FROM clause {from_!r}")
+
+    # -- unary pipelines -------------------------------------------------
+    def _plan_unary(self, select: ast.Select) -> UnaryPlan:
+        if select.from_ is None:
+            raise PlanError("SELECT without FROM is not a streaming job")
+        pin = self._resolve_input(select.from_)
+        execs = list(pin.executors)
+        scope = pin.scope
+
+        if select.where is not None:
+            b = Binder(scope)
+            execs.append(FilterExecutor(scope.schema, b.bind(select.where)))
+
+        has_agg = bool(select.group_by) or self._has_agg(select)
+        pk_positions: list[int] = []
+        if has_agg:
+            execs2, out_schema, pk_positions = self._plan_agg(
+                select, scope, pin
+            )
+            execs.extend(execs2)
+        else:
+            items = self._expand_items(select.items, scope)
+            b = Binder(scope)
+            proj = [(name, b.bind(e)) for name, e in items]
+            execs.append(ProjectExecutor(scope.schema, proj))
+            out_schema = execs[-1].out_schema
+
+        if select.order_by and select.limit is not None:
+            ob = []
+            b = Binder(Scope.of(out_schema))
+            for oi in select.order_by:
+                ob.append((self._bind_order_key(oi.expr, b, out_schema),
+                           oi.descending))
+            # append-only up to here ⇒ the TopN can evict non-band rows
+            topn_append_only = pin.append_only and not has_agg
+            pool = max(self.config.topn_pool_size,
+                       2 * self.config.chunk_capacity)
+            execs.append(GroupTopNExecutor(
+                out_schema, group_by=[], order_by=ob, limit=select.limit,
+                offset=select.offset or 0,
+                pool_size=pool,
+                emit_capacity=self.config.topn_emit_capacity,
+                append_only=topn_append_only,
+            ))
+
+        # materialize
+        retractable = has_agg or (select.order_by and select.limit)
+        if retractable:
+            # pk: group keys for aggs; whole row for TopN output
+            if has_agg and not (select.order_by and select.limit):
+                pk = pk_positions
+            else:
+                pk = list(range(len(out_schema)))
+            mv = MaterializeExecutor(
+                out_schema, pk_indices=pk,
+                table_size=self.config.mv_table_size,
+            )
+        else:
+            mv = AppendOnlyMaterialize(
+                out_schema, ring_size=self.config.mv_ring_size
+            )
+        execs.append(mv)
+        return UnaryPlan(pin.reader, Fragment(execs), len(execs) - 1)
+
+    # -- aggregation ------------------------------------------------------
+    def _has_agg(self, select: ast.Select) -> bool:
+        def walk(e) -> bool:
+            if isinstance(e, ast.FuncCall):
+                if e.name in AGG_NAMES:
+                    return True
+                return any(walk(a) for a in e.args
+                           if not isinstance(a, ast.Star))
+            if isinstance(e, ast.BinaryOp):
+                return walk(e.left) or walk(e.right)
+            if isinstance(e, ast.UnaryOp):
+                return walk(e.operand)
+            if isinstance(e, ast.Cast):
+                return walk(e.operand)
+            if isinstance(e, ast.Case):
+                return any(walk(c) or walk(r) for c, r in e.conditions) or (
+                    e.else_result is not None and walk(e.else_result)
+                )
+            return False
+
+        return any(walk(i.expr) for i in select.items
+                   if not isinstance(i.expr, ast.Star))
+
+    def _plan_agg(self, select: ast.Select, scope: Scope,
+                  pin: PlannedInput):
+        cfg = self.config
+        group_asts = list(select.group_by)
+        in_binder = Binder(scope)
+        group_by = []
+        for gi, ga in enumerate(group_asts):
+            name = ga.name if isinstance(ga, ast.ColumnRef) else f"_key{gi}"
+            group_by.append((name, in_binder.bind(ga)))
+
+        # bind select items collecting agg calls
+        item_binder = Binder(scope, allow_aggs=True)
+        bound_items: list[tuple[str, Expr]] = []
+        for idx, item in enumerate(select.items):
+            if isinstance(item.expr, ast.Star):
+                raise PlanError("SELECT * with GROUP BY is not valid")
+            name = item.alias or self._default_name(item.expr, idx)
+            bound_items.append((name, item_binder.bind(item.expr)))
+        agg_calls = item_binder.agg_calls
+
+        having_expr = None
+        if select.having is not None:
+            having_expr = item_binder.bind(select.having)
+            agg_calls = item_binder.agg_calls
+
+        # watermark-driven cleaning when a group key is the window start
+        wm_idx = None
+        lag = 0
+        if pin.window_size is not None and pin.watermark_col is not None:
+            for ki, ga in enumerate(group_asts):
+                if (isinstance(ga, ast.ColumnRef)
+                        and ga.name == "window_start"):
+                    wm_idx, lag = ki, pin.window_size
+        agg = HashAggExecutor(
+            scope.schema, group_by, agg_calls,
+            table_size=cfg.agg_table_size,
+            emit_capacity=cfg.agg_emit_capacity,
+            watermark_group_idx=wm_idx,
+            watermark_lag=lag,
+            watermark_src_col=pin.watermark_col,
+        )
+        execs: list[Executor] = [agg]
+
+        # post-projection over agg output: group keys + agg results
+        agg_scope = Scope.of(agg.out_schema)
+        rewritten = []
+        for (name, e) in bound_items:
+            rewritten.append((name, self._rewrite_post_agg(
+                e, group_by, len(group_by)
+            )))
+        # append hidden group keys that weren't selected (MV pk needs them)
+        selected_keys = set()
+        for name, e in rewritten:
+            if isinstance(e, InputRef) and e.index < len(group_by):
+                selected_keys.add(e.index)
+        hidden = [
+            (f"_hidden_{agg.out_schema[ki].name}", InputRef(ki))
+            for ki in range(len(group_by)) if ki not in selected_keys
+        ]
+        proj_items = rewritten + hidden
+        if having_expr is not None:
+            hv = self._rewrite_post_agg(having_expr, group_by, len(group_by))
+            execs.append(FilterExecutor(agg.out_schema, hv))
+        post = ProjectExecutor(agg.out_schema, proj_items)
+        execs.append(post)
+        # pk = positions of the group keys inside the projection
+        pk_pos = []
+        for ki in range(len(group_by)):
+            for pi, (n, e) in enumerate(proj_items):
+                if isinstance(e, InputRef) and e.index == ki:
+                    pk_pos.append(pi)
+                    break
+        return execs, post.out_schema, pk_pos
+
+    def _rewrite_post_agg(self, e: Expr, group_by, n_keys: int) -> Expr:
+        """Rewrite a bound select expr to read the agg output schema."""
+        if isinstance(e, AggRef):
+            return InputRef(n_keys + e.index)
+        for ki, (_, ge) in enumerate(group_by):
+            if self._expr_eq(e, ge):
+                return InputRef(ki)
+        if isinstance(e, InputRef):
+            raise PlanError(
+                "column referenced outside aggregates must appear in "
+                "GROUP BY"
+            )
+        if isinstance(e, EFuncCall):
+            return EFuncCall(
+                e.name,
+                tuple(self._rewrite_post_agg(a, group_by, n_keys)
+                      for a in e.args),
+            )
+        return e  # literals
+
+    @staticmethod
+    def _expr_eq(a: Expr, b: Expr) -> bool:
+        if type(a) is not type(b):
+            return False
+        if isinstance(a, InputRef):
+            return a.index == b.index
+        if isinstance(a, EFuncCall):
+            return a.name == b.name and len(a.args) == len(b.args) and all(
+                Planner._expr_eq(x, y) for x, y in zip(a.args, b.args)
+            )
+        from risingwave_tpu.expr.node import Literal as ELit
+        if isinstance(a, ELit):
+            return a.value == b.value and a.data_type == b.data_type
+        return False
+
+    # -- join pipelines ---------------------------------------------------
+    def _plan_join(self, select: ast.Select) -> JoinPlan:
+        cfg = self.config
+        jn: ast.Join = select.from_
+        if jn.kind != "inner":
+            raise PlanError("only INNER JOIN is supported this round")
+        if isinstance(jn.left, ast.Join) or isinstance(jn.right, ast.Join):
+            raise PlanError("multi-way joins land with the graph scheduler")
+        left = self._resolve_input(jn.left)
+        right = self._resolve_input(jn.right)
+        both = left.scope.concat(right.scope)
+        n_left = len(left.schema)
+
+        # split ON into equi-conjuncts and residual filters
+        left_keys: list[Expr] = []
+        right_keys: list[Expr] = []
+        residual: list = []
+        for conj in self._conjuncts(jn.on):
+            keypair = self._equi_pair(conj, left.scope, right.scope, n_left)
+            if keypair is not None:
+                lk, rk = keypair
+                left_keys.append(lk)
+                right_keys.append(rk)
+            else:
+                residual.append(conj)
+        if not left_keys:
+            raise PlanError("JOIN requires at least one equality condition")
+
+        join = HashJoinExecutor(
+            left.schema, right.schema, left_keys, right_keys,
+            table_size=cfg.join_table_size,
+            bucket_cap=cfg.join_bucket_cap,
+            out_capacity=cfg.join_out_capacity,
+        )
+        post_execs: list[Executor] = []
+        b = Binder(both)
+        for conj in residual:
+            post_execs.append(FilterExecutor(both.schema, b.bind(conj)))
+        if select.where is not None:
+            post_execs.append(
+                FilterExecutor(both.schema, b.bind(select.where))
+            )
+        items = self._expand_items(select.items, both)
+        proj = [(name, b.bind(e)) for name, e in items]
+        post_execs.append(ProjectExecutor(both.schema, proj))
+        out_schema = post_execs[-1].out_schema
+        if not (left.append_only and right.append_only):
+            raise PlanError(
+                "join MVs over retractable inputs need keyed "
+                "materialization (next round)"
+            )
+        mv = AppendOnlyMaterialize(out_schema, ring_size=cfg.mv_ring_size)
+        post_execs.append(mv)
+        return JoinPlan(
+            left.reader, right.reader,
+            Fragment(left.executors) if left.executors else None,
+            Fragment(right.executors) if right.executors else None,
+            join,
+            Fragment(post_execs),
+            len(post_execs) - 1,
+        )
+
+    def _conjuncts(self, e) -> list:
+        if isinstance(e, ast.BinaryOp) and e.op == "and":
+            return self._conjuncts(e.left) + self._conjuncts(e.right)
+        return [e]
+
+    def _equi_pair(self, e, lscope: Scope, rscope: Scope, n_left: int):
+        if not (isinstance(e, ast.BinaryOp) and e.op == "equal"):
+            return None
+        sides = []
+        for operand in (e.left, e.right):
+            try:
+                lb = Binder(lscope).bind(operand)
+                sides.append(("l", lb))
+                continue
+            except BindError:
+                pass
+            try:
+                rb = Binder(rscope).bind(operand)
+                sides.append(("r", rb))
+            except BindError:
+                return None
+        if len(sides) != 2 or {s[0] for s in sides} != {"l", "r"}:
+            return None
+        l = next(x for t, x in sides if t == "l")
+        r = next(x for t, x in sides if t == "r")
+        return l, r
+
+    # -- misc -------------------------------------------------------------
+    def _expand_items(self, items, scope: Scope):
+        out = []
+        for idx, item in enumerate(items):
+            if isinstance(item.expr, ast.Star):
+                for ci, f in enumerate(scope.schema):
+                    out.append((f.name, ast.ColumnRef(f.name,
+                                                      scope.qualifiers[ci])))
+                continue
+            out.append(
+                (item.alias or self._default_name(item.expr, idx), item.expr)
+            )
+        return out
+
+    @staticmethod
+    def _bind_order_key(e, binder: Binder, schema: Schema) -> Expr:
+        """ORDER BY <n> is positional (postgres); otherwise bind."""
+        if isinstance(e, ast.Literal) and e.type_name == "int":
+            if not (1 <= e.value <= len(schema)):
+                raise PlanError(f"ORDER BY position {e.value} out of range")
+            return InputRef(e.value - 1)
+        return binder.bind(e)
+
+    @staticmethod
+    def _default_name(e, idx: int) -> str:
+        if isinstance(e, ast.ColumnRef):
+            return e.name
+        if isinstance(e, ast.FuncCall):
+            return e.name
+        return f"col{idx}"

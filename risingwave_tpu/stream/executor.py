@@ -103,6 +103,56 @@ class ProjectExecutor(Executor):
         return state, chunk.with_columns(cols, self._out_schema)
 
 
+class HopWindowExecutor(Executor):
+    """Expand each row into the k sliding windows containing it.
+
+    ref: src/stream/src/executor/hop_window.rs (tumble/hop via row
+    expansion).  Output capacity = k * input capacity with a
+    ``window_start`` column appended; k = size // slide is static.
+    """
+
+    def __init__(self, in_schema: Schema, ts_col: int, slide_us: int,
+                 size_us: int, window_col: str = "window_start"):
+        super().__init__(in_schema)
+        if size_us % slide_us:
+            raise ValueError("hop size must be a multiple of slide")
+        self.ts_col = ts_col
+        self.slide_us = slide_us
+        self.size_us = size_us
+        self.k = size_us // slide_us
+        from risingwave_tpu.common.types import DataType as DT
+        self._out_schema = Schema(
+            in_schema.fields + (Field(window_col, DT.TIMESTAMP),)
+        )
+
+    @property
+    def out_schema(self) -> Schema:
+        return self._out_schema
+
+    def apply(self, state, chunk: Chunk):
+        from risingwave_tpu.common.chunk import StrCol
+
+        cap, k = chunk.capacity, self.k
+
+        def rep(col):
+            if isinstance(col, StrCol):
+                return StrCol(rep(col.data), rep(col.lens))
+            return jnp.repeat(col, k, axis=0)
+
+        ts = chunk.column(self.ts_col)
+        ws0 = ts - ts % self.slide_us           # latest window start
+        offs = jnp.tile(
+            jnp.arange(k, dtype=jnp.int64) * self.slide_us, (cap,)
+        )
+        # every generated window contains its row: ws = ws0 - i*slide
+        # with i < k gives ts - ws < slide + (k-1)*slide = size
+        ws = rep(ws0) - offs
+        cols = tuple(rep(c) for c in chunk.columns) + (ws,)
+        return state, Chunk(
+            cols, rep(chunk.ops), rep(chunk.valid), self._out_schema,
+        )
+
+
 class FilterExecutor(Executor):
     """Narrow visibility by a predicate (ref executor/filter.rs).
 

@@ -140,6 +140,7 @@ class GroupTopNExecutor(Executor):
         watermark_col_idx: int | None = None,
         watermark_lag: int = 0,
         watermark_src_col: int | None = None,
+        append_only: bool = False,
     ):
         super().__init__(in_schema)
         self.group_by = tuple(group_by)
@@ -153,6 +154,11 @@ class GroupTopNExecutor(Executor):
         #: only react to Watermark messages with this source col_idx
         #: (None = any — single-watermark fragments)
         self.watermark_src_col = watermark_src_col
+        #: append-only input: rows outside the band can never re-enter
+        #: (no retractions), so flush evicts them — the pool then only
+        #: needs to absorb one epoch of inserts plus the band (the
+        #: reference's append_only TopN cache makes the same move)
+        self.append_only = append_only
 
     def init_state(self) -> TopNState:
         protos = []
@@ -313,9 +319,13 @@ class GroupTopNExecutor(Executor):
         valid = cat(del_side, ins_side)
         out = Chunk(out_cols, ops, valid, self.in_schema)
 
+        # append-only inputs: rows outside the band can never re-enter
+        # (no retractions), so evict them — the pool then only needs to
+        # absorb one epoch of inserts plus the band
+        pool_valid = band if self.append_only else state.valid
         return TopNState(
             rows=state.rows,
-            valid=state.valid,
+            valid=pool_valid,
             row_hash=state.row_hash,
             prev_rows=cur_rows,
             prev_valid=cur_live,

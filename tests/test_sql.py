@@ -1,0 +1,223 @@
+"""SQL frontend tests: parse → plan → run → serve, nexmark-flavored.
+
+Mirrors the reference's e2e sqllogictest style (SURVEY.md §4): DDL +
+streaming MVs + serving SELECTs in one session.
+"""
+
+import numpy as np
+import pytest
+
+from risingwave_tpu.sql import Engine
+from risingwave_tpu.sql.parser import ParseError, parse
+from risingwave_tpu.sql import ast
+
+
+# -- parser ----------------------------------------------------------------
+
+def test_parse_select_shapes():
+    (s,) = parse("""
+        SELECT auction, bidder, 0.908 * price AS price_eur
+        FROM bid WHERE price > 100 AND bidder <> 5
+    """)
+    assert isinstance(s, ast.Select)
+    assert len(s.items) == 3
+    assert s.items[2].alias == "price_eur"
+    assert isinstance(s.where, ast.BinaryOp) and s.where.op == "and"
+
+
+def test_parse_create_source_with_watermark():
+    (s,) = parse("""
+        CREATE SOURCE bid (
+            auction BIGINT, price BIGINT, date_time TIMESTAMP,
+            WATERMARK FOR date_time AS date_time - INTERVAL '4' SECOND
+        ) WITH (connector = 'nexmark', nexmark.table = 'bid')
+    """)
+    assert isinstance(s, ast.CreateSource)
+    assert s.watermark.column == "date_time"
+    assert s.watermark.delay.micros == 4_000_000
+    assert s.with_options["connector"] == "nexmark"
+
+
+def test_parse_tumble_group_by():
+    (s,) = parse("""
+        SELECT window_start, max(price), count(*)
+        FROM TUMBLE(bid, date_time, INTERVAL '10' SECOND)
+        GROUP BY window_start
+    """)
+    assert isinstance(s.from_, ast.Tumble)
+    assert s.from_.size.micros == 10_000_000
+
+
+def test_parse_join_and_case():
+    (s,) = parse("""
+        SELECT p.name, CASE WHEN a.reserve > 100 THEN 1 ELSE 0 END
+        FROM person AS p JOIN auction AS a ON p.id = a.seller
+    """)
+    assert isinstance(s.from_, ast.Join)
+    assert isinstance(s.items[1].expr, ast.Case)
+
+
+def test_parse_errors():
+    with pytest.raises(ParseError):
+        parse("SELEC x FROM y")
+    with pytest.raises(ParseError):
+        parse("SELECT x FROM y WHERE")
+
+
+# -- end-to-end engine -----------------------------------------------------
+
+NEXMARK_DDL = """
+CREATE SOURCE bid (
+    auction BIGINT, bidder BIGINT, price BIGINT,
+    channel VARCHAR, url VARCHAR, date_time TIMESTAMP
+) WITH (connector = 'nexmark', nexmark.table = 'bid',
+        nexmark.event.rate = '100000');
+"""
+
+
+def _engine(cap=512):
+    from risingwave_tpu.sql.planner import PlannerConfig
+    return Engine(PlannerConfig(
+        chunk_capacity=cap, agg_table_size=1 << 10,
+        agg_emit_capacity=256, mv_table_size=1 << 10,
+        mv_ring_size=1 << 12, topn_pool_size=512, topn_emit_capacity=128,
+        join_table_size=1 << 10, join_bucket_cap=1024,
+        join_out_capacity=1 << 12,
+    ))
+
+
+def test_engine_q1_stateless():
+    eng = _engine()
+    eng.execute(NEXMARK_DDL)
+    eng.execute("""
+        CREATE MATERIALIZED VIEW q1 AS
+        SELECT auction, bidder, 0.908 * price AS price, date_time
+        FROM bid;
+    """)
+    eng.tick(barriers=2, chunks_per_barrier=1)
+    rows = eng.execute("SELECT auction, price FROM q1 LIMIT 5")
+    assert len(rows) == 5
+
+    from risingwave_tpu.connector.nexmark import NexmarkConfig, NexmarkGenerator
+    gen = NexmarkGenerator(NexmarkConfig(inter_event_us=10))
+    want = gen.gen_bids(0, 1024)
+    _, cols, _ = want.to_host()
+    got_all = eng.execute("SELECT price FROM q1")
+    np.testing.assert_allclose(
+        sorted(r[0] for r in got_all),
+        sorted(cols[2].astype(np.float64) * 0.908),
+        rtol=1e-9,
+    )
+
+
+def test_engine_q7_windowed_agg():
+    eng = _engine()
+    eng.execute(NEXMARK_DDL)
+    eng.execute("""
+        CREATE MATERIALIZED VIEW q7 AS
+        SELECT window_start, max(price) AS max_price, count(*) AS bids
+        FROM TUMBLE(bid, date_time, INTERVAL '1' SECOND)
+        GROUP BY window_start;
+    """)
+    eng.tick(barriers=3, chunks_per_barrier=1)
+    rows = eng.execute("SELECT window_start, max_price, bids FROM q7")
+    got = {int(r[0]): (int(r[1]), int(r[2])) for r in rows}
+
+    from risingwave_tpu.connector.nexmark import NexmarkConfig, NexmarkGenerator
+    gen = NexmarkGenerator(NexmarkConfig(inter_event_us=10))
+    bids = gen.gen_bids(0, 3 * 512)
+    _, cols, _ = bids.to_host()
+    price, ts = cols[2], cols[5]
+    w = ts - ts % 1_000_000
+    want = {}
+    for wv in np.unique(w):
+        m = w == wv
+        want[int(wv)] = (int(price[m].max()), int(m.sum()))
+    assert got == want
+
+
+def test_engine_filter_and_topn():
+    eng = _engine()
+    eng.execute(NEXMARK_DDL)
+    eng.execute("""
+        CREATE MATERIALIZED VIEW top_bids AS
+        SELECT price, auction FROM bid
+        WHERE price > 1000
+        ORDER BY price DESC LIMIT 10;
+    """)
+    eng.tick(barriers=2, chunks_per_barrier=1)
+    rows = eng.execute("SELECT price, auction FROM top_bids")
+
+    from risingwave_tpu.connector.nexmark import NexmarkConfig, NexmarkGenerator
+    gen = NexmarkGenerator(NexmarkConfig(inter_event_us=10))
+    bids = gen.gen_bids(0, 2 * 512)
+    _, cols, _ = bids.to_host()
+    price = cols[2]
+    want = sorted(price[price > 1000], reverse=True)[:10]
+    assert sorted((int(r[0]) for r in rows), reverse=True) == [
+        int(x) for x in want
+    ]
+
+
+def test_engine_join():
+    eng = _engine()
+    eng.execute("""
+        CREATE SOURCE person (
+            id BIGINT, name VARCHAR, date_time TIMESTAMP
+        ) WITH (connector = 'nexmark', nexmark.table = 'person');
+        CREATE SOURCE auction (
+            id BIGINT, seller BIGINT, reserve BIGINT, date_time TIMESTAMP
+        ) WITH (connector = 'nexmark', nexmark.table = 'auction');
+    """)
+    eng.execute("""
+        CREATE MATERIALIZED VIEW sellers AS
+        SELECT p.name AS name, a.reserve AS reserve
+        FROM person p JOIN auction a ON p.id = a.seller;
+    """)
+    eng.tick(barriers=2, chunks_per_barrier=1)
+    rows = eng.execute("SELECT name, reserve FROM sellers")
+    assert len(rows) > 0
+
+    from risingwave_tpu.connector.nexmark import NexmarkConfig, NexmarkGenerator
+    gen = NexmarkGenerator(NexmarkConfig(inter_event_us=10))
+    p = gen.gen_persons(0, 2 * 512)
+    a = gen.gen_auctions(0, 2 * 512)
+    _, pc, _ = p.to_host()
+    _, ac, _ = a.to_host()
+    n_match = sum(
+        int((pc[0] == s).sum()) for s in ac[7]
+    )
+    assert len(rows) == n_match
+
+
+def test_engine_show_and_drop():
+    eng = _engine()
+    eng.execute(NEXMARK_DDL)
+    assert eng.execute("SHOW SOURCES") == [("bid",)]
+    eng.execute("CREATE MATERIALIZED VIEW v AS SELECT auction FROM bid")
+    assert eng.execute("SHOW MATERIALIZED VIEWS") == [("v",)]
+    eng.execute("DROP MATERIALIZED VIEW v")
+    assert eng.execute("SHOW MATERIALIZED VIEWS") == []
+    assert len(eng.jobs) == 0
+
+
+def test_engine_datagen_group_by():
+    eng = _engine(cap=64)
+    eng.execute("""
+        CREATE SOURCE t (k BIGINT, v BIGINT)
+        WITH (connector = 'datagen');
+    """)
+    eng.execute("""
+        CREATE MATERIALIZED VIEW agg AS
+        SELECT k % 4 AS bucket, count(*) AS n, sum(v) AS s
+        FROM t GROUP BY k % 4;
+    """)
+    eng.tick(barriers=2, chunks_per_barrier=2)
+    rows = eng.execute("SELECT bucket, n, s FROM agg")
+    got = {int(r[0]): (int(r[1]), int(r[2])) for r in rows}
+    ks = np.arange(4 * 64, dtype=np.int64)
+    want = {
+        int(b): (int((ks % 4 == b).sum()), int(ks[ks % 4 == b].sum()))
+        for b in range(4)
+    }
+    assert got == want
