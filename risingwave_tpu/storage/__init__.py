@@ -1,0 +1,18 @@
+"""Storage layer: epoch-versioned persistence (the Hummock analog).
+
+Reference counterpart: ``src/storage`` (SURVEY.md §2.5) — an LSM over
+object storage.  Round-1 shape:
+
+- ``codec``          — C++ native memcomparable/varint-block codec
+- ``sst``            — block-based sorted-string-table files + merge reads
+- ``checkpoint_store`` — epoch-versioned snapshot persistence + manifest
+
+Device state stays dense in HBM; the storage layer owns the host-side
+durability path (checkpoint upload, serving from closed epochs,
+restart recovery), exactly the split the reference draws between
+executor caches and Hummock.
+"""
+
+from risingwave_tpu.storage.checkpoint_store import CheckpointStore
+
+__all__ = ["CheckpointStore"]

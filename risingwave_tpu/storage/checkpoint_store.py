@@ -1,0 +1,142 @@
+"""Epoch-versioned checkpoint persistence + manifest.
+
+Reference counterpart: the Hummock commit path — shared-buffer upload on
+checkpoint (uploader/mod.rs:1478), ``commit_epoch`` version bump
+(src/meta/src/hummock/manager/commit_epoch.rs:73), and meta-backed
+recovery (SURVEY.md §3.5).
+
+Round-1 shape: each job's checkpoint = the device state pytree fetched
+to host, stored as an ``.npz`` of leaves + a json tree spec, plus the
+source offsets.  A json manifest (atomic rename) tracks the latest
+committed epoch per job; old epochs are garbage-collected.  MV contents
+can additionally be exported as SSTs for engine-free serving
+(``export_mv_sst``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+from typing import Any
+
+import jax
+import numpy as np
+
+
+class CheckpointStore:
+    def __init__(self, root: str, keep_epochs: int = 2):
+        self.root = root
+        self.keep_epochs = keep_epochs
+        os.makedirs(root, exist_ok=True)
+        self._manifest_path = os.path.join(root, "MANIFEST.json")
+
+    # -- manifest -------------------------------------------------------
+    def _load_manifest(self) -> dict:
+        if not os.path.exists(self._manifest_path):
+            return {"jobs": {}}
+        with open(self._manifest_path) as f:
+            return json.load(f)
+
+    def _store_manifest(self, m: dict) -> None:
+        tmp = self._manifest_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(m, f, indent=1)
+        os.replace(tmp, self._manifest_path)
+
+    # -- checkpoint save/load -------------------------------------------
+    def save(self, job_name: str, epoch: int, states: Any,
+             source_state: dict) -> None:
+        """Persist one committed epoch (the 'SST upload' + commit)."""
+        job_dir = os.path.join(self.root, job_name)
+        os.makedirs(job_dir, exist_ok=True)
+        host_states = jax.device_get(states)
+        leaves, treedef = jax.tree.flatten(host_states)
+        path = os.path.join(job_dir, f"epoch_{epoch}")
+        np.savez(path + ".npz.tmp.npz",
+                 **{f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)})
+        os.replace(path + ".npz.tmp.npz", path + ".npz")
+        with open(path + ".meta.tmp", "wb") as f:
+            pickle.dump({
+                "treedef": treedef, "source_state": source_state,
+                "epoch": epoch,
+            }, f)
+        os.replace(path + ".meta.tmp", path + ".meta")
+
+        m = self._load_manifest()
+        job = m["jobs"].setdefault(job_name, {"epochs": []})
+        job["epochs"].append(epoch)
+        job["committed"] = epoch
+        # GC beyond keep_epochs (ref: hummock version GC)
+        while len(job["epochs"]) > self.keep_epochs:
+            old = job["epochs"].pop(0)
+            for suffix in (".npz", ".meta"):
+                p = os.path.join(job_dir, f"epoch_{old}{suffix}")
+                if os.path.exists(p):
+                    os.remove(p)
+        self._store_manifest(m)
+
+    def committed_epoch(self, job_name: str) -> int | None:
+        m = self._load_manifest()
+        job = m["jobs"].get(job_name)
+        return None if job is None else job.get("committed")
+
+    def load(self, job_name: str, epoch: int | None = None):
+        """Load (epoch, states_host, source_state); latest if epoch None."""
+        if epoch is None:
+            epoch = self.committed_epoch(job_name)
+            if epoch is None:
+                return None
+        path = os.path.join(self.root, job_name, f"epoch_{epoch}")
+        with open(path + ".meta", "rb") as f:
+            meta = pickle.load(f)
+        with np.load(path + ".npz") as z:
+            leaves = [z[f"leaf_{i}"] for i in range(len(z.files))]
+        states = jax.tree.unflatten(meta["treedef"], leaves)
+        return epoch, states, meta["source_state"]
+
+    # -- MV export to SSTs ----------------------------------------------
+    def export_mv_sst(self, job_name: str, epoch: int, mv_executor,
+                      mv_state) -> str:
+        """Write an MV's rows as an SST keyed by memcomparable pk.
+
+        The serving path (or another process) can then read the MV at
+        this epoch without the job's device state — the reference's
+        batch-scan-from-Hummock pattern (SURVEY.md §3.4).
+        """
+        from risingwave_tpu.storage.sst import write_sst
+
+        rows = mv_executor.to_host(mv_state)
+        schema = mv_executor.in_schema
+        pk = getattr(mv_executor, "pk_indices", tuple(range(len(schema))))
+        encoded: list[tuple[bytes, bytes]] = []
+        for row in rows:
+            key = b"".join(
+                _mc_encode_value(row[i], schema[i]) for i in pk
+            )
+            val = pickle.dumps(row, protocol=4)
+            encoded.append((key, val))
+        encoded.sort(key=lambda kv: kv[0])
+        job_dir = os.path.join(self.root, job_name)
+        os.makedirs(job_dir, exist_ok=True)
+        path = os.path.join(job_dir, f"mv_epoch_{epoch}.sst")
+        write_sst(path, [k for k, _ in encoded], [v for _, v in encoded])
+        return path
+
+
+def _mc_encode_value(v, field) -> bytes:
+    from risingwave_tpu.common.types import DataType
+    from risingwave_tpu.storage import codec as C
+
+    t = field.data_type
+    if t.is_string:
+        # terminated string encoding keeps prefix ordering correct
+        return str(v).encode() + b"\x00"
+    if t == DataType.DECIMAL:
+        # to_host returns logical floats; re-scale to the exact integer
+        # representation so fractional pks don't collide
+        scaled = int(round(float(v) * 10**field.decimal_scale))
+        return C.mc_encode_i64(np.asarray([scaled])).tobytes()
+    if t in (DataType.FLOAT32, DataType.FLOAT64):
+        return C.mc_encode_f64(np.asarray([float(v)])).tobytes()
+    return C.mc_encode_i64(np.asarray([int(v)])).tobytes()

@@ -1,0 +1,236 @@
+"""ctypes bindings for the native storage codec (+ numpy fallback).
+
+The C++ library (native/rwtpu_codec.cpp) implements the hot host-side
+loops: memcomparable scalar encoding, varint block encode/decode,
+crc32c.  Built on first use with g++ and cached beside the source; a
+pure-numpy fallback keeps the storage layer functional without a
+toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)
+)))
+_SRC = os.path.join(_REPO_ROOT, "native", "rwtpu_codec.cpp")
+_SO = os.path.join(_REPO_ROOT, "native", "librwtpu_codec.so")
+
+_lock = threading.Lock()
+_lib = None
+_native_failed = False
+
+
+def _load():
+    global _lib, _native_failed
+    if _lib is not None or _native_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _native_failed:
+            return _lib
+        try:
+            if (not os.path.exists(_SO)
+                    or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", _SRC, "-o", _SO],
+                    check=True, capture_output=True,
+                )
+            lib = ctypes.CDLL(_SO)
+            u8p = ctypes.POINTER(ctypes.c_uint8)
+            i64p = ctypes.POINTER(ctypes.c_int64)
+            f64p = ctypes.POINTER(ctypes.c_double)
+            lib.mc_encode_i64.argtypes = [i64p, ctypes.c_int64, u8p]
+            lib.mc_decode_i64.argtypes = [u8p, ctypes.c_int64, i64p]
+            lib.mc_encode_f64.argtypes = [f64p, ctypes.c_int64, u8p]
+            lib.mc_decode_f64.argtypes = [u8p, ctypes.c_int64, f64p]
+            lib.block_encode.argtypes = [u8p, i64p, u8p, i64p,
+                                         ctypes.c_int64, u8p, ctypes.c_int64]
+            lib.block_encode.restype = ctypes.c_int64
+            lib.block_scan.argtypes = [u8p, ctypes.c_int64, i64p, i64p, i64p]
+            lib.block_scan.restype = ctypes.c_int64
+            lib.block_decode.argtypes = [u8p, ctypes.c_int64, u8p, i64p,
+                                         u8p, i64p]
+            lib.block_decode.restype = ctypes.c_int64
+            lib.rw_crc32c.argtypes = [u8p, ctypes.c_int64]
+            lib.rw_crc32c.restype = ctypes.c_uint32
+            _lib = lib
+        except Exception:
+            _native_failed = True
+    return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def _u8(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def _i64(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _f64(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+
+
+# ---------------------------------------------------------------------------
+# memcomparable encoding
+
+
+def mc_encode_i64(vals: np.ndarray) -> np.ndarray:
+    vals = np.ascontiguousarray(vals, np.int64)
+    lib = _load()
+    out = np.empty(len(vals) * 8, np.uint8)
+    if lib is not None:
+        lib.mc_encode_i64(_i64(vals), len(vals), _u8(out))
+        return out.reshape(len(vals), 8)
+    u = (vals.view(np.uint64) ^ np.uint64(1 << 63)).byteswap()
+    return u.view(np.uint8).reshape(len(vals), 8)
+
+
+def mc_decode_i64(data: np.ndarray) -> np.ndarray:
+    data = np.ascontiguousarray(data, np.uint8).reshape(-1, 8)
+    lib = _load()
+    out = np.empty(len(data), np.int64)
+    if lib is not None:
+        lib.mc_decode_i64(_u8(data), len(data), _i64(out))
+        return out
+    u = data.reshape(-1).view(np.uint64).byteswap()
+    return (u ^ np.uint64(1 << 63)).view(np.int64)
+
+
+def mc_encode_f64(vals: np.ndarray) -> np.ndarray:
+    vals = np.ascontiguousarray(vals, np.float64)
+    lib = _load()
+    out = np.empty(len(vals) * 8, np.uint8)
+    if lib is not None:
+        lib.mc_encode_f64(_f64(vals), len(vals), _u8(out))
+        return out.reshape(len(vals), 8)
+    u = vals.view(np.uint64)
+    u = np.where(u >> np.uint64(63), ~u, u | np.uint64(1 << 63))
+    return u.byteswap().view(np.uint8).reshape(len(vals), 8)
+
+
+def mc_decode_f64(data: np.ndarray) -> np.ndarray:
+    data = np.ascontiguousarray(data, np.uint8).reshape(-1, 8)
+    lib = _load()
+    out = np.empty(len(data), np.float64)
+    if lib is not None:
+        lib.mc_decode_f64(_u8(data), len(data), _f64(out))
+        return out
+    u = data.reshape(-1).view(np.uint64).byteswap()
+    u = np.where(u >> np.uint64(63), u & np.uint64(0x7FFFFFFFFFFFFFFF), ~u)
+    return u.view(np.float64)
+
+
+# ---------------------------------------------------------------------------
+# block codec
+
+
+def block_encode(keys: np.ndarray, key_offsets: np.ndarray,
+                 vals: np.ndarray, val_offsets: np.ndarray) -> bytes:
+    """Encode n records given flat byte pools + (n+1) offset arrays."""
+    n = len(key_offsets) - 1
+    keys = np.ascontiguousarray(keys, np.uint8)
+    vals = np.ascontiguousarray(vals, np.uint8)
+    key_offsets = np.ascontiguousarray(key_offsets, np.int64)
+    val_offsets = np.ascontiguousarray(val_offsets, np.int64)
+    lib = _load()
+    if lib is not None:
+        cap = int(keys.size + vals.size + 20 * n + 64)
+        out = np.empty(cap, np.uint8)
+        w = lib.block_encode(_u8(keys), _i64(key_offsets), _u8(vals),
+                             _i64(val_offsets), n, _u8(out), cap)
+        if w < 0:
+            raise RuntimeError("block_encode overflow")
+        return out[:w].tobytes()
+    # fallback
+    import io
+    buf = io.BytesIO()
+    for i in range(n):
+        k = keys[key_offsets[i]:key_offsets[i + 1]].tobytes()
+        v = vals[val_offsets[i]:val_offsets[i + 1]].tobytes()
+        buf.write(_varint(len(k)))
+        buf.write(k)
+        buf.write(_varint(len(v)))
+        buf.write(v)
+    return buf.getvalue()
+
+
+def block_decode(data: bytes):
+    """Decode a block → (keys, key_offsets, vals, val_offsets)."""
+    arr = np.frombuffer(data, np.uint8)
+    lib = _load()
+    if lib is not None:
+        n = np.zeros(1, np.int64)
+        kb = np.zeros(1, np.int64)
+        vb = np.zeros(1, np.int64)
+        rc = lib.block_scan(_u8(arr), len(arr), _i64(n), _i64(kb), _i64(vb))
+        if rc < 0:
+            raise ValueError("corrupt block")
+        keys = np.empty(int(kb[0]), np.uint8)
+        vals = np.empty(int(vb[0]), np.uint8)
+        ko = np.empty(int(n[0]) + 1, np.int64)
+        vo = np.empty(int(n[0]) + 1, np.int64)
+        got = lib.block_decode(_u8(arr), len(arr), _u8(keys), _i64(ko),
+                               _u8(vals), _i64(vo))
+        if got != n[0]:
+            raise ValueError("corrupt block")
+        return keys, ko, vals, vo
+    # fallback
+    keys_l, vals_l = [], []
+    i = 0
+    while i < len(data):
+        klen, i = _read_varint(data, i)
+        keys_l.append(data[i:i + klen]); i += klen
+        vlen, i = _read_varint(data, i)
+        vals_l.append(data[i:i + vlen]); i += vlen
+    ko = np.cumsum([0] + [len(k) for k in keys_l]).astype(np.int64)
+    vo = np.cumsum([0] + [len(v) for v in vals_l]).astype(np.int64)
+    keys = np.frombuffer(b"".join(keys_l), np.uint8)
+    vals = np.frombuffer(b"".join(vals_l), np.uint8)
+    return keys, ko, vals, vo
+
+
+def crc32c(data: bytes) -> int:
+    lib = _load()
+    arr = np.frombuffer(data, np.uint8)
+    if lib is not None:
+        return int(lib.rw_crc32c(_u8(np.ascontiguousarray(arr)), len(arr)))
+    # fallback: python crc32c (slow but correct)
+    poly = 0x82F63B78
+    c = 0xFFFFFFFF
+    for b in data:
+        c ^= b
+        for _ in range(8):
+            c = (poly ^ (c >> 1)) if c & 1 else (c >> 1)
+    return c ^ 0xFFFFFFFF
+
+
+def _varint(v: int) -> bytes:
+    out = bytearray()
+    while v >= 0x80:
+        out.append((v & 0x7F) | 0x80)
+        v >>= 7
+    out.append(v)
+    return bytes(out)
+
+
+def _read_varint(data: bytes, i: int):
+    x = 0
+    shift = 0
+    while True:
+        b = data[i]
+        i += 1
+        x |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            return x, i
+        shift += 7

@@ -1,0 +1,180 @@
+"""Block-based sorted-string-table files + k-way merge reads.
+
+Reference counterpart: ``src/storage/src/hummock/sstable/`` (block
+format, builder, multi-SST iterators — SURVEY.md §2.5).  Simplified
+round-1 format, one file per SST:
+
+    [block 0][block 1]...[block k-1][index json][footer]
+    footer = index_offset (8B LE) + index_len (8B LE) + magic (8B)
+
+Each block holds varint-framed (key, value) records in key order with a
+crc32c trailer; the index stores each block's first key + offset/len.
+Point gets binary-search the index then scan one block; range scans
+merge blocks.  ``merge_iter`` merges multiple SSTs newest-first with
+tombstone handling — the LSM read path (compaction lands next round).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+from dataclasses import dataclass
+
+import numpy as np
+
+from risingwave_tpu.storage import codec
+
+MAGIC = b"RWTPUSST"
+TOMBSTONE = b"\xff\xfe__tombstone__"
+DEFAULT_BLOCK_BYTES = 64 * 1024
+
+
+@dataclass
+class SstMeta:
+    path: str
+    first_key: bytes
+    last_key: bytes
+    n_records: int
+
+
+def write_sst(path: str, keys: list[bytes], values: list[bytes],
+              block_bytes: int = DEFAULT_BLOCK_BYTES) -> SstMeta:
+    """Write sorted (key, value) pairs; keys must be pre-sorted unique."""
+    assert len(keys) == len(values)
+    index = []
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        i = 0
+        offset = 0
+        while i < len(keys):
+            # greedy block packing
+            j = i
+            sz = 0
+            while j < len(keys) and (sz < block_bytes or j == i):
+                sz += len(keys[j]) + len(values[j]) + 10
+                j += 1
+            blk_keys = keys[i:j]
+            blk_vals = values[i:j]
+            ko = np.cumsum([0] + [len(k) for k in blk_keys]).astype(np.int64)
+            vo = np.cumsum([0] + [len(v) for v in blk_vals]).astype(np.int64)
+            kpool = np.frombuffer(b"".join(blk_keys), np.uint8)
+            vpool = np.frombuffer(b"".join(blk_vals), np.uint8)
+            block = codec.block_encode(kpool, ko, vpool, vo)
+            crc = struct.pack("<I", codec.crc32c(block))
+            f.write(block)
+            f.write(crc)
+            index.append({
+                "first_key": blk_keys[0].hex(),
+                "offset": offset,
+                "len": len(block),
+            })
+            offset += len(block) + 4
+            i = j
+        index_bytes = json.dumps({
+            "blocks": index, "n": len(keys),
+        }).encode()
+        f.write(index_bytes)
+        f.write(struct.pack("<QQ", offset, len(index_bytes)))
+        f.write(MAGIC)
+    os.replace(tmp, path)
+    return SstMeta(
+        path=path,
+        first_key=keys[0] if keys else b"",
+        last_key=keys[-1] if keys else b"",
+        n_records=len(keys),
+    )
+
+
+class SstReader:
+    def __init__(self, path: str):
+        self.path = path
+        self._f = open(path, "rb")
+        self._f.seek(-24, os.SEEK_END)
+        tail = self._f.read(24)
+        index_offset, index_len = struct.unpack("<QQ", tail[:16])
+        if tail[16:] != MAGIC:
+            raise ValueError(f"{path}: bad magic")
+        self._f.seek(index_offset)
+        self.index = json.loads(self._f.read(index_len))
+        self._block_first_keys = [
+            bytes.fromhex(b["first_key"]) for b in self.index["blocks"]
+        ]
+
+    def close(self) -> None:
+        self._f.close()
+
+    def __del__(self):  # best-effort
+        try:
+            self._f.close()
+        except Exception:
+            pass
+
+    @property
+    def n_records(self) -> int:
+        return self.index["n"]
+
+    def _read_block(self, bi: int):
+        meta = self.index["blocks"][bi]
+        self._f.seek(meta["offset"])
+        data = self._f.read(meta["len"] + 4)
+        block, crc = data[:-4], struct.unpack("<I", data[-4:])[0]
+        if codec.crc32c(block) != crc:
+            raise ValueError(f"{self.path}: block {bi} checksum mismatch")
+        keys, ko, vals, vo = codec.block_decode(block)
+        out = []
+        kb = keys.tobytes()
+        vb = vals.tobytes()
+        for i in range(len(ko) - 1):
+            out.append((kb[ko[i]:ko[i + 1]], vb[vo[i]:vo[i + 1]]))
+        return out
+
+    def get(self, key: bytes) -> bytes | None:
+        import bisect
+        bi = bisect.bisect_right(self._block_first_keys, key) - 1
+        if bi < 0:
+            return None
+        for k, v in self._read_block(bi):
+            if k == key:
+                return v
+        return None
+
+    def scan(self, lo: bytes = b"", hi: bytes | None = None):
+        """Yield (key, value) with lo <= key < hi."""
+        import bisect
+        start = max(bisect.bisect_right(self._block_first_keys, lo) - 1, 0)
+        for bi in range(start, len(self.index["blocks"])):
+            for k, v in self._read_block(bi):
+                if k < lo:
+                    continue
+                if hi is not None and k >= hi:
+                    return
+                yield k, v
+
+
+def merge_scan(readers: list[SstReader], lo: bytes = b"",
+               hi: bytes | None = None):
+    """K-way merge over SSTs, newest FIRST in ``readers``; per key the
+    newest value wins; tombstones suppress (ref MergeIterator,
+    src/storage/src/hummock/iterator/merge_inner.rs:62)."""
+    import heapq
+
+    iters = []
+    for gen, r in enumerate(readers):
+        it = r.scan(lo, hi)
+        first = next(it, None)
+        if first is not None:
+            iters.append((first[0], gen, first[1], it))
+    heapq.heapify(iters)
+    last_key = None
+    while iters:
+        k, gen, v, it = heapq.heappop(iters)
+        nxt = next(it, None)
+        if nxt is not None:
+            heapq.heappush(iters, (nxt[0], gen, nxt[1], it))
+        if k == last_key:
+            continue  # older generation shadowed
+        last_key = k
+        if v == TOMBSTONE:
+            continue
+        yield k, v

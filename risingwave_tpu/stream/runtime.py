@@ -86,6 +86,17 @@ def maintain_fragment(fragment: Fragment, states, name: str):
     return tuple(states)
 
 
+def restore_source(source, state: dict) -> None:
+    """Restore a source from its checkpointed state() dict.
+
+    Sources may implement ``restore(state)`` for full-fidelity recovery;
+    the fallback covers plain offset-cursor sources."""
+    if hasattr(source, "restore"):
+        source.restore(state)
+    elif hasattr(source, "offset") and "offset" in state:
+        source.offset = state["offset"]
+
+
 def check_state_counters(name: str, st) -> None:
     if hasattr(st, "inconsistency") and int(st.inconsistency) > 0:
         raise RuntimeError(
@@ -112,11 +123,15 @@ class StreamingJob:
         fragment: Fragment,
         name: str = "job",
         checkpoint_frequency: int = 1,
+        checkpoint_store=None,
     ):
         self.source = source
         self.fragment = fragment
         self.name = name
         self.checkpoint_frequency = checkpoint_frequency
+        #: optional durable store (storage.CheckpointStore); when set,
+        #: commits persist across process restarts
+        self.checkpoint_store = checkpoint_store
         self.states = fragment.init_states()
         self.epoch = EpochPair.first()
         self.barriers_seen = 0
@@ -184,16 +199,21 @@ class StreamingJob:
 
     def _commit_checkpoint(self, barrier: Barrier) -> None:
         epoch_val = barrier.epoch.prev.value
+        src_state = self.source.state() if hasattr(self.source, "state") \
+            else {}
         snap = CheckpointSnapshot(
             epoch=epoch_val,
             states=jax.device_get(self.states),
-            source_state=self.source.state() if hasattr(self.source, "state")
-            else {},
+            source_state=src_state,
         )
-        # retain only the latest committed snapshot (ref: Hummock keeps
-        # versions; version history arrives with the storage layer)
+        # retain only the latest committed snapshot in memory; the
+        # durable store keeps epoch history (ref: Hummock versions)
         self.checkpoints = [snap]
         self.committed_epoch = epoch_val
+        if self.checkpoint_store is not None:
+            self.checkpoint_store.save(
+                self.name, epoch_val, snap.states, src_state
+            )
 
     def _apply_mutation(self, mutation) -> None:
         if mutation.kind == "pause":
@@ -206,7 +226,17 @@ class StreamingJob:
     # -- recovery -------------------------------------------------------
     def recover(self) -> None:
         """Reset to the last committed checkpoint (ref §3.5 recovery:
-        rebuild actors + resume from last committed epoch)."""
+        rebuild actors + resume from last committed epoch).  Prefers the
+        durable store (survives process restarts) over the in-memory
+        snapshot."""
+        if self.checkpoint_store is not None:
+            loaded = self.checkpoint_store.load(self.name)
+            if loaded is not None:
+                epoch, states, src_state = loaded
+                self.states = jax.device_put(states)
+                self.committed_epoch = epoch
+                restore_source(self.source, src_state)
+                return
         if not self.checkpoints:
             self.states = self.fragment.init_states()
             if hasattr(self.source, "offset"):
@@ -214,8 +244,7 @@ class StreamingJob:
             return
         snap = self.checkpoints[-1]
         self.states = jax.device_put(snap.states)
-        if hasattr(self.source, "offset") and "offset" in snap.source_state:
-            self.source.offset = snap.source_state["offset"]
+        restore_source(self.source, snap.source_state)
 
     # ------------------------------------------------------------------
     def run(self, barriers: int, chunks_per_barrier: int) -> None:
@@ -249,7 +278,9 @@ class BinaryJob:
         right_fragment: Fragment | None = None,
         checkpoint_frequency: int = 1,
         name: str = "join_job",
+        checkpoint_store=None,
     ):
+        self.checkpoint_store = checkpoint_store
         self.left_source = left_source
         self.right_source = right_source
         self.join = join
@@ -347,18 +378,23 @@ class BinaryJob:
         if self.barriers_seen % self.checkpoint_frequency == 0:
             self._maintain()
             lstate, rstate, jstate, pstate = self.states
+            src_state = {
+                "left": self.left_source.state()
+                if hasattr(self.left_source, "state") else {},
+                "right": self.right_source.state()
+                if hasattr(self.right_source, "state") else {},
+            }
             snap = CheckpointSnapshot(
                 epoch=sealed,
                 states=jax.device_get(self.states),
-                source_state={
-                    "left": self.left_source.state()
-                    if hasattr(self.left_source, "state") else {},
-                    "right": self.right_source.state()
-                    if hasattr(self.right_source, "state") else {},
-                },
+                source_state=src_state,
             )
             self.checkpoints = [snap]
             self.committed_epoch = sealed
+            if self.checkpoint_store is not None:
+                self.checkpoint_store.save(
+                    self.name, sealed, snap.states, src_state
+                )
         self.epoch = self.epoch.bump()
 
     def _maintain(self) -> None:
@@ -384,6 +420,16 @@ class BinaryJob:
 
     def recover(self) -> None:
         """Reset to the last committed checkpoint (ref §3.5)."""
+        if self.checkpoint_store is not None:
+            loaded = self.checkpoint_store.load(self.name)
+            if loaded is not None:
+                epoch, states, src_state = loaded
+                self.states = jax.device_put(states)
+                self.committed_epoch = epoch
+                for side, src in (("left", self.left_source),
+                                  ("right", self.right_source)):
+                    restore_source(src, src_state.get(side, {}))
+                return
         if not self.checkpoints:
             self.states = (
                 self.left_frag.init_states() if self.left_frag else (),
@@ -399,9 +445,7 @@ class BinaryJob:
         self.states = jax.device_put(snap.states)
         for side, src in (("left", self.left_source),
                           ("right", self.right_source)):
-            st = snap.source_state.get(side, {})
-            if hasattr(src, "offset") and "offset" in st:
-                src.offset = st["offset"]
+            restore_source(src, snap.source_state.get(side, {}))
 
     def run(self, barriers: int, chunks_per_barrier: int) -> None:
         for _ in range(barriers):
