@@ -1,0 +1,164 @@
+"""Layered configuration system.
+
+Reference counterpart (SURVEY.md §5.6): the reference layers
+1. per-node TOML config (``RwConfig``, src/common/src/config/mod.rs:81)
+2. cluster-wide runtime-mutable system params
+   (src/common/src/system_param/mod.rs:84)
+3. per-session ``SET`` variables (src/common/src/session_config/)
+4. WITH options on sources/sinks (handled by the SQL layer).
+
+Here: dataclass sections mirroring (1), a ``SystemParams`` registry with
+mutability flags mirroring (2) (``ALTER SYSTEM SET`` in the engine), and
+``SessionConfig`` for (3).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class StreamingConfig:
+    """ref config streaming section (src/common/src/config/streaming.rs)."""
+
+    chunk_size: int = 4096           # ref default 256; TPU chunks are larger
+    in_flight_barrier_nums: int = 1  # host loop is synchronous this round
+    exchange_vnode_count: int = 256
+
+
+@dataclass
+class StorageConfig:
+    """ref config storage section."""
+
+    data_directory: str | None = None   # None = in-memory checkpoints only
+    checkpoint_keep_epochs: int = 2
+    sst_block_size_bytes: int = 64 * 1024
+
+
+@dataclass
+class StateConfig:
+    """capacity knobs for device state tables (planner defaults)."""
+
+    agg_table_size: int = 1 << 16
+    agg_emit_capacity: int = 4096
+    join_table_size: int = 1 << 14
+    join_bucket_cap: int = 64
+    join_out_capacity: int = 1 << 15
+    topn_pool_size: int = 4096
+    topn_emit_capacity: int = 1024
+    mv_table_size: int = 1 << 16
+    mv_ring_size: int = 1 << 20
+
+
+@dataclass
+class RwConfig:
+    """Top-level node config (ref RwConfig, config/mod.rs:81)."""
+
+    streaming: StreamingConfig = field(default_factory=StreamingConfig)
+    storage: StorageConfig = field(default_factory=StorageConfig)
+    state: StateConfig = field(default_factory=StateConfig)
+
+    @staticmethod
+    def from_dict(d: dict) -> "RwConfig":
+        cfg = RwConfig()
+        for section_name, section in d.items():
+            target = getattr(cfg, section_name)
+            for k, v in section.items():
+                if not hasattr(target, k):
+                    raise KeyError(f"unknown config {section_name}.{k}")
+                setattr(target, k, v)
+        return cfg
+
+
+# ---------------------------------------------------------------------------
+# system params: cluster-wide, runtime mutable, persisted with checkpoints
+# (ref system_param/mod.rs:84 — declared with defaults + mutability)
+
+_SYSTEM_PARAM_DEFS = {
+    # name: (default, mutable)
+    "barrier_interval_ms": (1000, True),   # ref :84
+    "checkpoint_frequency": (1, True),     # ref :85
+    "chunks_per_barrier": (1, True),       # TPU batch knob (no ref analog)
+    "max_concurrent_creating_streaming_jobs": (1, True),
+    "pause_on_next_bootstrap": (False, True),
+}
+
+
+
+
+def _coerce(default, value):
+    """Type-safe coercion for param writes (bool('false') is True...)."""
+    if isinstance(default, bool):
+        if isinstance(value, bool):
+            return value
+        if isinstance(value, str):
+            low = value.strip().lower()
+            if low in ("true", "t", "on", "1"):
+                return True
+            if low in ("false", "f", "off", "0"):
+                return False
+            raise ValueError(f"not a boolean: {value!r}")
+        return bool(value)
+    if isinstance(default, int):
+        if isinstance(value, float) and value != int(value):
+            raise ValueError(f"not an integer: {value!r}")
+        return int(value)
+    if isinstance(default, float):
+        return float(value)
+    return type(default)(value)
+
+
+class SystemParams:
+    def __init__(self, overrides: dict | None = None):
+        self._values = {k: v for k, (v, _) in _SYSTEM_PARAM_DEFS.items()}
+        for k, v in (overrides or {}).items():
+            self.set(k, v)
+
+    def get(self, name: str):
+        if name not in self._values:
+            raise KeyError(f"unknown system param {name!r}")
+        return self._values[name]
+
+    def set(self, name: str, value) -> None:
+        if name not in _SYSTEM_PARAM_DEFS:
+            raise KeyError(f"unknown system param {name!r}")
+        default, mutable = _SYSTEM_PARAM_DEFS[name]
+        if not mutable:
+            raise ValueError(f"system param {name!r} is immutable")
+        self._values[name] = _coerce(default, value)
+
+    def to_dict(self) -> dict:
+        return dict(self._values)
+
+
+# ---------------------------------------------------------------------------
+# session config (ref session_config/mod.rs — SET-able per session)
+
+_SESSION_DEFS = {
+    "query_epoch": (0, "read at a specific committed epoch (0 = latest)"),
+    "streaming_parallelism": (0, "0 = adaptive (all shards)"),
+    "timezone": ("UTC", "display timezone"),
+    "batch_row_limit": (1_000_000, "serving scan cap"),
+}
+
+
+class SessionConfig:
+    def __init__(self):
+        self._values = {k: v for k, (v, _) in _SESSION_DEFS.items()}
+
+    def get(self, name: str):
+        if name not in self._values:
+            raise KeyError(f"unknown session variable {name!r}")
+        return self._values[name]
+
+    def set(self, name: str, value) -> None:
+        if name not in _SESSION_DEFS:
+            raise KeyError(f"unknown session variable {name!r}")
+        default, _ = _SESSION_DEFS[name]
+        self._values[name] = _coerce(default, value)
+
+    def show_all(self) -> list[tuple[str, str, str]]:
+        return [
+            (k, str(self._values[k]), _SESSION_DEFS[k][1])
+            for k in sorted(self._values)
+        ]

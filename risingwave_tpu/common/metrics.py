@@ -1,0 +1,130 @@
+"""Lightweight metrics registry (counters / gauges / histograms).
+
+Reference counterpart (SURVEY.md §5.5): guarded Prometheus metrics
+(src/common/metrics/src/guarded_metrics.rs) with per-subsystem
+registries (``StreamingMetrics`` etc.).  Here: an in-process registry
+with labeled series and a Prometheus-text exporter, feeding the
+``rw_catalog``-style introspection the engine exposes.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from collections import defaultdict
+
+
+class _Series:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+
+class _HistSeries:
+    __slots__ = ("buckets", "counts", "total", "sum")
+
+    def __init__(self, buckets):
+        self.buckets = buckets
+        self.counts = [0] * (len(buckets) + 1)
+        self.total = 0
+        self.sum = 0.0
+
+    def observe(self, v: float):
+        self.counts[bisect.bisect_left(self.buckets, v)] += 1
+        self.total += 1
+        self.sum += v
+
+
+_DEFAULT_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0,
+)
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[tuple, _Series] = defaultdict(_Series)
+        self._gauges: dict[tuple, _Series] = defaultdict(_Series)
+        self._hists: dict[tuple, _HistSeries] = {}
+
+    # ------------------------------------------------------------------
+    def counter(self, name: str, **labels) -> None:
+        raise TypeError("use inc()")
+
+    def inc(self, name: str, amount: float = 1.0, **labels) -> None:
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            self._counters[key].value += amount
+
+    def set_gauge(self, name: str, value: float, **labels) -> None:
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            self._gauges[key].value = value
+
+    def observe(self, name: str, value: float, **labels) -> None:
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            if key not in self._hists:
+                self._hists[key] = _HistSeries(_DEFAULT_BUCKETS)
+            self._hists[key].observe(value)
+
+    # ------------------------------------------------------------------
+    def get(self, name: str, **labels) -> float:
+        key = (name, tuple(sorted(labels.items())))
+        if key in self._counters:
+            return self._counters[key].value
+        if key in self._gauges:
+            return self._gauges[key].value
+        raise KeyError(name)
+
+    def quantile(self, name: str, q: float, **labels) -> float:
+        """Approximate quantile from histogram buckets (upper bound)."""
+        key = (name, tuple(sorted(labels.items())))
+        h = self._hists[key]
+        target = q * h.total
+        seen = 0
+        for i, c in enumerate(h.counts):
+            seen += c
+            if seen >= target:
+                return h.buckets[i] if i < len(h.buckets) else float("inf")
+        return float("inf")
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition (the scrape surface)."""
+        out = []
+
+        def fmt_labels(labels):
+            if not labels:
+                return ""
+            inner = ",".join(f'{k}="{v}"' for k, v in labels)
+            return "{" + inner + "}"
+
+        with self._lock:
+            for (name, labels), s in sorted(self._counters.items()):
+                out.append(f"{name}{fmt_labels(labels)} {s.value}")
+            for (name, labels), s in sorted(self._gauges.items()):
+                out.append(f"{name}{fmt_labels(labels)} {s.value}")
+            for (name, labels), h in sorted(self._hists.items()):
+                acc = 0
+                for i, b in enumerate(h.buckets):
+                    acc += h.counts[i]
+                    lb = dict(labels)
+                    lb["le"] = b
+                    out.append(
+                        f"{name}_bucket{fmt_labels(sorted(lb.items()))} {acc}"
+                    )
+                lb = dict(labels)
+                lb["le"] = "+Inf"
+                out.append(
+                    f"{name}_bucket{fmt_labels(sorted(lb.items()))} "
+                    f"{h.total}"
+                )
+                out.append(f"{name}_count{fmt_labels(labels)} {h.total}")
+                out.append(f"{name}_sum{fmt_labels(labels)} {h.sum}")
+        return "\n".join(out) + "\n"
+
+
+#: process-wide default registry (subsystems may make their own)
+GLOBAL_METRICS = MetricsRegistry()

@@ -176,3 +176,20 @@ class ShowStatement:
 @dataclass(frozen=True)
 class FlushStatement:
     pass
+
+
+@dataclass(frozen=True)
+class SetStatement:
+    name: str
+    value: Any
+    system: bool = False  # ALTER SYSTEM SET vs session SET
+
+
+@dataclass(frozen=True)
+class ShowParameters:
+    pass
+
+
+@dataclass(frozen=True)
+class Explain:
+    statement: Any

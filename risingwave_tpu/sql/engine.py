@@ -14,11 +14,14 @@ scheduler (SURVEY.md §2.4), and the batch local-execution mode
 
 from __future__ import annotations
 
+import time
 from typing import Any, Sequence
 
 import numpy as np
 
 from risingwave_tpu.common.chunk import Chunk
+from risingwave_tpu.common.config import RwConfig, SessionConfig, SystemParams
+from risingwave_tpu.common.metrics import MetricsRegistry
 from risingwave_tpu.common.types import DataType, Field, Schema
 from risingwave_tpu.connector.nexmark import (
     AUCTION_SCHEMA,
@@ -43,11 +46,42 @@ from risingwave_tpu.stream.runtime import BinaryJob, StreamingJob
 
 
 class Engine:
-    def __init__(self, config: PlannerConfig | None = None):
+    def __init__(self, config: "PlannerConfig | RwConfig | None" = None,
+                 data_dir: str | None = None):
         self.catalog = Catalog()
-        self.config = config or PlannerConfig()
+        if isinstance(config, RwConfig):
+            self.rw_config = config
+            st = config.state
+            self.config = PlannerConfig(
+                chunk_capacity=config.streaming.chunk_size,
+                agg_table_size=st.agg_table_size,
+                agg_emit_capacity=st.agg_emit_capacity,
+                join_table_size=st.join_table_size,
+                join_bucket_cap=st.join_bucket_cap,
+                join_out_capacity=st.join_out_capacity,
+                topn_pool_size=st.topn_pool_size,
+                topn_emit_capacity=st.topn_emit_capacity,
+                mv_table_size=st.mv_table_size,
+                mv_ring_size=st.mv_ring_size,
+            )
+            data_dir = data_dir or config.storage.data_directory
+        else:
+            self.rw_config = RwConfig()
+            self.config = config or PlannerConfig()
         self.planner = Planner(self.catalog, self.config)
         self.jobs: list[Any] = []
+        self.system_params = SystemParams()
+        self.session_config = SessionConfig()
+        # per-engine registry: restarted engines must not inherit a
+        # dead engine's counters for same-named jobs
+        self.metrics = MetricsRegistry()
+        self.checkpoint_store = None
+        if data_dir is not None:
+            from risingwave_tpu.storage import CheckpointStore
+            self.checkpoint_store = CheckpointStore(
+                data_dir,
+                keep_epochs=self.rw_config.storage.checkpoint_keep_epochs,
+            )
 
     # ------------------------------------------------------------------
     def execute(self, sql: str):
@@ -83,9 +117,49 @@ class Engine:
         if isinstance(stmt, ast.FlushStatement):
             self.tick(barriers=1, chunks_per_barrier=0)
             return None
+        if isinstance(stmt, ast.SetStatement):
+            if stmt.system:
+                self.system_params.set(stmt.name, stmt.value)
+            else:
+                self.session_config.set(stmt.name, stmt.value)
+            return None
+        if isinstance(stmt, ast.ShowParameters):
+            return self.session_config.show_all() + [
+                (k, str(v), "system")
+                for k, v in sorted(self.system_params.to_dict().items())
+            ]
+        if isinstance(stmt, ast.Explain):
+            return self._explain(stmt.statement)
         if isinstance(stmt, ast.Select):
             return self._serve(stmt)
         raise ValueError(f"unhandled statement {stmt!r}")
+
+    def _explain(self, stmt) -> list[tuple[str]]:
+        """Plan description (ref handler/explain.rs, simplified)."""
+        if isinstance(stmt, ast.CreateMaterializedView):
+            query = stmt.query
+        elif isinstance(stmt, ast.Select):
+            query = stmt
+        else:
+            return [(f"DDL: {type(stmt).__name__}",)]
+        plan = self.planner.plan(query)
+        lines: list[tuple[str]] = []
+        if isinstance(plan, UnaryPlan):
+            lines.append(("StreamJob",))
+            lines.append((f"  Source: {type(plan.reader).__name__}",))
+            for ex in plan.fragment.executors:
+                lines.append((f"  {ex!r}",))
+        else:
+            lines.append(("StreamJob (two-input)",))
+            for side, frag in (("left", plan.left_fragment),
+                               ("right", plan.right_fragment)):
+                if frag:
+                    for ex in frag.executors:
+                        lines.append((f"  [{side}] {ex!r}",))
+            lines.append((f"  HashJoin(keys={len(plan.join.left_keys)})",))
+            for ex in plan.post_fragment.executors:
+                lines.append((f"  {ex!r}",))
+        return lines
 
     # -- DDL -------------------------------------------------------------
     def _create_source(self, stmt: ast.CreateSource):
@@ -162,8 +236,13 @@ class Engine:
 
     def _create_mview(self, stmt: ast.CreateMaterializedView):
         plan = self.planner.plan(stmt.query)
+        ckpt_freq = int(self.system_params.get("checkpoint_frequency"))
         if isinstance(plan, UnaryPlan):
-            job = StreamingJob(plan.reader, plan.fragment, stmt.name)
+            job = StreamingJob(
+                plan.reader, plan.fragment, stmt.name,
+                checkpoint_frequency=ckpt_freq,
+                checkpoint_store=self.checkpoint_store,
+            )
             mv_exec = plan.fragment.executors[plan.mv_index]
             state_index = (plan.mv_index,)
         else:
@@ -173,6 +252,8 @@ class Engine:
                 left_fragment=plan.left_fragment,
                 right_fragment=plan.right_fragment,
                 name=stmt.name,
+                checkpoint_frequency=ckpt_freq,
+                checkpoint_store=self.checkpoint_store,
             )
             mv_exec = plan.post_fragment.executors[plan.mv_index]
             state_index = (3, plan.mv_index)
@@ -187,18 +268,41 @@ class Engine:
         return None
 
     # -- the global barrier loop ----------------------------------------
-    def tick(self, barriers: int = 1, chunks_per_barrier: int = 1) -> None:
+    def tick(self, barriers: int = 1,
+             chunks_per_barrier: int | None = None) -> None:
         """Advance every streaming job (meta's PeriodicBarriers analog)."""
+        if chunks_per_barrier is None:
+            chunks_per_barrier = int(
+                self.system_params.get("chunks_per_barrier")
+            )
+        # runtime-mutable cadence (ref ALTER SYSTEM SET applies live)
+        ckpt_freq = int(self.system_params.get("checkpoint_frequency"))
         for _ in range(barriers):
             for job in self.jobs:
+                job.checkpoint_frequency = ckpt_freq
+                t0 = time.perf_counter()
+                rows = 0
                 if isinstance(job, BinaryJob):
                     for _ in range(chunks_per_barrier):
-                        job.run_chunk("left")
-                        job.run_chunk("right")
+                        rows += job.run_chunk("left")
+                        rows += job.run_chunk("right")
                 else:
                     for _ in range(chunks_per_barrier):
-                        job.run_chunk()
+                        rows += job.run_chunk()
                 job.inject_barrier()
+                dt = time.perf_counter() - t0
+                self.metrics.inc("stream_rows_total", rows, job=job.name)
+                self.metrics.observe("barrier_latency_seconds", dt,
+                                     job=job.name)
+                self.metrics.set_gauge(
+                    "committed_epoch", job.committed_epoch, job=job.name
+                )
+
+    def recover(self) -> None:
+        """Restore every job from its last committed checkpoint
+        (ref §3.5: meta-driven recovery across all streaming jobs)."""
+        for job in self.jobs:
+            job.recover()
 
     # -- serving reads ---------------------------------------------------
     def _mv_rows(self, entry: CatalogEntry):

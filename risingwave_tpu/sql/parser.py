@@ -138,21 +138,48 @@ class Parser:
 
     # -- entry ----------------------------------------------------------
     def parse_statement(self):
+        if self.accept_word("explain"):
+            return ast.Explain(self.parse_statement())
         if self.accept_word("create"):
             return self._create()
         if self.accept_word("drop"):
             return self._drop()
         if self.accept_word("show"):
+            if self.accept_word("parameters") or self.accept_word("all"):
+                return ast.ShowParameters()
             kind = self.ident()
             if kind == "materialized":
                 self.expect_word("views")
                 kind = "materialized views"
             return ast.ShowStatement(kind)
+        if self.accept_word("alter"):
+            self.expect_word("system")
+            self.expect_word("set")
+            return self._set(system=True)
+        if self.accept_word("set"):
+            return self._set(system=False)
         if self.accept_word("flush"):
             return ast.FlushStatement()
         if self.peek() and self.peek().value == "select":
             return self._select()
         raise ParseError(f"unsupported statement at {self.peek()}")
+
+    def _set(self, system: bool):
+        name = self.ident()
+        while self.accept_op("."):
+            name += "." + self.ident()
+        if not self.accept_op("="):
+            self.expect_word("to")
+        t = self.next()
+        if t.kind == "number":
+            value = float(t.value) if "." in t.value else int(t.value)
+        elif t.kind == "string":
+            value = t.value[1:-1]
+        elif t.kind == "word" and t.value in ("true", "false"):
+            value = t.value == "true"
+        else:
+            value = t.value
+        return ast.SetStatement(name, value, system)
 
     # -- DDL ------------------------------------------------------------
     def _if_not_exists(self) -> bool:

@@ -141,12 +141,16 @@ class StreamingJob:
         self.paused = False
 
     # ------------------------------------------------------------------
-    def run_chunk(self) -> None:
-        """Pull one chunk from the source through the fragment."""
+    def run_chunk(self) -> int:
+        """Pull one chunk from the source through the fragment.
+
+        Returns the chunk capacity processed (0 when paused) so callers
+        can meter throughput without a device sync."""
         if self.paused:
-            return
+            return 0
         chunk = self.source.next_chunk()
         self.states, _ = self.fragment.step(self.states, chunk)
+        return chunk.capacity
 
     def inject_barrier(self, barrier: Barrier | None = None) -> list:
         """Cross a barrier: flush, (maybe) checkpoint, bump the epoch.
@@ -332,10 +336,11 @@ class BinaryJob:
             pstate, _ = self.post._step_impl(pstate, out)
         return jstate, pstate
 
-    def run_chunk(self, side: str) -> None:
+    def run_chunk(self, side: str) -> int:
         source = self.left_source if side == "left" else self.right_source
         chunk = source.next_chunk()
         self.states = self._step[side](self.states, chunk)
+        return chunk.capacity
 
     def inject_barrier(self) -> None:
         self.barriers_seen += 1
