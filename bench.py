@@ -1,15 +1,20 @@
-"""Benchmark driver: Nexmark q7-shaped streaming throughput per chip.
+"""Benchmark driver: Nexmark streaming throughput per chip via SQL.
 
-Pipeline: on-device bid generation → window projection → hash
-aggregation (max price + count per 10s tumble), with a barrier flush
-every ``CHUNKS_PER_BARRIER`` chunks — the BASELINE.md q5/q7 windowed-agg
-configuration at the reference's default freshness envelope
-(barrier_interval work-equivalent; see BASELINE.md).
+Runs the BASELINE.md configurations end-to-end through the SQL engine
+(source generation on device → jitted fragment steps → device MV), at
+the reference's default freshness envelope (checkpoint every barrier).
 
-Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
-``vs_baseline`` is measured-TPU / measured-CPU-single-thread-equivalent
-(the reference publishes no absolute numbers — BASELINE.md; the north
-star is >=5x vs CPU rows/sec at equal freshness).
+- q1: stateless project over the bid stream
+- q5: sliding-window (hop) bid counts per auction  (windowed hash agg)
+- q7: tumbling-window max price                    (windowed hash agg)
+- q8: windowed person × auction join
+
+Prints ONE json line {"metric", "value", "unit", "vs_baseline"} for the
+headline metric (q7; override with RWT_BENCH_QUERY=q1|q5|q7|q8|all —
+"all" reports q7 as the json line and the rest on stderr).
+``vs_baseline`` is measured-TPU / measured-CPU on the identical workload
+(the reference publishes no absolute numbers — BASELINE.md; north star
+is >=5x vs CPU at equal freshness).
 """
 
 from __future__ import annotations
@@ -22,56 +27,110 @@ import time
 
 import risingwave_tpu  # noqa: F401  (platform/x64 config before backend init)
 
-import jax
-import jax.numpy as jnp
-
-from __graft_entry__ import _q7_executors
-from risingwave_tpu.stream.fragment import Fragment
+from risingwave_tpu.sql import Engine
+from risingwave_tpu.sql.planner import PlannerConfig
 
 CHUNK_CAP = 8192
-CHUNKS = 64
+WARMUP_BARRIERS = 2
+BARRIERS = 8
 CHUNKS_PER_BARRIER = 8
-TABLE_SIZE = 1 << 16
-EMIT_CAP = 4096
+
+# q8 uses a lower event rate + 1s windows: per-(window, hot-seller)
+# auction counts must fit the join's bucket depth this round
+# (degree-adaptive join storage is queued for the next round)
+SOURCES = """
+CREATE SOURCE bid (
+    auction BIGINT, bidder BIGINT, price BIGINT,
+    channel VARCHAR, url VARCHAR, date_time TIMESTAMP
+) WITH (connector = 'nexmark', nexmark.table = 'bid',
+        nexmark.event.rate = '{rate}');
+CREATE SOURCE person (
+    id BIGINT, name VARCHAR, date_time TIMESTAMP,
+    WATERMARK FOR date_time AS date_time - INTERVAL '4' SECOND
+) WITH (connector = 'nexmark', nexmark.table = 'person',
+        nexmark.event.rate = '{rate}');
+CREATE SOURCE auction (
+    id BIGINT, seller BIGINT, reserve BIGINT, expires TIMESTAMP,
+    date_time TIMESTAMP,
+    WATERMARK FOR date_time AS date_time - INTERVAL '4' SECOND
+) WITH (connector = 'nexmark', nexmark.table = 'auction',
+        nexmark.event.rate = '{rate}');
+"""
+
+RATES = {"q8": "2000"}
+
+QUERIES = {
+    "q1": """
+        CREATE MATERIALIZED VIEW bench_mv AS
+        SELECT auction, bidder, 0.908 * price AS price, date_time
+        FROM bid;
+    """,
+    "q5": """
+        CREATE MATERIALIZED VIEW bench_mv AS
+        SELECT auction, window_start, count(*) AS bids
+        FROM HOP(bid, date_time, INTERVAL '2' SECOND, INTERVAL '10' SECOND)
+        GROUP BY auction, window_start;
+    """,
+    "q7": """
+        CREATE MATERIALIZED VIEW bench_mv AS
+        SELECT window_start, max(price) AS max_price, count(*) AS bids
+        FROM TUMBLE(bid, date_time, INTERVAL '10' SECOND)
+        GROUP BY window_start;
+    """,
+    "q8": """
+        CREATE MATERIALIZED VIEW bench_mv AS
+        SELECT p.id AS id, p.name AS name, a.reserve AS reserve
+        FROM TUMBLE(person, date_time, INTERVAL '1' SECOND) p
+        JOIN TUMBLE(auction, date_time, INTERVAL '1' SECOND) a
+        ON p.id = a.seller AND p.window_start = a.window_start;
+    """,
+}
 
 
-def measure_rows_per_sec() -> float:
-    gen, project, agg = _q7_executors(TABLE_SIZE, EMIT_CAP)
-    frag = Fragment([project, agg], name="nexmark_q7_bench")
-    states = frag.init_states()
-
-    # one fused program: generate + project + aggregate
-    @jax.jit
-    def fused_step(states, k0):
-        chunk = gen._bids_impl(k0, CHUNK_CAP)
-        states, _ = frag._step_impl(states, chunk)
-        return states
-
-    # warmup / compile
-    states = fused_step(states, jnp.int64(0))
-    states, _ = frag.flush(states, 0)
-    jax.block_until_ready(states)
+def measure(query: str) -> float:
+    eng = Engine(PlannerConfig(
+        chunk_capacity=CHUNK_CAP,
+        agg_table_size=1 << 18,
+        agg_emit_capacity=4096,
+        join_table_size=1 << 13,
+        join_bucket_cap=64,
+        join_out_capacity=1 << 18,
+        # q8: persons are (window, id)-unique — many keys, depth 4;
+        # auctions concentrate on hot sellers — fewer keys, depth 128
+        join_left_table_size=1 << 18,
+        join_left_bucket_cap=4,
+        join_right_table_size=1 << 14,
+        join_right_bucket_cap=128,
+        mv_table_size=1 << 18,
+        mv_ring_size=1 << 21,
+        topn_pool_size=1 << 14,
+    ))
+    eng.execute(SOURCES.format(rate=RATES.get(query, "1000000")))
+    eng.execute(QUERIES[query])
+    eng.execute("ALTER SYSTEM SET maintenance_interval_checkpoints = 8")
+    eng.tick(barriers=WARMUP_BARRIERS,
+             chunks_per_barrier=CHUNKS_PER_BARRIER)  # compile + warm state
+    import jax
+    jax.block_until_ready(eng.jobs[0].states)
 
     t0 = time.perf_counter()
-    k = 0
-    for b in range(CHUNKS // CHUNKS_PER_BARRIER):
-        for _ in range(CHUNKS_PER_BARRIER):
-            states = fused_step(states, jnp.int64((k + 1) * CHUNK_CAP))
-            k += 1
-        states, _ = frag.flush(states, b)
-    jax.block_until_ready(states)
+    eng.tick(barriers=BARRIERS, chunks_per_barrier=CHUNKS_PER_BARRIER)
+    jax.block_until_ready(eng.jobs[0].states)
     dt = time.perf_counter() - t0
-    return CHUNKS * CHUNK_CAP / dt
+    rows = eng.metrics.get("stream_rows_total", job="bench_mv") \
+        - WARMUP_BARRIERS * CHUNKS_PER_BARRIER * CHUNK_CAP * (
+            2 if query == "q8" else 1)
+    return rows / dt
 
 
-def _cpu_baseline() -> float:
-    """Same workload on one CPU device, in a subprocess."""
+def _cpu_baseline(query: str) -> float:
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["RWT_BENCH_RAW"] = "1"
+    env["RWT_BENCH_QUERY"] = query
     out = subprocess.run(
         [sys.executable, os.path.abspath(__file__)],
-        env=env, capture_output=True, text=True, timeout=900,
+        env=env, capture_output=True, text=True, timeout=1200,
         cwd=os.path.dirname(os.path.abspath(__file__)),
     )
     for line in out.stdout.splitlines():
@@ -81,20 +140,29 @@ def _cpu_baseline() -> float:
 
 
 def main() -> None:
-    rows_per_sec = measure_rows_per_sec()
+    query = os.environ.get("RWT_BENCH_QUERY", "q7")
     if os.environ.get("RWT_BENCH_RAW"):
-        print(f"RAW {rows_per_sec}")
+        print(f"RAW {measure(query)}")
         return
+    queries = list(QUERIES) if query == "all" else [query]
+    results = {}
+    for q in queries:
+        results[q] = measure(q)
+        if q != "q7" or query != "all":
+            print(f"# {q}: {results[q]:,.0f} rows/s", file=sys.stderr)
+    headline = "q7" if query == "all" else query
     try:
-        cpu = _cpu_baseline()
-        vs = rows_per_sec / cpu
+        cpu = _cpu_baseline(headline)
+        vs = results[headline] / cpu
+        print(f"# cpu baseline {headline}: {cpu:,.0f} rows/s",
+              file=sys.stderr)
     except Exception as e:
         print(f"warning: cpu baseline failed, vs_baseline=0: {e}",
               file=sys.stderr)
         vs = 0.0
     print(json.dumps({
-        "metric": "nexmark_q7_windowed_agg_throughput",
-        "value": round(rows_per_sec, 1),
+        "metric": f"nexmark_{headline}_throughput",
+        "value": round(results[headline], 1),
         "unit": "rows/s/chip",
         "vs_baseline": round(vs, 3),
     }))

@@ -80,6 +80,9 @@ _SYSTEM_PARAM_DEFS = {
     "checkpoint_frequency": (1, True),     # ref :85
     "chunks_per_barrier": (1, True),       # TPU batch knob (no ref analog)
     "max_concurrent_creating_streaming_jobs": (1, True),
+    #: checkpoints between state-maintenance passes (rehash + counter
+    #: checks); >1 amortizes the per-barrier device syncs
+    "maintenance_interval_checkpoints": (1, True),
     "pause_on_next_bootstrap": (False, True),
 }
 

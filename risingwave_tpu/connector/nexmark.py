@@ -361,6 +361,26 @@ class NexmarkSplitReader:
             "auction": self.gen.gen_auctions,
             "person": self.gen.gen_persons,
         }[table]
+        #: traceable generator body — runtimes fuse this into the
+        #: fragment step so chunk generation never materializes
+        #: standalone in HBM (impl(k0, cap) -> Chunk)
+        self.impl = {
+            "bid": self.gen._bids_impl,
+            "auction": self.gen._auctions_impl,
+            "person": self.gen._persons_impl,
+        }[table]
+
+    @property
+    def events_per_row(self):
+        """Global events consumed per emitted row (Fraction) — pacing
+        hint so multi-source jobs advance event time in lockstep (the
+        reference's single interleaved stream does this implicitly)."""
+        from fractions import Fraction
+        return {
+            "bid": Fraction(TOTAL_PROPORTION, BID_PROPORTION),
+            "auction": Fraction(TOTAL_PROPORTION, AUCTION_PROPORTION),
+            "person": Fraction(TOTAL_PROPORTION, PERSON_PROPORTION),
+        }[self.table]
 
     @property
     def schema(self) -> Schema:
@@ -369,15 +389,16 @@ class NexmarkSplitReader:
             "person": PERSON_SCHEMA,
         }[self.table]
 
-    def next_chunk(self) -> Chunk:
-        # split i owns ordinal stripe [i*stride + offset) with stride cap*m:
-        # each call produces one contiguous cap-row block from this split's
-        # interleaved position.
+    def next_base(self) -> int:
+        """Advance the cursor and return the global ordinal of the next
+        cap-row block (host arithmetic; feeds the fused step)."""
         base = (self.offset // self.cap) * self.cap * self.num_splits + \
             self.split_id * self.cap + (self.offset % self.cap)
-        chunk = self._fn(base, self.cap)
         self.offset += self.cap
-        return chunk
+        return base
+
+    def next_chunk(self) -> Chunk:
+        return self._fn(self.next_base(), self.cap)
 
     def state(self) -> dict:
         """Checkpointable offset (rides the barrier, ref SourceChangeSplit)."""

@@ -277,15 +277,22 @@ class Engine:
             )
         # runtime-mutable cadence (ref ALTER SYSTEM SET applies live)
         ckpt_freq = int(self.system_params.get("checkpoint_frequency"))
+        maint = int(self.system_params.get(
+            "maintenance_interval_checkpoints"
+        ))
         for _ in range(barriers):
             for job in self.jobs:
                 job.checkpoint_frequency = ckpt_freq
+                job.maintenance_interval = maint
                 t0 = time.perf_counter()
                 rows = 0
                 if isinstance(job, BinaryJob):
+                    l, r = job.chunk_ratio
                     for _ in range(chunks_per_barrier):
-                        rows += job.run_chunk("left")
-                        rows += job.run_chunk("right")
+                        for _ in range(l):
+                            rows += job.run_chunk("left")
+                        for _ in range(r):
+                            rows += job.run_chunk("right")
                 else:
                     for _ in range(chunks_per_barrier):
                         rows += job.run_chunk()
@@ -390,6 +397,14 @@ class _ProjectingReader:
         self.inner = inner
         self.idxs = list(idxs)
         self.schema = schema
+        if hasattr(inner, "impl"):
+            self.impl = lambda k0, cap: inner.impl(k0, cap).project(
+                self.idxs
+            )
+            self.cap = inner.cap
+            self.next_base = inner.next_base
+        if hasattr(inner, "events_per_row"):
+            self.events_per_row = inner.events_per_row
 
     def next_chunk(self) -> Chunk:
         return self.inner.next_chunk().project(self.idxs)

@@ -372,6 +372,12 @@ class Parser:
             alias = None
             if self.accept_word("as"):
                 alias = self.ident()
+            elif (self.peek() and self.peek().kind == "word"
+                  and self.peek().value not in (
+                      "join", "inner", "left", "on", "where", "group",
+                      "having", "order", "limit", "offset", "emit",
+                  )):
+                alias = self.ident()
             if fn == "tumble":
                 return ast.Tumble(table, col, iv1, alias)
             return ast.Hop(table, col, iv1, iv2, alias)

@@ -83,6 +83,10 @@ class PlannerConfig:
     join_table_size: int = 1 << 14
     join_bucket_cap: int = 64
     join_out_capacity: int = 1 << 15
+    join_left_table_size: int | None = None
+    join_right_table_size: int | None = None
+    join_left_bucket_cap: int | None = None
+    join_right_bucket_cap: int | None = None
     topn_pool_size: int = 4096
     topn_emit_capacity: int = 1024
     mv_table_size: int = 1 << 16
@@ -136,10 +140,12 @@ class Planner:
                 slide = from_.slide.micros
             hop = HopWindowExecutor(inner.schema, ts_idx, slide, size)
             qual = from_.alias or from_.table.name
-            scope = Scope(
-                hop.out_schema,
-                tuple(inner.scope.qualifiers) + (qual,),
-            )
+            if from_.alias:
+                # an aliased window table re-qualifies EVERY column
+                quals = tuple(qual for _ in hop.out_schema)
+            else:
+                quals = tuple(inner.scope.qualifiers) + (qual,)
+            scope = Scope(hop.out_schema, quals)
             # window_start is addressable by the window alias OR the
             # underlying table name (postgres-ish leniency)
             return PlannedInput(
@@ -377,7 +383,23 @@ class Planner:
             table_size=cfg.join_table_size,
             bucket_cap=cfg.join_bucket_cap,
             out_capacity=cfg.join_out_capacity,
+            left_table_size=cfg.join_left_table_size,
+            right_table_size=cfg.join_right_table_size,
+            left_bucket_cap=cfg.join_left_bucket_cap,
+            right_bucket_cap=cfg.join_right_bucket_cap,
         )
+        # window-keyed joins over watermarked sources clean closed
+        # windows at barriers (bounded state, ref q8 pattern)
+        for side_name, pin, keys in (("left", left, left_keys),
+                                     ("right", right, right_keys)):
+            if pin.window_size is None or pin.watermark_col is None:
+                continue
+            window_idx = len(pin.schema) - 1  # hop appends window_start
+            for ki, ke in enumerate(keys):
+                if isinstance(ke, InputRef) and ke.index == window_idx:
+                    setattr(join, f"{side_name}_clean",
+                            (ki, pin.window_size, pin.watermark_col))
+                    break
         post_execs: list[Executor] = []
         b = Binder(both)
         for conj in residual:

@@ -166,9 +166,14 @@ class HashTable:
         row_idx = jnp.arange(cap, dtype=jnp.int32)
         sentinel = jnp.int32(size)
 
+        # probe-length bound: at sane load factors chains are a handful
+        # of slots; a pathological (near-full) table must degrade to
+        # overflow counters, not O(size) loop iterations
+        max_iters = min(size + 2, 1024)
+
         def cond(carry):
             _, _, _, done, _, _, iters = carry
-            return jnp.any(~done) & (iters < size + 2)
+            return jnp.any(~done) & (iters < max_iters)
 
         def body(carry):
             occupied, key_store, slots, done, inserted, off, iters = carry
@@ -185,12 +190,19 @@ class HashTable:
             if insert:
                 # only a *true-empty* slot (no tombstone) is claimable:
                 # claiming a tombstone could shadow the same key further
-                # along a probe chain
+                # along a probe chain.  Intra-chunk claim races resolve
+                # by scatter-min of the row index into a chunk-sized
+                # scratch (hashed by candidate slot): exact for same-slot
+                # contenders; cross-slot scratch collisions only delay a
+                # row to the next iteration.  O(cap), never touching a
+                # table-sized array.
                 want = ~done & ~occ & ~tomb
-                claim = jnp.full((size,), cap, jnp.int32).at[
-                    jnp.where(want, cand, sentinel)
+                m = 4 * cap
+                scratch_idx = cand % m
+                claim = jnp.full((m,), cap, jnp.int32).at[
+                    jnp.where(want, scratch_idx, m)
                 ].min(jnp.where(want, row_idx, cap), mode="drop")
-                won = want & (claim[cand] == row_idx)
+                won = want & (claim[scratch_idx] == row_idx)
                 pos = jnp.where(won, cand, sentinel)
                 occupied = occupied.at[pos].set(True, mode="drop")
                 key_store = tuple(

@@ -141,6 +141,11 @@ class HopWindowExecutor(Executor):
 
         ts = chunk.column(self.ts_col)
         ws0 = ts - ts % self.slide_us           # latest window start
+        if k == 1:  # TUMBLE: append the window column, no expansion
+            return state, Chunk(
+                chunk.columns + (ws0,), chunk.ops, chunk.valid,
+                self._out_schema,
+            )
         offs = jnp.tile(
             jnp.arange(k, dtype=jnp.int64) * self.slide_us, (cap,)
         )

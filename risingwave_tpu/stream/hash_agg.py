@@ -150,17 +150,22 @@ class HashAggExecutor(Executor):
     def init_state(self) -> AggState:
         size = self.table_size
         table = HashTable.create(self._key_protos(), size)
-        prims = []
-        for agg_idx, ps in self._prim_specs:
-            in_dt = self._input_dtype(agg_idx)
-            st_dt = ps.dtype(in_dt)
-            prims.append(jnp.full((size,), ps.init(st_dt), st_dt))
+        def make_prims():
+            out = []
+            for agg_idx, ps in self._prim_specs:
+                in_dt = self._input_dtype(agg_idx)
+                st_dt = ps.dtype(in_dt)
+                out.append(jnp.full((size,), ps.init(st_dt), st_dt))
+            return tuple(out)
+
         return AggState(
             table=table,
-            prims=tuple(prims),
+            # prev_prims must be INDEPENDENT buffers (donation forbids
+            # the same buffer appearing twice in a donated pytree)
+            prims=make_prims(),
             row_count=jnp.zeros((size,), jnp.int64),
             dirty=jnp.zeros((size,), jnp.bool_),
-            prev_prims=tuple(prims),
+            prev_prims=make_prims(),
             prev_row_count=jnp.zeros((size,), jnp.int64),
             emitted=jnp.zeros((size,), jnp.bool_),
             overflow=jnp.zeros((), jnp.int64),

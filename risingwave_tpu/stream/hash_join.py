@@ -146,6 +146,8 @@ class HashJoinExecutor:
         out_capacity: int = 16384,
         left_bucket_cap: int | None = None,
         right_bucket_cap: int | None = None,
+        left_table_size: int | None = None,
+        right_table_size: int | None = None,
     ):
         self.left_schema = left_schema
         self.right_schema = right_schema
@@ -157,8 +159,17 @@ class HashJoinExecutor:
         # deep build side while a unique-keyed side stays shallow)
         self.left_bucket_cap = left_bucket_cap or bucket_cap
         self.right_bucket_cap = right_bucket_cap or bucket_cap
+        # per-side key-table sizes: a unique-keyed side wants many slots
+        # and shallow buckets; a hot-keyed side the opposite
+        self.left_table_size = left_table_size or table_size
+        self.right_table_size = right_table_size or table_size
         self.out_capacity = out_capacity
         self._out_schema = left_schema.concat(right_schema)
+        #: per-side watermark cleaning: (key_idx, lag_us, src_col) —
+        #: at barriers the runtime evicts keys whose key_idx-th join key
+        #: < watermark(src_col) - lag (windowed joins, nexmark q8)
+        self.left_clean: tuple[int, int, int] | None = None
+        self.right_clean: tuple[int, int, int] | None = None
 
     @property
     def out_schema(self) -> Schema:
@@ -179,16 +190,14 @@ class HashJoinExecutor:
         return protos
 
     def _side_state(self, schema: Schema, keys: Sequence[Expr],
-                    bucket: int) -> SideState:
+                    bucket: int, size: int) -> SideState:
         return SideState(
             key_table=HashTable.create(
-                self._key_protos(schema, keys), self.table_size
+                self._key_protos(schema, keys), size
             ),
-            rows=tuple(
-                _empty_store(f, self.table_size, bucket) for f in schema
-            ),
-            occupied=jnp.zeros((self.table_size, bucket), jnp.bool_),
-            count=jnp.zeros((self.table_size,), jnp.int32),
+            rows=tuple(_empty_store(f, size, bucket) for f in schema),
+            occupied=jnp.zeros((size, bucket), jnp.bool_),
+            count=jnp.zeros((size,), jnp.int32),
             overflow=jnp.zeros((), jnp.int64),
             inconsistency=jnp.zeros((), jnp.int64),
         )
@@ -196,10 +205,12 @@ class HashJoinExecutor:
     def init_state(self) -> JoinState:
         return JoinState(
             left=self._side_state(
-                self.left_schema, self.left_keys, self.left_bucket_cap
+                self.left_schema, self.left_keys, self.left_bucket_cap,
+                self.left_table_size,
             ),
             right=self._side_state(
-                self.right_schema, self.right_keys, self.right_bucket_cap
+                self.right_schema, self.right_keys, self.right_bucket_cap,
+                self.right_table_size,
             ),
             emit_overflow=jnp.zeros((), jnp.int64),
         )
@@ -212,7 +223,7 @@ class HashJoinExecutor:
         Returns the updated side.
         """
         B = side.occupied.shape[1]
-        size = self.table_size
+        size = side.key_table.size
         key_cols = [e.eval(chunk) for e in keys]
         signs = chunk.signs()
         is_ins = chunk.valid & (signs > 0)
@@ -325,7 +336,7 @@ class HashJoinExecutor:
                probe_is_left: bool, probe_keys: Sequence[Expr]):
         """Emit (probe row × build bucket entry) pairs, compacted."""
         B = build.occupied.shape[1]
-        size = self.table_size
+        size = build.key_table.size
         out_cap = self.out_capacity
         key_cols = [e.eval(probe_chunk) for e in probe_keys]
         slots, found = build.key_table.lookup(key_cols, probe_chunk.valid)
@@ -415,6 +426,29 @@ class HashJoinExecutor:
         ), out
 
     # ------------------------------------------------------------------
+    def maybe_rehash(self, state: JoinState) -> JoinState:
+        """Rebuild tombstone-heavy side key tables (runtime maintenance).
+
+        Without this, watermark cleaning would fill the tables with
+        unclaimable tombstones and probes would degrade to overflow."""
+        from risingwave_tpu.state.hash_table import permute_dense
+
+        sides = {}
+        for name in ("left", "right"):
+            s: SideState = getattr(state, name)
+            if int(s.key_table.tombstone_count()) > s.key_table.size // 4:
+                fresh, moved = s.key_table.rehashed()
+                s = SideState(
+                    key_table=fresh,
+                    rows=tuple(permute_dense(r, moved) for r in s.rows),
+                    occupied=permute_dense(s.occupied, moved),
+                    count=permute_dense(s.count, moved),
+                    overflow=s.overflow,
+                    inconsistency=s.inconsistency,
+                )
+            sides[name] = s
+        return JoinState(sides["left"], sides["right"], state.emit_overflow)
+
     def clean_below(self, state: JoinState, side: str, key_col_idx: int,
                     threshold) -> JoinState:
         """Watermark state cleaning on a window key column (q8 pattern)."""

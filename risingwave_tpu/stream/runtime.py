@@ -132,6 +132,9 @@ class StreamingJob:
         #: optional durable store (storage.CheckpointStore); when set,
         #: commits persist across process restarts
         self.checkpoint_store = checkpoint_store
+        #: checkpoints between maintenance passes (amortizes syncs)
+        self.maintenance_interval = 1
+        self._ckpts_since_maintain = 0
         self.states = fragment.init_states()
         self.epoch = EpochPair.first()
         self.barriers_seen = 0
@@ -139,6 +142,19 @@ class StreamingJob:
         #: committed epoch visible to batch reads (ref pinned snapshots)
         self.committed_epoch: int = 0
         self.paused = False
+        # fuse generation into the step when the source is traceable:
+        # the source chunk never materializes standalone — XLA fuses
+        # generator arithmetic straight into the executor kernels
+        self._fused = None
+        if hasattr(source, "impl") and hasattr(source, "next_base"):
+            import jax as _jax
+
+            def _fused(states, k0):
+                return fragment._step_impl(
+                    states, source.impl(k0, source.cap)
+                )
+
+            self._fused = _jax.jit(_fused, donate_argnums=(0,))
 
     # ------------------------------------------------------------------
     def run_chunk(self) -> int:
@@ -148,6 +164,12 @@ class StreamingJob:
         can meter throughput without a device sync."""
         if self.paused:
             return 0
+        if self._fused is not None:
+            import jax.numpy as _jnp
+            self.states, _ = self._fused(
+                self.states, _jnp.int64(self.source.next_base())
+            )
+            return self.source.cap
         chunk = self.source.next_chunk()
         self.states, _ = self.fragment.step(self.states, chunk)
         return chunk.capacity
@@ -186,7 +208,10 @@ class StreamingJob:
         self.states = propagate_watermarks(self.fragment, self.states)
         outs.extend(self._drain_pending(epoch_val))
         if barrier.is_checkpoint:
-            self._maintain()
+            self._ckpts_since_maintain += 1
+            if self._ckpts_since_maintain >= self.maintenance_interval:
+                self._maintain()
+                self._ckpts_since_maintain = 0
             self._commit_checkpoint(barrier)
         self.epoch = barrier.epoch
         return outs
@@ -205,9 +230,14 @@ class StreamingJob:
         epoch_val = barrier.epoch.prev.value
         src_state = self.source.state() if hasattr(self.source, "state") \
             else {}
+        # the in-memory snapshot device-copies the state: the donated
+        # step/flush buffers would otherwise be invalidated under the
+        # snapshot (use-after-donation); durable persistence additionally
+        # pays the device->host transfer
+        import jax.numpy as _jnp
         snap = CheckpointSnapshot(
             epoch=epoch_val,
-            states=jax.device_get(self.states),
+            states=jax.tree.map(_jnp.copy, self.states),
             source_state=src_state,
         )
         # retain only the latest committed snapshot in memory; the
@@ -216,7 +246,7 @@ class StreamingJob:
         self.committed_epoch = epoch_val
         if self.checkpoint_store is not None:
             self.checkpoint_store.save(
-                self.name, epoch_val, snap.states, src_state
+                self.name, epoch_val, jax.device_get(snap.states), src_state
             )
 
     def _apply_mutation(self, mutation) -> None:
@@ -247,7 +277,10 @@ class StreamingJob:
                 self.source.offset = 0
             return
         snap = self.checkpoints[-1]
-        self.states = jax.device_put(snap.states)
+        import jax.numpy as _jnp
+        # copy: the next step donates its input buffers, which must not
+        # invalidate the retained snapshot
+        self.states = jax.tree.map(_jnp.copy, snap.states)
         restore_source(self.source, snap.source_state)
 
     # ------------------------------------------------------------------
@@ -285,6 +318,13 @@ class BinaryJob:
         checkpoint_store=None,
     ):
         self.checkpoint_store = checkpoint_store
+        self.maintenance_interval = 1
+        self._ckpts_since_maintain = 0
+        #: chunks pulled per scheduling unit (left, right) — sides whose
+        #: rows represent different event-time spans pace proportionally
+        #: so neither watermark runs unboundedly ahead (nexmark persons
+        #: sweep event time 3x faster per row than auctions)
+        self.chunk_ratio = self._compute_ratio(left_source, right_source)
         self.left_source = left_source
         self.right_source = right_source
         self.join = join
@@ -304,8 +344,10 @@ class BinaryJob:
         self.checkpoints: list[CheckpointSnapshot] = []
         self.committed_epoch = 0
         self._step = {
-            "left": jax.jit(lambda st, ch: self._side_step(st, ch, "left")),
-            "right": jax.jit(lambda st, ch: self._side_step(st, ch, "right")),
+            "left": jax.jit(lambda st, ch: self._side_step(st, ch, "left"),
+                            donate_argnums=(0,)),
+            "right": jax.jit(lambda st, ch: self._side_step(st, ch, "right"),
+                             donate_argnums=(0,)),
         }
         # barrier-time feed: a side fragment's flush emissions cross the
         # join and the post fragment exactly like steady-state chunks
@@ -315,6 +357,19 @@ class BinaryJob:
                 lambda j, p, ch: self._feed_impl(j, p, ch, "right")
             ),
         }
+
+    @staticmethod
+    def _compute_ratio(left_source, right_source) -> tuple[int, int]:
+        try:
+            from fractions import Fraction
+            frac = Fraction(left_source.events_per_row) / Fraction(
+                right_source.events_per_row
+            )
+            if frac.numerator <= 16 and frac.denominator <= 16:
+                return (frac.denominator, frac.numerator)
+        except AttributeError:
+            pass
+        return (1, 1)
 
     def _side_step(self, states, chunk, side: str):
         lstate, rstate, jstate, pstate = states
@@ -378,10 +433,14 @@ class BinaryJob:
                 jstate, pstate = self._feed["right"](jstate, pstate, out)
         pstate = propagate_watermarks(self.post, pstate)
         pstate, _ = drain_agg_pending(self.post, pstate, sealed)
+        jstate = self._clean_join_state(lstate, rstate, jstate)
         self.states = (lstate, rstate, jstate, pstate)
 
         if self.barriers_seen % self.checkpoint_frequency == 0:
-            self._maintain()
+            self._ckpts_since_maintain += 1
+            if self._ckpts_since_maintain >= self.maintenance_interval:
+                self._maintain()
+                self._ckpts_since_maintain = 0
             lstate, rstate, jstate, pstate = self.states
             src_state = {
                 "left": self.left_source.state()
@@ -389,18 +448,66 @@ class BinaryJob:
                 "right": self.right_source.state()
                 if hasattr(self.right_source, "state") else {},
             }
+            import jax.numpy as _jnp
             snap = CheckpointSnapshot(
                 epoch=sealed,
-                states=jax.device_get(self.states),
+                states=jax.tree.map(_jnp.copy, self.states),
                 source_state=src_state,
             )
             self.checkpoints = [snap]
             self.committed_epoch = sealed
             if self.checkpoint_store is not None:
                 self.checkpoint_store.save(
-                    self.name, sealed, snap.states, src_state
+                    self.name, sealed, jax.device_get(snap.states), src_state
                 )
         self.epoch = self.epoch.bump()
+
+    def _side_watermark(self, frag, st, src_col):
+        from risingwave_tpu.stream.watermark import WatermarkFilterExecutor
+
+        if frag is None:
+            return None
+        for i, ex in enumerate(frag.executors):
+            if isinstance(ex, WatermarkFilterExecutor) \
+                    and ex.ts_col == src_col:
+                return ex.current_watermark(st[i])
+        return None
+
+    def _clean_join_state(self, lstate, rstate, jstate):
+        """Watermark-driven join state cleaning (windowed joins).
+
+        A build-side row for window W serves the OTHER side's future
+        probes, so each side is cleaned by the MINIMUM watermark across
+        both inputs (one side's event time may run far ahead — e.g.
+        nexmark persons sweep event numbers ~3x faster than auctions)."""
+        wms = []
+        for side, frag, st in (("left", self.left_frag, lstate),
+                               ("right", self.right_frag, rstate)):
+            clean = getattr(self.join, f"{side}_clean", None)
+            if clean is None:
+                continue
+            wm = self._side_watermark(frag, st, clean[2])
+            if wm is None:
+                return jstate  # one side has no watermark yet
+            wms.append(wm)
+        if not wms:
+            return jstate
+        min_wm = min(wms)
+        cleaned = False
+        for side in ("left", "right"):
+            clean = getattr(self.join, f"{side}_clean", None)
+            if clean is None:
+                continue
+            key_idx, lag, _ = clean
+            jstate = self.join.clean_below(
+                jstate, side, key_idx, min_wm - lag
+            )
+            cleaned = True
+        # cleaning tombstones slots; reclaim promptly (self-gated on
+        # tombstone fraction) or the table starves within a few barriers
+        if cleaned and hasattr(self.join, "maybe_rehash"):
+            jstate = self.join.maybe_rehash(jstate)
+        return jstate
 
     def _maintain(self) -> None:
         lstate, rstate, jstate, pstate = self.states
@@ -412,6 +519,8 @@ class BinaryJob:
             rstate = maintain_fragment(
                 self.right_frag, rstate, f"{self.name}/right"
             )
+        if hasattr(self.join, "maybe_rehash"):
+            jstate = self.join.maybe_rehash(jstate)
         check_state_counters(f"{self.name}/join.left", jstate.left)
         check_state_counters(f"{self.name}/join.right", jstate.right)
         if int(jstate.emit_overflow) > 0:
@@ -447,14 +556,18 @@ class BinaryJob:
                     src.offset = 0
             return
         snap = self.checkpoints[-1]
-        self.states = jax.device_put(snap.states)
+        import jax.numpy as _jnp
+        self.states = jax.tree.map(_jnp.copy, snap.states)
         for side, src in (("left", self.left_source),
                           ("right", self.right_source)):
             restore_source(src, snap.source_state.get(side, {}))
 
     def run(self, barriers: int, chunks_per_barrier: int) -> None:
+        l, r = self.chunk_ratio
         for _ in range(barriers):
             for _ in range(chunks_per_barrier):
-                self.run_chunk("left")
-                self.run_chunk("right")
+                for _ in range(l):
+                    self.run_chunk("left")
+                for _ in range(r):
+                    self.run_chunk("right")
             self.inject_barrier()

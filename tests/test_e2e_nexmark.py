@@ -111,9 +111,10 @@ def test_q8_style_windowed_join():
     job.run(barriers=2, chunks_per_barrier=1)
     rows = mv.to_host(job.states[3][0])
 
-    # ground truth join in numpy
+    # ground truth join in numpy (sides pace 1:3 by event time, so two
+    # scheduling units pull 2 person chunks and 6 auction chunks)
     p = NexmarkGenerator().gen_persons(0, 2 * cap)
-    a = NexmarkGenerator().gen_auctions(0, 2 * cap)
+    a = NexmarkGenerator().gen_auctions(0, 6 * cap)
     _, pc, _ = p.to_host()
     _, ac, _ = a.to_host()
     p_w = pc[6] - pc[6] % WINDOW_US

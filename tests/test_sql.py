@@ -81,7 +81,7 @@ def _engine(cap=512):
         chunk_capacity=cap, agg_table_size=1 << 10,
         agg_emit_capacity=256, mv_table_size=1 << 10,
         mv_ring_size=1 << 12, topn_pool_size=512, topn_emit_capacity=128,
-        join_table_size=1 << 10, join_bucket_cap=1024,
+        join_table_size=1 << 12, join_bucket_cap=1024,
         join_out_capacity=1 << 12,
     ))
 
@@ -180,8 +180,9 @@ def test_engine_join():
 
     from risingwave_tpu.connector.nexmark import NexmarkConfig, NexmarkGenerator
     gen = NexmarkGenerator(NexmarkConfig(inter_event_us=10))
+    # event-time pacing pulls 3 auction chunks per person chunk
     p = gen.gen_persons(0, 2 * 512)
-    a = gen.gen_auctions(0, 2 * 512)
+    a = gen.gen_auctions(0, 6 * 512)
     _, pc, _ = p.to_host()
     _, ac, _ = a.to_host()
     n_match = sum(
