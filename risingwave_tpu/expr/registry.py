@@ -21,7 +21,11 @@ import re
 from dataclasses import dataclass
 from typing import Callable, Sequence
 
-from risingwave_tpu.common.types import DataType, Field
+from risingwave_tpu.common.types import (
+    DEFAULT_STR_WIDTH,
+    DataType,
+    Field,
+)
 
 _FAMILIES: dict[str, tuple[DataType, ...]] = {
     "intlike": (DataType.INT16, DataType.INT32, DataType.INT64, DataType.SERIAL),
@@ -124,7 +128,19 @@ class FuncSig:
         if self.ret == "auto":
             return Field("?expr", promote_numeric([f.data_type for f in arg_fields]))
         _, accepted = _parse_type(self.ret)
-        return Field("?expr", accepted[0])
+        t = accepted[0]
+        if t in (DataType.VARCHAR, DataType.BYTEA):
+            # device width of a produced string: concat sums its inputs;
+            # everything else is bounded by the widest string argument
+            str_widths = [f.str_width for f in arg_fields
+                          if f.data_type in (DataType.VARCHAR,
+                                             DataType.BYTEA)]
+            if self.name == "concat":
+                width = sum(str_widths)
+            else:
+                width = max(str_widths, default=DEFAULT_STR_WIDTH)
+            return Field("?expr", t, str_width=width)
+        return Field("?expr", t)
 
 
 _SIG_RE = re.compile(r"^\s*(\w+)\s*\(([^)]*)\)\s*->\s*([\w ]+)\s*$")

@@ -331,3 +331,246 @@ def _lower(a: StrCol):
 def _upper(a: StrCol):
     lo = (a.data >= ord("a")) & (a.data <= ord("z"))
     return StrCol(jnp.where(lo, a.data - 32, a.data), a.lens)
+
+
+# ---------------------------------------------------------------------------
+# math
+
+@function("sqrt(numeric) -> double precision")
+def _sqrt(a, fields: Sequence[Field]):
+    return jnp.sqrt(coerce(a, fields[0], DataType.FLOAT64))
+
+
+@function("power(numeric, numeric) -> double precision")
+def _power(a, b, fields: Sequence[Field]):
+    return jnp.power(coerce(a, fields[0], DataType.FLOAT64),
+                     coerce(b, fields[1], DataType.FLOAT64))
+
+
+@function("exp(numeric) -> double precision")
+def _exp(a, fields: Sequence[Field]):
+    return jnp.exp(coerce(a, fields[0], DataType.FLOAT64))
+
+
+@function("ln(numeric) -> double precision")
+def _ln(a, fields: Sequence[Field]):
+    return jnp.log(coerce(a, fields[0], DataType.FLOAT64))
+
+
+@function("log10(numeric) -> double precision")
+def _log10(a, fields: Sequence[Field]):
+    return jnp.log10(coerce(a, fields[0], DataType.FLOAT64))
+
+
+@function("floor(floatlike) -> same")
+def _floor(a):
+    return jnp.floor(a)
+
+
+@function("ceil(floatlike) -> same")
+def _ceil(a):
+    return jnp.ceil(a)
+
+
+@function("sign(numeric) -> int")
+def _sign(a):
+    return jnp.sign(a).astype(jnp.int32)
+
+
+@function("greatest(numeric, numeric) -> auto")
+def _greatest(a, b, fields: Sequence[Field]):
+    (a, b), _ = _promote_args((a, b), fields)
+    return jnp.maximum(a, b)
+
+
+@function("least(numeric, numeric) -> auto")
+def _least(a, b, fields: Sequence[Field]):
+    (a, b), _ = _promote_args((a, b), fields)
+    return jnp.minimum(a, b)
+
+
+# ---------------------------------------------------------------------------
+# strings (fixed-width byte kernels; ref src/expr/impl/src/scalar/)
+
+@function("concat(stringlike, stringlike) -> character varying")
+def _concat(a: StrCol, b: StrCol):
+    wa, wb = a.data.shape[1], b.data.shape[1]
+    w = wa + wb
+    idx = jnp.arange(w, dtype=jnp.int32)[None, :]
+    # bytes: a's first len_a bytes, then b's bytes shifted to len_a
+    from_a = idx < a.lens[:, None]
+    b_pos = jnp.clip(idx - a.lens[:, None], 0, wb - 1)
+    a_pos = jnp.clip(idx, 0, wa - 1)
+    data = jnp.where(
+        from_a,
+        jnp.take_along_axis(a.data, a_pos, axis=1),
+        jnp.take_along_axis(b.data, b_pos, axis=1),
+    )
+    lens = a.lens + b.lens
+    in_range = idx < lens[:, None]
+    return StrCol(jnp.where(in_range, data, 0).astype(jnp.uint8), lens)
+
+
+def _substr_window(a: StrCol, start, count=None):
+    """Postgres window semantics: the count window starts at the GIVEN
+    (possibly <=0) position; e.g. substr('hello', -1, 3) = 'h'."""
+    w = a.data.shape[1]
+    idx = jnp.arange(w, dtype=jnp.int64)[None, :]
+    s0 = start.astype(jnp.int64) - 1                      # 0-based, may be <0
+    if count is None:
+        end = jnp.full_like(s0, w)
+    else:
+        end = s0 + jnp.maximum(count.astype(jnp.int64), 0)
+    lo = jnp.maximum(s0, 0)
+    hi = jnp.minimum(end, a.lens.astype(jnp.int64))
+    lens = jnp.maximum(hi - lo, 0).astype(jnp.int32)
+    src = jnp.clip(idx + lo[:, None], 0, w - 1)
+    data = jnp.take_along_axis(a.data, src.astype(jnp.int32), axis=1)
+    keep = idx < lens[:, None]
+    return StrCol(jnp.where(keep, data, 0).astype(jnp.uint8), lens)
+
+
+@function("substr(stringlike, int) -> same")
+@function("substr(stringlike, bigint) -> same")
+def _substr2(a: StrCol, start):
+    return _substr_window(a, start)
+
+
+@function("substr(stringlike, int, int) -> same")
+@function("substr(stringlike, bigint, bigint) -> same")
+def _substr3(a: StrCol, start, count):
+    return _substr_window(a, start, count)
+
+
+def _trim_side(a: StrCol, left: bool, right: bool) -> StrCol:
+    w = a.data.shape[1]
+    idx = jnp.arange(w, dtype=jnp.int32)[None, :]
+    in_str = idx < a.lens[:, None]
+    is_sp = (a.data == ord(" ")) & in_str
+    nonsp = in_str & ~is_sp
+    any_nonsp = jnp.any(nonsp, axis=1)
+    first = jnp.argmax(nonsp, axis=1).astype(jnp.int32)
+    last = (w - 1 - jnp.argmax(nonsp[:, ::-1], axis=1)).astype(jnp.int32)
+    s0 = jnp.where(any_nonsp, first if left else 0, 0)
+    e0 = jnp.where(any_nonsp, (last + 1) if right else a.lens, 0)
+    lens = jnp.maximum(e0 - s0, 0)
+    src = jnp.clip(idx + s0[:, None], 0, w - 1)
+    data = jnp.take_along_axis(a.data, src, axis=1)
+    return StrCol(
+        jnp.where(idx < lens[:, None], data, 0).astype(jnp.uint8), lens
+    )
+
+
+@function("trim(stringlike) -> same")
+def _trim(a: StrCol):
+    return _trim_side(a, True, True)
+
+
+@function("ltrim(stringlike) -> same")
+def _ltrim(a: StrCol):
+    return _trim_side(a, True, False)
+
+
+@function("rtrim(stringlike) -> same")
+def _rtrim(a: StrCol):
+    return _trim_side(a, False, True)
+
+
+def _match_at(a: StrCol, pat: StrCol, offsets: jnp.ndarray) -> jnp.ndarray:
+    """[cap, n_off] bool: pattern matches a at each byte offset."""
+    wa, wp = a.data.shape[1], pat.data.shape[1]
+    j = jnp.arange(wp, dtype=jnp.int32)
+    src = offsets[:, :, None] + j[None, None, :]          # [cap, off, wp]
+    src_c = jnp.clip(src, 0, wa - 1)
+    got = jnp.take_along_axis(
+        a.data[:, None, :], src_c, axis=2
+    )                                                     # [cap, off, wp]
+    want = pat.data[:, None, :]
+    in_pat = j[None, None, :] < pat.lens[:, None, None]
+    in_str = src < a.lens[:, None, None]
+    ok = jnp.where(in_pat, (got == want) & in_str, True)
+    return jnp.all(ok, axis=2)
+
+
+@function("starts_with(stringlike, stringlike) -> boolean")
+def _starts_with(a: StrCol, p: StrCol):
+    return _match_at(a, p, jnp.zeros((a.data.shape[0], 1), jnp.int32))[:, 0] \
+        & (p.lens <= a.lens)
+
+
+@function("ends_with(stringlike, stringlike) -> boolean")
+def _ends_with(a: StrCol, p: StrCol):
+    off = (a.lens - p.lens)[:, None]
+    ok = _match_at(a, p, jnp.maximum(off, 0))[:, 0]
+    return ok & (p.lens <= a.lens)
+
+
+@function("contains(stringlike, stringlike) -> boolean")
+def _contains(a: StrCol, p: StrCol):
+    wa = a.data.shape[1]
+    offs = jnp.broadcast_to(
+        jnp.arange(wa, dtype=jnp.int32)[None, :], (a.data.shape[0], wa)
+    )
+    hits = _match_at(a, p, offs)
+    valid_off = offs <= (a.lens - p.lens)[:, None]
+    return jnp.any(hits & valid_off, axis=1) & (p.lens <= a.lens)
+
+
+@function("octet_length(stringlike) -> int")
+def _octet_length(a: StrCol):
+    return a.lens
+
+
+# ---------------------------------------------------------------------------
+# calendar (proleptic Gregorian; Howard Hinnant's civil_from_days,
+# vectorized over int64 microsecond timestamps)
+
+def _civil_from_ts(us: jnp.ndarray):
+    days = us // 86_400_000_000
+    z = days + 719468
+    era = z // 146097  # // floors, so no negative-z correction needed
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = jnp.where(mp < 10, mp + 3, mp - 9)
+    y = jnp.where(m <= 2, y + 1, y)
+    return y, m, d
+
+
+for _part in ("year", "month", "day", "hour", "minute", "second",
+              "dow", "doy"):
+
+    def _mk_extract(part):
+        def impl(ts):
+            if part in ("year", "month", "day", "dow", "doy"):
+                y, m, d = _civil_from_ts(ts)
+                if part == "year":
+                    return y
+                if part == "month":
+                    return m
+                if part == "day":
+                    return d
+                days = ts // 86_400_000_000
+                if part == "dow":
+                    return (days + 4) % 7  # 1970-01-01 was a Thursday
+                # day-of-year = date - Jan1 + 1; Jan 1 via the inverse
+                # civil mapping
+                yy = y - 1
+                days_jan1 = (
+                    yy * 365 + yy // 4 - yy // 100 + yy // 400
+                ) - 719162
+                return (days - days_jan1 + 1).astype(jnp.int64)
+            us_in_day = ts % 86_400_000_000
+            if part == "hour":
+                return us_in_day // 3_600_000_000
+            if part == "minute":
+                return (us_in_day // 60_000_000) % 60
+            return (us_in_day // 1_000_000) % 60
+
+        return impl
+
+    function(f"extract_{_part}(timestamp) -> bigint")(_mk_extract(_part))
+    function(f"extract_{_part}(timestamptz) -> bigint")(_mk_extract(_part))

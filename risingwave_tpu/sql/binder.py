@@ -99,9 +99,34 @@ class Binder:
         if isinstance(e, ast.FuncCall):
             if e.name in AGG_NAMES:
                 return self._bind_agg(e)
+            if e.name == "like":
+                return self._bind_like(e)
             args = tuple(self.bind(a) for a in e.args)
             return EFuncCall(e.name, args)
         raise BindError(f"cannot bind {e!r}")
+
+    def _bind_like(self, e: ast.FuncCall) -> Expr:
+        """LIKE with literal %-only patterns compiles to prefix/suffix/
+        substring kernels (full regex LIKE needs per-char wildcards —
+        later round)."""
+        target, pat = e.args
+        if not (isinstance(pat, ast.Literal) and pat.type_name == "string"):
+            raise BindError("LIKE requires a string literal pattern")
+        p = pat.value
+        if "_" in p:
+            raise BindError("LIKE '_' wildcards not yet supported")
+        body = p.strip("%")
+        if "%" in body:
+            raise BindError("LIKE with interior % not yet supported")
+        lhs = self.bind(target)
+        lit_body = ELiteral(body, DataType.VARCHAR)
+        if p.startswith("%") and p.endswith("%"):
+            return EFuncCall("contains", (lhs, lit_body))
+        if p.endswith("%"):
+            return EFuncCall("starts_with", (lhs, lit_body))
+        if p.startswith("%"):
+            return EFuncCall("ends_with", (lhs, lit_body))
+        return EFuncCall("equal", (lhs, lit_body))
 
     def _bind_agg(self, e: ast.FuncCall) -> Expr:
         if not self.allow_aggs:

@@ -400,6 +400,14 @@ class Parser:
             t = self.peek()
             if t is None:
                 break
+            if t.kind == "word" and t.value in ("like", "between", "in",
+                                                "is", "not") \
+                    and min_prec <= 4:
+                parsed = self._word_op(left)
+                if parsed is None:
+                    break
+                left = parsed
+                continue
             op = t.value if t.kind == "op" else (
                 t.value if t.kind == "word" and t.value in ("and", "or")
                 else None
@@ -414,11 +422,58 @@ class Parser:
             left = ast.BinaryOp(_BIN_NAMES[op], left, right)
         return left
 
+    def _word_op(self, left):
+        """LIKE / BETWEEN / IN / IS [NOT] NULL postfix operators."""
+        negate = False
+        if self.peek().value == "not":
+            nxt = self.peek(1)
+            if not (nxt and nxt.kind == "word"
+                    and nxt.value in ("like", "between", "in")):
+                return None
+            self.next()
+            negate = True
+        w = self.next().value
+        if w == "like":
+            pat = self._expr(5)
+            out = ast.FuncCall("like", (left, pat))
+        elif w == "between":
+            lo = self._expr(3)  # stop before AND
+            self.expect_word("and")
+            hi = self._expr(3)
+            out = ast.BinaryOp(
+                "and",
+                ast.BinaryOp("greater_than_or_equal", left, lo),
+                ast.BinaryOp("less_than_or_equal", left, hi),
+            )
+        elif w == "in":
+            self.expect_op("(")
+            items = [self._expr()]
+            while self.accept_op(","):
+                items.append(self._expr())
+            self.expect_op(")")
+            out = None
+            for it in items:
+                eq = ast.BinaryOp("equal", left, it)
+                out = eq if out is None else ast.BinaryOp("or", out, eq)
+        elif w == "is":
+            self.accept_word("not")
+            self.expect_word("null")
+            raise ParseError(
+                "IS [NOT] NULL requires NULL columns (validity-bitmap "
+                "round)"
+            )
+        else:
+            raise ParseError(f"unexpected {w}")
+        if negate:
+            out = ast.UnaryOp("not", out)
+        return out
+
     def _unary(self):
         if self.accept_op("-"):
             return ast.UnaryOp("neg", self._unary())
         if self.accept_word("not"):
-            return ast.UnaryOp("not", self._unary())
+            # postgres: NOT binds LOOSER than LIKE/BETWEEN/IN/comparisons
+            return ast.UnaryOp("not", self._expr(3))
         return self._postfix(self._primary())
 
     def _postfix(self, e):
@@ -462,6 +517,13 @@ class Parser:
                 els = self._expr()
             self.expect_word("end")
             return ast.Case(tuple(conds), els)
+        if w == "extract":
+            self.expect_op("(")
+            part = self.ident()
+            self.expect_word("from")
+            e = self._expr()
+            self.expect_op(")")
+            return ast.FuncCall(f"extract_{part}", (e,))
         if w == "cast":
             self.expect_op("(")
             e = self._expr()

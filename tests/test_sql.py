@@ -222,3 +222,49 @@ def test_engine_datagen_group_by():
         for b in range(4)
     }
     assert got == want
+
+
+def test_string_functions_and_like():
+    eng = _engine()
+    eng.execute(NEXMARK_DDL)
+    eng.execute("""
+        CREATE MATERIALIZED VIEW ch AS
+        SELECT substr(channel, 1, 3) AS pre, channel || url AS cu, auction
+        FROM bid
+        WHERE channel LIKE 'Goo%' AND price BETWEEN 10 AND 1000000
+              AND auction IN (1000, 1001, 1002, 2000, 2500)
+    """)
+    eng.tick(barriers=1, chunks_per_barrier=1)
+    rows = eng.execute("SELECT pre, cu, auction FROM ch")
+    from risingwave_tpu.connector.nexmark import NexmarkConfig, NexmarkGenerator
+    gen = NexmarkGenerator(NexmarkConfig(inter_event_us=10))
+    _, cols, _ = gen.gen_bids(0, 512).to_host()
+    want = [
+        (c[:3], c + u, int(a))
+        for a, c, u, p in zip(cols[0], cols[3], cols[4], cols[2])
+        if c.startswith("Goo") and 10 <= p <= 1000000
+        and int(a) in (1000, 1001, 1002, 2000, 2500)
+    ]
+    assert sorted(rows) == sorted(want)
+    assert len(want) > 0
+
+
+def test_extract_and_math():
+    eng = _engine(cap=64)
+    eng.execute("""
+        CREATE SOURCE t (k BIGINT, v BIGINT) WITH (connector='datagen');
+        CREATE MATERIALIZED VIEW m AS
+        SELECT k, sqrt(v::DOUBLE PRECISION) AS r,
+               extract(year FROM (v * 86400000000)::TIMESTAMP) AS y
+        FROM t;
+    """)
+    eng.tick(barriers=1, chunks_per_barrier=1)
+    rows = eng.execute("SELECT k, r, y FROM m")
+    import math, datetime
+    for k, r, y in rows[:20]:
+        v = int(k)  # datagen v == k
+        assert abs(r - math.sqrt(v)) < 1e-9
+        want_y = datetime.datetime.fromtimestamp(
+            v * 86400, datetime.timezone.utc
+        ).year
+        assert int(y) == want_y
