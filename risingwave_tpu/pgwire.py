@@ -1,0 +1,189 @@
+"""Postgres wire-protocol server (simple query protocol, text format).
+
+Reference counterpart: ``src/utils/pgwire`` (``pg_serve()``,
+pg_server.rs:338) — the reference implements the full simple+extended
+protocol with SSL and auth; this round covers the simple-query flow that
+``psql`` and most drivers use for DDL + ad-hoc reads:
+
+    StartupMessage → AuthenticationOk → ParameterStatus* →
+    BackendKeyData → ReadyForQuery → (Query → RowDescription →
+    DataRow* → CommandComplete → ReadyForQuery)*
+
+Extended protocol (parse/bind/execute), SASL auth and SSL land in later
+rounds; SSLRequest is answered with 'N' so clients fall back cleanly.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+
+from risingwave_tpu.common.types import DataType
+
+# pg type OIDs for the text protocol
+_OID = {
+    DataType.BOOLEAN: 16,
+    DataType.INT16: 21,
+    DataType.INT32: 23,
+    DataType.INT64: 20,
+    DataType.FLOAT32: 700,
+    DataType.FLOAT64: 701,
+    DataType.DECIMAL: 1700,
+    DataType.VARCHAR: 1043,
+    DataType.BYTEA: 17,
+    DataType.DATE: 1082,
+    DataType.TIME: 1083,
+    DataType.TIMESTAMP: 1114,
+    DataType.TIMESTAMPTZ: 1184,
+    DataType.INTERVAL: 1186,
+    DataType.SERIAL: 20,
+}
+
+PROTOCOL_VERSION = 196608       # 3.0
+SSL_REQUEST = 80877103
+CANCEL_REQUEST = 80877102
+
+
+def _msg(tag: bytes, payload: bytes) -> bytes:
+    return tag + struct.pack("!I", len(payload) + 4) + payload
+
+
+def _cstr(s: str) -> bytes:
+    return s.encode() + b"\x00"
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):  # noqa: C901 — the protocol state machine
+        sock: socket.socket = self.request
+        engine = self.server.engine
+        lock = self.server.engine_lock
+        f = sock.makefile("rwb")
+        try:
+            if not self._startup(f):
+                return
+            self._ready(f)
+            while True:
+                header = f.read(5)
+                if len(header) < 5:
+                    return
+                tag, length = header[:1], struct.unpack("!I", header[1:])[0]
+                body = f.read(length - 4)
+                if tag == b"X":  # Terminate
+                    return
+                if tag != b"Q":  # only simple queries this round
+                    self._error(f, f"unsupported message {tag!r}")
+                    self._ready(f)
+                    continue
+                sql = body.rstrip(b"\x00").decode()
+                try:
+                    with lock:
+                        cols, rows = engine.query(sql)
+                    self._results(f, sql, cols, rows)
+                except Exception as e:  # surface as pg error, keep session
+                    self._error(f, str(e))
+                self._ready(f)
+        finally:
+            f.close()
+
+    # -- protocol pieces -------------------------------------------------
+    def _startup(self, f) -> bool:
+        while True:
+            raw = f.read(4)
+            if len(raw) < 4:
+                return False
+            length = struct.unpack("!I", raw)[0]
+            body = f.read(length - 4)
+            code = struct.unpack("!I", body[:4])[0]
+            if code == SSL_REQUEST:
+                f.write(b"N")
+                f.flush()
+                continue
+            if code == CANCEL_REQUEST:
+                return False
+            if code != PROTOCOL_VERSION:
+                self._error(f, f"unsupported protocol {code}")
+                return False
+            break
+        f.write(_msg(b"R", struct.pack("!I", 0)))  # AuthenticationOk
+        for k, v in (
+            ("server_version", "13.0 (risingwave_tpu 0.1)"),
+            ("server_encoding", "UTF8"),
+            ("client_encoding", "UTF8"),
+        ):
+            f.write(_msg(b"S", _cstr(k) + _cstr(v)))
+        f.write(_msg(b"K", struct.pack("!II", 0, 0)))  # BackendKeyData
+        f.flush()
+        return True
+
+    def _ready(self, f) -> None:
+        f.write(_msg(b"Z", b"I"))
+        f.flush()
+
+    def _error(self, f, message: str) -> None:
+        payload = b"SERROR\x00" + b"CXX000\x00" + b"M" + _cstr(message) + \
+            b"\x00"
+        f.write(_msg(b"E", payload))
+        f.flush()
+
+    def _results(self, f, sql: str, cols, rows) -> None:
+        verb = sql.strip().split()[0].upper() if sql.strip() else "QUERY"
+        if cols:
+            desc = struct.pack("!H", len(cols))
+            for name in cols:
+                # text protocol: report every column as TEXT (oid 25);
+                # typed OIDs (_OID) arrive with the extended protocol
+                desc += _cstr(str(name)) + struct.pack(
+                    "!IHIhiH", 0, 0, 25, -1, -1, 0
+                )
+            f.write(_msg(b"T", desc))
+            for row in rows:
+                data = struct.pack("!H", len(row))
+                for v in row:
+                    text = _pg_text(v)
+                    data += struct.pack("!i", len(text)) + text
+                f.write(_msg(b"D", data))
+            tagline = f"SELECT {len(rows)}"
+        else:
+            tagline = {"CREATE": "CREATE", "DROP": "DROP",
+                       "FLUSH": "FLUSH", "SET": "SET",
+                       "ALTER": "ALTER SYSTEM"}.get(verb, verb)
+        f.write(_msg(b"C", _cstr(tagline)))
+        f.flush()
+
+
+def _pg_text(v) -> bytes:
+    if isinstance(v, bool):
+        return b"t" if v else b"f"
+    if isinstance(v, bytes):
+        return v
+    if isinstance(v, float):
+        return repr(v).encode()
+    return str(v).encode()
+
+
+class PgServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, engine, host: str = "127.0.0.1", port: int = 4566,
+                 engine_lock: threading.Lock | None = None):
+        super().__init__((host, port), _Handler)
+        self.engine = engine
+        # the engine is single-threaded; serialize statements across
+        # connections (the reference runs per-session tokio tasks over a
+        # shared catalog — same effective serialization for DDL).  The
+        # lock must be installed BEFORE accepting: callers sharing it
+        # with a barrier ticker pass it here
+        self.engine_lock = engine_lock or threading.Lock()
+
+
+def pg_serve(engine, host: str = "127.0.0.1", port: int = 4566,
+             engine_lock: threading.Lock | None = None) -> PgServer:
+    """Start serving in a background thread; returns the server handle
+    (ref pg_serve, pg_server.rs:338)."""
+    server = PgServer(engine, host, port, engine_lock)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return server

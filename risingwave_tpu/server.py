@@ -1,0 +1,87 @@
+"""Single-node server: engine + barrier ticker + pgwire front door.
+
+Reference counterpart: the single-binary modes (``src/cmd_all/src/
+single_node.rs``) that bundle frontend + meta + compute into one
+process.  Here: one Engine, a background barrier loop paced by the
+``barrier_interval_ms`` system param, and the wire server.
+
+    python -m risingwave_tpu.server --port 4566 --data-dir ./data
+"""
+
+from __future__ import annotations
+
+import argparse
+import threading
+import time
+
+from risingwave_tpu.sql.engine import Engine
+from risingwave_tpu.sql.planner import PlannerConfig
+
+
+class SingleNode:
+    def __init__(self, config: PlannerConfig | None = None,
+                 data_dir: str | None = None):
+        self.engine = Engine(config, data_dir=data_dir)
+        self._stop = threading.Event()
+        self._ticker: threading.Thread | None = None
+        self._lock = threading.Lock()
+
+    # -- barrier loop ---------------------------------------------------
+    def _tick_loop(self) -> None:
+        while not self._stop.is_set():
+            interval = int(
+                self.engine.system_params.get("barrier_interval_ms")
+            ) / 1000.0
+            t0 = time.monotonic()
+            with self._lock:
+                if self.engine.jobs:
+                    self.engine.tick(barriers=1)
+            elapsed = time.monotonic() - t0
+            self._stop.wait(max(interval - elapsed, 0.0))
+
+    def start(self, host: str = "127.0.0.1", port: int = 4566,
+              ticker: bool = True):
+        from risingwave_tpu.pgwire import pg_serve
+
+        if ticker:
+            self._ticker = threading.Thread(
+                target=self._tick_loop, daemon=True
+            )
+            self._ticker.start()
+        # pgwire statements and the ticker share the engine lock
+        server = pg_serve(self.engine, host, port, engine_lock=self._lock)
+        return server
+
+    def tick(self, barriers: int = 1,
+             chunks_per_barrier: int | None = None) -> None:
+        """Deterministic manual ticks (tests/FLUSH); lock-coordinated
+        with the background ticker."""
+        with self._lock:
+            self.engine.tick(barriers, chunks_per_barrier)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._ticker is not None:
+            self._ticker.join(timeout=5)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="risingwave_tpu single node")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=4566)
+    p.add_argument("--data-dir", default=None)
+    args = p.parse_args()
+    node = SingleNode(data_dir=args.data_dir)
+    server = node.start(args.host, args.port)
+    print(f"listening on {args.host}:{args.port} (psql -h {args.host} "
+          f"-p {args.port} any_db)")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        node.stop()
+        server.shutdown()
+
+
+if __name__ == "__main__":
+    main()

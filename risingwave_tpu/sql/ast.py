@@ -162,6 +162,15 @@ class CreateMaterializedView:
 
 
 @dataclass(frozen=True)
+class CreateSink:
+    name: str
+    query: Any          # Select (AS form) or None
+    from_rel: str | None
+    with_options: dict
+    if_not_exists: bool = False
+
+
+@dataclass(frozen=True)
 class DropStatement:
     kind: str  # "source" | "materialized view" | "table"
     name: str

@@ -91,28 +91,48 @@ class Engine:
             result = self._execute_one(stmt)
         return result
 
+    def query(self, sql: str):
+        """Run statements; returns (column_names, rows) for wire clients."""
+        self._last_columns = None
+        rows = self.execute(sql)
+        if rows is None:
+            return [], []
+        cols = self._last_columns
+        if cols is None:
+            cols = [f"col{i}" for i in range(len(rows[0]))] if rows else []
+        return cols, rows
+
     def _execute_one(self, stmt):
+        # column names are per-statement: a trailing non-SELECT must not
+        # inherit an earlier SELECT's RowDescription
+        self._last_columns = None
         if isinstance(stmt, ast.CreateSource):
             return self._create_source(stmt)
         if isinstance(stmt, ast.CreateMaterializedView):
             return self._create_mview(stmt)
+        if isinstance(stmt, ast.CreateSink):
+            return self._create_sink(stmt)
         if isinstance(stmt, ast.DropStatement):
             entry = self.catalog.get(stmt.name) \
                 if stmt.name in self.catalog else None
             if entry is not None:
                 want = {"source": "source", "table": "source",
-                        "materialized view": "mview"}[stmt.kind]
+                        "materialized view": "mview",
+                        "sink": "sink"}[stmt.kind]
                 if entry.kind != want:
                     raise ValueError(
                         f"{stmt.name} is a {entry.kind}, not a {want}"
                     )
                 if entry.job is not None:
                     self.jobs.remove(entry.job)
+                if entry.kind == "sink" and entry.mv_executor is not None:
+                    entry.mv_executor.sink.close()
             self.catalog.drop(stmt.name, stmt.if_exists)
             return None
         if isinstance(stmt, ast.ShowStatement):
             kind = {"sources": "source", "tables": "source",
-                    "materialized views": "mview"}.get(stmt.kind)
+                    "materialized views": "mview",
+                    "sinks": "sink"}.get(stmt.kind)
             return [(e.name,) for e in self.catalog.list(kind)]
         if isinstance(stmt, ast.FlushStatement):
             self.tick(barriers=1, chunks_per_barrier=0)
@@ -234,16 +254,16 @@ class Engine:
             watermark=wm, append_only=True, definition=str(stmt),
         )
 
-    def _create_mview(self, stmt: ast.CreateMaterializedView):
-        plan = self.planner.plan(stmt.query)
+    def _build_job(self, plan, name: str):
+        """Instantiate the runtime job for a plan (shared MV/sink path)."""
         ckpt_freq = int(self.system_params.get("checkpoint_frequency"))
         if isinstance(plan, UnaryPlan):
             job = StreamingJob(
-                plan.reader, plan.fragment, stmt.name,
+                plan.reader, plan.fragment, name,
                 checkpoint_frequency=ckpt_freq,
                 checkpoint_store=self.checkpoint_store,
             )
-            mv_exec = plan.fragment.executors[plan.mv_index]
+            terminal = plan.fragment.executors[plan.mv_index]
             state_index = (plan.mv_index,)
         else:
             job = BinaryJob(
@@ -251,16 +271,43 @@ class Engine:
                 plan.post_fragment,
                 left_fragment=plan.left_fragment,
                 right_fragment=plan.right_fragment,
-                name=stmt.name,
+                name=name,
                 checkpoint_frequency=ckpt_freq,
                 checkpoint_store=self.checkpoint_store,
             )
-            mv_exec = plan.post_fragment.executors[plan.mv_index]
+            terminal = plan.post_fragment.executors[plan.mv_index]
             state_index = (3, plan.mv_index)
+        return job, terminal, state_index
+
+    def _create_mview(self, stmt: ast.CreateMaterializedView):
+        plan = self.planner.plan(stmt.query)
+        job, mv_exec, state_index = self._build_job(plan, stmt.name)
         entry = CatalogEntry(
             stmt.name, "mview", mv_exec.in_schema,
             job=job, mv_executor=mv_exec, mv_state_index=state_index,
             definition=str(stmt),
+        )
+        created = self.catalog.create(entry, stmt.if_not_exists)
+        if created:
+            self.jobs.append(job)
+        return None
+
+    def _create_sink(self, stmt: ast.CreateSink):
+        from risingwave_tpu.connector.sinks import create_sink
+
+        if stmt.query is not None:
+            query = stmt.query
+        else:
+            query = ast.Select(
+                (ast.SelectItem(ast.Star(), None),),
+                ast.TableRef(stmt.from_rel),
+            )
+        sink = create_sink(stmt.with_options)
+        plan = self.planner.plan(query, sink=sink)
+        job, sink_exec, _ = self._build_job(plan, stmt.name)
+        entry = CatalogEntry(
+            stmt.name, "sink", sink_exec.in_schema,
+            job=job, mv_executor=sink_exec, definition=str(stmt),
         )
         created = self.catalog.create(entry, stmt.if_not_exists)
         if created:
@@ -352,6 +399,7 @@ class Engine:
                 name, f.data_type, str_width=f.str_width,
                 decimal_scale=f.decimal_scale,
             ))
+        self._last_columns = [f.name for f in bound_fields]
         out_chunk = chunk.with_columns(out_cols, Schema(tuple(bound_fields)))
         _, cols, _ = out_chunk.to_host()
         result = [tuple(c[i] for c in cols) for i in range(len(cols[0]))] \

@@ -212,22 +212,21 @@ class Parser:
                     if not self.accept_op(","):
                         break
                 self.expect_op(")")
-            options = {}
-            if self.accept_word("with"):
-                self.expect_op("(")
-                while True:
-                    k = self.ident()
-                    while self.accept_op("."):  # dotted option keys
-                        k += "." + self.ident()
-                    self.expect_op("=")
-                    v = self.next()
-                    options[k] = v.value.strip("'") if v.kind == "string" \
-                        else v.value
-                    if not self.accept_op(","):
-                        break
-                self.expect_op(")")
+            options = self._with_options()
             return ast.CreateSource(name, tuple(columns), watermark, options,
                                     ine)
+        if self.accept_word("sink"):
+            ine = self._if_not_exists()
+            name = self.ident()
+            query = None
+            from_rel = None
+            if self.accept_word("as"):
+                query = self._select()
+            else:
+                self.expect_word("from")
+                from_rel = self.ident()
+            options = self._with_options()
+            return ast.CreateSink(name, query, from_rel, options, ine)
         if self.accept_word("materialized"):
             self.expect_word("view")
             ine = self._if_not_exists()
@@ -242,6 +241,25 @@ class Parser:
                 eowc = True
             return ast.CreateMaterializedView(name, query, ine, eowc)
         raise ParseError("expected SOURCE, TABLE or MATERIALIZED VIEW")
+
+    def _with_options(self) -> dict:
+        options: dict = {}
+        if self.accept_word("with"):
+            self.expect_op("(")
+            while True:
+                k = self.ident()
+                while self.accept_op("."):  # dotted option keys
+                    k += "." + self.ident()
+                self.expect_op("=")
+                v = self.next()
+                if v.kind == "string":
+                    options[k] = v.value[1:-1].replace("''", "'")
+                else:
+                    options[k] = v.value
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+        return options
 
     def _watermark_delay(self, expr, wcol: str) -> ast.IntervalLit:
         """WATERMARK FOR c AS c - INTERVAL 'x' => the delay interval."""
@@ -268,7 +286,7 @@ class Parser:
         return " ".join(parts)
 
     def _drop(self):
-        kind = self.ident()
+        kind = self.ident()  # source | table | sink | materialized view
         if kind == "materialized":
             self.expect_word("view")
             kind = "materialized view"

@@ -101,10 +101,12 @@ class Planner:
         self.config = config or PlannerConfig()
 
     # ------------------------------------------------------------------
-    def plan(self, select: ast.Select) -> UnaryPlan | JoinPlan:
+    def plan(self, select: ast.Select,
+             sink=None) -> UnaryPlan | JoinPlan:
+        """``sink`` (a connector Sink) replaces the MV terminal."""
         if isinstance(select.from_, ast.Join):
-            return self._plan_join(select)
-        return self._plan_unary(select)
+            return self._plan_join(select, sink)
+        return self._plan_unary(select, sink)
 
     # -- FROM resolution ------------------------------------------------
     def _resolve_input(self, from_) -> PlannedInput:
@@ -156,7 +158,7 @@ class Planner:
         raise PlanError(f"unsupported FROM clause {from_!r}")
 
     # -- unary pipelines -------------------------------------------------
-    def _plan_unary(self, select: ast.Select) -> UnaryPlan:
+    def _plan_unary(self, select: ast.Select, sink=None) -> UnaryPlan:
         if select.from_ is None:
             raise PlanError("SELECT without FROM is not a streaming job")
         pin = self._resolve_input(select.from_)
@@ -198,6 +200,22 @@ class Planner:
                 emit_capacity=self.config.topn_emit_capacity,
                 append_only=topn_append_only,
             ))
+
+        if sink is not None:
+            from risingwave_tpu.stream.sink import SinkExecutor
+            # hidden MV-pk bookkeeping columns must not leak externally
+            visible = [i for i, f in enumerate(out_schema)
+                       if not f.name.startswith("_hidden_")]
+            if len(visible) != len(out_schema):
+                execs.append(ProjectExecutor(
+                    out_schema,
+                    [(out_schema[i].name, InputRef(i)) for i in visible],
+                ))
+                out_schema = execs[-1].out_schema
+            execs.append(SinkExecutor(
+                out_schema, sink, ring_size=self.config.mv_ring_size
+            ))
+            return UnaryPlan(pin.reader, Fragment(execs), len(execs) - 1)
 
         # materialize
         retractable = has_agg or (select.order_by and select.limit)
@@ -250,6 +268,11 @@ class Planner:
         for gi, ga in enumerate(group_asts):
             name = ga.name if isinstance(ga, ast.ColumnRef) else f"_key{gi}"
             group_by.append((name, in_binder.bind(ga)))
+        if not group_by:
+            # global aggregation = one hidden constant group (the
+            # reference's simple agg / Distribution::Single)
+            from risingwave_tpu.expr.node import as_expr
+            group_by.append(("_global", as_expr(0)))
 
         # bind select items collecting agg calls
         item_binder = Binder(scope, allow_aggs=True)
@@ -351,7 +374,7 @@ class Planner:
         return False
 
     # -- join pipelines ---------------------------------------------------
-    def _plan_join(self, select: ast.Select) -> JoinPlan:
+    def _plan_join(self, select: ast.Select, sink=None) -> JoinPlan:
         cfg = self.config
         jn: ast.Join = select.from_
         if jn.kind != "inner":
@@ -412,13 +435,20 @@ class Planner:
         proj = [(name, b.bind(e)) for name, e in items]
         post_execs.append(ProjectExecutor(both.schema, proj))
         out_schema = post_execs[-1].out_schema
-        if not (left.append_only and right.append_only):
-            raise PlanError(
-                "join MVs over retractable inputs need keyed "
-                "materialization (next round)"
+        if sink is not None:
+            from risingwave_tpu.stream.sink import SinkExecutor
+            post_execs.append(SinkExecutor(
+                out_schema, sink, ring_size=cfg.mv_ring_size
+            ))
+        else:
+            if not (left.append_only and right.append_only):
+                raise PlanError(
+                    "join MVs over retractable inputs need keyed "
+                    "materialization (next round)"
+                )
+            post_execs.append(
+                AppendOnlyMaterialize(out_schema, ring_size=cfg.mv_ring_size)
             )
-        mv = AppendOnlyMaterialize(out_schema, ring_size=cfg.mv_ring_size)
-        post_execs.append(mv)
         return JoinPlan(
             left.reader, right.reader,
             Fragment(left.executors) if left.executors else None,

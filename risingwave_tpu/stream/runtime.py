@@ -75,6 +75,15 @@ def propagate_watermarks(fragment: Fragment, states):
     return states
 
 
+def deliver_sinks(fragment: Fragment, states, epoch_val):
+    """Drain sink ring buffers to their connectors (host barrier hook)."""
+    states = list(states)
+    for i, ex in enumerate(fragment.executors):
+        if hasattr(ex, "deliver"):
+            states[i] = ex.deliver(states[i], epoch_val)
+    return tuple(states)
+
+
 def maintain_fragment(fragment: Fragment, states, name: str):
     """Checkpoint-time housekeeping: rehash tombstone-heavy tables and
     surface consistency violations (ref consistency_error!)."""
@@ -207,6 +216,12 @@ class StreamingJob:
         # barrier's watermark must emit at this barrier, not the next
         self.states = propagate_watermarks(self.fragment, self.states)
         outs.extend(self._drain_pending(epoch_val))
+        if barrier.is_checkpoint:
+            # deliver+commit only at checkpoint barriers: replay after
+            # recovery must never duplicate a committed sink epoch
+            self.states = deliver_sinks(
+                self.fragment, self.states, epoch_val
+            )
         if barrier.is_checkpoint:
             self._ckpts_since_maintain += 1
             if self._ckpts_since_maintain >= self.maintenance_interval:
@@ -433,6 +448,8 @@ class BinaryJob:
                 jstate, pstate = self._feed["right"](jstate, pstate, out)
         pstate = propagate_watermarks(self.post, pstate)
         pstate, _ = drain_agg_pending(self.post, pstate, sealed)
+        if self.barriers_seen % self.checkpoint_frequency == 0:
+            pstate = deliver_sinks(self.post, pstate, sealed)
         jstate = self._clean_join_state(lstate, rstate, jstate)
         self.states = (lstate, rstate, jstate, pstate)
 

@@ -1,0 +1,172 @@
+"""pgwire protocol tests with a raw-socket minimal client
+(no postgres driver in the image; the client speaks protocol 3.0
+simple-query flow exactly as psql would)."""
+
+import socket
+import struct
+
+import pytest
+
+from risingwave_tpu.server import SingleNode
+from risingwave_tpu.sql.planner import PlannerConfig
+
+
+class MiniPgClient:
+    def __init__(self, host, port):
+        self.sock = socket.create_connection((host, port), timeout=10)
+        self.f = self.sock.makefile("rwb")
+        self._startup()
+
+    def _startup(self):
+        params = b"user\x00tpu\x00database\x00dev\x00\x00"
+        body = struct.pack("!I", 196608) + params
+        self.f.write(struct.pack("!I", len(body) + 4) + body)
+        self.f.flush()
+        # read until ReadyForQuery
+        while True:
+            tag, payload = self._read_msg()
+            if tag == b"Z":
+                return
+
+    def _read_msg(self):
+        header = self.f.read(5)
+        assert len(header) == 5, "connection closed"
+        tag = header[:1]
+        length = struct.unpack("!I", header[1:])[0]
+        return tag, self.f.read(length - 4)
+
+    def query(self, sql):
+        body = sql.encode() + b"\x00"
+        self.f.write(b"Q" + struct.pack("!I", len(body) + 4) + body)
+        self.f.flush()
+        cols, rows, error = [], [], None
+        while True:
+            tag, payload = self._read_msg()
+            if tag == b"T":
+                n = struct.unpack("!H", payload[:2])[0]
+                off = 2
+                for _ in range(n):
+                    end = payload.index(b"\x00", off)
+                    cols.append(payload[off:end].decode())
+                    off = end + 1 + 18
+            elif tag == b"D":
+                n = struct.unpack("!H", payload[:2])[0]
+                off = 2
+                row = []
+                for _ in range(n):
+                    ln = struct.unpack("!i", payload[off:off + 4])[0]
+                    off += 4
+                    if ln < 0:
+                        row.append(None)
+                    else:
+                        row.append(payload[off:off + ln].decode())
+                        off += ln
+                rows.append(tuple(row))
+            elif tag == b"E":
+                error = payload.decode(errors="replace")
+            elif tag == b"Z":
+                if error:
+                    raise RuntimeError(error)
+                return cols, rows
+
+    def close(self):
+        self.f.write(b"X" + struct.pack("!I", 4))
+        self.f.flush()
+        self.sock.close()
+
+
+@pytest.fixture()
+def node():
+    n = SingleNode(PlannerConfig(
+        chunk_capacity=128, agg_table_size=256, agg_emit_capacity=64,
+        mv_table_size=256, mv_ring_size=1024,
+    ))
+    # port 0 = ephemeral
+    server = n.start(port=0, ticker=False)  # deterministic ticks
+    host, port = server.server_address
+    yield n, host, port
+    n.stop()
+    server.shutdown()
+
+
+def test_pgwire_end_to_end(node):
+    n, host, port = node
+    c = MiniPgClient(host, port)
+    try:
+        c.query("""
+            CREATE SOURCE t (k BIGINT, v BIGINT)
+            WITH (connector = 'datagen')
+        """)
+        c.query("""
+            CREATE MATERIALIZED VIEW m AS
+            SELECT k % 2 AS b, count(*) AS n FROM t GROUP BY k % 2
+        """)
+        # drive the dataflow deterministically (the background ticker
+        # paces at barrier_interval_ms; FLUSH-style direct ticks are
+        # exact for the assertion)
+        n.tick(barriers=2, chunks_per_barrier=1)
+        cols, rows = c.query("SELECT b, n FROM m ORDER BY b")
+        assert cols == ["b", "n"]
+        assert [(r[0], r[1]) for r in rows] == [("0", "128"), ("1", "128")]
+
+        cols, rows = c.query("SHOW MATERIALIZED VIEWS")
+        assert rows == [("m",)]
+    finally:
+        c.close()
+
+
+def test_pgwire_error_keeps_session(node):
+    n, host, port = node
+    c = MiniPgClient(host, port)
+    try:
+        with pytest.raises(RuntimeError):
+            c.query("SELECT broken FROM nowhere")
+        # session still usable after an error
+        cols, rows = c.query("SHOW SOURCES")
+        assert rows == []
+    finally:
+        c.close()
+
+
+def test_pgwire_concurrent_sessions(node):
+    n, host, port = node
+    a = MiniPgClient(host, port)
+    b = MiniPgClient(host, port)
+    try:
+        a.query("CREATE SOURCE s1 (k BIGINT) WITH (connector='datagen')")
+        b.query("CREATE SOURCE s2 (k BIGINT) WITH (connector='datagen')")
+        _, rows = a.query("SHOW SOURCES")
+        assert sorted(rows) == [("s1",), ("s2",)]
+    finally:
+        a.close()
+        b.close()
+
+
+def test_background_ticker_advances_jobs():
+    """The barrier ticker (barrier_interval_ms) drives jobs on its own."""
+    import time
+
+    n = SingleNode(PlannerConfig(
+        chunk_capacity=64, agg_table_size=256, agg_emit_capacity=64,
+        mv_table_size=256, mv_ring_size=1024,
+    ))
+    n.engine.system_params.set("barrier_interval_ms", 50)
+    server = n.start(port=0)
+    try:
+        host, port = server.server_address
+        c = MiniPgClient(host, port)
+        c.query("CREATE SOURCE t (k BIGINT) WITH (connector='datagen')")
+        c.query("CREATE MATERIALIZED VIEW m AS SELECT count(*) AS n FROM t")
+        deadline = time.time() + 15
+        total = 0
+        while time.time() < deadline:
+            _, rows = c.query("SELECT n FROM m")
+            if rows and int(rows[0][0]) > 0:
+                total = int(rows[0][0])
+                break
+            time.sleep(0.1)
+        assert total > 0  # the ticker advanced the dataflow by itself
+        c.close()
+    finally:
+        n.stop()
+        server.shutdown()

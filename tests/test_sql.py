@@ -268,3 +268,32 @@ def test_extract_and_math():
             v * 86400, datetime.timezone.utc
         ).year
         assert int(y) == want_y
+
+
+def test_create_sink_file_and_blackhole(tmp_path):
+    import json as _json
+
+    eng = _engine(cap=64)
+    eng.execute("""
+        CREATE SOURCE t (k BIGINT, v BIGINT) WITH (connector='datagen');
+    """)
+    path = str(tmp_path / "out.jsonl")
+    eng.execute(f"""
+        CREATE SINK f AS SELECT k, v FROM t WHERE k < 5
+        WITH (connector = 'file', path = '{path}');
+        CREATE SINK b AS
+        SELECT k % 2 AS g, count(*) AS n FROM t GROUP BY k % 2
+        WITH (connector = 'blackhole');
+    """)
+    assert eng.execute("SHOW SINKS") == [("f",), ("b",)]
+    eng.tick(barriers=2, chunks_per_barrier=1)
+    recs = [_json.loads(l) for l in open(path)]
+    data = [r for r in recs if r["op"] == "insert"]
+    commits = [r for r in recs if r["op"] == "commit"]
+    assert [(r["k"], r["v"]) for r in data] == [(i, i) for i in range(5)]
+    assert len(commits) == 2  # one per checkpoint barrier
+    # blackhole sink saw the agg changelog
+    bh = eng.catalog.get("b").mv_executor.sink
+    assert bh.rows_written > 0 and bh.commits == 2
+    eng.execute("DROP SINK f")
+    assert eng.execute("SHOW SINKS") == [("b",)]
