@@ -139,7 +139,9 @@ class SystemParams:
 
 _SESSION_DEFS = {
     "query_epoch": (0, "read at a specific committed epoch (0 = latest)"),
-    "streaming_parallelism": (0, "0 = adaptive (all shards)"),
+    "streaming_parallelism": (
+        1, "1 = linear; 0 = adaptive (all devices); N = N shards"
+    ),
     "timezone": ("UTC", "display timezone"),
     "batch_row_limit": (1_000_000, "serving scan cap"),
 }

@@ -255,8 +255,20 @@ class Engine:
         )
 
     def _build_job(self, plan, name: str):
-        """Instantiate the runtime job for a plan (shared MV/sink path)."""
+        """Instantiate the runtime job for a plan (shared MV/sink path).
+
+        When the session sets ``streaming_parallelism`` > 1, eligible
+        aggregation plans run vnode-sharded over the device mesh
+        (ref: adaptive parallelism, ADAPTIVE streaming jobs)."""
         ckpt_freq = int(self.system_params.get("checkpoint_frequency"))
+        par = int(self.session_config.get("streaming_parallelism"))
+        if par == 0:  # adaptive: all devices (ref ADAPTIVE parallelism)
+            import jax as _jax
+            par = len(_jax.devices())
+        if par > 1 and isinstance(plan, UnaryPlan):
+            sharded = self._try_sharded_job(plan, name, par, ckpt_freq)
+            if sharded is not None:
+                return sharded
         if isinstance(plan, UnaryPlan):
             job = StreamingJob(
                 plan.reader, plan.fragment, name,
@@ -278,6 +290,70 @@ class Engine:
             terminal = plan.post_fragment.executors[plan.mv_index]
             state_index = (3, plan.mv_index)
         return job, terminal, state_index
+
+    def _try_sharded_job(self, plan, name: str, par: int, ckpt_freq: int):
+        import jax
+        from risingwave_tpu.stream.executor import (
+            FilterExecutor as _F,
+            HopWindowExecutor as _H,
+            ProjectExecutor as _P,
+        )
+        from risingwave_tpu.stream.hash_agg import HashAggExecutor as _A
+        from risingwave_tpu.stream.sharded import (
+            ShardedJob,
+            ShardedStreamingJob,
+            make_mesh,
+        )
+
+        reader = plan.reader
+        if not (hasattr(reader, "impl") and hasattr(reader, "next_base")):
+            return None
+        from risingwave_tpu.stream.materialize import (
+            AppendOnlyMaterialize as _AOM,
+            MaterializeExecutor as _M,
+        )
+
+        execs = plan.fragment.executors
+        agg_idx = None
+        for i, ex in enumerate(execs):
+            if isinstance(ex, _A):
+                if agg_idx is not None:
+                    return None
+                agg_idx = i
+        if agg_idx is None:
+            return None
+        # prefix must be stateless; watermark cleaning in the sharded
+        # path lands next round
+        prefix = execs[:agg_idx]
+        if any(not isinstance(ex, (_F, _H, _P)) for ex in prefix):
+            return None
+        # suffix after the agg: only per-key-safe operators (a TopN or
+        # sink here would compute per-SHARD results — stays linear)
+        for ex in execs[agg_idx + 1:]:
+            if not isinstance(ex, (_F, _P, _M, _AOM)):
+                return None
+        agg = execs[agg_idx]
+        if agg.watermark_group_idx is not None:
+            return None
+        n = min(par, len(jax.devices()))
+        if n < 2:
+            return None
+        mesh = make_mesh(n)
+        sharded = ShardedJob(
+            mesh,
+            source_fn=reader.impl,
+            chunk_capacity=reader.cap,
+            local_executors=list(prefix),
+            exchange_key_fn=lambda c: [e.eval(c) for _, e in agg.group_by],
+            keyed_executors=list(execs[agg_idx:]),
+        )
+        job = ShardedStreamingJob(
+            sharded, reader, name,
+            checkpoint_frequency=ckpt_freq,
+            checkpoint_store=self.checkpoint_store,
+        )
+        terminal = execs[-1]
+        return job, terminal, (len(execs) - 1,)
 
     def _create_mview(self, stmt: ast.CreateMaterializedView):
         plan = self.planner.plan(stmt.query)
@@ -360,7 +436,11 @@ class Engine:
 
     # -- serving reads ---------------------------------------------------
     def _mv_rows(self, entry: CatalogEntry):
+        from risingwave_tpu.stream.sharded import ShardedStreamingJob
+
         idx = entry.mv_state_index
+        if isinstance(entry.job, ShardedStreamingJob):
+            return entry.job.mv_rows(entry.mv_executor, idx[0])
         state = entry.job.states
         for i in idx:
             state = state[i]
