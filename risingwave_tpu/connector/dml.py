@@ -1,0 +1,88 @@
+"""DML: user tables fed by INSERT statements.
+
+Reference counterpart: ``src/dml`` (``DmlManager``,
+src/dml/src/dml_manager.rs) — frontend DML batches flow through
+channels into every dataflow reading the table — and the table source
+executor (``dml.rs``).
+
+Here a ``TableDmlManager`` per table fans each INSERT batch out to one
+queue per downstream job reader; readers emit fixed-capacity chunks
+(possibly with zero valid rows when idle — shape-static by
+construction).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from risingwave_tpu.common.chunk import Chunk
+from risingwave_tpu.common.types import Schema
+
+
+class TableDmlManager:
+    """Fan-out of INSERT batches to all readers of one table.
+
+    The full history is retained so readers created later (new MVs)
+    replay earlier inserts — the poor-man's backfill (the reference
+    backfills new MVs from the table's state; a bounded log + real
+    backfill executor land with the storage round)."""
+
+    def __init__(self, schema: Schema):
+        self.schema = schema
+        self._readers: list["TableSourceReader"] = []
+        self._history: list[tuple] = []
+        self.rows_inserted = 0
+
+    def new_reader(self, chunk_capacity: int) -> "TableSourceReader":
+        r = TableSourceReader(self.schema, chunk_capacity)
+        r.enqueue(self._history)  # replay everything inserted so far
+        self._readers.append(r)
+        return r
+
+    def insert(self, rows: Sequence[tuple]) -> int:
+        rows = list(rows)
+        self._history.extend(rows)
+        for r in self._readers:
+            r.enqueue(rows)
+        self.rows_inserted += len(rows)
+        return len(rows)
+
+
+class TableSourceReader:
+    """Queue-fed source reader; empty chunks when idle."""
+
+    def __init__(self, schema: Schema, chunk_capacity: int):
+        self.schema = schema
+        self.cap = chunk_capacity
+        self._pending: deque[tuple] = deque()
+        #: consumed-row offset (checkpointable like any source cursor;
+        #: replay of unread DML after recovery is the caller's concern
+        #: until the log-store lands)
+        self.offset = 0
+
+    def enqueue(self, rows: Sequence[tuple]) -> None:
+        self._pending.extend(rows)
+
+    def pending(self) -> int:
+        return len(self._pending)
+
+    def next_chunk(self) -> Chunk:
+        n = min(len(self._pending), self.cap)
+        batch = [self._pending.popleft() for _ in range(n)]
+        self.offset += n
+        if n == 0:
+            # shape-static empty chunk
+            arrays = [np.zeros((0,), np.int64) for _ in self.schema]
+            return Chunk.from_numpy(self.schema, arrays, capacity=self.cap)
+        arrays = [
+            np.asarray([row[i] for row in batch])
+            for i in range(len(self.schema))
+        ]
+        return Chunk.from_numpy(self.schema, arrays, capacity=self.cap)
+
+    def state(self) -> dict:
+        return {"offset": self.offset}

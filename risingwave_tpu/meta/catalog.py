@@ -28,6 +28,8 @@ class CatalogEntry:
     job: Any = None
     mv_executor: Any = None
     mv_state_index: Any = None  # index path to the MV state in job.states
+    #: DML-fed tables: the TableDmlManager feeding all readers
+    dml: Any = None
     definition: str = ""
 
 

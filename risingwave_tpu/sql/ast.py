@@ -151,6 +151,14 @@ class CreateSource:
     watermark: WatermarkDef | None
     with_options: dict
     if_not_exists: bool = False
+    is_table: bool = False
+
+
+@dataclass(frozen=True)
+class Insert:
+    table: str
+    columns: tuple[str, ...]  # () = positional
+    rows: tuple               # tuples of literal AST exprs
 
 
 @dataclass(frozen=True)

@@ -150,9 +150,37 @@ class Engine:
             ]
         if isinstance(stmt, ast.Explain):
             return self._explain(stmt.statement)
+        if isinstance(stmt, ast.Insert):
+            return self._insert(stmt)
         if isinstance(stmt, ast.Select):
             return self._serve(stmt)
         raise ValueError(f"unhandled statement {stmt!r}")
+
+    def _insert(self, stmt: ast.Insert):
+        entry = self.catalog.get(stmt.table)
+        if entry.dml is None:
+            raise ValueError(f"{stmt.table} is not an INSERT-able table")
+        schema = entry.schema
+        if stmt.columns:
+            order = [schema.index_of(c) for c in stmt.columns]
+            if sorted(order) != list(range(len(schema))):
+                raise ValueError(
+                    "INSERT must provide every column this round"
+                )
+        else:
+            order = list(range(len(schema)))
+        rows = []
+        for r in stmt.rows:
+            if len(r) != len(order):
+                raise ValueError("INSERT arity mismatch")
+            vals = [None] * len(schema)
+            for pos, e in zip(order, r):
+                vals[pos] = _coerce_const(
+                    _const_value(e), schema[pos]
+                )
+            rows.append(tuple(vals))
+        entry.dml.insert(rows)
+        return None
 
     def _explain(self, stmt) -> list[tuple[str]]:
         """Plan description (ref handler/explain.rs, simplified)."""
@@ -184,7 +212,9 @@ class Engine:
     # -- DDL -------------------------------------------------------------
     def _create_source(self, stmt: ast.CreateSource):
         connector = stmt.with_options.get("connector")
-        if connector == "nexmark":
+        if connector is None and stmt.is_table:
+            entry = self._dml_table(stmt)
+        elif connector == "nexmark":
             entry = self._nexmark_source(stmt)
         elif connector == "datagen":
             entry = self._datagen_source(stmt)
@@ -234,21 +264,43 @@ class Engine:
             watermark=wm, append_only=True, definition=str(stmt),
         )
 
-    def _datagen_source(self, stmt: ast.CreateSource) -> CatalogEntry:
-        fields = tuple(
+    @staticmethod
+    def _declared_schema(stmt: ast.CreateSource):
+        """(schema, watermark) from a CREATE SOURCE/TABLE statement."""
+        schema = Schema(tuple(
             Field(c.name, DataType.from_sql(c.type_name))
             for c in stmt.columns
+        ))
+        wm = None
+        if stmt.watermark is not None:
+            wm = (schema.index_of(stmt.watermark.column),
+                  stmt.watermark.delay.micros)
+        return schema, wm
+
+    def _dml_table(self, stmt: ast.CreateSource) -> CatalogEntry:
+        """CREATE TABLE without a connector: INSERT-fed (ref src/dml)."""
+        from risingwave_tpu.connector.dml import TableDmlManager
+
+        schema, wm = self._declared_schema(stmt)
+        dml = TableDmlManager(schema)
+        cap = self.config.chunk_capacity
+
+        def factory(split_id: int = 0, num_splits: int = 1):
+            return dml.new_reader(cap)
+
+        return CatalogEntry(
+            stmt.name, "source", schema, reader_factory=factory,
+            watermark=wm, append_only=True, definition=str(stmt),
+            dml=dml,
         )
-        schema = Schema(fields)
+
+    def _datagen_source(self, stmt: ast.CreateSource) -> CatalogEntry:
+        schema, wm = self._declared_schema(stmt)
         cap = self.config.chunk_capacity
 
         def factory(split_id: int = 0, num_splits: int = 1):
             return _DatagenReader(schema, cap, split_id, num_splits)
 
-        wm = None
-        if stmt.watermark is not None:
-            wm = (schema.index_of(stmt.watermark.column),
-                  stmt.watermark.delay.micros)
         return CatalogEntry(
             stmt.name, "source", schema, reader_factory=factory,
             watermark=wm, append_only=True, definition=str(stmt),
@@ -516,6 +568,45 @@ class Engine:
         if select.limit is not None:
             result = result[:select.limit]
         return result
+
+
+def _const_value(e):
+    """Evaluate a constant VALUES expression host-side."""
+    if isinstance(e, ast.Literal):
+        return e.value
+    if isinstance(e, ast.IntervalLit):
+        return e.micros
+    if isinstance(e, ast.UnaryOp) and e.op == "neg":
+        return -_const_value(e.operand)
+    if isinstance(e, ast.Cast):
+        v = _const_value(e.operand)
+        t = DataType.from_sql(e.type_name)
+        return _coerce_const(v, Field("?", t))
+    raise ValueError(f"INSERT VALUES must be constants, got {e!r}")
+
+
+def _coerce_const(v, field: Field):
+    """Validate/convert one INSERT value to the column type at statement
+    time — a bad constant must fail the INSERT, never poison the queue
+    for every downstream job."""
+    t = field.data_type
+    try:
+        if t.is_string:
+            return str(v)
+        if t in (DataType.FLOAT32, DataType.FLOAT64, DataType.DECIMAL):
+            return float(v)
+        if t == DataType.BOOLEAN:
+            if isinstance(v, str):
+                raise ValueError(v)
+            return bool(v)
+        if isinstance(v, float):
+            return int(round(v))  # SQL casts round, not truncate
+        return int(v)
+    except (TypeError, ValueError) as e:
+        raise ValueError(
+            f"invalid value {v!r} for column "
+            f"{field.name} ({t.value})"
+        ) from e
 
 
 class _ProjectingReader:

@@ -158,6 +158,28 @@ class Parser:
             return self._set(system=True)
         if self.accept_word("set"):
             return self._set(system=False)
+        if self.accept_word("insert"):
+            self.expect_word("into")
+            name = self.ident()
+            cols: list[str] = []
+            if self.accept_op("("):
+                while True:
+                    cols.append(self.ident())
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+            self.expect_word("values")
+            rows = []
+            while True:
+                self.expect_op("(")
+                row = [self._expr()]
+                while self.accept_op(","):
+                    row.append(self._expr())
+                self.expect_op(")")
+                rows.append(tuple(row))
+                if not self.accept_op(","):
+                    break
+            return ast.Insert(name, tuple(cols), tuple(rows))
         if self.accept_word("flush"):
             return ast.FlushStatement()
         if self.peek() and self.peek().value == "select":
@@ -190,6 +212,9 @@ class Parser:
         return False
 
     def _create(self):
+        is_table = False
+        if self.peek() and self.peek().value == "table":
+            is_table = True
         if self.accept_word("source") or self.accept_word("table"):
             ine = self._if_not_exists()
             name = self.ident()
@@ -214,7 +239,7 @@ class Parser:
                 self.expect_op(")")
             options = self._with_options()
             return ast.CreateSource(name, tuple(columns), watermark, options,
-                                    ine)
+                                    ine, is_table)
         if self.accept_word("sink"):
             ine = self._if_not_exists()
             name = self.ident()

@@ -297,3 +297,43 @@ def test_create_sink_file_and_blackhole(tmp_path):
     assert bh.rows_written > 0 and bh.commits == 2
     eng.execute("DROP SINK f")
     assert eng.execute("SHOW SINKS") == [("b",)]
+
+
+def test_create_table_insert_tpch_style():
+    """DML tables + a TPC-H q1-shaped pricing summary MV."""
+    eng = _engine(cap=64)
+    eng.execute("""
+        CREATE TABLE lineitem (
+            l_quantity BIGINT, l_extendedprice DOUBLE PRECISION,
+            l_discount DOUBLE PRECISION, l_returnflag BIGINT
+        );
+        CREATE MATERIALIZED VIEW pricing AS
+        SELECT l_returnflag,
+               sum(l_quantity) AS sum_qty,
+               sum(l_extendedprice * (1 - l_discount)) AS revenue,
+               count(*) AS n
+        FROM lineitem GROUP BY l_returnflag;
+    """)
+    eng.execute("""
+        INSERT INTO lineitem VALUES
+            (10, 100.0, 0.1, 0),
+            (5, 50.0, 0.0, 0),
+            (7, 70.0, 0.5, 1);
+        INSERT INTO lineitem (l_returnflag, l_quantity, l_extendedprice,
+                              l_discount)
+        VALUES (1, 3, 30.0, 0.0);
+    """)
+    eng.tick(barriers=1, chunks_per_barrier=1)
+    rows = {int(r[0]): (int(r[1]), round(r[2], 6), int(r[3]))
+            for r in eng.execute(
+                "SELECT l_returnflag, sum_qty, revenue, n FROM pricing")}
+    assert rows == {
+        0: (15, 100.0 * 0.9 + 50.0, 2),
+        1: (10, 70.0 * 0.5 + 30.0, 2),
+    }
+    # a second epoch of inserts updates the MV incrementally
+    eng.execute("INSERT INTO lineitem VALUES (1, 10.0, 0.0, 0)")
+    eng.tick(barriers=1, chunks_per_barrier=1)
+    rows = {int(r[0]): int(r[1]) for r in eng.execute(
+        "SELECT l_returnflag, n FROM pricing")}
+    assert rows == {0: 3, 1: 2}
