@@ -408,7 +408,8 @@ class Engine:
         return job, terminal, (len(execs) - 1,)
 
     def _create_mview(self, stmt: ast.CreateMaterializedView):
-        plan = self.planner.plan(stmt.query)
+        plan = self.planner.plan(stmt.query,
+                                 eowc=stmt.emit_on_window_close)
         job, mv_exec, state_index = self._build_job(plan, stmt.name)
         entry = CatalogEntry(
             stmt.name, "mview", mv_exec.in_schema,

@@ -101,12 +101,15 @@ class Planner:
         self.config = config or PlannerConfig()
 
     # ------------------------------------------------------------------
-    def plan(self, select: ast.Select,
-             sink=None) -> UnaryPlan | JoinPlan:
-        """``sink`` (a connector Sink) replaces the MV terminal."""
+    def plan(self, select: ast.Select, sink=None,
+             eowc: bool = False) -> UnaryPlan | JoinPlan:
+        """``sink`` replaces the MV terminal; ``eowc`` = EMIT ON WINDOW
+        CLOSE (final append-only rows when windows close)."""
+        if eowc and isinstance(select.from_, ast.Join):
+            raise PlanError("EMIT ON WINDOW CLOSE on joins: next round")
         if isinstance(select.from_, ast.Join):
             return self._plan_join(select, sink)
-        return self._plan_unary(select, sink)
+        return self._plan_unary(select, sink, eowc)
 
     # -- FROM resolution ------------------------------------------------
     def _resolve_input(self, from_) -> PlannedInput:
@@ -158,7 +161,8 @@ class Planner:
         raise PlanError(f"unsupported FROM clause {from_!r}")
 
     # -- unary pipelines -------------------------------------------------
-    def _plan_unary(self, select: ast.Select, sink=None) -> UnaryPlan:
+    def _plan_unary(self, select: ast.Select, sink=None,
+                    eowc: bool = False) -> UnaryPlan:
         if select.from_ is None:
             raise PlanError("SELECT without FROM is not a streaming job")
         pin = self._resolve_input(select.from_)
@@ -170,10 +174,15 @@ class Planner:
             execs.append(FilterExecutor(scope.schema, b.bind(select.where)))
 
         has_agg = bool(select.group_by) or self._has_agg(select)
+        if eowc and not has_agg:
+            raise PlanError(
+                "EMIT ON WINDOW CLOSE needs GROUP BY window_start over a "
+                "watermarked windowed source"
+            )
         pk_positions: list[int] = []
         if has_agg:
             execs2, out_schema, pk_positions = self._plan_agg(
-                select, scope, pin
+                select, scope, pin, eowc
             )
             execs.extend(execs2)
         else:
@@ -183,14 +192,30 @@ class Planner:
             execs.append(ProjectExecutor(scope.schema, proj))
             out_schema = execs[-1].out_schema
 
-        if select.order_by and select.limit is not None:
+        self._append_terminal(
+            execs, out_schema, select,
+            input_append_only=pin.append_only, has_agg=has_agg,
+            pk_positions=pk_positions, sink=sink, eowc=eowc,
+        )
+        return UnaryPlan(pin.reader, Fragment(execs), len(execs) - 1)
+
+    def _append_terminal(self, execs, out_schema, select, *,
+                         input_append_only: bool, has_agg: bool,
+                         pk_positions, sink, eowc: bool) -> None:
+        """Shared plan tail: optional TopN, then sink or materialize."""
+        has_topn = bool(select.order_by and select.limit is not None)
+        if has_topn:
+            if eowc:
+                raise PlanError(
+                    "ORDER BY ... LIMIT with EMIT ON WINDOW CLOSE: "
+                    "next round"
+                )
             ob = []
             b = Binder(Scope.of(out_schema))
             for oi in select.order_by:
                 ob.append((self._bind_order_key(oi.expr, b, out_schema),
                            oi.descending))
             # append-only up to here ⇒ the TopN can evict non-band rows
-            topn_append_only = pin.append_only and not has_agg
             pool = max(self.config.topn_pool_size,
                        2 * self.config.chunk_capacity)
             execs.append(GroupTopNExecutor(
@@ -198,7 +223,7 @@ class Planner:
                 offset=select.offset or 0,
                 pool_size=pool,
                 emit_capacity=self.config.topn_emit_capacity,
-                append_only=topn_append_only,
+                append_only=input_append_only and not has_agg,
             ))
 
         if sink is not None:
@@ -215,26 +240,22 @@ class Planner:
             execs.append(SinkExecutor(
                 out_schema, sink, ring_size=self.config.mv_ring_size
             ))
-            return UnaryPlan(pin.reader, Fragment(execs), len(execs) - 1)
+            return
 
-        # materialize
-        retractable = has_agg or (select.order_by and select.limit)
+        # materialize (EOWC output is final append-only rows)
+        retractable = (has_agg or has_topn) and not eowc
         if retractable:
             # pk: group keys for aggs; whole row for TopN output
-            if has_agg and not (select.order_by and select.limit):
-                pk = pk_positions
-            else:
-                pk = list(range(len(out_schema)))
-            mv = MaterializeExecutor(
+            pk = pk_positions if (has_agg and not has_topn) \
+                else list(range(len(out_schema)))
+            execs.append(MaterializeExecutor(
                 out_schema, pk_indices=pk,
                 table_size=self.config.mv_table_size,
-            )
+            ))
         else:
-            mv = AppendOnlyMaterialize(
+            execs.append(AppendOnlyMaterialize(
                 out_schema, ring_size=self.config.mv_ring_size
-            )
-        execs.append(mv)
-        return UnaryPlan(pin.reader, Fragment(execs), len(execs) - 1)
+            ))
 
     # -- aggregation ------------------------------------------------------
     def _has_agg(self, select: ast.Select) -> bool:
@@ -260,7 +281,7 @@ class Planner:
                    if not isinstance(i.expr, ast.Star))
 
     def _plan_agg(self, select: ast.Select, scope: Scope,
-                  pin: PlannedInput):
+                  pin: PlannedInput, eowc: bool = False):
         cfg = self.config
         group_asts = list(select.group_by)
         in_binder = Binder(scope)
@@ -297,6 +318,11 @@ class Planner:
                 if (isinstance(ga, ast.ColumnRef)
                         and ga.name == "window_start"):
                     wm_idx, lag = ki, pin.window_size
+        if eowc and wm_idx is None:
+            raise PlanError(
+                "EMIT ON WINDOW CLOSE needs GROUP BY window_start over a "
+                "watermarked windowed source"
+            )
         agg = HashAggExecutor(
             scope.schema, group_by, agg_calls,
             table_size=cfg.agg_table_size,
@@ -304,6 +330,7 @@ class Planner:
             watermark_group_idx=wm_idx,
             watermark_lag=lag,
             watermark_src_col=pin.watermark_col,
+            emit_on_window_close=eowc,
         )
         execs: list[Executor] = [agg]
 
@@ -431,6 +458,33 @@ class Planner:
             post_execs.append(
                 FilterExecutor(both.schema, b.bind(select.where))
             )
+
+        has_agg = bool(select.group_by) or self._has_agg(select)
+        if has_agg:
+            # aggregation over the joined stream (TPC-H/q4 shape): the
+            # join's retractions flow into the agg, which handles them
+            dummy_pin = PlannedInput(
+                None, [], both, both.schema, None, None,
+                left.append_only and right.append_only,
+            )
+            execs2, out_schema, pk_pos = self._plan_agg(
+                select, both, dummy_pin
+            )
+            post_execs.extend(execs2)
+            self._append_terminal(
+                post_execs, out_schema, select,
+                input_append_only=False, has_agg=True,
+                pk_positions=pk_pos, sink=sink, eowc=False,
+            )
+            return JoinPlan(
+                left.reader, right.reader,
+                Fragment(left.executors) if left.executors else None,
+                Fragment(right.executors) if right.executors else None,
+                join,
+                Fragment(post_execs),
+                len(post_execs) - 1,
+            )
+
         items = self._expand_items(select.items, both)
         proj = [(name, b.bind(e)) for name, e in items]
         post_execs.append(ProjectExecutor(both.schema, proj))

@@ -65,6 +65,8 @@ class AggState(NamedTuple):
     #: consistency_error! analog (ref src/stream/src/lib.rs:93); the
     #: runtime surfaces this at barrier time
     inconsistency: jnp.ndarray  # int64 scalar
+    #: latest watermark received (EOWC emission; INT64_MIN = none)
+    wm: jnp.ndarray             # int64 scalar
 
 
 def _interleave(old, new):
@@ -94,8 +96,16 @@ class HashAggExecutor(Executor):
         watermark_group_idx: int | None = None,
         watermark_lag: int = 0,
         watermark_src_col: int | None = None,
+        emit_on_window_close: bool = False,
     ):
         super().__init__(in_schema)
+        #: EOWC (ref emit_on_window_close plan property): flush emits
+        #: only CLOSED windows as final append-only rows and evicts them
+        self.emit_on_window_close = emit_on_window_close
+        if emit_on_window_close and watermark_group_idx is None:
+            raise ValueError(
+                "EMIT ON WINDOW CLOSE needs a watermarked window group key"
+            )
         self.group_by = tuple(group_by)
         self.aggs = tuple(aggs)
         #: when set, watermarks clean groups whose key[idx] < wm - lag
@@ -170,6 +180,7 @@ class HashAggExecutor(Executor):
             emitted=jnp.zeros((size,), jnp.bool_),
             overflow=jnp.zeros((), jnp.int64),
             inconsistency=jnp.zeros((), jnp.int64),
+            wm=jnp.asarray(np.iinfo(np.int64).min, jnp.int64),
         )
 
     # ------------------------------------------------------------------
@@ -226,6 +237,7 @@ class HashAggExecutor(Executor):
             emitted=state.emitted,
             overflow=state.overflow + n_over,
             inconsistency=state.inconsistency + n_bad,
+            wm=state.wm,
         ), None
 
     # ------------------------------------------------------------------
@@ -245,6 +257,8 @@ class HashAggExecutor(Executor):
         return cols
 
     def flush(self, state: AggState, epoch):
+        if self.emit_on_window_close:
+            return self._flush_eowc(state)
         cap = self.emit_capacity
         size = self.table_size
         (slots,) = jnp.nonzero(state.dirty, size=cap, fill_value=size)
@@ -296,13 +310,62 @@ class HashAggExecutor(Executor):
             emitted=emitted,
             overflow=state.overflow,
             inconsistency=state.inconsistency,
+            wm=state.wm,
+        ), out
+
+    def _closed_mask(self, state: AggState) -> jnp.ndarray:
+        key = state.table.key_cols[self.watermark_group_idx]
+        no_wm = state.wm == np.iinfo(np.int64).min
+        closed = state.table.occupied & (
+            key + self.watermark_lag <= state.wm
+        )
+        return closed & ~no_wm
+
+    def _flush_eowc(self, state: AggState):
+        """Emit final rows for closed windows; evict them (ref EOWC)."""
+        cap = self.emit_capacity
+        size = self.table_size
+        closed = self._closed_mask(state)
+        (slots,) = jnp.nonzero(closed, size=cap, fill_value=size)
+        slot_live = slots < size
+        safe = jnp.minimum(slots, size - 1)
+        live = slot_live & (state.row_count[safe] > 0)
+
+        key_vals = state.table.gather_keys(slots)
+        out_cols = list(key_vals) + self._outputs(
+            state.prims, state.row_count, slots
+        )
+        out = Chunk(
+            tuple(out_cols),
+            jnp.full((cap,), OP_INSERT, jnp.int8),
+            live,
+            self._out_schema,
+        )
+        emitted_mask = jnp.zeros((size,), jnp.bool_).at[slots].set(
+            slot_live, mode="drop"
+        )
+        table = state.table.clear_where(emitted_mask)
+        return AggState(
+            table=table,
+            prims=state.prims,
+            row_count=jnp.where(emitted_mask, 0, state.row_count),
+            dirty=state.dirty & ~emitted_mask,
+            prev_prims=state.prev_prims,
+            prev_row_count=state.prev_row_count,
+            emitted=state.emitted,
+            overflow=state.overflow,
+            inconsistency=state.inconsistency,
+            wm=state.wm,
         ), out
 
     def pending_dirty(self, state: AggState) -> jnp.ndarray:
         return jnp.sum(state.dirty.astype(jnp.int32))
 
     # runtime drain protocol
-    pending_flush = pending_dirty
+    def pending_flush(self, state: AggState) -> jnp.ndarray:
+        if self.emit_on_window_close:
+            return jnp.sum(self._closed_mask(state).astype(jnp.int32))
+        return self.pending_dirty(state)
 
     def on_watermark(self, state: AggState, watermark):
         if self.watermark_group_idx is None:
@@ -310,6 +373,11 @@ class HashAggExecutor(Executor):
         if (self.watermark_src_col is not None
                 and watermark.col_idx != self.watermark_src_col):
             return state
+        state = state._replace(
+            wm=jnp.maximum(state.wm, jnp.int64(watermark.value))
+        )
+        if self.emit_on_window_close:
+            return state  # emission evicts; no pre-cleaning
         return self.clean_below(
             state, self.watermark_group_idx,
             watermark.value - self.watermark_lag,
@@ -340,6 +408,7 @@ class HashAggExecutor(Executor):
             emitted=permute_dense(state.emitted, moved),
             overflow=state.overflow,
             inconsistency=state.inconsistency,
+            wm=state.wm,
         )
 
     # ------------------------------------------------------------------
@@ -362,4 +431,5 @@ class HashAggExecutor(Executor):
             emitted=state.emitted & ~stale,
             overflow=state.overflow,
             inconsistency=state.inconsistency,
+            wm=state.wm,
         )

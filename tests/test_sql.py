@@ -337,3 +337,136 @@ def test_create_table_insert_tpch_style():
     rows = {int(r[0]): int(r[1]) for r in eng.execute(
         "SELECT l_returnflag, n FROM pricing")}
     assert rows == {0: 3, 1: 2}
+
+
+def test_agg_over_join_q4_style():
+    """q4-shape: aggregate over the joined stream (join -> hash agg)."""
+    eng = _engine()
+    eng.execute("""
+        CREATE SOURCE person (
+            id BIGINT, date_time TIMESTAMP
+        ) WITH (connector = 'nexmark', nexmark.table = 'person');
+        CREATE SOURCE auction (
+            id BIGINT, seller BIGINT, reserve BIGINT, category BIGINT,
+            date_time TIMESTAMP
+        ) WITH (connector = 'nexmark', nexmark.table = 'auction');
+    """)
+    eng.execute("""
+        CREATE MATERIALIZED VIEW cat_stats AS
+        SELECT a.category AS category, count(*) AS n,
+               sum(a.reserve) AS total_reserve
+        FROM person p JOIN auction a ON p.id = a.seller
+        GROUP BY a.category;
+    """)
+    eng.tick(barriers=2, chunks_per_barrier=1)
+    rows = eng.execute("SELECT category, n, total_reserve FROM cat_stats")
+    got = {int(r[0]): (int(r[1]), int(r[2])) for r in rows}
+
+    from collections import defaultdict
+    from risingwave_tpu.connector.nexmark import NexmarkConfig, NexmarkGenerator
+    gen = NexmarkGenerator(NexmarkConfig(inter_event_us=10))
+    _, pc, _ = gen.gen_persons(0, 2 * 512).to_host()
+    _, ac, _ = gen.gen_auctions(0, 6 * 512).to_host()
+    person_count = defaultdict(int)
+    for pid in pc[0]:
+        person_count[int(pid)] += 1
+    want = defaultdict(lambda: [0, 0])
+    for i in range(len(ac[0])):
+        seller, reserve, cat = int(ac[7][i]), int(ac[4][i]), int(ac[8][i])
+        m = person_count.get(seller, 0)
+        if m:
+            want[cat][0] += m
+            want[cat][1] += m * reserve
+    assert got == {k: tuple(v) for k, v in want.items()}
+    assert len(got) > 0
+
+
+def test_emit_on_window_close():
+    """EOWC MV: windows appear once, final, append-only, after closing."""
+    eng = _engine()
+    eng.execute("""
+        CREATE SOURCE bid2 (
+            auction BIGINT, bidder BIGINT, price BIGINT,
+            channel VARCHAR, url VARCHAR, date_time TIMESTAMP,
+            WATERMARK FOR date_time AS date_time
+        ) WITH (connector = 'nexmark', nexmark.table = 'bid',
+                nexmark.event.rate = '1000');
+        CREATE MATERIALIZED VIEW w AS
+        SELECT window_start, max(price) AS hi, count(*) AS n
+        FROM TUMBLE(bid2, date_time, INTERVAL '1' SECOND)
+        GROUP BY window_start
+        EMIT ON WINDOW CLOSE;
+    """)
+    eng.tick(barriers=3, chunks_per_barrier=1)
+    rows = eng.execute("SELECT window_start, hi, n FROM w")
+    got = {int(r[0]): (int(r[1]), int(r[2])) for r in rows}
+
+    import numpy as np
+    from risingwave_tpu.connector.nexmark import NexmarkConfig, NexmarkGenerator
+    gen = NexmarkGenerator(NexmarkConfig(inter_event_us=1000))
+    _, cols, _ = gen.gen_bids(0, 3 * 512).to_host()
+    price, ts = cols[2], cols[5]
+    wm = ts.max()  # watermark after all processed rows
+    w = ts - ts % 1_000_000
+    want = {}
+    for wv in np.unique(w):
+        if wv + 1_000_000 <= wm:  # only CLOSED windows are in the MV
+            m = w == wv
+            want[int(wv)] = (int(price[m].max()), int(m.sum()))
+    assert got == want
+    assert 0 < len(got)
+    # open windows must NOT be present
+    open_windows = {int(wv) for wv in np.unique(w)
+                    if wv + 1_000_000 > wm}
+    assert not (set(got) & open_windows)
+
+
+def test_join_agg_with_topn():
+    """ORDER BY/LIMIT over join aggregates keeps only the top groups."""
+    eng = _engine()
+    eng.execute("""
+        CREATE SOURCE person (
+            id BIGINT, date_time TIMESTAMP
+        ) WITH (connector = 'nexmark', nexmark.table = 'person');
+        CREATE SOURCE auction (
+            id BIGINT, seller BIGINT, reserve BIGINT, category BIGINT,
+            date_time TIMESTAMP
+        ) WITH (connector = 'nexmark', nexmark.table = 'auction');
+        CREATE MATERIALIZED VIEW top_cats AS
+        SELECT a.category AS category, count(*) AS n
+        FROM person p JOIN auction a ON p.id = a.seller
+        GROUP BY a.category
+        ORDER BY n DESC LIMIT 2;
+    """)
+    eng.tick(barriers=2, chunks_per_barrier=1)
+    rows = eng.execute("SELECT category, n FROM top_cats")
+    assert len(rows) <= 2
+
+    from collections import defaultdict
+    from risingwave_tpu.connector.nexmark import NexmarkConfig, NexmarkGenerator
+    gen = NexmarkGenerator(NexmarkConfig(inter_event_us=10))
+    _, pc, _ = gen.gen_persons(0, 2 * 512).to_host()
+    _, ac, _ = gen.gen_auctions(0, 6 * 512).to_host()
+    person_count = defaultdict(int)
+    for pid in pc[0]:
+        person_count[int(pid)] += 1
+    want = defaultdict(int)
+    for i in range(len(ac[0])):
+        m = person_count.get(int(ac[7][i]), 0)
+        if m:
+            want[int(ac[8][i])] += m
+    top2 = sorted(want.items(), key=lambda kv: -kv[1])[:2]
+    assert sorted((int(r[0]), int(r[1])) for r in rows) == sorted(top2)
+
+
+def test_eowc_without_agg_rejected():
+    import pytest as _pytest
+    from risingwave_tpu.sql.planner import PlanError
+
+    eng = _engine()
+    eng.execute(NEXMARK_DDL)
+    with _pytest.raises(PlanError):
+        eng.execute(
+            "CREATE MATERIALIZED VIEW v AS SELECT auction FROM bid "
+            "EMIT ON WINDOW CLOSE"
+        )
