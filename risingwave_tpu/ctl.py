@@ -1,0 +1,143 @@
+"""Admin/introspection surface (the risectl + dashboard analog).
+
+Reference counterparts: ``src/ctl`` (risectl: cluster-info, pause/
+resume-barrier, await-tree dump) and the meta dashboard's fragment
+graph / ``EXPLAIN ANALYZE`` for streaming jobs
+(``GetStreamingStats``, proto/monitor_service.proto:152).
+
+``describe_job`` is the await-tree analog: instead of async stack
+traces (there are no tasks to trace — fragments are jitted programs),
+it reports the executor tree with live state-occupancy gauges, the
+consistency counters, and the job's epoch/offset positions — what an
+operator actually needs to see for a stuck or skewed job.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+
+def _state_gauges(executor, state) -> dict:
+    out: dict[str, Any] = {}
+    table = getattr(state, "table", None)
+    if table is not None and hasattr(table, "occupied"):
+        out["groups"] = int(jnp.sum(table.occupied))
+        out["tombstones"] = int(table.tombstone_count())
+        out["table_size"] = table.size
+    if hasattr(state, "valid") and getattr(state, "valid", None) is not None \
+            and hasattr(state.valid, "dtype"):
+        out["pool_rows"] = int(jnp.sum(state.valid))
+    if hasattr(state, "cursor"):
+        out["rows_written"] = int(state.cursor)
+    if hasattr(state, "dirty"):
+        out["dirty"] = int(jnp.sum(state.dirty))
+    if hasattr(state, "wm"):
+        out["watermark"] = int(state.wm)
+    if hasattr(state, "max_ts"):
+        out["max_event_time"] = int(state.max_ts)
+    for counter in ("overflow", "inconsistency", "late_rows",
+                    "emit_overflow"):
+        if hasattr(state, counter):
+            v = getattr(state, counter)
+            out[counter] = int(jnp.sum(v))
+    # join side states
+    for side in ("left", "right"):
+        if hasattr(state, side):
+            s = getattr(state, side)
+            out[side] = {
+                "keys": int(jnp.sum(s.key_table.occupied)),
+                "rows": int(jnp.sum(s.occupied)),
+                "overflow": int(s.overflow),
+                "inconsistency": int(s.inconsistency),
+            }
+    return out
+
+
+def describe_job(job) -> dict:
+    """Executor tree + state gauges for one streaming job."""
+    from risingwave_tpu.stream.runtime import BinaryJob, StreamingJob
+    from risingwave_tpu.stream.sharded import ShardedStreamingJob
+
+    info: dict[str, Any] = {
+        "name": job.name,
+        "kind": type(job).__name__,
+        "committed_epoch": job.committed_epoch,
+        "barriers": job.barriers_seen,
+        "paused": getattr(job, "paused", False),
+    }
+    if isinstance(job, StreamingJob):
+        info["source_offset"] = getattr(job.source, "offset", None)
+        info["executors"] = [
+            {"executor": repr(ex), **_state_gauges(ex, job.states[i])}
+            for i, ex in enumerate(job.fragment.executors)
+        ]
+    elif isinstance(job, BinaryJob):
+        info["executors"] = []
+        lstate, rstate, jstate, pstate = job.states
+        for label, frag, states in (
+            ("left", job.left_frag, lstate), ("right", job.right_frag, rstate)
+        ):
+            if frag is not None:
+                for i, ex in enumerate(frag.executors):
+                    info["executors"].append({
+                        "executor": f"[{label}] {ex!r}",
+                        **_state_gauges(ex, states[i]),
+                    })
+        info["executors"].append({
+            "executor": "HashJoinExecutor", **_state_gauges(job.join, jstate)
+        })
+        for i, ex in enumerate(job.post.executors):
+            info["executors"].append({
+                "executor": f"[post] {ex!r}",
+                **_state_gauges(ex, pstate[i]),
+            })
+    elif isinstance(job, ShardedStreamingJob):
+        info["n_shards"] = job.sharded.n_shards
+        info["source_offset"] = getattr(job.reader, "offset", None)
+        info["executors"] = [
+            {"executor": f"[sharded] {ex!r}",
+             **_state_gauges(ex, job.states[i])}
+            for i, ex in enumerate(job.sharded.executors)
+        ]
+    return info
+
+
+def cluster_info(engine) -> dict:
+    """risectl cluster-info analog."""
+    import jax
+
+    return {
+        "devices": [str(d) for d in jax.devices()],
+        "jobs": [describe_job(j) for j in engine.jobs],
+        "catalog": [
+            {"name": e.name, "kind": e.kind,
+             "columns": [f"{f.name}:{f.data_type.name.lower()}"
+                         for f in e.schema]}
+            for e in engine.catalog.list()
+        ],
+        "system_params": engine.system_params.to_dict(),
+    }
+
+
+def main() -> None:  # pragma: no cover - thin CLI
+    """``python -m risingwave_tpu.ctl <host> <port> <sql>`` — send one
+    statement to a running node over pgwire (risectl's transport is
+    gRPC; ours is the SQL front door)."""
+    import sys
+
+    from risingwave_tpu.pgwire import SimpleClient
+
+    host, port, sql = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+    c = SimpleClient(host, port)
+    cols, rows = c.query(sql)
+    if cols:
+        print("\t".join(cols))
+    for r in rows:
+        print("\t".join("" if v is None else str(v) for v in r))
+    c.close()
+
+
+if __name__ == "__main__":
+    main()

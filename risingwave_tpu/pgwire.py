@@ -179,6 +179,72 @@ class PgServer(socketserver.ThreadingTCPServer):
         self.engine_lock = engine_lock or threading.Lock()
 
 
+class SimpleClient:
+    """Minimal simple-query-protocol client (text format).
+
+    Used by ``risingwave_tpu.ctl`` and the protocol tests; real
+    deployments use psql/any postgres driver."""
+
+    def __init__(self, host: str, port: int, user: str = "tpu",
+                 database: str = "dev"):
+        self.sock = socket.create_connection((host, port), timeout=30)
+        self.f = self.sock.makefile("rwb")
+        params = _cstr("user") + _cstr(user) + _cstr("database") + \
+            _cstr(database) + b"\x00"
+        body = struct.pack("!I", PROTOCOL_VERSION) + params
+        self.f.write(struct.pack("!I", len(body) + 4) + body)
+        self.f.flush()
+        while self._read_msg()[0] != b"Z":
+            pass
+
+    def _read_msg(self):
+        header = self.f.read(5)
+        if len(header) < 5:
+            raise ConnectionError("connection closed")
+        return header[:1], self.f.read(
+            struct.unpack("!I", header[1:])[0] - 4
+        )
+
+    def query(self, sql: str):
+        body = sql.encode() + b"\x00"
+        self.f.write(b"Q" + struct.pack("!I", len(body) + 4) + body)
+        self.f.flush()
+        cols, rows, error = [], [], None
+        while True:
+            tag, payload = self._read_msg()
+            if tag == b"T":
+                n = struct.unpack("!H", payload[:2])[0]
+                off = 2
+                for _ in range(n):
+                    end = payload.index(b"\x00", off)
+                    cols.append(payload[off:end].decode())
+                    off = end + 1 + 18
+            elif tag == b"D":
+                n = struct.unpack("!H", payload[:2])[0]
+                off = 2
+                row = []
+                for _ in range(n):
+                    ln = struct.unpack("!i", payload[off:off + 4])[0]
+                    off += 4
+                    if ln < 0:
+                        row.append(None)
+                    else:
+                        row.append(payload[off:off + ln].decode())
+                        off += ln
+                rows.append(tuple(row))
+            elif tag == b"E":
+                error = payload.decode(errors="replace")
+            elif tag == b"Z":
+                if error:
+                    raise RuntimeError(error)
+                return cols, rows
+
+    def close(self) -> None:
+        self.f.write(b"X" + struct.pack("!I", 4))
+        self.f.flush()
+        self.sock.close()
+
+
 def pg_serve(engine, host: str = "127.0.0.1", port: int = 4566,
              engine_lock: threading.Lock | None = None) -> PgServer:
     """Start serving in a background thread; returns the server handle

@@ -488,6 +488,81 @@ class Engine:
             job.recover()
 
     # -- serving reads ---------------------------------------------------
+    def _serve_agg(self, select: ast.Select, scope, chunk):
+        """Host-side global aggregates over an MV snapshot (the batch
+        hash/sort-agg executors of SURVEY §2.8 for the local mode)."""
+        from risingwave_tpu.common.chunk import StrCol, decode_strings
+
+        if select.group_by:
+            raise PlanError(
+                "serving GROUP BY reads: create a materialized view "
+                "(batch hash-agg lands next round)"
+            )
+        if select.having is not None:
+            raise PlanError("HAVING on serving aggregates: next round")
+        vis = np.asarray(chunk.valid)
+        out = []
+        names = []
+        for item in select.items:
+            e = item.expr
+            if not (isinstance(e, ast.FuncCall)
+                    and e.name in ("count", "sum", "min", "max", "avg")):
+                raise PlanError(
+                    "serving aggregates support plain count/sum/min/max/"
+                    "avg items"
+                )
+            names.append(item.alias or e.name)
+            if e.name == "count" and (
+                not e.args or isinstance(e.args[0], ast.Star)
+            ):
+                out.append(int(vis.sum()))
+                continue
+            bound = Binder(scope).bind(e.args[0])
+            col = bound.eval(chunk)
+            f = bound.return_field(chunk.schema)
+            if isinstance(col, StrCol):
+                vals = decode_strings(
+                    np.asarray(col.data)[vis], np.asarray(col.lens)[vis]
+                ).tolist()
+                if e.name in ("sum", "avg"):
+                    raise PlanError(f"{e.name} over strings is not valid")
+            else:
+                vals = np.asarray(col)[vis]
+                if f.data_type == DataType.DECIMAL:
+                    # device representation is scaled int64
+                    vals = vals.astype(np.float64) / 10**f.decimal_scale
+            if e.distinct:
+                if e.name != "count":
+                    raise PlanError(
+                        "DISTINCT supported for count only (serving)"
+                    )
+                out.append(len(set(
+                    vals if isinstance(vals, list) else vals.tolist()
+                )))
+                continue
+            if e.name == "count":
+                out.append(len(vals))  # COUNT over empty = 0, not NULL
+            elif len(vals) == 0:
+                out.append(None)
+            elif e.name == "sum":
+                out.append(sum(vals) if isinstance(vals, list)
+                           else vals.sum().item())
+            elif e.name == "min":
+                out.append(min(vals) if isinstance(vals, list)
+                           else vals.min().item())
+            elif e.name == "max":
+                out.append(max(vals) if isinstance(vals, list)
+                           else vals.max().item())
+            else:
+                out.append(float(np.mean(vals)))
+        self._last_columns = names
+        result = [tuple(out)]
+        if select.offset:
+            result = result[select.offset:]
+        if select.limit is not None:
+            result = result[:select.limit]
+        return result
+
     def _mv_rows(self, entry: CatalogEntry):
         from risingwave_tpu.stream.sharded import ShardedStreamingJob
 
@@ -520,6 +595,8 @@ class Engine:
         if select.where is not None:
             keep = Binder(scope).bind(select.where).eval(chunk)
             chunk = chunk.mask(keep)
+        if self.planner._has_agg(select):
+            return self._serve_agg(select, scope, chunk)
         items = self.planner._expand_items(select.items, scope)
         b = Binder(scope)
         out_cols = []

@@ -1,0 +1,57 @@
+"""TroublemakerExecutor: deterministic chaos injection between executors.
+
+Reference counterpart: ``src/stream/src/executor/troublemaker.rs`` —
+randomly corrupts ops/values between executors when
+``RW_UNSAFE_ENABLE_INSANE_MODE`` is set, to prove the engine degrades
+loudly (consistency counters) rather than silently.
+
+Here corruption is derived from a counter-based hash (seeded, fully
+deterministic — reproducible chaos like the reference's madsim seeds):
+a fraction of Insert rows flip to Delete, which downstream stateful
+executors must surface via their ``inconsistency`` counters.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from risingwave_tpu.common.chunk import Chunk, OP_DELETE, OP_INSERT
+from risingwave_tpu.common.types import Schema
+from risingwave_tpu.stream.executor import Executor
+
+_K1 = np.uint64(0x9E3779B97F4A7C15)
+_K2 = np.uint64(0xBF58476D1CE4E5B9)
+
+
+def _mix(x):
+    x = (x ^ (x >> np.uint64(30))) * _K2
+    return x ^ (x >> np.uint64(31))
+
+
+class TroublemakerExecutor(Executor):
+    """Flip ~1/ratio of Insert ops to Delete (deterministic by seed)."""
+
+    emits_on_apply = True
+    emits_on_flush = False
+
+    def __init__(self, in_schema: Schema, seed: int = 0, ratio: int = 16):
+        super().__init__(in_schema)
+        self.seed = seed
+        self.ratio = ratio
+
+    def init_state(self):
+        return jnp.zeros((), jnp.uint64)  # chunk counter
+
+    def apply(self, state, chunk: Chunk):
+        cap = chunk.capacity
+        row = jnp.arange(cap, dtype=jnp.uint64)
+        h = _mix(
+            row * _K1 ^ state * _K2 ^ np.uint64(self.seed)
+        )
+        flip = (h % np.uint64(self.ratio) == 0) & chunk.valid & (
+            chunk.ops == OP_INSERT
+        )
+        ops = jnp.where(flip, OP_DELETE, chunk.ops)
+        return state + 1, Chunk(chunk.columns, ops, chunk.valid,
+                                chunk.schema)

@@ -1,0 +1,71 @@
+"""Introspection (ctl/dashboard analog) + troublemaker chaos tests."""
+
+import pytest
+
+from risingwave_tpu.ctl import cluster_info, describe_job
+from risingwave_tpu.sql import Engine
+from risingwave_tpu.sql.planner import PlannerConfig
+
+
+def _engine():
+    return Engine(PlannerConfig(
+        chunk_capacity=128, agg_table_size=512, agg_emit_capacity=128,
+        mv_table_size=512, mv_ring_size=1024,
+    ))
+
+
+def test_describe_job_and_cluster_info():
+    eng = _engine()
+    eng.execute("""
+        CREATE SOURCE t (k BIGINT, v BIGINT) WITH (connector='datagen');
+        CREATE MATERIALIZED VIEW m AS
+        SELECT k % 8 AS g, count(*) AS n FROM t GROUP BY k % 8;
+    """)
+    eng.tick(barriers=2, chunks_per_barrier=1)
+    info = describe_job(eng.jobs[0])
+    assert info["name"] == "m"
+    assert info["committed_epoch"] > 0
+    execs = {e["executor"]: e for e in info["executors"]}
+    agg = next(v for k, v in execs.items() if "HashAgg" in k)
+    assert agg["groups"] == 8
+    assert agg["overflow"] == 0 and agg["inconsistency"] == 0
+    mv = next(v for k, v in execs.items() if "Materialize" in k)
+    assert mv["groups"] == 8
+
+    ci = cluster_info(eng)
+    assert any(c["name"] == "m" and c["kind"] == "mview"
+               for c in ci["catalog"])
+    assert ci["system_params"]["checkpoint_frequency"] == 1
+
+
+def test_troublemaker_corruption_is_caught():
+    """Injected op corruption must surface via consistency counters,
+    never silently wrong results (ref RW_UNSAFE_ENABLE_INSANE_MODE)."""
+    from risingwave_tpu.expr.agg import AggCall
+    from risingwave_tpu.expr.node import col
+    from risingwave_tpu.stream.fragment import Fragment
+    from risingwave_tpu.stream.hash_join import HashJoinExecutor
+    from risingwave_tpu.stream.troublemaker import TroublemakerExecutor
+    from risingwave_tpu.common.chunk import Chunk
+    from risingwave_tpu.common.types import DataType, Schema
+    import numpy as np
+
+    schema = Schema.of(("k", DataType.INT64), ("v", DataType.INT64))
+    tm = TroublemakerExecutor(schema, seed=7, ratio=4)
+    frag = Fragment([tm])
+    st = frag.init_states()
+    arrays = [np.arange(64, dtype=np.int64),
+              np.arange(64, dtype=np.int64)]
+    st, out = frag.step(st, Chunk.from_numpy(schema, arrays))
+    ops = [r[0] for r in out.to_rows()]
+    assert ops.count(1) > 0  # some inserts flipped to deletes
+
+    # the corrupted stream hits a join side: deletes of never-inserted
+    # rows must be COUNTED as inconsistencies
+    join = HashJoinExecutor(
+        schema, schema, [col("k")], [col("k")],
+        table_size=256, bucket_cap=4, out_capacity=256,
+    )
+    jst = join.init_state()
+    jst, _ = join.apply(jst, out, "left")
+    assert int(jst.left.inconsistency) > 0

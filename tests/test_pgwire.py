@@ -11,68 +11,7 @@ from risingwave_tpu.server import SingleNode
 from risingwave_tpu.sql.planner import PlannerConfig
 
 
-class MiniPgClient:
-    def __init__(self, host, port):
-        self.sock = socket.create_connection((host, port), timeout=10)
-        self.f = self.sock.makefile("rwb")
-        self._startup()
-
-    def _startup(self):
-        params = b"user\x00tpu\x00database\x00dev\x00\x00"
-        body = struct.pack("!I", 196608) + params
-        self.f.write(struct.pack("!I", len(body) + 4) + body)
-        self.f.flush()
-        # read until ReadyForQuery
-        while True:
-            tag, payload = self._read_msg()
-            if tag == b"Z":
-                return
-
-    def _read_msg(self):
-        header = self.f.read(5)
-        assert len(header) == 5, "connection closed"
-        tag = header[:1]
-        length = struct.unpack("!I", header[1:])[0]
-        return tag, self.f.read(length - 4)
-
-    def query(self, sql):
-        body = sql.encode() + b"\x00"
-        self.f.write(b"Q" + struct.pack("!I", len(body) + 4) + body)
-        self.f.flush()
-        cols, rows, error = [], [], None
-        while True:
-            tag, payload = self._read_msg()
-            if tag == b"T":
-                n = struct.unpack("!H", payload[:2])[0]
-                off = 2
-                for _ in range(n):
-                    end = payload.index(b"\x00", off)
-                    cols.append(payload[off:end].decode())
-                    off = end + 1 + 18
-            elif tag == b"D":
-                n = struct.unpack("!H", payload[:2])[0]
-                off = 2
-                row = []
-                for _ in range(n):
-                    ln = struct.unpack("!i", payload[off:off + 4])[0]
-                    off += 4
-                    if ln < 0:
-                        row.append(None)
-                    else:
-                        row.append(payload[off:off + ln].decode())
-                        off += ln
-                rows.append(tuple(row))
-            elif tag == b"E":
-                error = payload.decode(errors="replace")
-            elif tag == b"Z":
-                if error:
-                    raise RuntimeError(error)
-                return cols, rows
-
-    def close(self):
-        self.f.write(b"X" + struct.pack("!I", 4))
-        self.f.flush()
-        self.sock.close()
+from risingwave_tpu.pgwire import SimpleClient as MiniPgClient  # noqa: E402
 
 
 @pytest.fixture()

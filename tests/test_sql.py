@@ -470,3 +470,17 @@ def test_eowc_without_agg_rejected():
             "CREATE MATERIALIZED VIEW v AS SELECT auction FROM bid "
             "EMIT ON WINDOW CLOSE"
         )
+
+
+def test_serving_aggregates_over_mv():
+    eng = _engine(cap=64)
+    eng.execute("""
+        CREATE SOURCE t (k BIGINT, v BIGINT) WITH (connector='datagen');
+        CREATE MATERIALIZED VIEW m AS SELECT k, v FROM t;
+    """)
+    eng.tick(barriers=1, chunks_per_barrier=1)
+    (row,) = eng.execute(
+        "SELECT count(*), sum(v), min(v), max(v), avg(v) FROM m "
+        "WHERE v < 32"
+    )
+    assert row == (32, sum(range(32)), 0, 31, sum(range(32)) / 32)
