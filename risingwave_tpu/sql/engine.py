@@ -391,21 +391,59 @@ class Engine:
         if n < 2:
             return None
         mesh = make_mesh(n)
+        # two-phase aggregation: a stateless in-chunk partial agg before
+        # the exchange collapses duplicate keys, shrinking all_to_all
+        # volume (ref §2.3 item 4 — local partial -> hash exchange ->
+        # global combine)
+        from risingwave_tpu.expr.node import InputRef as _IR
+        from risingwave_tpu.stream.partial_agg import (
+            TWO_PHASE_KINDS,
+            PartialAggExecutor,
+            translated_global_calls,
+        )
+
+        local_execs = list(prefix)
+        keyed_execs = list(execs[agg_idx:])
+        exchange_key_fn = lambda c: [e.eval(c) for _, e in agg.group_by]
+        # two-phase is retraction-unsafe (partial min/max ignore signs;
+        # global row_count counts partial rows) — append-only plans only
+        if plan.append_only and all(
+            a.kind in TWO_PHASE_KINDS for a in agg.aggs
+        ):
+            partial = PartialAggExecutor(
+                agg.in_schema, agg.group_by, agg.aggs
+            )
+            n_keys = len(agg.group_by)
+            global_agg = type(agg)(
+                partial.out_schema,
+                [(nm, _IR(i))
+                 for i, (nm, _) in enumerate(agg.group_by)],
+                translated_global_calls(agg.aggs, n_keys),
+                table_size=agg.table_size,
+                emit_capacity=agg.emit_capacity,
+            )
+            local_execs = local_execs + [partial]
+            keyed_execs = [global_agg] + list(execs[agg_idx + 1:])
+            exchange_key_fn = (
+                lambda c, k=n_keys: [c.column(i) for i in range(k)]
+            )
         sharded = ShardedJob(
             mesh,
             source_fn=reader.impl,
             chunk_capacity=reader.cap,
-            local_executors=list(prefix),
-            exchange_key_fn=lambda c: [e.eval(c) for _, e in agg.group_by],
-            keyed_executors=list(execs[agg_idx:]),
+            local_executors=local_execs,
+            exchange_key_fn=exchange_key_fn,
+            keyed_executors=keyed_execs,
         )
         job = ShardedStreamingJob(
             sharded, reader, name,
             checkpoint_frequency=ckpt_freq,
             checkpoint_store=self.checkpoint_store,
         )
-        terminal = execs[-1]
-        return job, terminal, (len(execs) - 1,)
+        # index into the SHARDED executor list (the two-phase rewrite
+        # inserts a partial agg, shifting positions vs the linear plan)
+        terminal = keyed_execs[-1]
+        return job, terminal, (len(local_execs) + len(keyed_execs) - 1,)
 
     def _create_mview(self, stmt: ast.CreateMaterializedView):
         plan = self.planner.plan(stmt.query,

@@ -63,6 +63,8 @@ class UnaryPlan:
     reader: Any
     fragment: Fragment
     mv_index: int                # executor index of the MV in the fragment
+    #: the source stream never retracts (gates the two-phase rewrite)
+    append_only: bool = True
 
 
 @dataclass
@@ -197,7 +199,8 @@ class Planner:
             input_append_only=pin.append_only, has_agg=has_agg,
             pk_positions=pk_positions, sink=sink, eowc=eowc,
         )
-        return UnaryPlan(pin.reader, Fragment(execs), len(execs) - 1)
+        return UnaryPlan(pin.reader, Fragment(execs), len(execs) - 1,
+                         append_only=pin.append_only)
 
     def _append_terminal(self, execs, out_schema, select, *,
                          input_append_only: bool, has_agg: bool,

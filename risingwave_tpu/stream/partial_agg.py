@@ -1,0 +1,149 @@
+"""Chunk-local partial aggregation (phase 1 of two-phase agg).
+
+Reference counterpart: the optimizer's two-phase aggregation rewrite —
+local stateless partial agg → hash exchange → global agg (SURVEY.md
+§2.3 parallelism item 4; ``stateless_simple_agg.rs`` +
+``logical_agg.rs`` two-phase planning).
+
+TPU-first design: the partial phase is STATELESS — one sort + segment
+reduce per chunk collapses duplicate keys before the ``all_to_all``,
+shrinking shuffle volume by the in-chunk duplication factor (hot
+nexmark keys collapse thousands of rows to one partial row).  Output:
+one row per distinct key (at its segment leader position, mask
+elsewhere) carrying signed partial states, consumed by a translated
+global agg (count → sum0 of partials, sum → sum, min/max → min/max).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from risingwave_tpu.common.chunk import Chunk, OP_INSERT, StrCol
+from risingwave_tpu.common.hash import hash64_columns
+from risingwave_tpu.common.types import DataType, Field, Schema
+from risingwave_tpu.expr.agg import AggCall
+from risingwave_tpu.expr.node import Expr
+from risingwave_tpu.stream.executor import Executor
+
+#: aggs decomposable into ONE signed/monoid partial column
+TWO_PHASE_KINDS = {"count", "count_star", "sum", "sum0", "min", "max"}
+
+
+def translated_global_calls(aggs: Sequence[AggCall], n_keys: int):
+    """Global-phase calls reading the partial columns (same output
+    arity/order as the original calls)."""
+    from risingwave_tpu.expr.node import InputRef
+
+    combine = {"count": "sum0", "count_star": "sum0", "sum": "sum",
+               "sum0": "sum0", "min": "min", "max": "max"}
+    return [
+        AggCall(combine[a.kind], InputRef(n_keys + i), a.alias or a.kind)
+        for i, a in enumerate(aggs)
+    ]
+
+
+class PartialAggExecutor(Executor):
+    """Stateless in-chunk combine: distinct keys + signed partials."""
+
+    emits_on_apply = True
+    emits_on_flush = False
+
+    def __init__(self, in_schema: Schema,
+                 group_by: Sequence[tuple[str, Expr]],
+                 aggs: Sequence[AggCall]):
+        super().__init__(in_schema)
+        for a in aggs:
+            if a.kind not in TWO_PHASE_KINDS:
+                raise ValueError(f"{a.kind} is not two-phase decomposable")
+        self.group_by = tuple(group_by)
+        self.aggs = tuple(aggs)
+        key_fields = tuple(
+            Field(name, e.return_field(in_schema).data_type,
+                  str_width=e.return_field(in_schema).str_width,
+                  decimal_scale=e.return_field(in_schema).decimal_scale)
+            for name, e in self.group_by
+        )
+        partial_fields = []
+        for a in self.aggs:
+            if a.kind in ("count", "count_star"):
+                partial_fields.append(
+                    Field(f"_p_{a.alias or a.kind}", DataType.INT64)
+                )
+            else:
+                f = a.out_field(in_schema)
+                partial_fields.append(Field(f"_p_{f.name}", f.data_type,
+                                            decimal_scale=f.decimal_scale))
+        self._out_schema = Schema(key_fields + tuple(partial_fields))
+
+    @property
+    def out_schema(self) -> Schema:
+        return self._out_schema
+
+    def apply(self, state, chunk: Chunk):
+        from risingwave_tpu.state.hash_table import _keys_equal
+
+        cap = chunk.capacity
+        key_cols = [e.eval(chunk) for _, e in self.group_by]
+        signs = chunk.signs()  # 0 for invalid rows
+        kh = hash64_columns(key_cols)
+        kh = jnp.where(chunk.valid, kh, jnp.uint64(0xFFFFFFFFFFFFFFFF))
+        order = jnp.argsort(kh, stable=True)
+        valid_s = chunk.valid[order]
+        signs_s = signs[order]
+
+        def sort_col(c):
+            if isinstance(c, StrCol):
+                return StrCol(c.data[order], c.lens[order])
+            return c[order]
+
+        sorted_keys = [sort_col(c) for c in key_cols]
+        # segment boundaries by FULL key equality of adjacent sorted rows
+        # (the hash only orders; colliding distinct keys must still
+        # split) and by validity flips (garbage keys of invalid rows
+        # must never merge with real groups)
+        same_as_prev = jnp.ones((cap,), jnp.bool_)
+        for c in sorted_keys:
+            if isinstance(c, StrCol):
+                prev = StrCol(c.data[:-1], c.lens[:-1])
+                cur = StrCol(c.data[1:], c.lens[1:])
+            else:
+                prev, cur = c[:-1], c[1:]
+            eq = _keys_equal(cur, prev)
+            same_as_prev = same_as_prev.at[1:].min(eq)
+        same_validity = jnp.ones((cap,), jnp.bool_).at[1:].set(
+            valid_s[1:] == valid_s[:-1]
+        )
+        is_new = ~(same_as_prev & same_validity)
+        is_new = is_new.at[0].set(True)
+        seg_id = jnp.cumsum(is_new) - 1  # [cap]
+
+        out_cols = list(sorted_keys)
+        for a in self.aggs:
+            if a.arg is None:
+                col_s = jnp.ones((cap,), jnp.int64)
+            else:
+                col_s = sort_col(a.arg.eval(chunk))
+            if a.kind in ("count", "count_star"):
+                contrib = signs_s.astype(jnp.int64)
+                part = jax.ops.segment_sum(contrib, seg_id,
+                                           num_segments=cap)
+            elif a.kind in ("sum", "sum0"):
+                dt = jnp.int64 if jnp.issubdtype(col_s.dtype, jnp.integer) \
+                    else col_s.dtype
+                contrib = col_s.astype(dt) * signs_s.astype(dt)
+                part = jax.ops.segment_sum(contrib, seg_id,
+                                           num_segments=cap)
+            elif a.kind == "min":
+                part = jax.ops.segment_min(col_s, seg_id, num_segments=cap)
+            else:
+                part = jax.ops.segment_max(col_s, seg_id, num_segments=cap)
+            out_cols.append(part[seg_id])  # broadcast back; leaders keep it
+
+        valid_out = is_new & valid_s
+        ops = jnp.full((cap,), OP_INSERT, jnp.int8)
+        return state, Chunk(tuple(out_cols), ops, valid_out,
+                            self._out_schema)

@@ -205,3 +205,53 @@ def test_sql_sharded_mv_matches_single_shard():
     got_b = {int(r[0]): (int(r[1]), int(r[2])) for r in rows_b}
     assert got_b == want(1024)
     assert b.jobs[0].committed_epoch > 0
+
+
+def test_two_phase_partial_agg_unit():
+    """PartialAgg collapses duplicate keys; global combine is exact."""
+    import jax.numpy as jnp
+    from collections import Counter
+    from risingwave_tpu.common.chunk import Chunk
+    from risingwave_tpu.expr.agg import AggCall, count_star
+    from risingwave_tpu.expr.node import InputRef, col
+    from risingwave_tpu.stream.fragment import Fragment
+    from risingwave_tpu.stream.hash_agg import HashAggExecutor
+    from risingwave_tpu.stream.partial_agg import (
+        PartialAggExecutor,
+        translated_global_calls,
+    )
+
+    schema = Schema.of(("g", DataType.INT64), ("v", DataType.INT64))
+    group_by = [("g", col("g"))]
+    aggs = [count_star("n"), AggCall("sum", col("v"), "s"),
+            AggCall("max", col("v"), "hi")]
+    partial = PartialAggExecutor(schema, group_by, aggs)
+    st, out = Fragment([partial]).step(
+        Fragment([partial]).init_states(),
+        Chunk.from_pretty("""
+            I I
+            + 1 10
+            + 1 5
+            + 2 7
+            + 1 1
+            + 2 3
+        """, names=["g", "v"]),
+    )
+    rows = sorted(out.to_rows())
+    # 5 input rows collapse to 2 partial rows
+    assert rows == [(0, 1, 3, 16, 10), (0, 2, 2, 10, 7)]
+
+    glob = HashAggExecutor(
+        partial.out_schema,
+        [("g", InputRef(0))],
+        translated_global_calls(aggs, 1),
+        table_size=64, emit_capacity=16,
+    )
+    frag = Fragment([glob])
+    gst = frag.init_states()
+    gst, _ = frag.step(gst, out)
+    gst, outs = frag.flush(gst, 1)
+    mv = Counter()
+    for op, *vals in outs[0].to_rows():
+        mv[tuple(vals)] += 1 if op in (0, 3) else -1
+    assert +mv == Counter({(1, 3, 16, 10): 1, (2, 2, 10, 7): 1})
