@@ -164,14 +164,16 @@ AGG_REGISTRY: dict[str, AggSpec] = {
 class AggCall:
     """One aggregate call in a plan: kind + input expression.
 
-    Ref: ``AggCall`` (src/expr/core/src/aggregate/mod.rs) — distinct and
-    filter clauses are planner-level rewrites (distinct dedup tables),
-    not yet implemented.
+    Ref: ``AggCall`` (src/expr/core/src/aggregate/mod.rs).  ``distinct``
+    is handled by the planner as a dedup-before-agg rewrite (the
+    reference's distinct dedup tables) — append-only inputs only this
+    round.
     """
 
     kind: str
     arg: Expr | None = None
     alias: str | None = None
+    distinct: bool = False
 
     def spec(self) -> AggSpec:
         return AGG_REGISTRY[self.kind]

@@ -131,14 +131,18 @@ class Binder:
     def _bind_agg(self, e: ast.FuncCall) -> Expr:
         if not self.allow_aggs:
             raise BindError(f"aggregate {e.name} not allowed here")
-        if e.distinct:
-            raise BindError("DISTINCT aggregates not yet supported")
+        if e.distinct and e.name not in ("count", "sum"):
+            raise BindError(
+                f"DISTINCT {e.name} not yet supported (count/sum only)"
+            )
         if e.name == "count" and (not e.args or
                                   isinstance(e.args[0], ast.Star)):
+            if e.distinct:
+                raise BindError("COUNT(DISTINCT *) is not valid")
             call = agg_mod.AggCall("count_star", None)
         else:
             arg = self.bind(e.args[0])
-            call = agg_mod.AggCall(e.name, arg)
+            call = agg_mod.AggCall(e.name, arg, distinct=e.distinct)
         self.agg_calls.append(call)
         # placeholder referencing the agg output (resolved by the planner:
         # agg outputs are appended after the group keys)

@@ -23,6 +23,7 @@ from risingwave_tpu.common.types import DataType, Field, Schema
 from risingwave_tpu.expr.node import Expr, FuncCall as EFuncCall, InputRef, lit
 from risingwave_tpu.meta.catalog import Catalog, CatalogEntry
 from risingwave_tpu.sql import ast
+from risingwave_tpu.expr.agg import AggCall
 from risingwave_tpu.sql.binder import AGG_NAMES, AggRef, BindError, Binder, Scope
 from risingwave_tpu.stream.executor import (
     Executor,
@@ -326,6 +327,42 @@ class Planner:
                 "EMIT ON WINDOW CLOSE needs GROUP BY window_start over a "
                 "watermarked windowed source"
             )
+        execs: list[Executor] = []
+        distinct_calls = [a for a in agg_calls if a.distinct]
+        if distinct_calls:
+            # DISTINCT via dedup-before-agg (ref distinct dedup tables):
+            # drop duplicate (group keys..., arg) rows, then aggregate.
+            # Exact for append-only inputs; retractable distinct needs
+            # per-key counted dedup state (next round).
+            if not pin.append_only:
+                raise PlanError(
+                    "DISTINCT aggregates over retractable inputs: "
+                    "next round"
+                )
+            first_arg = distinct_calls[0].arg
+            if len(distinct_calls) != len(agg_calls) or any(
+                not self._expr_eq(a.arg, first_arg)
+                for a in distinct_calls[1:]
+            ):
+                raise PlanError(
+                    "mixing DISTINCT and plain aggregates (or multiple "
+                    "distinct args) needs the expand rewrite: next round"
+                )
+            import dataclasses
+
+            from risingwave_tpu.stream.top_n import AppendOnlyDedupExecutor
+            dedup_keys = [e for _, e in group_by] + [first_arg]
+            execs.append(AppendOnlyDedupExecutor(
+                scope.schema, dedup_keys,
+                table_size=cfg.agg_table_size,
+                # window-keyed DISTINCT state is evicted with the window
+                watermark_key_idx=wm_idx,
+                watermark_lag=lag,
+                watermark_src_col=pin.watermark_col,
+            ))
+            agg_calls = [
+                dataclasses.replace(a, distinct=False) for a in agg_calls
+            ]
         agg = HashAggExecutor(
             scope.schema, group_by, agg_calls,
             table_size=cfg.agg_table_size,
@@ -335,7 +372,7 @@ class Planner:
             watermark_src_col=pin.watermark_col,
             emit_on_window_close=eowc,
         )
-        execs: list[Executor] = [agg]
+        execs.append(agg)
 
         # post-projection over agg output: group keys + agg results
         agg_scope = Scope.of(agg.out_schema)

@@ -364,23 +364,37 @@ class GroupTopNExecutor(Executor):
         )
 
 
+class DedupState(NamedTuple):
+    table: "HashTable"  # noqa: F821
+    overflow: jnp.ndarray  # rows wrongly dropped because the table filled
+
+
 class AppendOnlyDedupExecutor(Executor):
     """Drop rows whose key was already seen (ref dedup/append_only_dedup.rs).
 
     A HashTable of seen keys; the chunk keeps only first-occurrence rows
-    (both vs state and within the chunk, via insert-rank).
+    (both vs state and within the chunk, via insert-rank).  Overflowed
+    rows are counted (maintenance raises) — a full table must never
+    silently undercount.  With ``watermark_key_idx`` set, watermarks
+    evict keys of closed windows (bounding DISTINCT-over-window state).
     """
 
     emits_on_apply = True
     emits_on_flush = False
 
     def __init__(self, in_schema: Schema, key_exprs: Sequence[Expr],
-                 table_size: int = 1 << 16):
+                 table_size: int = 1 << 16,
+                 watermark_key_idx: int | None = None,
+                 watermark_lag: int = 0,
+                 watermark_src_col: int | None = None):
         super().__init__(in_schema)
         self.key_exprs = tuple(key_exprs)
         self.table_size = table_size
+        self.watermark_key_idx = watermark_key_idx
+        self.watermark_lag = watermark_lag
+        self.watermark_src_col = watermark_src_col
 
-    def init_state(self):
+    def init_state(self) -> DedupState:
         from risingwave_tpu.state.hash_table import HashTable
         protos = []
         for e in self.key_exprs:
@@ -392,12 +406,36 @@ class AppendOnlyDedupExecutor(Executor):
                 ))
             else:
                 protos.append(jnp.zeros((1,), f.data_type.physical_dtype))
-        return HashTable.create(protos, self.table_size)
+        return DedupState(
+            HashTable.create(protos, self.table_size),
+            jnp.zeros((), jnp.int64),
+        )
 
-    def apply(self, table, chunk: Chunk):
+    def apply(self, state: DedupState, chunk: Chunk):
         key_cols = [e.eval(chunk) for e in self.key_exprs]
-        table, slots, inserted, _ = table.lookup_or_insert(
+        table, slots, inserted, overflow = state.table.lookup_or_insert(
             key_cols, chunk.valid
         )
+        n_over = jnp.sum((overflow & chunk.valid).astype(jnp.int64))
         # only rows that inserted a fresh key survive
-        return table, chunk.mask(inserted)
+        return DedupState(
+            table, state.overflow + n_over
+        ), chunk.mask(inserted)
+
+    def on_watermark(self, state: DedupState, watermark):
+        if self.watermark_key_idx is None:
+            return state
+        if (self.watermark_src_col is not None
+                and watermark.col_idx != self.watermark_src_col):
+            return state
+        key = state.table.key_cols[self.watermark_key_idx]
+        stale = state.table.occupied & (
+            key < watermark.value - self.watermark_lag
+        )
+        return DedupState(state.table.clear_where(stale), state.overflow)
+
+    def maybe_rehash(self, state: DedupState) -> DedupState:
+        if int(state.table.tombstone_count()) <= self.table_size // 4:
+            return state
+        fresh, _ = state.table.rehashed()
+        return DedupState(fresh, state.overflow)

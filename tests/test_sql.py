@@ -484,3 +484,22 @@ def test_serving_aggregates_over_mv():
         "WHERE v < 32"
     )
     assert row == (32, sum(range(32)), 0, 31, sum(range(32)) / 32)
+
+
+def test_count_distinct_streaming():
+    eng = _engine(cap=64)
+    eng.execute("""
+        CREATE SOURCE t (k BIGINT, v BIGINT) WITH (connector='datagen');
+        CREATE MATERIALIZED VIEW d AS
+        SELECT k % 4 AS g, count(DISTINCT v % 10) AS u FROM t
+        GROUP BY k % 4;
+    """)
+    eng.tick(barriers=2, chunks_per_barrier=2)
+    rows = {int(r[0]): int(r[1]) for r in eng.execute("SELECT g, u FROM d")}
+    import numpy as np
+    ks = np.arange(4 * 64, dtype=np.int64)
+    want = {
+        int(g): len({int(v % 10) for v in ks[ks % 4 == g]})
+        for g in range(4)
+    }
+    assert rows == want
