@@ -139,7 +139,23 @@ def _cpu_baseline(query: str) -> float:
     raise RuntimeError(f"cpu baseline failed: {out.stderr[-500:]}")
 
 
+def _ensure_backend() -> None:
+    """Fall back to CPU if the accelerator backend cannot initialize
+    (e.g. the TPU tunnel is down) — the driver must always get its
+    JSON line, clearly labeled via stderr."""
+    import jax
+
+    try:
+        jax.devices()
+    except Exception as e:
+        print(f"warning: accelerator init failed ({e!r}); "
+              "falling back to CPU", file=sys.stderr)
+        jax.config.update("jax_platforms", "cpu")
+        jax.devices()
+
+
 def main() -> None:
+    _ensure_backend()
     query = os.environ.get("RWT_BENCH_QUERY", "q7")
     if os.environ.get("RWT_BENCH_RAW"):
         print(f"RAW {measure(query)}")
