@@ -16,7 +16,6 @@ from __future__ import annotations
 from collections import deque
 from typing import Sequence
 
-import jax.numpy as jnp
 import numpy as np
 
 from risingwave_tpu.common.chunk import Chunk

@@ -26,15 +26,14 @@ avg = sum / count) and is evaluated only at barrier emit time.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field as dfield
-from typing import Callable, Sequence
+from dataclasses import dataclass
+from typing import Callable
 
 import jax.numpy as jnp
 import numpy as np
 
 from risingwave_tpu.common.types import DataType, Field
 from risingwave_tpu.expr.node import Expr
-from risingwave_tpu.expr.registry import promote_numeric
 
 
 @dataclass(frozen=True)

@@ -7,7 +7,7 @@ registry for the single-node round.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Callable
 
 from risingwave_tpu.common.types import Schema

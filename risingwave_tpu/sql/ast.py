@@ -6,7 +6,7 @@ streaming surface this frontend implements.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any
 
 

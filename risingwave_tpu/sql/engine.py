@@ -36,7 +36,6 @@ from risingwave_tpu.sql import ast
 from risingwave_tpu.sql.binder import Binder, Scope
 from risingwave_tpu.sql.parser import parse
 from risingwave_tpu.sql.planner import (
-    JoinPlan,
     PlanError,
     Planner,
     PlannerConfig,

@@ -10,7 +10,6 @@ CASE, intervals.
 from __future__ import annotations
 
 import re
-from typing import Any
 
 from risingwave_tpu.sql import ast
 

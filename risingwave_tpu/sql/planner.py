@@ -19,9 +19,9 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Any
 
-from risingwave_tpu.common.types import DataType, Field, Schema
-from risingwave_tpu.expr.node import Expr, FuncCall as EFuncCall, InputRef, lit
-from risingwave_tpu.meta.catalog import Catalog, CatalogEntry
+from risingwave_tpu.common.types import Schema
+from risingwave_tpu.expr.node import Expr, FuncCall as EFuncCall, InputRef
+from risingwave_tpu.meta.catalog import Catalog
 from risingwave_tpu.sql import ast
 from risingwave_tpu.expr.agg import AggCall
 from risingwave_tpu.sql.binder import AGG_NAMES, AggRef, BindError, Binder, Scope

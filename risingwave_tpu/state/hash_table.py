@@ -34,7 +34,7 @@ rebuild the table once tombstones accumulate.
 
 from __future__ import annotations
 
-from typing import Callable, Sequence
+from typing import Sequence
 
 import jax
 import jax.numpy as jnp

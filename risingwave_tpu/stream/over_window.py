@@ -27,7 +27,7 @@ cleaning frees closed partitions (EOWC-style plans).
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import NamedTuple, Sequence
+from typing import Sequence
 
 import jax
 import jax.numpy as jnp

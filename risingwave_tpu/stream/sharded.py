@@ -14,7 +14,6 @@ loop drives all shards in lockstep, so merge alignment is structural.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Callable, Sequence
 
 import jax
