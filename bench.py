@@ -139,19 +139,41 @@ def _cpu_baseline(query: str) -> float:
     raise RuntimeError(f"cpu baseline failed: {out.stderr[-500:]}")
 
 
-def _ensure_backend() -> None:
-    """Fall back to CPU if the accelerator backend cannot initialize
-    (e.g. the TPU tunnel is down) — the driver must always get its
-    JSON line, clearly labeled via stderr."""
-    import jax
+def _ensure_backend(timeout_s: float = 240.0) -> None:
+    """Fall back to CPU if the accelerator backend cannot initialize.
 
-    try:
-        jax.devices()
-    except Exception as e:
-        print(f"warning: accelerator init failed ({e!r}); "
-              "falling back to CPU", file=sys.stderr)
-        jax.config.update("jax_platforms", "cpu")
-        jax.devices()
+    A dead TPU tunnel HANGS inside ``jax.devices()`` rather than
+    raising, so the probe runs in a watchdog thread; on timeout (or
+    error) the process re-execs itself with ``JAX_PLATFORMS=cpu`` —
+    the driver must always get its JSON line, labeled via stderr."""
+    if os.environ.get("RWT_BENCH_NO_PROBE"):
+        return
+    import threading
+
+    result: dict = {}
+
+    def probe():
+        try:
+            import jax
+
+            jax.devices()
+            result["ok"] = True
+        except Exception as e:  # init error: also fall back
+            result["err"] = repr(e)
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if result.get("ok"):
+        return
+    why = result.get("err", f"backend init hung > {timeout_s:.0f}s")
+    print(f"warning: accelerator unavailable ({why}); "
+          "re-executing on CPU", file=sys.stderr)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["RWT_BENCH_NO_PROBE"] = "1"
+    os.execve(sys.executable,
+              [sys.executable, os.path.abspath(__file__)], env)
 
 
 def main() -> None:
