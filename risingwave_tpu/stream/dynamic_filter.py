@@ -1,0 +1,154 @@
+"""DynamicFilterExecutor: filter a stream against a changing scalar.
+
+Reference counterpart: ``src/stream/src/executor/dynamic_filter.rs`` —
+the band join behind ``WHERE v > (SELECT max(x) FROM t)``: the left
+stream is filtered by a comparison whose right side is a 1-row
+changelog (usually a global aggregate).  When the scalar moves, rows
+in the band between the old and new thresholds must be emitted
+(threshold dropped → inserts) or retracted (threshold rose → deletes).
+
+TPU-first design: the left side lives in the same flat device row pool
+as TopN; a threshold change emits the whole flipped band with one
+vectorized comparison over the pool — the reference walks a range scan
+over its ordered state table.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from risingwave_tpu.common.chunk import Chunk, OP_DELETE, OP_INSERT
+from risingwave_tpu.common.types import Schema
+from risingwave_tpu.stream.top_n import (
+    _empty_like_col,
+    pool_apply,
+    schema_protos,
+)
+
+_CMPS = {
+    "gt": lambda v, t: v > t,
+    "ge": lambda v, t: v >= t,
+    "lt": lambda v, t: v < t,
+    "le": lambda v, t: v <= t,
+}
+
+
+class DynFilterState(NamedTuple):
+    rows: tuple
+    valid: jnp.ndarray
+    row_hash: jnp.ndarray
+    threshold: jnp.ndarray      # current RHS scalar
+    has_threshold: jnp.ndarray  # bool — RHS seen at least once
+    overflow: jnp.ndarray
+    inconsistency: jnp.ndarray
+
+
+class DynamicFilterExecutor:
+    """Two-input executor: ``apply(state, chunk, side)`` like the join.
+
+    ``filter_col`` indexes the left schema; the right chunk's column 0
+    carries the scalar (its last visible insert-side row wins, matching
+    the reference's expectation of a 1-row changelog).
+    """
+
+    def __init__(self, left_schema: Schema, filter_col: int,
+                 cmp: str = "gt", pool_size: int = 4096):
+        if cmp not in _CMPS:
+            raise ValueError(f"cmp must be one of {sorted(_CMPS)}")
+        self.filter_field = left_schema[filter_col]
+        if self.filter_field.data_type.is_string:
+            raise ValueError(
+                "dynamic filter on string columns is not supported"
+            )
+        self.left_schema = left_schema
+        self.filter_col = filter_col
+        self.cmp = _CMPS[cmp]
+        self.pool_size = pool_size
+        self._out_schema = left_schema
+
+    @property
+    def out_schema(self) -> Schema:
+        return self._out_schema
+
+    def init_state(self) -> DynFilterState:
+        S = self.pool_size
+        protos = schema_protos(self.left_schema)
+        dt = self.left_schema[self.filter_col].data_type.physical_dtype
+        return DynFilterState(
+            rows=tuple(_empty_like_col(p, S) for p in protos),
+            valid=jnp.zeros((S,), jnp.bool_),
+            row_hash=jnp.zeros((S,), jnp.uint64),
+            threshold=jnp.zeros((), dt),
+            has_threshold=jnp.zeros((), jnp.bool_),
+            overflow=jnp.zeros((), jnp.int64),
+            inconsistency=jnp.zeros((), jnp.int64),
+        )
+
+    # -- left: data rows -------------------------------------------------
+    def _apply_left(self, state: DynFilterState, chunk: Chunk):
+        rows, valid, hashes, n_over, n_missing = pool_apply(
+            state.rows, state.valid, state.row_hash, chunk, self.pool_size
+        )
+        # pass-through: rows currently clearing the threshold
+        v = chunk.column(self.filter_col)
+        passing = self.cmp(v, state.threshold) & state.has_threshold
+        out = chunk.mask(passing)
+        return DynFilterState(
+            rows, valid, hashes, state.threshold, state.has_threshold,
+            state.overflow + n_over, state.inconsistency + n_missing,
+        ), out
+
+    # -- right: the scalar changelog -------------------------------------
+    def _apply_right(self, state: DynFilterState, chunk: Chunk):
+        # the RHS scalar's logical type must match the filter column's
+        # (DECIMAL scales and int/float semantics differ on device)
+        rf = chunk.schema[0]
+        lf = self.filter_field
+        if rf.data_type != lf.data_type or (
+            rf.data_type.value == "numeric"
+            and rf.decimal_scale != lf.decimal_scale
+        ):
+            raise ValueError(
+                f"dynamic filter RHS type {rf.data_type} does not match "
+                f"filter column type {lf.data_type}"
+            )
+        signs = chunk.signs()
+        ins = chunk.valid & (signs > 0)
+        dels = chunk.valid & (signs < 0)
+        # last visible insert-side row wins; a delete-only chunk means
+        # the 1-row RHS became EMPTY (subquery over no rows): nothing
+        # passes and everything emitted so far is retracted
+        cap = chunk.capacity
+        idx = jnp.arange(cap, dtype=jnp.int32)
+        last = jnp.max(jnp.where(ins, idx, -1))
+        has_new = last >= 0
+        rhs_emptied = jnp.any(dels) & ~has_new
+        new_thr = jnp.where(
+            has_new,
+            chunk.column(0)[jnp.maximum(last, 0)].astype(
+                state.threshold.dtype
+            ),
+            state.threshold,
+        )
+        old_thr = state.threshold
+        new_has = (state.has_threshold | has_new) & ~rhs_emptied
+        v = state.rows[self.filter_col]
+        was = self.cmp(v, old_thr) & state.has_threshold
+        now = self.cmp(v, new_thr) & new_has
+        emit_ins = state.valid & now & ~was
+        emit_del = state.valid & was & ~now
+        emit = emit_ins | emit_del
+        ops = jnp.where(emit_ins, OP_INSERT, OP_DELETE).astype(jnp.int8)
+        out = Chunk(state.rows, ops, emit, self.left_schema)
+        return DynFilterState(
+            state.rows, state.valid, state.row_hash,
+            new_thr, new_has,
+            state.overflow, state.inconsistency,
+        ), out
+
+    def apply(self, state: DynFilterState, chunk: Chunk, side: str):
+        if side == "left":
+            return self._apply_left(state, chunk)
+        return self._apply_right(state, chunk)

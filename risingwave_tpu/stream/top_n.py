@@ -116,6 +116,80 @@ def _scatter(store, pos, col):
     return store.at[pos].set(col, mode="drop")
 
 
+def schema_protos(schema: Schema) -> list:
+    """One-row column prototypes for pool/table creation."""
+    protos = []
+    for f in schema:
+        if f.data_type.is_string:
+            protos.append(StrCol(
+                jnp.zeros((1, f.str_width), jnp.uint8),
+                jnp.zeros((1,), jnp.int32),
+            ))
+        else:
+            protos.append(jnp.zeros((1,), f.data_type.physical_dtype))
+    return protos
+
+
+def pool_apply(rows: tuple, valid, row_hash_store, chunk: Chunk, S: int):
+    """Apply a changelog chunk to a flat row pool (shared by TopN,
+    OverWindow and DynamicFilter).
+
+    In-chunk +row/-row pairs annihilate first (a delete can only match
+    pre-chunk state — same guard as the join's update path), then
+    deletes clear their rank-th hash match and inserts claim free
+    slots.  Returns (rows, valid, hashes, n_overflow, n_missing)."""
+    from risingwave_tpu.stream.hash_join import _group_totals, _rank_by
+
+    cap = chunk.capacity
+    signs = chunk.signs()
+    is_ins = chunk.valid & (signs > 0)
+    is_del = chunk.valid & (signs < 0)
+    row_hash = hash64_columns(list(chunk.columns))
+
+    # in-chunk annihilation
+    ins_rank_h = _rank_by(row_hash, is_ins)
+    del_rank_h = _rank_by(row_hash, is_del)
+    n_ins_h = _group_totals(row_hash, is_ins)
+    n_del_h = _group_totals(row_hash, is_del)
+    is_ins = is_ins & ~(ins_rank_h < n_del_h)
+    is_del = is_del & ~(del_rank_h < n_ins_h)
+
+    # deletes: rank-th pool row with matching hash
+    match = valid[None, :] & (row_hash_store[None, :] == row_hash[:, None])
+    del_rank = _rank_by(row_hash, is_del)
+    mrank = jnp.cumsum(match, axis=1) - 1
+    clear_onehot = match & (mrank == del_rank[:, None]) & is_del[:, None]
+    any_clear = jnp.any(clear_onehot, axis=1)
+    j_clear = jnp.argmax(clear_onehot, axis=1).astype(jnp.int32)
+    pos_clear = jnp.where(any_clear, j_clear, jnp.int32(S))
+    valid = valid.at[pos_clear].set(False, mode="drop")
+    n_missing = jnp.sum((is_del & ~any_clear).astype(jnp.int64))
+
+    # inserts: rank-th free slot
+    free = ~valid
+    free_pos = jnp.cumsum(free) - 1
+    slot_of_rank = jnp.full((S,), S, jnp.int32).at[
+        jnp.where(free, free_pos.astype(jnp.int32), S)
+    ].min(jnp.arange(S, dtype=jnp.int32), mode="drop")
+    ins_rank = _rank_by(jnp.zeros((cap,), jnp.uint64), is_ins)
+    tgt = jnp.where(
+        is_ins & (ins_rank < S),
+        slot_of_rank[jnp.minimum(ins_rank, S - 1)],
+        jnp.int32(S),
+    )
+    got = is_ins & (tgt < S)
+    valid = valid.at[jnp.where(got, tgt, S)].set(True, mode="drop")
+    rows = tuple(
+        _scatter(store, jnp.where(got, tgt, S), col)
+        for store, col in zip(rows, chunk.columns)
+    )
+    hashes = row_hash_store.at[jnp.where(got, tgt, S)].set(
+        row_hash, mode="drop"
+    )
+    n_over = jnp.sum((is_ins & ~got).astype(jnp.int64))
+    return rows, valid, hashes, n_over, n_missing
+
+
 class GroupTopNExecutor(Executor):
     """TOP N (+offset) per group over a changelog (plain TopN: no group).
 
@@ -184,50 +258,9 @@ class GroupTopNExecutor(Executor):
 
     # ------------------------------------------------------------------
     def apply(self, state: TopNState, chunk: Chunk):
-        S = self.pool_size
-        cap = chunk.capacity
-        signs = chunk.signs()
-        is_ins = chunk.valid & (signs > 0)
-        is_del = chunk.valid & (signs < 0)
-        row_hash = hash64_columns(list(chunk.columns))
-
-        # deletes: rank-th pool row with matching hash
-        match = state.valid[None, :] & (
-            state.row_hash[None, :] == row_hash[:, None]
-        )  # [cap, S]
-        from risingwave_tpu.stream.hash_join import _rank_by
-        del_rank = _rank_by(row_hash, is_del)
-        mrank = jnp.cumsum(match, axis=1) - 1
-        clear_onehot = match & (mrank == del_rank[:, None]) & is_del[:, None]
-        any_clear = jnp.any(clear_onehot, axis=1)
-        j_clear = jnp.argmax(clear_onehot, axis=1).astype(jnp.int32)
-        pos_clear = jnp.where(any_clear, j_clear, jnp.int32(S))
-        valid = state.valid.at[pos_clear].set(False, mode="drop")
-        n_missing = jnp.sum((is_del & ~any_clear).astype(jnp.int64))
-
-        # inserts: rank-th free slot
-        free = ~valid                                   # [S]
-        free_pos = jnp.cumsum(free) - 1                 # rank of each slot
-        ins_rank = _rank_by(jnp.zeros((cap,), jnp.uint64), is_ins)
-        # slot for insert r = index of the r-th free slot
-        slot_of_rank = jnp.full((S,), S, jnp.int32).at[
-            jnp.where(free, free_pos.astype(jnp.int32), S)
-        ].min(jnp.arange(S, dtype=jnp.int32), mode="drop")
-        tgt = jnp.where(
-            is_ins & (ins_rank < S),
-            slot_of_rank[jnp.minimum(ins_rank, S - 1)],
-            jnp.int32(S),
+        rows, valid, hashes, n_over, n_missing = pool_apply(
+            state.rows, state.valid, state.row_hash, chunk, self.pool_size
         )
-        got = is_ins & (tgt < S)
-        valid = valid.at[jnp.where(got, tgt, S)].set(True, mode="drop")
-        rows = tuple(
-            _scatter(store, jnp.where(got, tgt, S), col)
-            for store, col in zip(state.rows, chunk.columns)
-        )
-        hashes = state.row_hash.at[jnp.where(got, tgt, S)].set(
-            row_hash, mode="drop"
-        )
-        n_over = jnp.sum((is_ins & ~got).astype(jnp.int64))
         return TopNState(
             rows=rows,
             valid=valid,

@@ -1,0 +1,129 @@
+"""DynamicFilter: stream filtered by a moving scalar (band emission)."""
+
+from collections import Counter
+
+from risingwave_tpu.common.chunk import Chunk
+from risingwave_tpu.common.types import DataType, Schema
+from risingwave_tpu.stream.dynamic_filter import DynamicFilterExecutor
+
+L = Schema.of(("k", DataType.INT64), ("v", DataType.INT64))
+R = Schema.of(("thr", DataType.INT64))
+
+
+def _lc(text):
+    return Chunk.from_pretty(text, names=["k", "v"])
+
+
+def _rc(text):
+    return Chunk.from_pretty(text, names=["thr"])
+
+
+def _fold(mv, out):
+    for op, *vals in out.to_rows():
+        mv[tuple(vals)] += 1 if op in (0, 3) else -1
+    return +mv
+
+
+def test_dynamic_filter_band_emission():
+    f = DynamicFilterExecutor(L, filter_col=1, cmp="gt", pool_size=64)
+    st = f.init_state()
+
+    # rows arrive before any threshold: stored, nothing emitted
+    st, out = f.apply(st, _lc("""
+        I I
+        + 1 10
+        + 2 20
+        + 3 30
+    """), "left")
+    assert out.to_rows() == []
+
+    # threshold 15 arrives: rows v > 15 emitted as inserts
+    mv = Counter()
+    st, out = f.apply(st, _rc("""
+        I
+        + 15
+    """), "right")
+    mv = _fold(mv, out)
+    assert mv == Counter({(2, 20): 1, (3, 30): 1})
+
+    # threshold rises to 25: the band (15, 25] is retracted
+    st, out = f.apply(st, _rc("""
+        I
+        U- 15
+        U+ 25
+    """), "right")
+    mv = _fold(mv, out)
+    assert mv == Counter({(3, 30): 1})
+
+    # threshold drops to 5: band (5, 25] re-emitted
+    st, out = f.apply(st, _rc("""
+        I
+        U- 25
+        U+ 5
+    """), "right")
+    mv = _fold(mv, out)
+    assert mv == Counter({(1, 10): 1, (2, 20): 1, (3, 30): 1})
+
+    # new left rows flow through against the current threshold
+    st, out = f.apply(st, _lc("""
+        I I
+        + 4 3
+        + 5 50
+    """), "left")
+    mv = _fold(mv, out)
+    assert mv == Counter({(1, 10): 1, (2, 20): 1, (3, 30): 1, (5, 50): 1})
+
+    # left retraction of a passing row
+    st, out = f.apply(st, _lc("""
+        I I
+        - 2 20
+    """), "left")
+    mv = _fold(mv, out)
+    assert mv == Counter({(1, 10): 1, (3, 30): 1, (5, 50): 1})
+    assert int(st.inconsistency) == 0 and int(st.overflow) == 0
+
+
+def test_dynamic_filter_rhs_emptied_retracts_all():
+    f = DynamicFilterExecutor(L, filter_col=1, cmp="gt", pool_size=64)
+    st = f.init_state()
+    st, _ = f.apply(st, _lc("""
+        I I
+        + 1 50
+    """), "left")
+    mv = Counter()
+    st, out = f.apply(st, _rc("""
+        I
+        + 10
+    """), "right")
+    mv = _fold(mv, out)
+    assert mv == Counter({(1, 50): 1})
+    # the RHS 1-row aggregate becomes empty: everything retracts
+    st, out = f.apply(st, _rc("""
+        I
+        - 10
+    """), "right")
+    mv = _fold(mv, out)
+    assert mv == Counter()
+    # new left rows don't pass while the RHS is empty
+    st, out = f.apply(st, _lc("""
+        I I
+        + 2 99
+    """), "left")
+    assert out.to_rows() == []
+
+
+def test_dynamic_filter_inchunk_annihilation():
+    f = DynamicFilterExecutor(L, filter_col=1, cmp="gt", pool_size=64)
+    st = f.init_state()
+    st, _ = f.apply(st, _lc("""
+        I I
+        + 1 50
+        - 1 50
+    """), "left")
+    assert int(st.inconsistency) == 0
+    # threshold drop must NOT resurrect the annihilated row
+    st, out = f.apply(st, _rc("""
+        I
+        + 0
+    """), "right")
+    assert out.to_rows() == []
