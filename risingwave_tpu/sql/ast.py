@@ -208,5 +208,10 @@ class ShowParameters:
 
 
 @dataclass(frozen=True)
+class DescribeStatement:
+    name: str
+
+
+@dataclass(frozen=True)
 class Explain:
     statement: Any

@@ -142,6 +142,10 @@ class Engine:
             else:
                 self.session_config.set(stmt.name, stmt.value)
             return None
+        if isinstance(stmt, ast.DescribeStatement):
+            entry = self.catalog.get(stmt.name)
+            self._last_columns = ["name", "type"]
+            return [(f.name, f.data_type.value) for f in entry.schema]
         if isinstance(stmt, ast.ShowParameters):
             return self.session_config.show_all() + [
                 (k, str(v), "system")

@@ -143,9 +143,14 @@ class Parser:
             return self._create()
         if self.accept_word("drop"):
             return self._drop()
+        if self.accept_word("describe"):
+            return ast.DescribeStatement(self.ident())
         if self.accept_word("show"):
             if self.accept_word("parameters") or self.accept_word("all"):
                 return ast.ShowParameters()
+            if self.accept_word("columns"):
+                self.expect_word("from")
+                return ast.DescribeStatement(self.ident())
             kind = self.ident()
             if kind == "materialized":
                 self.expect_word("views")
