@@ -1,0 +1,24 @@
+"""sqllogictest-format e2e tests (the reference's e2e mechanism)."""
+
+import glob
+import os
+
+import pytest
+
+from risingwave_tpu.slt import run_slt
+from risingwave_tpu.sql import Engine
+from risingwave_tpu.sql.planner import PlannerConfig
+
+SLT_DIR = os.path.join(os.path.dirname(__file__), "slt")
+
+
+@pytest.mark.parametrize(
+    "path", sorted(glob.glob(os.path.join(SLT_DIR, "*.slt")))
+)
+def test_slt_file(path):
+    eng = Engine(PlannerConfig(
+        chunk_capacity=256, agg_table_size=1 << 10, agg_emit_capacity=256,
+        mv_table_size=1 << 10, mv_ring_size=1 << 12,
+    ))
+    n = run_slt(eng, path)
+    assert n > 0
