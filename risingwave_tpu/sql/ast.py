@@ -51,6 +51,16 @@ class FuncCall:
 
 
 @dataclass(frozen=True)
+class WindowCall:
+    """fn(args) OVER (PARTITION BY ... ORDER BY ...)."""
+
+    name: str
+    args: tuple
+    partition_by: tuple
+    order_by: tuple  # OrderItem
+
+
+@dataclass(frozen=True)
 class Cast:
     operand: Any
     type_name: str

@@ -589,6 +589,33 @@ class Parser:
                     if not self.accept_op(","):
                         break
             self.expect_op(")")
+            if self.accept_word("over"):
+                if distinct:
+                    raise ParseError(
+                        "DISTINCT in window functions is not supported"
+                    )
+                self.expect_op("(")
+                part: list = []
+                if self.accept_word("partition"):
+                    self.expect_word("by")
+                    while True:
+                        part.append(self._expr())
+                        if not self.accept_op(","):
+                            break
+                ob: list = []
+                if self.accept_word("order"):
+                    self.expect_word("by")
+                    while True:
+                        e = self._expr()
+                        desc = bool(self.accept_word("desc"))
+                        if not desc:
+                            self.accept_word("asc")
+                        ob.append(ast.OrderItem(e, desc))
+                        if not self.accept_op(","):
+                            break
+                self.expect_op(")")
+                return ast.WindowCall(w, tuple(args), tuple(part),
+                                      tuple(ob))
             return ast.FuncCall(w, tuple(args), distinct)
         if self.accept_op("."):
             return ast.ColumnRef(self.ident(), table=w)

@@ -176,6 +176,16 @@ class Planner:
             b = Binder(scope)
             execs.append(FilterExecutor(scope.schema, b.bind(select.where)))
 
+        has_window = any(
+            isinstance(i.expr, ast.WindowCall) for i in select.items
+        )
+        if has_window:
+            if sink is not None or eowc:
+                raise PlanError(
+                    "window functions with sinks/EOWC: next round"
+                )
+            return self._plan_over_window(select, pin, execs, scope)
+
         has_agg = bool(select.group_by) or self._has_agg(select)
         if eowc and not has_agg:
             raise PlanError(
@@ -202,6 +212,100 @@ class Planner:
         )
         return UnaryPlan(pin.reader, Fragment(execs), len(execs) - 1,
                          append_only=pin.append_only)
+
+    def _plan_over_window(self, select: ast.Select, pin, execs,
+                          scope) -> UnaryPlan:
+        """SELECT items with fn() OVER (...): one OverWindowExecutor.
+
+        All window calls must share one OVER clause this round (the
+        reference groups calls per window spec the same way)."""
+        from risingwave_tpu.stream.over_window import (
+            OverWindowExecutor,
+            WindowFuncCall,
+        )
+
+        if (select.group_by or select.having is not None
+                or select.order_by or select.limit is not None
+                or select.offset):
+            raise PlanError(
+                "window functions with GROUP BY/HAVING/ORDER BY/LIMIT "
+                "in one SELECT: next round"
+            )
+        witems = [(item, item.expr) for item in select.items
+                  if isinstance(item.expr, ast.WindowCall)]
+        spec = (witems[0][1].partition_by, witems[0][1].order_by)
+        for _, w in witems[1:]:
+            if (w.partition_by, w.order_by) != spec:
+                raise PlanError(
+                    "all window calls must share one OVER clause "
+                    "(multi-spec plans: next round)"
+                )
+        b = Binder(scope)
+        partition = [b.bind(e) for e in spec[0]]
+        order = [(b.bind(oi.expr), oi.descending) for oi in spec[1]]
+        calls = []
+        supported = {"row_number", "rank", "dense_rank", "lag", "lead",
+                     "sum", "count", "min", "max"}
+        needs_arg = {"lag", "lead", "sum", "min", "max"}
+        for idx, (item, w) in enumerate(witems):
+            if w.name not in supported:
+                raise PlanError(f"window function {w.name} not supported")
+            if w.name in needs_arg and (
+                not w.args or isinstance(w.args[0], ast.Star)
+            ):
+                raise PlanError(f"{w.name}() OVER needs an argument")
+            if w.name in ("lag", "lead") and len(w.args) > 2:
+                raise PlanError(
+                    "lag/lead default values are not yet supported"
+                )
+            arg = b.bind(w.args[0]) if w.args and not isinstance(
+                w.args[0], ast.Star
+            ) else None
+            offset = 1
+            if w.name in ("lag", "lead") and len(w.args) > 1:
+                off_ast = w.args[1]
+                if not (isinstance(off_ast, ast.Literal)
+                        and off_ast.type_name == "int"):
+                    raise PlanError("lag/lead offset must be an integer")
+                offset = off_ast.value
+            calls.append(WindowFuncCall(
+                w.name, arg, offset,
+                item.alias or f"{w.name}{idx}",
+            ))
+        ow = OverWindowExecutor(
+            scope.schema, partition, order, calls,
+            pool_size=max(self.config.topn_pool_size,
+                          2 * self.config.chunk_capacity),
+            emit_capacity=self.config.topn_emit_capacity,
+        )
+        execs.append(ow)
+        # post-projection: inputs by name, window outputs by position
+        out_schema = ow.out_schema
+        n_in = len(scope.schema)
+        proj = []
+        wi = 0
+        post_b = Binder(Scope(out_schema,
+                              tuple(scope.qualifiers)
+                              + tuple(None for _ in calls)))
+        for idx, item in enumerate(select.items):
+            if isinstance(item.expr, ast.WindowCall):
+                name = item.alias or calls[wi].alias
+                proj.append((name, InputRef(n_in + wi)))
+                wi += 1
+            elif isinstance(item.expr, ast.Star):
+                for ci, f in enumerate(scope.schema):
+                    proj.append((f.name, InputRef(ci)))
+            else:
+                name = item.alias or self._default_name(item.expr, idx)
+                proj.append((name, post_b.bind(item.expr)))
+        execs.append(ProjectExecutor(out_schema, proj))
+        out_schema = execs[-1].out_schema
+        execs.append(MaterializeExecutor(
+            out_schema, pk_indices=list(range(len(out_schema))),
+            table_size=self.config.mv_table_size,
+        ))
+        return UnaryPlan(pin.reader, Fragment(execs), len(execs) - 1,
+                         append_only=False)
 
     def _append_terminal(self, execs, out_schema, select, *,
                          input_append_only: bool, has_agg: bool,
