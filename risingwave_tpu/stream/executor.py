@@ -182,3 +182,65 @@ class FilterExecutor(Executor):
         ops = jnp.where(is_ud & keep & ~partner_keep_for_ud, OP_DELETE, ops)
         ops = jnp.where(is_ui & keep & ~partner_keep_for_ui, OP_INSERT, ops)
         return state, Chunk(chunk.columns, ops, keep, chunk.schema)
+
+
+class ChangelogExecutor(Executor):
+    """Expose the changelog as append-only rows with an op column.
+
+    ref: src/stream/src/executor/changelog.rs (CHANGELOG syntax /
+    debezium-style sinks): every Insert/Delete/U-/U+ becomes a plain
+    Insert carrying its original op code.
+    """
+
+    def __init__(self, in_schema: Schema, op_col: str = "changelog_op"):
+        super().__init__(in_schema)
+        from risingwave_tpu.common.types import DataType as DT
+        self._out_schema = Schema(
+            in_schema.fields + (Field(op_col, DT.INT16),)
+        )
+
+    @property
+    def out_schema(self) -> Schema:
+        return self._out_schema
+
+    def apply(self, state, chunk: Chunk):
+        op_col = chunk.ops.astype(jnp.int16)
+        ops = jnp.zeros_like(chunk.ops)  # all Insert
+        return state, Chunk(
+            chunk.columns + (op_col,), ops, chunk.valid, self._out_schema
+        )
+
+
+class RowIdGenExecutor(Executor):
+    """Append a monotonically increasing serial row id.
+
+    ref: src/stream/src/executor/row_id_gen.rs — pk generation for
+    tables without one.  Ids are dense per executor instance; the
+    vnode-prefixed id space of the reference arrives with the graph
+    scheduler's per-shard id ranges.
+    """
+
+    def __init__(self, in_schema: Schema, id_col: str = "_row_id"):
+        super().__init__(in_schema)
+        from risingwave_tpu.common.types import DataType as DT
+        self._out_schema = Schema(
+            in_schema.fields + (Field(id_col, DT.SERIAL),)
+        )
+
+    @property
+    def out_schema(self) -> Schema:
+        return self._out_schema
+
+    def init_state(self):
+        return jnp.zeros((), jnp.int64)
+
+    def apply(self, state, chunk: Chunk):
+        cap = chunk.capacity
+        # ids assigned to VISIBLE rows only, densely
+        rank = jnp.cumsum(chunk.valid.astype(jnp.int64)) - 1
+        ids = jnp.where(chunk.valid, state + rank, -1)
+        n = chunk.cardinality().astype(jnp.int64)
+        return state + n, Chunk(
+            chunk.columns + (ids,), chunk.ops, chunk.valid,
+            self._out_schema,
+        )

@@ -255,3 +255,39 @@ def test_agg_into_materialize_chain():
     ))
     states, _ = frag.flush(states, 2)
     assert sorted(mv.to_host(states[1])) == [(1, 1), (2, 1)]
+
+
+def test_changelog_executor():
+    from risingwave_tpu.stream.executor import ChangelogExecutor
+
+    schema = Schema.of(("v", DataType.INT64))
+    frag = Fragment([ChangelogExecutor(schema)])
+    _, out = frag.step(frag.init_states(), Chunk.from_pretty("""
+        I
+        + 1
+        - 2
+        U- 3
+        U+ 4
+    """, names=["v"]))
+    # every row becomes an Insert carrying its original op
+    assert out.to_rows() == [(0, 1, 0), (0, 2, 1), (0, 3, 2), (0, 4, 3)]
+
+
+def test_row_id_gen_executor():
+    from risingwave_tpu.stream.executor import RowIdGenExecutor
+
+    schema = Schema.of(("v", DataType.INT64))
+    gen = RowIdGenExecutor(schema)
+    frag = Fragment([gen])
+    st = frag.init_states()
+    st, out = frag.step(st, Chunk.from_pretty("""
+        I
+        + 10
+        + 11
+    """, names=["v"]))
+    assert out.to_rows() == [(0, 10, 0), (0, 11, 1)]
+    st, out = frag.step(st, Chunk.from_pretty("""
+        I
+        + 12
+    """, names=["v"]))
+    assert out.to_rows() == [(0, 12, 2)]  # counter persists
