@@ -108,6 +108,7 @@ def measure(query: str) -> float:
     eng.execute(SOURCES.format(rate=RATES.get(query, "1000000")))
     eng.execute(QUERIES[query])
     eng.execute("ALTER SYSTEM SET maintenance_interval_checkpoints = 8")
+    eng.execute("ALTER SYSTEM SET snapshot_interval_checkpoints = 8")
     eng.tick(barriers=WARMUP_BARRIERS,
              chunks_per_barrier=CHUNKS_PER_BARRIER)  # compile + warm state
     import jax

@@ -83,6 +83,10 @@ _SYSTEM_PARAM_DEFS = {
     #: checkpoints between state-maintenance passes (rehash + counter
     #: checks); >1 amortizes the per-barrier device syncs
     "maintenance_interval_checkpoints": (1, True),
+    #: checkpoints between in-memory snapshot copies; >1 amortizes the
+    #: full-state device copy (recovery falls back up to N-1 extra
+    #: epochs; the reference uploads deltas instead — next round)
+    "snapshot_interval_checkpoints": (1, True),
     "pause_on_next_bootstrap": (False, True),
 }
 

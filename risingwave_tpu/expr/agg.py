@@ -128,7 +128,10 @@ def _out_count(states, count, out_field):
 def _out_avg(states, count, out_field):
     s, c = states
     if out_field.data_type == DataType.DECIMAL:
-        return jnp.where(c != 0, s // jnp.where(c == 0, 1, c), 0)
+        # truncate toward zero (floor division biases negative sums)
+        safe_c = jnp.where(c == 0, 1, c)
+        q = jnp.sign(s) * (jnp.abs(s) // safe_c)
+        return jnp.where(c != 0, q, 0)
     return jnp.where(
         c != 0, s / jnp.where(c == 0, 1, c).astype(jnp.float64), 0.0
     )

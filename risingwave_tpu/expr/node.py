@@ -18,6 +18,7 @@ import numpy as np
 from risingwave_tpu.common.chunk import Chunk, StrCol, encode_strings
 from risingwave_tpu.common.types import (
     DEFAULT_DECIMAL_SCALE,
+    DEFAULT_STR_WIDTH,
     DataType,
     Field,
     Schema,
@@ -150,7 +151,7 @@ class Literal(Expr):
         cap = chunk.capacity
         t = self.data_type
         if t.is_string:
-            data, lens = encode_strings([self.value], 64)
+            data, lens = encode_strings([self.value], DEFAULT_STR_WIDTH)
             return StrCol(
                 jnp.broadcast_to(jnp.asarray(data[0]), (cap, data.shape[1])),
                 jnp.broadcast_to(jnp.asarray(lens[0]), (cap,)),

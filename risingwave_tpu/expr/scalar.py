@@ -174,7 +174,9 @@ def _round(a):
 def _round_dec(a, fields: Sequence[Field]):
     if fields[0].data_type == DataType.DECIMAL:
         s = 10**fields[0].decimal_scale
-        return (a + s // 2) // s * s
+        # half-away-from-zero (floor division alone biases negatives)
+        mag = (jnp.abs(a) + s // 2) // s * s
+        return jnp.sign(a) * mag
     return jnp.round(a)
 
 

@@ -497,10 +497,14 @@ class Engine:
         maint = int(self.system_params.get(
             "maintenance_interval_checkpoints"
         ))
+        snap_iv = int(self.system_params.get(
+            "snapshot_interval_checkpoints"
+        ))
         for _ in range(barriers):
             for job in self.jobs:
                 job.checkpoint_frequency = ckpt_freq
                 job.maintenance_interval = maint
+                job.snapshot_interval = snap_iv
                 t0 = time.perf_counter()
                 rows = 0
                 if isinstance(job, BinaryJob):

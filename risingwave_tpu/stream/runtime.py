@@ -144,6 +144,9 @@ class StreamingJob:
         #: checkpoints between maintenance passes (amortizes syncs)
         self.maintenance_interval = 1
         self._ckpts_since_maintain = 0
+        #: checkpoints between in-memory snapshot copies
+        self.snapshot_interval = 1
+        self._ckpts_since_snapshot = 0
         self.states = fragment.init_states()
         self.epoch = EpochPair.first()
         self.barriers_seen = 0
@@ -217,12 +220,6 @@ class StreamingJob:
         self.states = propagate_watermarks(self.fragment, self.states)
         outs.extend(self._drain_pending(epoch_val))
         if barrier.is_checkpoint:
-            # deliver+commit only at checkpoint barriers: replay after
-            # recovery must never duplicate a committed sink epoch
-            self.states = deliver_sinks(
-                self.fragment, self.states, epoch_val
-            )
-        if barrier.is_checkpoint:
             self._ckpts_since_maintain += 1
             if self._ckpts_since_maintain >= self.maintenance_interval:
                 self._maintain()
@@ -242,7 +239,17 @@ class StreamingJob:
         return outs
 
     def _commit_checkpoint(self, barrier: Barrier) -> None:
+        """Commit = snapshot + sink delivery + committed_epoch, all on
+        the SAME cadence: recovery rewinds to the last snapshot, so a
+        sink delivery or committed_epoch beyond it would be a lie
+        (duplicated sink rows / unrecoverable epochs)."""
         epoch_val = barrier.epoch.prev.value
+        self._ckpts_since_snapshot += 1
+        if self._ckpts_since_snapshot < self.snapshot_interval:
+            return
+        self._ckpts_since_snapshot = 0
+        self.states = deliver_sinks(self.fragment, self.states, epoch_val)
+        self.committed_epoch = epoch_val
         src_state = self.source.state() if hasattr(self.source, "state") \
             else {}
         # the in-memory snapshot device-copies the state: the donated
@@ -258,7 +265,6 @@ class StreamingJob:
         # retain only the latest committed snapshot in memory; the
         # durable store keeps epoch history (ref: Hummock versions)
         self.checkpoints = [snap]
-        self.committed_epoch = epoch_val
         if self.checkpoint_store is not None:
             self.checkpoint_store.save(
                 self.name, epoch_val, jax.device_get(snap.states), src_state
@@ -335,6 +341,8 @@ class BinaryJob:
         self.checkpoint_store = checkpoint_store
         self.maintenance_interval = 1
         self._ckpts_since_maintain = 0
+        self.snapshot_interval = 1
+        self._ckpts_since_snapshot = 0
         #: chunks pulled per scheduling unit (left, right) — sides whose
         #: rows represent different event-time spans pace proportionally
         #: so neither watermark runs unboundedly ahead (nexmark persons
@@ -448,8 +456,6 @@ class BinaryJob:
                 jstate, pstate = self._feed["right"](jstate, pstate, out)
         pstate = propagate_watermarks(self.post, pstate)
         pstate, _ = drain_agg_pending(self.post, pstate, sealed)
-        if self.barriers_seen % self.checkpoint_frequency == 0:
-            pstate = deliver_sinks(self.post, pstate, sealed)
         jstate = self._clean_join_state(lstate, rstate, jstate)
         self.states = (lstate, rstate, jstate, pstate)
 
@@ -458,25 +464,31 @@ class BinaryJob:
             if self._ckpts_since_maintain >= self.maintenance_interval:
                 self._maintain()
                 self._ckpts_since_maintain = 0
-            lstate, rstate, jstate, pstate = self.states
-            src_state = {
-                "left": self.left_source.state()
-                if hasattr(self.left_source, "state") else {},
-                "right": self.right_source.state()
-                if hasattr(self.right_source, "state") else {},
-            }
-            import jax.numpy as _jnp
-            snap = CheckpointSnapshot(
-                epoch=sealed,
-                states=jax.tree.map(_jnp.copy, self.states),
-                source_state=src_state,
-            )
-            self.checkpoints = [snap]
-            self.committed_epoch = sealed
-            if self.checkpoint_store is not None:
-                self.checkpoint_store.save(
-                    self.name, sealed, jax.device_get(snap.states), src_state
+            self._ckpts_since_snapshot += 1
+            if self._ckpts_since_snapshot >= self.snapshot_interval:
+                self._ckpts_since_snapshot = 0
+                lstate, rstate, jstate, pstate = self.states
+                pstate = deliver_sinks(self.post, pstate, sealed)
+                self.states = (lstate, rstate, jstate, pstate)
+                self.committed_epoch = sealed
+                src_state = {
+                    "left": self.left_source.state()
+                    if hasattr(self.left_source, "state") else {},
+                    "right": self.right_source.state()
+                    if hasattr(self.right_source, "state") else {},
+                }
+                import jax.numpy as _jnp
+                snap = CheckpointSnapshot(
+                    epoch=sealed,
+                    states=jax.tree.map(_jnp.copy, self.states),
+                    source_state=src_state,
                 )
+                self.checkpoints = [snap]
+                if self.checkpoint_store is not None:
+                    self.checkpoint_store.save(
+                        self.name, sealed, jax.device_get(snap.states),
+                        src_state,
+                    )
         self.epoch = self.epoch.bump()
 
     def _side_watermark(self, frag, st, src_col):

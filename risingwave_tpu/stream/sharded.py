@@ -206,6 +206,8 @@ class ShardedStreamingJob:
         self.checkpoint_store = checkpoint_store
         self.maintenance_interval = 1
         self._ckpts_since_maintain = 0
+        self.snapshot_interval = 1
+        self._ckpts_since_snapshot = 0
         self.states = sharded.init_states()
         self.epoch = EpochPair.first()
         self.barriers_seen = 0
@@ -250,17 +252,20 @@ class ShardedStreamingJob:
                                     f"({total} rows) across shards"
                                 )
                 self._ckpts_since_maintain = 0
-            import jax.numpy as _jnp
-            snap_states = jax.tree.map(_jnp.copy, self.states)
-            self._mem_snapshot = (
-                sealed, snap_states, {"offset": self.reader.offset}
-            )
-            self.committed_epoch = sealed
-            if self.checkpoint_store is not None:
-                self.checkpoint_store.save(
-                    self.name, sealed, jax.device_get(snap_states),
-                    {"offset": self.reader.offset},
+            self._ckpts_since_snapshot += 1
+            if self._ckpts_since_snapshot >= self.snapshot_interval:
+                self._ckpts_since_snapshot = 0
+                import jax.numpy as _jnp
+                snap_states = jax.tree.map(_jnp.copy, self.states)
+                self._mem_snapshot = (
+                    sealed, snap_states, {"offset": self.reader.offset}
                 )
+                self.committed_epoch = sealed
+                if self.checkpoint_store is not None:
+                    self.checkpoint_store.save(
+                        self.name, sealed, jax.device_get(snap_states),
+                        {"offset": self.reader.offset},
+                    )
         self.epoch = self.epoch.bump()
 
     def recover(self) -> None:
