@@ -533,16 +533,28 @@ class Engine:
             job.recover()
 
     # -- serving reads ---------------------------------------------------
-    def _serve_agg(self, select: ast.Select, scope, chunk):
-        """Host-side global aggregates over an MV snapshot (the batch
-        hash/sort-agg executors of SURVEY §2.8 for the local mode)."""
+    @staticmethod
+    def _host_col(bound, chunk, vis):
+        """Materialize a bound expr over visible rows as host values
+        (strings decoded, decimals descaled)."""
         from risingwave_tpu.common.chunk import StrCol, decode_strings
 
+        col = bound.eval(chunk)
+        if isinstance(col, StrCol):
+            return decode_strings(
+                np.asarray(col.data)[vis], np.asarray(col.lens)[vis]
+            ).tolist(), True
+        f = bound.return_field(chunk.schema)
+        vals = np.asarray(col)[vis]
+        if f.data_type == DataType.DECIMAL:
+            vals = vals.astype(np.float64) / 10**f.decimal_scale
+        return vals.tolist(), False
+
+    def _serve_agg(self, select: ast.Select, scope, chunk):
+        """Host-side aggregates over an MV snapshot (the batch
+        hash/sort-agg executors of SURVEY §2.8 for the local mode)."""
         if select.group_by:
-            raise PlanError(
-                "serving GROUP BY reads: create a materialized view "
-                "(batch hash-agg lands next round)"
-            )
+            return self._serve_group_agg(select, scope, chunk)
         if select.having is not None:
             raise PlanError("HAVING on serving aggregates: next round")
         vis = np.asarray(chunk.valid)
@@ -562,20 +574,13 @@ class Engine:
             ):
                 out.append(int(vis.sum()))
                 continue
-            bound = Binder(scope).bind(e.args[0])
-            col = bound.eval(chunk)
-            f = bound.return_field(chunk.schema)
-            if isinstance(col, StrCol):
-                vals = decode_strings(
-                    np.asarray(col.data)[vis], np.asarray(col.lens)[vis]
-                ).tolist()
-                if e.name in ("sum", "avg"):
-                    raise PlanError(f"{e.name} over strings is not valid")
-            else:
-                vals = np.asarray(col)[vis]
-                if f.data_type == DataType.DECIMAL:
-                    # device representation is scaled int64
-                    vals = vals.astype(np.float64) / 10**f.decimal_scale
+            vals, is_str = self._host_col(
+                Binder(scope).bind(e.args[0]), chunk, vis
+            )
+            if is_str and e.name in ("sum", "avg"):
+                raise PlanError(f"{e.name} over strings is not valid")
+            if not is_str:
+                vals = np.asarray(vals)
             if e.distinct:
                 if e.name != "count":
                     raise PlanError(
@@ -607,6 +612,128 @@ class Engine:
         if select.limit is not None:
             result = result[:select.limit]
         return result
+
+    def _serve_group_agg(self, select: ast.Select, scope, chunk):
+        """Batch GROUP BY over an MV snapshot (hash-agg local mode)."""
+        from collections import defaultdict
+
+        from risingwave_tpu.common.chunk import StrCol, decode_strings
+
+        if select.having is not None:
+            raise PlanError("HAVING on serving aggregates: next round")
+        vis = np.asarray(chunk.valid)
+        b = Binder(scope)
+        group_cols = [
+            self._host_col(b.bind(g), chunk, vis)[0]
+            for g in select.group_by
+        ]
+        n = int(vis.sum())
+        keys = [tuple(c[i] for c in group_cols) for i in range(n)]
+
+        names = []
+        # per item: either a group expr (echo) or an aggregate
+        plans = []  # ("key", gi) | ("agg", name, values, distinct)
+        for idx, item in enumerate(select.items):
+            e = item.expr
+            matched = None
+            for gi, g in enumerate(select.group_by):
+                if e == g:
+                    matched = gi
+                    break
+            if matched is not None:
+                names.append(item.alias or self.planner._default_name(
+                    e, idx
+                ))
+                plans.append(("key", matched))
+                continue
+            if not (isinstance(e, ast.FuncCall)
+                    and e.name in ("count", "sum", "min", "max", "avg")):
+                raise PlanError(
+                    "serving GROUP BY items must be group keys or "
+                    "count/sum/min/max/avg"
+                )
+            names.append(item.alias or e.name)
+            if e.name == "count" and (
+                not e.args or isinstance(e.args[0], ast.Star)
+            ):
+                plans.append(("agg", "count_star", None, False))
+            else:
+                vals, is_str = self._host_col(
+                    b.bind(e.args[0]), chunk, vis
+                )
+                if is_str and e.name in ("sum", "avg"):
+                    raise PlanError(
+                        f"{e.name} over strings is not valid"
+                    )
+                plans.append(("agg", e.name, vals, e.distinct))
+
+        groups: dict = defaultdict(list)
+        for i in range(n):
+            groups[keys[i]].append(i)
+        out = []
+        for key, idxs in groups.items():
+            row = []
+            for p in plans:
+                if p[0] == "key":
+                    row.append(key[p[1]])
+                    continue
+                _, kind, vals, distinct = p
+                if kind == "count_star":
+                    row.append(len(idxs))
+                    continue
+                sel = [vals[i] for i in idxs]
+                if distinct:
+                    if kind != "count":
+                        raise PlanError(
+                            "DISTINCT supported for count only (serving)"
+                        )
+                    row.append(len(set(sel)))
+                elif kind == "count":
+                    row.append(len(sel))
+                elif kind == "sum":
+                    row.append(sum(sel))
+                elif kind == "min":
+                    row.append(min(sel))
+                elif kind == "max":
+                    row.append(max(sel))
+                else:
+                    row.append(float(np.mean(sel)))
+            out.append(tuple(row))
+        self._last_columns = names
+        # ORDER BY/LIMIT/OFFSET over the grouped result
+        if select.order_by:
+            for oi in reversed(select.order_by):
+                pos = None
+                if isinstance(oi.expr, ast.Literal) \
+                        and oi.expr.type_name == "int":
+                    if not (1 <= oi.expr.value <= len(names)):
+                        raise PlanError(
+                            f"ORDER BY position {oi.expr.value} out of "
+                            "range"
+                        )
+                    pos = oi.expr.value - 1
+                else:
+                    ref_name = oi.expr.name if isinstance(
+                        oi.expr, ast.ColumnRef
+                    ) else None
+                    for ni, item in enumerate(select.items):
+                        if item.expr == oi.expr or (
+                            ref_name is not None
+                            and item.alias == ref_name
+                        ):
+                            pos = ni
+                            break
+                if pos is None:
+                    raise PlanError(
+                        "serving GROUP BY ORDER BY must reference a "
+                        "select item"
+                    )
+                out.sort(key=lambda r: r[pos], reverse=oi.descending)
+        if select.offset:
+            out = out[select.offset:]
+        if select.limit is not None:
+            out = out[:select.limit]
+        return out
 
     def _mv_rows(self, entry: CatalogEntry):
         from risingwave_tpu.stream.sharded import ShardedStreamingJob
@@ -640,7 +767,7 @@ class Engine:
         if select.where is not None:
             keep = Binder(scope).bind(select.where).eval(chunk)
             chunk = chunk.mask(keep)
-        if self.planner._has_agg(select):
+        if self.planner._has_agg(select) or select.group_by:
             return self._serve_agg(select, scope, chunk)
         items = self.planner._expand_items(select.items, scope)
         b = Binder(scope)

@@ -18,7 +18,9 @@ SLT_DIR = os.path.join(os.path.dirname(__file__), "slt")
 def test_slt_file(path):
     eng = Engine(PlannerConfig(
         chunk_capacity=256, agg_table_size=1 << 10, agg_emit_capacity=256,
-        mv_table_size=1 << 10, mv_ring_size=1 << 12,
+        mv_table_size=1 << 10, mv_ring_size=1 << 13,
+        join_table_size=1 << 10, join_bucket_cap=1024,
+        join_out_capacity=1 << 14,
     ))
     n = run_slt(eng, path)
     assert n > 0

@@ -530,3 +530,29 @@ def test_window_functions_over_clause():
             run += v
             want.append((v, v, i + 1, run))
     assert rows == sorted(want)
+
+
+def test_serving_group_by_over_mv():
+    eng = _engine(cap=64)
+    eng.execute("""
+        CREATE SOURCE t (k BIGINT, v BIGINT) WITH (connector='datagen');
+        CREATE MATERIALIZED VIEW m AS SELECT k, v FROM t;
+    """)
+    eng.tick(barriers=1, chunks_per_barrier=1)
+    rows = eng.execute(
+        "SELECT k % 4 AS g, count(*) AS n, sum(v) AS s FROM m "
+        "GROUP BY k % 4 ORDER BY g"
+    )
+    import numpy as np
+    ks = np.arange(64)
+    want = [
+        (g, int((ks % 4 == g).sum()), int(ks[ks % 4 == g].sum()))
+        for g in range(4)
+    ]
+    assert [(int(a), int(b), int(c)) for a, b, c in rows] == want
+
+    top = eng.execute(
+        "SELECT k % 4 AS g, sum(v) AS s FROM m GROUP BY k % 4 "
+        "ORDER BY s DESC LIMIT 1"
+    )
+    assert int(top[0][0]) == 3
