@@ -738,6 +738,27 @@ class Engine:
     def _mv_rows(self, entry: CatalogEntry):
         from risingwave_tpu.stream.sharded import ShardedStreamingJob
 
+        # time travel: SET query_epoch reads a retained historical
+        # checkpoint (ref FOR SYSTEM_TIME AS OF over Hummock versions,
+        # time_travel_version_cache.rs)
+        qe = int(self.session_config.get("query_epoch"))
+        if qe:
+            if self.checkpoint_store is None:
+                raise PlanError(
+                    "query_epoch needs a durable data_dir"
+                )
+            epochs = self.checkpoint_store.epochs(entry.name)
+            if qe not in epochs:
+                raise PlanError(
+                    f"epoch {qe} is not retained for {entry.name} "
+                    f"(retained: {epochs})"
+                )
+            _, states, _ = self.checkpoint_store.load(entry.name, qe)
+            st = states
+            for i in entry.mv_state_index:
+                st = st[i]
+            return entry.mv_executor.to_host(st)
+
         idx = entry.mv_state_index
         if isinstance(entry.job, ShardedStreamingJob):
             return entry.job.mv_rows(entry.mv_executor, idx[0])

@@ -81,6 +81,12 @@ class CheckpointStore:
         job = m["jobs"].get(job_name)
         return None if job is None else job.get("committed")
 
+    def epochs(self, job_name: str) -> list[int]:
+        """Retained (time-travel-readable) epochs, oldest first."""
+        m = self._load_manifest()
+        job = m["jobs"].get(job_name)
+        return list(job.get("epochs", [])) if job else []
+
     def load(self, job_name: str, epoch: int | None = None):
         """Load (epoch, states_host, source_state); latest if epoch None."""
         if epoch is None:

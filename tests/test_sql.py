@@ -556,3 +556,37 @@ def test_serving_group_by_over_mv():
         "ORDER BY s DESC LIMIT 1"
     )
     assert int(top[0][0]) == 3
+
+
+def test_time_travel_query_epoch(tmp_path):
+    """SET query_epoch reads a retained historical checkpoint."""
+    eng = Engine(
+        __import__("risingwave_tpu.sql.planner",
+                   fromlist=["PlannerConfig"]).PlannerConfig(
+            chunk_capacity=64, agg_table_size=256, agg_emit_capacity=64,
+            mv_table_size=256, mv_ring_size=1024,
+        ),
+        data_dir=str(tmp_path),
+    )
+    eng.execute("""
+        CREATE SOURCE t (k BIGINT) WITH (connector='datagen');
+        CREATE MATERIALIZED VIEW m AS SELECT count(*) AS n FROM t;
+    """)
+    eng.tick(barriers=1, chunks_per_barrier=1)
+    e1 = eng.jobs[0].committed_epoch
+    eng.tick(barriers=1, chunks_per_barrier=1)
+    e2 = eng.jobs[0].committed_epoch
+    assert e2 > e1
+
+    assert eng.execute("SELECT n FROM m") == [(128,)]
+    eng.execute(f"SET query_epoch = {e1}")
+    assert eng.execute("SELECT n FROM m") == [(64,)]  # the past
+    eng.execute("SET query_epoch = 0")
+    assert eng.execute("SELECT n FROM m") == [(128,)]
+
+    # unretained epochs fail loudly
+    import pytest as _p
+    from risingwave_tpu.sql.planner import PlanError
+    eng.execute("SET query_epoch = 12345")
+    with _p.raises(PlanError):
+        eng.execute("SELECT n FROM m")
