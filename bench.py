@@ -31,8 +31,8 @@ from risingwave_tpu.sql import Engine
 from risingwave_tpu.sql.planner import PlannerConfig
 
 CHUNK_CAP = 8192
-WARMUP_BARRIERS = 2
-BARRIERS = 8
+WARMUP_BARRIERS = 3
+BARRIERS = 16
 CHUNKS_PER_BARRIER = 8
 
 # q8 uses a lower event rate + 1s windows: per-(window, hot-seller)
