@@ -16,14 +16,53 @@ from __future__ import annotations
 from typing import Sequence
 
 import jax
+import jax.numpy as jnp
+import numpy as np
 
 from risingwave_tpu.common.chunk import Chunk
 from risingwave_tpu.common.types import Schema
 from risingwave_tpu.stream.executor import Executor
 
+#: sentinel for "no watermark yet" (matches WmState.max_ts init)
+WM_NONE = np.iinfo(np.int64).min
+#: safe stand-in threshold when no watermark exists: far enough below
+#: any real event time that cleaning predicates match nothing, far
+#: enough above INT64_MIN that `value - lag` cannot wrap
+WM_SAFE_FLOOR = -(1 << 62)
+
+#: per-executor-state scalar counters surfaced to maintenance checks
+COUNTER_ATTRS = ("inconsistency", "overflow", "emit_overflow")
+
+
+def collect_counters(executors, states):
+    """Gather every executor's error counters + residual pending-flush
+    into ONE device vector (labels, int64 [n]).
+
+    The host reads this vector once per maintenance interval — a single
+    device sync — instead of one sync per counter per barrier (each
+    host readback costs a full host↔device round trip; over a tunneled
+    accelerator that is ~10^2 ms)."""
+    labels: list[str] = []
+    vals: list[jnp.ndarray] = []
+    for i, ex in enumerate(executors):
+        st = states[i]
+        for attr in COUNTER_ATTRS:
+            if hasattr(st, attr):
+                labels.append(f"{ex}.{attr}")
+                vals.append(getattr(st, attr).astype(jnp.int64))
+        if hasattr(ex, "pending_flush"):
+            labels.append(f"{ex}.pending")
+            vals.append(ex.pending_flush(st).astype(jnp.int64))
+    vec = jnp.stack(vals) if vals else jnp.zeros((0,), jnp.int64)
+    return labels, vec
+
 
 class Fragment:
     """An executor chain with jit-compiled chunk/barrier paths."""
+
+    #: bound on device-side flush re-drain rounds per barrier (each
+    #: round emits one emit_capacity chunk per flushing executor)
+    MAX_DRAIN_ROUNDS = 64
 
     def __init__(self, executors: Sequence[Executor], name: str = "fragment"):
         if not executors:
@@ -37,6 +76,14 @@ class Fragment:
         self._step = jax.jit(self._step_impl, donate_argnums=(0,))
         # epoch is passed as a traced scalar so barriers never retrace
         self._flush = jax.jit(self._flush_impl, donate_argnums=(0,))
+        # the whole barrier crossing (flush + drain + watermarks +
+        # counter collection) as ONE async dispatch — the steady-state
+        # loop never synchronizes with the device
+        self._barrier = jax.jit(self._barrier_impl, donate_argnums=(0,))
+        self._maintain = jax.jit(self._maintain_impl, donate_argnums=(0,))
+        #: counter labels aligned with the barrier counters vector;
+        #: populated on first barrier trace
+        self.counter_labels: list[str] = []
 
     # ------------------------------------------------------------------
     @property
@@ -90,6 +137,100 @@ class Fragment:
         for i, ex in enumerate(self.executors):
             new_states[i] = ex.on_watermark(states[i], watermark)
         return tuple(new_states)
+
+    # -- async barrier machinery (traceable; composed by the runtimes) --
+    def has_pending_protocol(self) -> bool:
+        return any(hasattr(ex, "pending_flush") for ex in self.executors)
+
+    def pending_total(self, states) -> jnp.ndarray:
+        """Total rows awaiting a further flush round (device scalar)."""
+        tot = jnp.zeros((), jnp.int64)
+        for i, ex in enumerate(self.executors):
+            if hasattr(ex, "pending_flush"):
+                tot = tot + ex.pending_flush(states[i]).astype(jnp.int64)
+        return tot
+
+    def _flush_states_only(self, states, epoch):
+        s, _ = self._flush_impl(states, epoch)
+        return s
+
+    def _drain_impl(self, states, epoch):
+        """Device-side emit-capacity drain: repeat flush passes until no
+        executor reports pending output (the reference's re-drain loop
+        in the runtime, moved into the program so the host never reads
+        the pending count).  Only valid for terminal chains — drained
+        emissions feed the rest of the chain and are then discarded."""
+        if not self.has_pending_protocol():
+            return states
+
+        def cond(carry):
+            sts, it = carry
+            return (self.pending_total(sts) > 0) & (
+                it < self.MAX_DRAIN_ROUNDS
+            )
+
+        def body(carry):
+            sts, it = carry
+            return self._flush_states_only(sts, epoch), it + 1
+
+        states, _ = jax.lax.while_loop(cond, body, (states, jnp.int32(0)))
+        return states
+
+    def _wm_impl(self, states):
+        """Propagate watermarks from generator executors through the
+        chain, entirely on device (no scalar readback).  The "no
+        watermark yet" sentinel maps to WM_SAFE_FLOOR so downstream
+        cleaning predicates match nothing."""
+        from risingwave_tpu.stream.message import Watermark
+        from risingwave_tpu.stream.watermark import WatermarkFilterExecutor
+
+        new_states = list(states)
+        for i, ex in enumerate(self.executors):
+            if not isinstance(ex, WatermarkFilterExecutor):
+                continue
+            raw = new_states[i].max_ts
+            val = jnp.where(
+                raw == WM_NONE,
+                jnp.int64(WM_SAFE_FLOOR),
+                raw - ex.delay_us,
+            )
+            wm = Watermark(ex.ts_col, val)
+            for j, ex2 in enumerate(self.executors):
+                new_states[j] = ex2.on_watermark(new_states[j], wm)
+        return tuple(new_states)
+
+    def _barrier_impl(self, states, epoch):
+        """One-dispatch barrier crossing: flush, drain, watermarks,
+        post-watermark drain (EOWC rows closed by THIS barrier emit at
+        this barrier), then counter collection."""
+        states, outs = self._flush_impl(states, epoch)
+        states = self._drain_impl(states, epoch)
+        states = self._wm_impl(states)
+        states = self._drain_impl(states, epoch)
+        labels, counters = collect_counters(self.executors, states)
+        self.counter_labels = labels
+        return states, outs, counters
+
+    def barrier(self, states, epoch):
+        """Cross a barrier asynchronously.
+
+        Returns (states, first-pass emissions, counters int64 vector).
+        The counters stay on device; the runtime reads them once per
+        maintenance interval."""
+        return self._barrier(states, epoch)
+
+    def _maintain_impl(self, states):
+        """Checkpoint-time housekeeping, all on device: executors whose
+        tombstones dominate rebuild their tables (lax.cond inside
+        maybe_rehash — no host readback of tombstone counts)."""
+        new_states = list(states)
+        for i, ex in enumerate(self.executors):
+            if hasattr(ex, "maybe_rehash"):
+                new_states[i] = ex.maybe_rehash(new_states[i])
+        return tuple(new_states)
+
+    def maintain(self, states):
+        return self._maintain(states)
 
     def __repr__(self) -> str:
         chain = " -> ".join(map(repr, self.executors))

@@ -385,30 +385,40 @@ class HashAggExecutor(Executor):
 
     def maybe_rehash(self, state: AggState) -> AggState:
         """Rebuild the group table once tombstones dominate (called by
-        the runtime at checkpoint barriers after state cleaning)."""
-        if int(state.table.tombstone_count()) <= self.table_size // 4:
-            return state
-        from risingwave_tpu.state.hash_table import permute_dense
+        the runtime at checkpoint barriers after state cleaning).
 
-        fresh, moved = state.table.rehashed()
-        prims = []
-        prev_prims = []
-        for pi, (agg_idx, ps) in enumerate(self._prim_specs):
-            st_dt = state.prims[pi].dtype
-            init = ps.init(st_dt)
-            prims.append(permute_dense(state.prims[pi], moved, init))
-            prev_prims.append(permute_dense(state.prev_prims[pi], moved, init))
-        return AggState(
-            table=fresh,
-            prims=tuple(prims),
-            row_count=permute_dense(state.row_count, moved),
-            dirty=permute_dense(state.dirty, moved),
-            prev_prims=tuple(prev_prims),
-            prev_row_count=permute_dense(state.prev_row_count, moved),
-            emitted=permute_dense(state.emitted, moved),
-            overflow=state.overflow,
-            inconsistency=state.inconsistency,
-            wm=state.wm,
+        Traceable: the decision is a ``lax.cond`` on the device-resident
+        tombstone count, so maintenance never reads back to the host."""
+
+        def do_rehash(state: AggState) -> AggState:
+            from risingwave_tpu.state.hash_table import permute_dense
+
+            fresh, moved = state.table.rehashed()
+            prims = []
+            prev_prims = []
+            for pi, (agg_idx, ps) in enumerate(self._prim_specs):
+                st_dt = state.prims[pi].dtype
+                init = ps.init(st_dt)
+                prims.append(permute_dense(state.prims[pi], moved, init))
+                prev_prims.append(
+                    permute_dense(state.prev_prims[pi], moved, init)
+                )
+            return AggState(
+                table=fresh,
+                prims=tuple(prims),
+                row_count=permute_dense(state.row_count, moved),
+                dirty=permute_dense(state.dirty, moved),
+                prev_prims=tuple(prev_prims),
+                prev_row_count=permute_dense(state.prev_row_count, moved),
+                emitted=permute_dense(state.emitted, moved),
+                overflow=state.overflow,
+                inconsistency=state.inconsistency,
+                wm=state.wm,
+            )
+
+        return jax.lax.cond(
+            state.table.tombstone_count() > self.table_size // 4,
+            do_rehash, lambda s: s, state,
         )
 
     # ------------------------------------------------------------------

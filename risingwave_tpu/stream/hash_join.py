@@ -430,23 +430,28 @@ class HashJoinExecutor:
         """Rebuild tombstone-heavy side key tables (runtime maintenance).
 
         Without this, watermark cleaning would fill the tables with
-        unclaimable tombstones and probes would degrade to overflow."""
+        unclaimable tombstones and probes would degrade to overflow.
+        Traceable: per-side ``lax.cond`` on the device tombstone count."""
         from risingwave_tpu.state.hash_table import permute_dense
+
+        def rebuild(s: SideState) -> SideState:
+            fresh, moved = s.key_table.rehashed()
+            return SideState(
+                key_table=fresh,
+                rows=tuple(permute_dense(r, moved) for r in s.rows),
+                occupied=permute_dense(s.occupied, moved),
+                count=permute_dense(s.count, moved),
+                overflow=s.overflow,
+                inconsistency=s.inconsistency,
+            )
 
         sides = {}
         for name in ("left", "right"):
             s: SideState = getattr(state, name)
-            if int(s.key_table.tombstone_count()) > s.key_table.size // 4:
-                fresh, moved = s.key_table.rehashed()
-                s = SideState(
-                    key_table=fresh,
-                    rows=tuple(permute_dense(r, moved) for r in s.rows),
-                    occupied=permute_dense(s.occupied, moved),
-                    count=permute_dense(s.count, moved),
-                    overflow=s.overflow,
-                    inconsistency=s.inconsistency,
-                )
-            sides[name] = s
+            sides[name] = jax.lax.cond(
+                s.key_table.tombstone_count() > s.key_table.size // 4,
+                rebuild, lambda x: x, s,
+            )
         return JoinState(sides["left"], sides["right"], state.emit_overflow)
 
     def clean_below(self, state: JoinState, side: str, key_col_idx: int,

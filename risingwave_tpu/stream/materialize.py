@@ -103,18 +103,25 @@ class MaterializeExecutor(Executor):
             pk_cols, chunk.valid
         )
         n_over = jnp.sum((overflow & chunk.valid).astype(jnp.int64))
-        # delete side first (handles U-/U+ pairs on the same pk in order)
-        table = table.clear_slots(slots, del_rows)
-        # insert side: re-occupy + write values.  XLA scatter order for
-        # duplicate indices is unspecified, so keep only the LAST
-        # insert-side row per slot (reference applies conflicts in row
-        # order, materialize.rs conflict handling)
+        # per-slot conflict resolution honors INTRA-CHUNK ROW ORDER (the
+        # reference applies conflicts row by row, materialize.rs): the
+        # last op in row order wins — a [+pk, -pk] chunk ends absent, a
+        # [-pk, +pk] chunk ends present.  XLA scatter order for duplicate
+        # indices is unspecified, so the winner is chosen by scatter-max
+        # of the row index per side.
         row_idx = jnp.arange(slots.shape[0], dtype=jnp.int32)
-        last_writer = jnp.full((self.table_size,), -1, jnp.int32).at[
+        last_del = jnp.full((self.table_size,), -1, jnp.int32).at[
+            jnp.where(del_rows, slots, jnp.int32(self.table_size))
+        ].max(jnp.where(del_rows, row_idx, -1), mode="drop")
+        last_ins = jnp.full((self.table_size,), -1, jnp.int32).at[
             jnp.where(ins_rows, slots, jnp.int32(self.table_size))
         ].max(jnp.where(ins_rows, row_idx, -1), mode="drop")
-        is_last = ins_rows & (
-            last_writer[jnp.minimum(slots, self.table_size - 1)] == row_idx
+        safe = jnp.minimum(slots, self.table_size - 1)
+        # delete wins where its last row index beats the last insert's
+        del_wins = del_rows & (last_del[safe] > last_ins[safe])
+        table = table.clear_slots(slots, del_wins)
+        is_last = ins_rows & (last_ins[safe] == row_idx) & (
+            last_ins[safe] > last_del[safe]
         )
         ins_pos = jnp.where(is_last, slots, jnp.int32(self.table_size))
         table = HashTable(
@@ -131,15 +138,20 @@ class MaterializeExecutor(Executor):
 
     # -- maintenance ----------------------------------------------------
     def maybe_rehash(self, state: MvState) -> MvState:
-        """Rebuild the pk table once tombstones dominate (runtime calls
-        this at checkpoint barriers; one scalar readback)."""
-        if int(state.table.tombstone_count()) <= self.table_size // 4:
-            return state
-        fresh, moved = state.table.rehashed()
-        from risingwave_tpu.state.hash_table import permute_dense
+        """Rebuild the pk table once tombstones dominate (traceable:
+        lax.cond on the device tombstone count, no host readback)."""
 
-        values = tuple(permute_dense(v, moved) for v in state.values)
-        return MvState(fresh, values, state.overflow)
+        def do_rehash(state: MvState) -> MvState:
+            fresh, moved = state.table.rehashed()
+            from risingwave_tpu.state.hash_table import permute_dense
+
+            values = tuple(permute_dense(v, moved) for v in state.values)
+            return MvState(fresh, values, state.overflow)
+
+        return jax.lax.cond(
+            state.table.tombstone_count() > self.table_size // 4,
+            do_rehash, lambda s: s, state,
+        )
 
     # -- serving (snapshot read) ----------------------------------------
     def to_host(self, state: MvState) -> list[tuple]:
@@ -206,7 +218,15 @@ class AppendOnlyMaterialize(Executor):
             else:
                 gathered = col[safe_idx]
             values.append(_scatter_col(store, pos, gathered))
-        return RingState(tuple(values), state.cursor + n, state.overflow), None
+        # ring laps silently overwrite the oldest MV rows — count them as
+        # overflow so maintenance fails loudly instead of serving a
+        # truncated MV (history beyond ring_size needs the SST spill path)
+        lost_before = jnp.maximum(state.cursor - self.ring_size, 0)
+        lost_after = jnp.maximum(state.cursor + n - self.ring_size, 0)
+        return RingState(
+            tuple(values), state.cursor + n,
+            state.overflow + (lost_after - lost_before),
+        ), None
 
     def to_host(self, state: RingState, limit: int | None = None) -> list[tuple]:
         total = int(state.cursor)

@@ -6,14 +6,23 @@ Reference counterparts:
 - CN's ``LocalBarrierWorker`` + actor event loop
   (src/stream/src/task/barrier_worker/mod.rs:303)
 
-TPU-first design (SURVEY.md §7.1): barriers are host control flow.  The
-runtime ticks epochs, runs K jitted fragment steps per epoch (each step
-processes one source chunk), then crosses the barrier: flush
-emit-on-barrier state, commit the epoch, snapshot on checkpoint
-barriers.  "One actor = one tokio task" collapses into "one fragment =
-one jitted program", so barrier alignment inside a single fragment is
-trivial (sequential steps) and multi-fragment alignment is the loop
-order.
+TPU-first design (SURVEY.md §7.1): barriers are host control flow, but
+the barrier CROSSING is one asynchronously dispatched XLA program.  The
+steady-state loop — K chunk steps, then a barrier — performs ZERO
+synchronous host↔device round trips:
+
+- emit-capacity drain loops run on device (``lax.while_loop`` inside
+  the barrier program) instead of host readback loops;
+- watermarks propagate as device scalars inside the same program;
+- error counters (overflow/inconsistency) are collected into ONE device
+  vector per barrier and read back once per maintenance interval;
+- rehash decisions are ``lax.cond`` on device tombstone counts;
+- in-memory snapshots are jit-compiled device→device tree copies.
+
+This matters doubly on a tunneled accelerator where every synchronous
+readback costs a full round trip (measured ~66 ms on the dev tunnel vs
+~40 µs per async dispatch), but it is the right shape for local TPUs
+too: the host never stalls the device pipeline.
 """
 
 from __future__ import annotations
@@ -22,19 +31,28 @@ from dataclasses import dataclass
 from typing import Any
 
 import jax
+import jax.numpy as jnp
+import numpy as np
 
 from risingwave_tpu.common.epoch import EpochPair
-from risingwave_tpu.stream.fragment import Fragment
+from risingwave_tpu.stream.fragment import (
+    COUNTER_ATTRS,
+    Fragment,
+    WM_NONE,
+    WM_SAFE_FLOOR,
+    collect_counters,
+)
 from risingwave_tpu.stream.message import Barrier, BarrierKind
 
 
 @dataclass
 class CheckpointSnapshot:
-    """A committed epoch: host copies of all state + source offsets.
+    """A committed epoch: device copies of all state + source offsets.
 
     ref: Hummock ``commit_epoch`` (src/meta/src/hummock/manager/
-    commit_epoch.rs:73) — here the "SST upload" is a device→host state
-    fetch; the persistent-store spill lands with the storage layer.
+    commit_epoch.rs:73) — the in-memory snapshot stays device-resident
+    (a jitted tree copy); only the durable store pays a device→host
+    transfer.
     """
 
     epoch: int
@@ -42,57 +60,53 @@ class CheckpointSnapshot:
     source_state: dict
 
 
-def drain_agg_pending(fragment: Fragment, states, epoch_val):
-    """Re-flush until nothing pending remains (emit-capacity spill).
+#: jitted device→device snapshot copy (one dispatch for the whole tree)
+@jax.jit
+def _snapshot_copy(tree):
+    return jax.tree.map(jnp.copy, tree)
 
-    Any executor exposing ``pending_flush(state) -> count`` participates
-    (hash agg dirty groups, EOWC closed rows, ...).
+
+def check_counter_values(name: str, labels: list[str],
+                         values: np.ndarray) -> list[str]:
+    """Raise on error counters; return labels with residual pending.
+
+    ``values`` is the host copy of a barrier program's counters vector.
     """
-    outs = []
-    for i, ex in enumerate(fragment.executors):
-        if hasattr(ex, "pending_flush"):
-            # one scalar readback per barrier; loops only under extreme
-            # pending-set sizes
-            while int(ex.pending_flush(states[i])) > 0:
-                states, emitted = fragment.flush(states, epoch_val)
-                outs.extend(emitted)
-    return states, outs
-
-
-def propagate_watermarks(fragment: Fragment, states):
-    """Read watermark generators (one scalar each), push the control
-    message through the fragment (ref watermark_filter.rs emission)."""
-    from risingwave_tpu.stream.message import Watermark
-    from risingwave_tpu.stream.watermark import WatermarkFilterExecutor
-
-    for i, ex in enumerate(fragment.executors):
-        if isinstance(ex, WatermarkFilterExecutor):
-            wm = ex.current_watermark(states[i])
-            if wm is not None:
-                states = fragment.on_watermark(
-                    states, Watermark(ex.ts_col, wm)
+    residual = []
+    for label, v in zip(labels, values):
+        if label.endswith(".pending"):
+            if v > 0:
+                residual.append(label)
+        elif v > 0:
+            kind = label.rsplit(".", 1)[-1]
+            if kind == "inconsistency":
+                raise RuntimeError(
+                    f"{name}/{label}: {v} inconsistent changelog rows "
+                    "(deletes with no matching state)"
                 )
-    return states
+            if kind == "emit_overflow":
+                raise RuntimeError(
+                    f"{name}/{label}: emit overflow ({v} output rows "
+                    "dropped) — increase out_capacity"
+                )
+            hint = "ring_size" if "Ring" in label or "AppendOnly" in label \
+                else "table/bucket capacity"
+            raise RuntimeError(
+                f"{name}/{label}: state overflow ({v} rows dropped) — "
+                f"increase {hint}"
+            )
+    return residual
 
 
-def deliver_sinks(fragment: Fragment, states, epoch_val):
-    """Drain sink ring buffers to their connectors (host barrier hook)."""
-    states = list(states)
-    for i, ex in enumerate(fragment.executors):
-        if hasattr(ex, "deliver"):
-            states[i] = ex.deliver(states[i], epoch_val)
-    return tuple(states)
-
-
-def maintain_fragment(fragment: Fragment, states, name: str):
-    """Checkpoint-time housekeeping: rehash tombstone-heavy tables and
-    surface consistency violations (ref consistency_error!)."""
-    states = list(states)
-    for i, ex in enumerate(fragment.executors):
-        if hasattr(ex, "maybe_rehash"):
-            states[i] = ex.maybe_rehash(states[i])
-        check_state_counters(f"{name}/{ex}", states[i])
-    return tuple(states)
+def check_state_counters(name: str, st) -> None:
+    """Eager single-state check (test/debug surface; one readback per
+    counter — not for the steady-state loop)."""
+    for attr in ("inconsistency", "overflow"):
+        if hasattr(st, attr) and int(getattr(st, attr)) > 0:
+            check_counter_values(
+                name, [f"state.{attr}"],
+                np.asarray([int(getattr(st, attr))]),
+            )
 
 
 def restore_source(source, state: dict) -> None:
@@ -106,17 +120,15 @@ def restore_source(source, state: dict) -> None:
         source.offset = state["offset"]
 
 
-def check_state_counters(name: str, st) -> None:
-    if hasattr(st, "inconsistency") and int(st.inconsistency) > 0:
-        raise RuntimeError(
-            f"{name}: {int(st.inconsistency)} inconsistent changelog rows "
-            "(deletes with no matching state)"
-        )
-    if hasattr(st, "overflow") and int(st.overflow) > 0:
-        raise RuntimeError(
-            f"{name}: state table overflow ({int(st.overflow)} rows "
-            "dropped) — increase table/bucket capacity"
-        )
+def deliver_sinks(fragment: Fragment, states, epoch_val):
+    """Drain sink ring buffers to their connectors (host barrier hook).
+
+    Inherently a device→host read — runs on the snapshot cadence only."""
+    states = list(states)
+    for i, ex in enumerate(fragment.executors):
+        if hasattr(ex, "deliver"):
+            states[i] = ex.deliver(states[i], epoch_val)
+    return tuple(states)
 
 
 class StreamingJob:
@@ -141,7 +153,8 @@ class StreamingJob:
         #: optional durable store (storage.CheckpointStore); when set,
         #: commits persist across process restarts
         self.checkpoint_store = checkpoint_store
-        #: checkpoints between maintenance passes (amortizes syncs)
+        #: checkpoints between maintenance passes (amortizes the ONE
+        #: counters readback + rehash program)
         self.maintenance_interval = 1
         self._ckpts_since_maintain = 0
         #: checkpoints between in-memory snapshot copies
@@ -154,19 +167,21 @@ class StreamingJob:
         #: committed epoch visible to batch reads (ref pinned snapshots)
         self.committed_epoch: int = 0
         self.paused = False
+        #: counters vector from the last barrier program (device array;
+        #: read back once per maintenance interval)
+        self._counters = None
         # fuse generation into the step when the source is traceable:
         # the source chunk never materializes standalone — XLA fuses
         # generator arithmetic straight into the executor kernels
         self._fused = None
         if hasattr(source, "impl") and hasattr(source, "next_base"):
-            import jax as _jax
 
             def _fused(states, k0):
                 return fragment._step_impl(
                     states, source.impl(k0, source.cap)
                 )
 
-            self._fused = _jax.jit(_fused, donate_argnums=(0,))
+            self._fused = jax.jit(_fused, donate_argnums=(0,))
 
     # ------------------------------------------------------------------
     def run_chunk(self) -> int:
@@ -177,9 +192,8 @@ class StreamingJob:
         if self.paused:
             return 0
         if self._fused is not None:
-            import jax.numpy as _jnp
             self.states, _ = self._fused(
-                self.states, _jnp.int64(self.source.next_base())
+                self.states, jnp.int64(self.source.next_base())
             )
             return self.source.cap
         chunk = self.source.next_chunk()
@@ -187,11 +201,14 @@ class StreamingJob:
         return chunk.capacity
 
     def inject_barrier(self, barrier: Barrier | None = None) -> list:
-        """Cross a barrier: flush, (maybe) checkpoint, bump the epoch.
+        """Cross a barrier: one async dispatch (flush + drain +
+        watermarks + counters), then maintenance / checkpoint on their
+        cadences.
 
-        Returns the chunks emitted by flush (they have already flowed
-        through the downstream executors inside the fragment — e.g. into
-        a trailing Materialize — so callers usually ignore them).
+        Returns the chunks emitted by the first flush pass (they have
+        already flowed through the downstream executors inside the
+        fragment — e.g. into a trailing Materialize — so callers
+        usually ignore them).
         """
         if barrier is None:
             self.barriers_seen += 1
@@ -209,34 +226,39 @@ class StreamingJob:
             self._apply_mutation(barrier.mutation)
 
         epoch_val = barrier.epoch.prev.value
-        outs = []
-        self.states, emitted = self.fragment.flush(self.states, epoch_val)
-        outs.extend(emitted)
-        # drain aggregations whose dirty set exceeded one emit chunk
-        outs.extend(self._drain_pending(epoch_val))
-
-        # propagate watermarks, then re-drain: EOWC rows closed by THIS
-        # barrier's watermark must emit at this barrier, not the next
-        self.states = propagate_watermarks(self.fragment, self.states)
-        outs.extend(self._drain_pending(epoch_val))
+        self.states, outs, self._counters = self.fragment.barrier(
+            self.states, epoch_val
+        )
         if barrier.is_checkpoint:
             self._ckpts_since_maintain += 1
             if self._ckpts_since_maintain >= self.maintenance_interval:
-                self._maintain()
+                self._maintain(epoch_val)
                 self._ckpts_since_maintain = 0
             self._commit_checkpoint(barrier)
         self.epoch = barrier.epoch
         return outs
 
-
-    def _maintain(self) -> None:
-        self.states = maintain_fragment(self.fragment, self.states, self.name)
-
-    def _drain_pending(self, epoch_val) -> list:
-        self.states, outs = drain_agg_pending(
-            self.fragment, self.states, epoch_val
+    def _maintain(self, epoch_val) -> None:
+        """Rehash (on device) + the single counters readback."""
+        self.states = self.fragment.maintain(self.states)
+        if self._counters is None:
+            return
+        values = np.asarray(self._counters)  # THE one device sync
+        residual = check_counter_values(
+            self.name, self.fragment.counter_labels, values
         )
-        return outs
+        # residual pending beyond MAX_DRAIN_ROUNDS×emit_capacity per
+        # barrier: pathological; finish draining with host loops
+        for _ in range(64):
+            if not residual:
+                break
+            self.states, _, self._counters = self.fragment.barrier(
+                self.states, epoch_val
+            )
+            residual = check_counter_values(
+                self.name, self.fragment.counter_labels,
+                np.asarray(self._counters),
+            )
 
     def _commit_checkpoint(self, barrier: Barrier) -> None:
         """Commit = snapshot + sink delivery + committed_epoch, all on
@@ -252,14 +274,13 @@ class StreamingJob:
         self.committed_epoch = epoch_val
         src_state = self.source.state() if hasattr(self.source, "state") \
             else {}
-        # the in-memory snapshot device-copies the state: the donated
-        # step/flush buffers would otherwise be invalidated under the
-        # snapshot (use-after-donation); durable persistence additionally
-        # pays the device->host transfer
-        import jax.numpy as _jnp
+        # the in-memory snapshot device-copies the state in ONE jitted
+        # dispatch: the donated step/flush buffers would otherwise be
+        # invalidated under the snapshot (use-after-donation); durable
+        # persistence additionally pays the device→host transfer
         snap = CheckpointSnapshot(
             epoch=epoch_val,
-            states=jax.tree.map(_jnp.copy, self.states),
+            states=_snapshot_copy(self.states),
             source_state=src_state,
         )
         # retain only the latest committed snapshot in memory; the
@@ -284,6 +305,7 @@ class StreamingJob:
         rebuild actors + resume from last committed epoch).  Prefers the
         durable store (survives process restarts) over the in-memory
         snapshot."""
+        self._counters = None
         if self.checkpoint_store is not None:
             loaded = self.checkpoint_store.load(self.name)
             if loaded is not None:
@@ -298,10 +320,9 @@ class StreamingJob:
                 self.source.offset = 0
             return
         snap = self.checkpoints[-1]
-        import jax.numpy as _jnp
         # copy: the next step donates its input buffers, which must not
         # invalidate the retained snapshot
-        self.states = jax.tree.map(_jnp.copy, snap.states)
+        self.states = _snapshot_copy(snap.states)
         restore_source(self.source, snap.source_state)
 
     # ------------------------------------------------------------------
@@ -323,7 +344,10 @@ class BinaryJob:
     barrier-aligned by ``barrier_align.rs:44``; here alignment is the
     host loop pulling both sides before each barrier, and the whole
     per-chunk path (side fragment + join update/probe + post fragment)
-    is one jitted program per side.
+    is one jitted program per side.  The barrier crossing — side
+    flushes + drains feeding the join, watermark propagation, join
+    state cleaning, counters — is ONE jitted program, so the loop stays
+    fully asynchronous like ``StreamingJob``.
     """
 
     def __init__(
@@ -366,20 +390,18 @@ class BinaryJob:
         self.barriers_seen = 0
         self.checkpoints: list[CheckpointSnapshot] = []
         self.committed_epoch = 0
+        self._counters = None
+        self.counter_labels: list[str] = []
         self._step = {
             "left": jax.jit(lambda st, ch: self._side_step(st, ch, "left"),
                             donate_argnums=(0,)),
             "right": jax.jit(lambda st, ch: self._side_step(st, ch, "right"),
                              donate_argnums=(0,)),
         }
-        # barrier-time feed: a side fragment's flush emissions cross the
-        # join and the post fragment exactly like steady-state chunks
-        self._feed = {
-            "left": jax.jit(lambda j, p, ch: self._feed_impl(j, p, ch, "left")),
-            "right": jax.jit(
-                lambda j, p, ch: self._feed_impl(j, p, ch, "right")
-            ),
-        }
+        self._barrier = jax.jit(self._barrier_impl, donate_argnums=(0,))
+        self._maintain_prog = jax.jit(
+            self._maintain_impl, donate_argnums=(0,)
+        )
 
     @staticmethod
     def _compute_ratio(left_source, right_source) -> tuple[int, int]:
@@ -408,61 +430,171 @@ class BinaryJob:
                 pstate, _ = self.post._step_impl(pstate, out)
         return (lstate, rstate, jstate, pstate)
 
-    def _feed_impl(self, jstate, pstate, chunk, side: str):
-        jstate, out = self.join.apply(jstate, chunk, side)
-        if out is not None:
-            pstate, _ = self.post._step_impl(pstate, out)
-        return jstate, pstate
-
     def run_chunk(self, side: str) -> int:
         source = self.left_source if side == "left" else self.right_source
         chunk = source.next_chunk()
         self.states = self._step[side](self.states, chunk)
         return chunk.capacity
 
+    # -- the single-dispatch barrier program ----------------------------
+    def _feed(self, jstate, pstate, chunk, side: str):
+        jstate, out = self.join.apply(jstate, chunk, side)
+        if out is not None:
+            pstate, _ = self.post._step_impl(pstate, out)
+        return jstate, pstate
+
+    def _flush_side(self, frag, st, jstate, pstate, side: str, epoch):
+        """Flush one side fragment; its emissions cross the join and the
+        post fragment.  Drains on device when the side has pending."""
+        st, outs = frag._flush_impl(st, epoch)
+        for out in outs:
+            jstate, pstate = self._feed(jstate, pstate, out, side)
+        if frag.has_pending_protocol():
+
+            def cond(carry):
+                st, jstate, pstate, it = carry
+                return (frag.pending_total(st) > 0) & (
+                    it < frag.MAX_DRAIN_ROUNDS
+                )
+
+            def body(carry):
+                st, jstate, pstate, it = carry
+                st, outs = frag._flush_impl(st, epoch)
+                for out in outs:
+                    jstate, pstate = self._feed(jstate, pstate, out, side)
+                return st, jstate, pstate, it + 1
+
+            st, jstate, pstate, _ = jax.lax.while_loop(
+                cond, body, (st, jstate, pstate, jnp.int32(0))
+            )
+        return st, jstate, pstate
+
+    def _side_wm_device(self, frag, st, src_col):
+        """(value, has) device watermark from a side's wm filter, or
+        None when the side has no matching generator (static)."""
+        from risingwave_tpu.stream.watermark import WatermarkFilterExecutor
+
+        if frag is None:
+            return None
+        for i, ex in enumerate(frag.executors):
+            if isinstance(ex, WatermarkFilterExecutor) \
+                    and ex.ts_col == src_col:
+                raw = st[i].max_ts
+                has = raw != WM_NONE
+                val = jnp.where(
+                    has, raw - ex.delay_us, jnp.int64(WM_SAFE_FLOOR)
+                )
+                return val, has
+        return None
+
+    def _clean_join_state(self, lstate, rstate, jstate):
+        """Watermark-driven join state cleaning (windowed joins).
+
+        A build-side row for window W serves the OTHER side's future
+        probes, so each side is cleaned by the MINIMUM watermark across
+        both inputs (one side's event time may run far ahead — e.g.
+        nexmark persons sweep event numbers ~3x faster than auctions).
+        Fully on device: values are traced scalars, the clean+rehash is
+        gated by ``lax.cond`` on watermark presence."""
+        wms = []
+        for side, frag, st in (("left", self.left_frag, lstate),
+                               ("right", self.right_frag, rstate)):
+            clean = getattr(self.join, f"{side}_clean", None)
+            if clean is None:
+                continue
+            wm = self._side_wm_device(frag, st, clean[2])
+            if wm is None:
+                return jstate  # side lacks a wm generator (static)
+            wms.append(wm)
+        if not wms:
+            return jstate
+        has_all = wms[0][1]
+        min_wm = wms[0][0]
+        for val, has in wms[1:]:
+            has_all = has_all & has
+            min_wm = jnp.minimum(min_wm, val)
+
+        def do_clean(jstate):
+            for side in ("left", "right"):
+                clean = getattr(self.join, f"{side}_clean", None)
+                if clean is None:
+                    continue
+                key_idx, lag, _ = clean
+                jstate = self.join.clean_below(
+                    jstate, side, key_idx, min_wm - lag
+                )
+            # cleaning tombstones slots; reclaim promptly (self-gated on
+            # tombstone fraction) or the table starves within barriers
+            if hasattr(self.join, "maybe_rehash"):
+                jstate = self.join.maybe_rehash(jstate)
+            return jstate
+
+        return jax.lax.cond(has_all, do_clean, lambda j: j, jstate)
+
+    def _barrier_impl(self, states, epoch):
+        lstate, rstate, jstate, pstate = states
+
+        # side fragments flush first; their emissions cross the join
+        if self.left_frag is not None:
+            lstate, jstate, pstate = self._flush_side(
+                self.left_frag, lstate, jstate, pstate, "left", epoch
+            )
+        if self.right_frag is not None:
+            rstate, jstate, pstate = self._flush_side(
+                self.right_frag, rstate, jstate, pstate, "right", epoch
+            )
+        pstate = self.post._flush_states_only(pstate, epoch)
+        pstate = self.post._drain_impl(pstate, epoch)
+
+        # watermarks propagate within each fragment, then re-drain:
+        # EOWC rows closed by THIS barrier's watermark emit now
+        if self.left_frag is not None:
+            lstate = self.left_frag._wm_impl(lstate)
+            lstate, jstate, pstate = self._flush_side(
+                self.left_frag, lstate, jstate, pstate, "left", epoch
+            )
+        if self.right_frag is not None:
+            rstate = self.right_frag._wm_impl(rstate)
+            rstate, jstate, pstate = self._flush_side(
+                self.right_frag, rstate, jstate, pstate, "right", epoch
+            )
+        pstate = self.post._wm_impl(pstate)
+        pstate = self.post._drain_impl(pstate, epoch)
+        jstate = self._clean_join_state(lstate, rstate, jstate)
+
+        # one counters vector for the whole job
+        labels: list[str] = []
+        vals: list[jnp.ndarray] = []
+        for tag, frag, st in (("left", self.left_frag, lstate),
+                              ("right", self.right_frag, rstate),
+                              ("post", self.post, pstate)):
+            if frag is None:
+                continue
+            sub_labels, sub = collect_counters(frag.executors, st)
+            labels.extend(f"{tag}.{x}" for x in sub_labels)
+            vals.append(sub)
+        for side_name in ("left", "right"):
+            s = getattr(jstate, side_name)
+            for attr in COUNTER_ATTRS:
+                if hasattr(s, attr):
+                    labels.append(f"join.{side_name}.{attr}")
+                    vals.append(getattr(s, attr).astype(jnp.int64)[None])
+        labels.append("join.emit_overflow")
+        vals.append(jstate.emit_overflow.astype(jnp.int64)[None])
+        counters = jnp.concatenate(vals) if vals \
+            else jnp.zeros((0,), jnp.int64)
+        self.counter_labels = labels
+        return (lstate, rstate, jstate, pstate), counters
+
     def inject_barrier(self) -> None:
         self.barriers_seen += 1
         sealed = self.epoch.curr.value
-        lstate, rstate, jstate, pstate = self.states
-
-        # side fragments flush first; their emissions cross the join
-        for side, frag in (("left", self.left_frag),
-                           ("right", self.right_frag)):
-            if frag is None:
-                continue
-            st = lstate if side == "left" else rstate
-            st, outs = frag.flush(st, sealed)
-            st, more = drain_agg_pending(frag, st, sealed)
-            for out in list(outs) + list(more):
-                jstate, pstate = self._feed[side](jstate, pstate, out)
-            if side == "left":
-                lstate = st
-            else:
-                rstate = st
-
-        pstate, _ = self.post.flush(pstate, sealed)
-        pstate, _ = drain_agg_pending(self.post, pstate, sealed)
-        # watermarks propagate within each fragment (cross-fragment /
-        # through-join propagation arrives with the graph scheduler)
-        if self.left_frag is not None:
-            lstate = propagate_watermarks(self.left_frag, lstate)
-            lstate, more = drain_agg_pending(self.left_frag, lstate, sealed)
-            for out in more:
-                jstate, pstate = self._feed["left"](jstate, pstate, out)
-        if self.right_frag is not None:
-            rstate = propagate_watermarks(self.right_frag, rstate)
-            rstate, more = drain_agg_pending(self.right_frag, rstate, sealed)
-            for out in more:
-                jstate, pstate = self._feed["right"](jstate, pstate, out)
-        pstate = propagate_watermarks(self.post, pstate)
-        pstate, _ = drain_agg_pending(self.post, pstate, sealed)
-        jstate = self._clean_join_state(lstate, rstate, jstate)
-        self.states = (lstate, rstate, jstate, pstate)
+        self.states, self._counters = self._barrier(self.states, sealed)
 
         if self.barriers_seen % self.checkpoint_frequency == 0:
             self._ckpts_since_maintain += 1
             if self._ckpts_since_maintain >= self.maintenance_interval:
-                self._maintain()
+                self._maintain(sealed)
                 self._ckpts_since_maintain = 0
             self._ckpts_since_snapshot += 1
             if self._ckpts_since_snapshot >= self.snapshot_interval:
@@ -477,10 +609,9 @@ class BinaryJob:
                     "right": self.right_source.state()
                     if hasattr(self.right_source, "state") else {},
                 }
-                import jax.numpy as _jnp
                 snap = CheckpointSnapshot(
                     epoch=sealed,
-                    states=jax.tree.map(_jnp.copy, self.states),
+                    states=_snapshot_copy(self.states),
                     source_state=src_state,
                 )
                 self.checkpoints = [snap]
@@ -491,78 +622,36 @@ class BinaryJob:
                     )
         self.epoch = self.epoch.bump()
 
-    def _side_watermark(self, frag, st, src_col):
-        from risingwave_tpu.stream.watermark import WatermarkFilterExecutor
-
-        if frag is None:
-            return None
-        for i, ex in enumerate(frag.executors):
-            if isinstance(ex, WatermarkFilterExecutor) \
-                    and ex.ts_col == src_col:
-                return ex.current_watermark(st[i])
-        return None
-
-    def _clean_join_state(self, lstate, rstate, jstate):
-        """Watermark-driven join state cleaning (windowed joins).
-
-        A build-side row for window W serves the OTHER side's future
-        probes, so each side is cleaned by the MINIMUM watermark across
-        both inputs (one side's event time may run far ahead — e.g.
-        nexmark persons sweep event numbers ~3x faster than auctions)."""
-        wms = []
-        for side, frag, st in (("left", self.left_frag, lstate),
-                               ("right", self.right_frag, rstate)):
-            clean = getattr(self.join, f"{side}_clean", None)
-            if clean is None:
-                continue
-            wm = self._side_watermark(frag, st, clean[2])
-            if wm is None:
-                return jstate  # one side has no watermark yet
-            wms.append(wm)
-        if not wms:
-            return jstate
-        min_wm = min(wms)
-        cleaned = False
-        for side in ("left", "right"):
-            clean = getattr(self.join, f"{side}_clean", None)
-            if clean is None:
-                continue
-            key_idx, lag, _ = clean
-            jstate = self.join.clean_below(
-                jstate, side, key_idx, min_wm - lag
-            )
-            cleaned = True
-        # cleaning tombstones slots; reclaim promptly (self-gated on
-        # tombstone fraction) or the table starves within a few barriers
-        if cleaned and hasattr(self.join, "maybe_rehash"):
-            jstate = self.join.maybe_rehash(jstate)
-        return jstate
-
-    def _maintain(self) -> None:
-        lstate, rstate, jstate, pstate = self.states
+    def _maintain_impl(self, states):
+        lstate, rstate, jstate, pstate = states
         if self.left_frag is not None:
-            lstate = maintain_fragment(
-                self.left_frag, lstate, f"{self.name}/left"
-            )
+            lstate = self.left_frag._maintain_impl(lstate)
         if self.right_frag is not None:
-            rstate = maintain_fragment(
-                self.right_frag, rstate, f"{self.name}/right"
-            )
+            rstate = self.right_frag._maintain_impl(rstate)
         if hasattr(self.join, "maybe_rehash"):
             jstate = self.join.maybe_rehash(jstate)
-        check_state_counters(f"{self.name}/join.left", jstate.left)
-        check_state_counters(f"{self.name}/join.right", jstate.right)
-        if int(jstate.emit_overflow) > 0:
-            raise RuntimeError(
-                f"{self.name}: join emit overflow "
-                f"({int(jstate.emit_overflow)} matches dropped) — "
-                "increase out_capacity"
+        pstate = self.post._maintain_impl(pstate)
+        return (lstate, rstate, jstate, pstate)
+
+    def _maintain(self, sealed) -> None:
+        self.states = self._maintain_prog(self.states)
+        if self._counters is None:
+            return
+        values = np.asarray(self._counters)  # THE one device sync
+        residual = check_counter_values(
+            self.name, self.counter_labels, values
+        )
+        for _ in range(64):
+            if not residual:
+                break
+            self.states, self._counters = self._barrier(self.states, sealed)
+            residual = check_counter_values(
+                self.name, self.counter_labels, np.asarray(self._counters)
             )
-        pstate = maintain_fragment(self.post, pstate, f"{self.name}/post")
-        self.states = (lstate, rstate, jstate, pstate)
 
     def recover(self) -> None:
         """Reset to the last committed checkpoint (ref §3.5)."""
+        self._counters = None
         if self.checkpoint_store is not None:
             loaded = self.checkpoint_store.load(self.name)
             if loaded is not None:
@@ -585,8 +674,7 @@ class BinaryJob:
                     src.offset = 0
             return
         snap = self.checkpoints[-1]
-        import jax.numpy as _jnp
-        self.states = jax.tree.map(_jnp.copy, snap.states)
+        self.states = _snapshot_copy(snap.states)
         for side, src in (("left", self.left_source),
                           ("right", self.right_source)):
             restore_source(src, snap.source_state.get(side, {}))

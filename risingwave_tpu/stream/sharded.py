@@ -123,6 +123,17 @@ class ShardedJob:
             lambda x: x[None], tuple(local_states) + tuple(keyed_states)
         )
 
+    def _feed_exchange(self, keyed_states, emitted):
+        """Route a local-half emission across the vnode exchange into
+        the keyed half (inside the shard_map body — rides ICI)."""
+        shuffled = shuffle_chunk(
+            emitted, self.exchange_key_fn(emitted), self.AXIS, self.n_shards
+        )
+        keyed_states, out = self.keyed_frag._step_impl(
+            keyed_states, shuffled
+        )
+        return keyed_states, out
+
     def _local_flush(self, states, epoch):
         states = jax.tree.map(lambda x: x[0], states)
         local_states, keyed_states = self._split(states)
@@ -133,19 +144,37 @@ class ShardedJob:
             )
             # barrier emissions from the local half cross the exchange
             for emitted in local_outs:
-                shuffled = shuffle_chunk(
-                    emitted, self.exchange_key_fn(emitted), self.AXIS,
-                    self.n_shards,
-                )
-                keyed_states, out = self.keyed_frag._step_impl(
-                    keyed_states, shuffled
+                keyed_states, out = self._feed_exchange(
+                    keyed_states, emitted
                 )
                 if out is not None:
                     outs.append(out)
+            if self.local_frag.has_pending_protocol():
+                # device-side drain of the local half, feeding each
+                # round across the exchange (no host pending readbacks)
+                def cond(carry):
+                    ls, ks, it = carry
+                    return (self.local_frag.pending_total(ls) > 0) & (
+                        it < self.local_frag.MAX_DRAIN_ROUNDS
+                    )
+
+                def body(carry):
+                    ls, ks, it = carry
+                    ls, more = self.local_frag._flush_impl(ls, epoch[0])
+                    for emitted in more:
+                        ks, _ = self._feed_exchange(ks, emitted)
+                    return ls, ks, it + 1
+
+                local_states, keyed_states, _ = jax.lax.while_loop(
+                    cond, body,
+                    (local_states, keyed_states, jnp.int32(0)),
+                )
         keyed_states, keyed_outs = self.keyed_frag._flush_impl(
             keyed_states, epoch[0]
         )
         outs.extend(keyed_outs)
+        # keyed half is terminal — drain it on device too
+        keyed_states = self.keyed_frag._drain_impl(keyed_states, epoch[0])
         out_tree = jax.tree.map(lambda x: x[None], tuple(outs))
         new_states = tuple(local_states) + tuple(keyed_states)
         return jax.tree.map(lambda x: x[None], new_states), out_tree
@@ -227,36 +256,64 @@ class ShardedStreamingJob:
         self.states = self.sharded.step(self.states, k0)
         return n * cap
 
+    def _gather_counters(self, states):
+        """All shard-summed error counters + residual pending as ONE
+        device vector (read back once per maintenance interval)."""
+        from risingwave_tpu.stream.fragment import COUNTER_ATTRS
+
+        labels: list[str] = []
+        vals: list[jnp.ndarray] = []
+        for i, ex in enumerate(self.sharded.executors):
+            st = states[i]
+            for counter in COUNTER_ATTRS:
+                if hasattr(st, counter):
+                    labels.append(f"{ex}.{counter}")
+                    vals.append(
+                        jnp.sum(getattr(st, counter)).astype(jnp.int64)
+                    )
+            if hasattr(ex, "pending_flush"):
+                # pending_flush maps over the [n_shards] leading axis
+                labels.append(f"{ex}.pending")
+                vals.append(jnp.sum(jax.vmap(ex.pending_flush)(st))
+                            .astype(jnp.int64))
+        self._counter_labels = labels
+        return jnp.stack(vals) if vals else jnp.zeros((0,), jnp.int64)
+
     def inject_barrier(self, barrier=None) -> None:
+        from risingwave_tpu.stream.runtime import (
+            _snapshot_copy,
+            check_counter_values,
+        )
+
         self.barriers_seen += 1
         sealed = self.epoch.curr.value
+        # flush drains on device inside the shard_map body — the host
+        # never reads pending counts
         self.states, _ = self.sharded.flush(self.states, sealed)
-        # drain aggs whose dirty set exceeded one emit chunk (summed
-        # over shards; one scalar readback per barrier)
-        for i, ex in enumerate(self.sharded.executors):
-            if hasattr(ex, "pending_flush"):
-                while int(jnp.sum(ex.pending_flush(self.states[i]))) > 0:
-                    self.states, _ = self.sharded.flush(self.states, sealed)
         if self.barriers_seen % self.checkpoint_frequency == 0:
             self._ckpts_since_maintain += 1
             if self._ckpts_since_maintain >= self.maintenance_interval:
-                for i, ex in enumerate(self.sharded.executors):
-                    st = self.states[i]
-                    # counters are [n_shards]-stacked; check their sums
-                    for counter in ("inconsistency", "overflow"):
-                        if hasattr(st, counter):
-                            total = int(jnp.sum(getattr(st, counter)))
-                            if total > 0:
-                                raise RuntimeError(
-                                    f"{self.name}/{ex}: {counter} "
-                                    f"({total} rows) across shards"
-                                )
+                values = jax.device_get(
+                    self._gather_counters(self.states)
+                )  # THE one device sync
+                residual = check_counter_values(
+                    self.name, self._counter_labels, values
+                )
+                # pathological pending beyond the device drain bound:
+                # finish with host-looped flushes before committing
+                for _ in range(64):
+                    if not residual:
+                        break
+                    self.states, _ = self.sharded.flush(self.states, sealed)
+                    residual = check_counter_values(
+                        self.name, self._counter_labels,
+                        jax.device_get(self._gather_counters(self.states)),
+                    )
                 self._ckpts_since_maintain = 0
             self._ckpts_since_snapshot += 1
             if self._ckpts_since_snapshot >= self.snapshot_interval:
                 self._ckpts_since_snapshot = 0
-                import jax.numpy as _jnp
-                snap_states = jax.tree.map(_jnp.copy, self.states)
+                snap_states = _snapshot_copy(self.states)
                 self._mem_snapshot = (
                     sealed, snap_states, {"offset": self.reader.offset}
                 )

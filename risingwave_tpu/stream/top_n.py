@@ -468,7 +468,12 @@ class AppendOnlyDedupExecutor(Executor):
         return DedupState(state.table.clear_where(stale), state.overflow)
 
     def maybe_rehash(self, state: DedupState) -> DedupState:
-        if int(state.table.tombstone_count()) <= self.table_size // 4:
-            return state
-        fresh, _ = state.table.rehashed()
-        return DedupState(fresh, state.overflow)
+        """Traceable: lax.cond on the device tombstone count."""
+        def do_rehash(state: DedupState) -> DedupState:
+            fresh, _ = state.table.rehashed()
+            return DedupState(fresh, state.overflow)
+
+        return jax.lax.cond(
+            state.table.tombstone_count() > self.table_size // 4,
+            do_rehash, lambda s: s, state,
+        )

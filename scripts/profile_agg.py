@@ -1,0 +1,80 @@
+"""Microprofile hash-agg internals on the current backend."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import risingwave_tpu  # noqa: F401
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from risingwave_tpu.common.hash import hash64_columns
+from risingwave_tpu.state.hash_table import HashTable
+
+CAP = 8192
+
+
+def timeit(name, fn, n=50):
+    jax.block_until_ready(fn())
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(n):
+        out = fn()
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / n
+    print(f"{name:40s} {dt*1e3:9.3f} ms/call")
+    return dt
+
+
+def main():
+    print("backend:", jax.default_backend())
+    keys = jnp.asarray(np.random.randint(0, 50, CAP), jnp.int64)
+    valid = jnp.ones((CAP,), jnp.bool_)
+
+    h64 = jax.jit(lambda k: hash64_columns([k]))
+    timeit("hash64 (1 i64 col)", lambda: h64(keys))
+
+    for logsize in (14, 18):
+        size = 1 << logsize
+        table = HashTable.create([jnp.zeros((1,), jnp.int64)], size)
+        lookup = jax.jit(lambda t, k: t.lookup_or_insert([k], valid))
+        # warm inserts
+        table2, *_ = lookup(table, keys)
+        timeit(f"lookup_or_insert 2^{logsize}",
+               lambda: lookup(table2, keys))
+
+        vals = jnp.zeros((size,), jnp.int64)
+        slots = jnp.asarray(np.random.randint(0, size, CAP), jnp.int32)
+        contrib = jnp.ones((CAP,), jnp.int64)
+        scat = jax.jit(lambda v, s, c: v.at[s].add(c, mode="drop"),
+                       donate_argnums=(0,))
+        v = vals
+        def run_scat():
+            nonlocal v
+            v = scat(v, slots, contrib)
+            return v
+        timeit(f"scatter-add i64 into 2^{logsize}", run_scat)
+
+        # flush-shaped ops
+        dirty = jnp.zeros((size,), jnp.bool_).at[slots].set(True)
+        nz = jax.jit(lambda d: jnp.nonzero(d, size=4096, fill_value=size))
+        timeit(f"nonzero(dirty 2^{logsize}, size=4096)",
+               lambda: nz(dirty))
+
+    # while_loop iteration overhead: trivial 4-iter loop over [CAP]
+    def loop(x):
+        def body(c):
+            v, it = c
+            return v + 1, it + 1
+        def cond(c):
+            return c[1] < 4
+        return jax.lax.while_loop(cond, body, (x, jnp.int32(0)))
+    lo = jax.jit(loop)
+    timeit("while_loop 4 trivial iters [8192]",
+           lambda: lo(jnp.zeros((CAP,), jnp.int64)))
+
+
+if __name__ == "__main__":
+    main()

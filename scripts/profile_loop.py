@@ -1,0 +1,62 @@
+"""Time the actual engine barrier loop for q7 (async-path version)."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import risingwave_tpu  # noqa: F401
+import jax
+
+from risingwave_tpu.sql import Engine
+from risingwave_tpu.sql.planner import PlannerConfig
+
+CAP = 8192
+
+
+def main():
+    eng = Engine(PlannerConfig(
+        chunk_capacity=CAP, agg_table_size=1 << 18, agg_emit_capacity=4096,
+        mv_table_size=1 << 18, mv_ring_size=1 << 21))
+    eng.execute("""
+    CREATE SOURCE bid (
+        auction BIGINT, bidder BIGINT, price BIGINT,
+        channel VARCHAR, url VARCHAR, date_time TIMESTAMP
+    ) WITH (connector = 'nexmark', nexmark.table = 'bid',
+            nexmark.event.rate = '1000000');
+    """)
+    eng.execute("""
+    CREATE MATERIALIZED VIEW bench_mv AS
+    SELECT window_start, max(price) AS max_price, count(*) AS bids
+    FROM TUMBLE(bid, date_time, INTERVAL '10' SECOND)
+    GROUP BY window_start;
+    """)
+    eng.execute("ALTER SYSTEM SET maintenance_interval_checkpoints = 8")
+    eng.execute("ALTER SYSTEM SET snapshot_interval_checkpoints = 8")
+    job = eng.jobs[0]
+    eng.tick(barriers=9, chunks_per_barrier=8)  # warm/compile incl. maint
+    jax.block_until_ready(job.states)
+
+    N = 16
+    t0 = time.perf_counter()
+    tc = 0.0
+    tb = 0.0
+    for _ in range(N):
+        t1 = time.perf_counter()
+        for _ in range(8):
+            job.run_chunk()
+        tc += time.perf_counter() - t1
+        t1 = time.perf_counter()
+        job.inject_barrier()
+        tb += time.perf_counter() - t1
+    jax.block_until_ready(job.states)
+    total = time.perf_counter() - t0
+    print(f"total {total*1e3:.1f} ms for {N} barriers "
+          f"({CAP*8*N/total/1e6:.3f} Mrows/s)")
+    print(f"  submit chunks  {tc*1e3:8.1f} ms")
+    print(f"  submit barrier {tb*1e3:8.1f} ms")
+    print(f"  device wait    {(total-tc-tb)*1e3:8.1f} ms")
+
+
+if __name__ == "__main__":
+    main()

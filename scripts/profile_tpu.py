@@ -1,0 +1,113 @@
+"""Decompose q7 step time on the current backend.
+
+Measures, per jitted call: dispatch floor (trivial kernel), source
+generation, hop expansion, full q7 step (gen+hop+agg), and flush.
+Run with JAX_PLATFORMS=cpu for the CPU comparison.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import risingwave_tpu  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+from risingwave_tpu.sql import Engine
+from risingwave_tpu.sql.planner import PlannerConfig
+
+CAP = 8192
+
+
+def timeit(name, fn, n=30):
+    fn()  # compile/warm
+    jax.block_until_ready(fn())
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(n):
+        out = fn()
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / n
+    print(f"{name:34s} {dt*1e3:9.3f} ms/call  {CAP/dt/1e6:8.2f} Mrows/s")
+    return dt
+
+
+def main():
+    print("backend:", jax.default_backend())
+
+    # dispatch floor: how much does one tiny jitted call cost?
+    x = jnp.zeros((8,), jnp.int32)
+    tiny = jax.jit(lambda v: v + 1)
+    timeit("dispatch floor (v+1)", lambda: tiny(x), n=100)
+
+    eng = Engine(PlannerConfig(
+        chunk_capacity=CAP, agg_table_size=1 << 18, agg_emit_capacity=4096,
+        mv_table_size=1 << 18, mv_ring_size=1 << 21))
+    eng.execute("""
+    CREATE SOURCE bid (
+        auction BIGINT, bidder BIGINT, price BIGINT,
+        channel VARCHAR, url VARCHAR, date_time TIMESTAMP
+    ) WITH (connector = 'nexmark', nexmark.table = 'bid',
+            nexmark.event.rate = '1000000');
+    """)
+    eng.execute("""
+    CREATE MATERIALIZED VIEW bench_mv AS
+    SELECT window_start, max(price) AS max_price, count(*) AS bids
+    FROM TUMBLE(bid, date_time, INTERVAL '10' SECOND)
+    GROUP BY window_start;
+    """)
+    job = eng.jobs[0]
+    src = job.source
+    frag = job.fragment
+
+    # source generation alone
+    gen = jax.jit(lambda k0: src.impl(k0, src.cap))
+    timeit("source gen (bid chunk)", lambda: gen(jnp.int64(12345)))
+
+    # per-executor step decomposition: run the chain up to executor i
+    chunk0 = gen(jnp.int64(12345))
+    states = frag.init_states()
+    names = [type(e).__name__ for e in frag.executors]
+    print("executors:", names)
+
+    for upto in range(1, len(frag.executors) + 1):
+        sub = frag.executors[:upto]
+
+        def partial_step(sts, ch, sub=sub):
+            sts = list(sts)
+            out = ch
+            for i, ex in enumerate(sub):
+                if out is None:
+                    break
+                sts[i], out = ex.apply(sts[i], out)
+            return tuple(sts), out
+
+        f = jax.jit(partial_step)
+        st = frag.init_states()
+        timeit(f"step thru {names[upto-1]:20s}", lambda: f(st, chunk0))
+
+    # full fused step (gen + all executors), as the job runs it
+    fused = job._fused
+    st = frag.init_states()
+
+    def run_fused():
+        nonlocal st
+        st, _ = fused(st, jnp.int64(src.next_base()))
+        return st
+    # note: donation means st is consumed; rebuild each call is wrong —
+    # instead chain (realistic: state carries forward)
+    timeit("full fused step (donated)", run_fused)
+
+    # flush
+    st2 = frag.init_states()
+    fl = jax.jit(frag._flush_impl if hasattr(frag, "_flush_impl")
+                 else lambda s, e: frag.flush(s, e))
+    try:
+        timeit("flush", lambda: fl(st2, jnp.int64(1)))
+    except Exception as e:
+        print("flush timing skipped:", e)
+
+
+if __name__ == "__main__":
+    main()
