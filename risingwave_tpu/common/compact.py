@@ -1,0 +1,70 @@
+"""Mask compaction primitives tuned for TPU.
+
+``jnp.nonzero(mask, size=k)`` lowers to a cumsum + full-size scatter,
+which on TPU costs ~milliseconds for table-sized masks (measured 18.6ms
+for 2^18 — the single hottest op in barrier flush).  ``lax.top_k`` is a
+tuned TPU primitive (~0.02ms for the same shape), and its tie-breaking
+(equal values ordered by ascending index) makes it a drop-in
+replacement for nonzero's ascending index order.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def mask_indices(mask: jnp.ndarray, k: int, fill) -> jnp.ndarray:
+    """Indices of up to ``k`` set bits of ``mask`` (ascending), ``fill``
+    for the rest — the fast equivalent of
+    ``jnp.nonzero(mask, size=k, fill_value=fill)[0]``."""
+    vals, idx = jax.lax.top_k(mask.astype(jnp.int32), k)
+    return jnp.where(vals > 0, idx, jnp.asarray(fill, idx.dtype))
+
+
+def segment_starts(sorted_neq: jnp.ndarray) -> jnp.ndarray:
+    """[n-1] adjacent-inequality -> [n] is-segment-start mask."""
+    return jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), sorted_neq]
+    )
+
+
+def segment_start_positions(starts: jnp.ndarray) -> jnp.ndarray:
+    """Running index of each row's segment start (int32 [n]).
+
+    One ``cummax`` — the building block for the cheap segmented
+    reductions below.  (``associative_scan`` would unroll to ~8 ops per
+    level × log2(n) levels; at TPU's per-op launch floor that costs
+    milliseconds, while cumsum/cummax lower to single reduce-window
+    ops.)"""
+    idx = jnp.arange(starts.shape[0], dtype=jnp.int32)
+    return jax.lax.cummax(jnp.where(starts, idx, 0))
+
+
+def segmented_sum(values: jnp.ndarray, start_pos: jnp.ndarray) -> jnp.ndarray:
+    """Inclusive segmented running sum; the value at each segment's END
+    is the segment total.  cumsum + gather-of-prefix — 4 ops total."""
+    c = jnp.cumsum(values, axis=0, dtype=values.dtype)
+    prev = jnp.maximum(start_pos - 1, 0)
+    base = jnp.where(start_pos > 0, c[prev], jnp.zeros((), values.dtype))
+    return c - base
+
+
+def segmented_minmax_at_ends(sort_key: jnp.ndarray, values: jnp.ndarray,
+                             start_pos: jnp.ndarray, mode: str):
+    """Per-segment min AND max of ``values``, both available at every
+    row of the segment (in particular its END, where the representative
+    row lives).
+
+    One secondary sort by (segment key, value): the segment's min lands
+    on its start row and its max on its end row.  ``mode`` selects
+    which to return ("min" | "max" | "both")."""
+    n = values.shape[0]
+    _, sorted_v = jax.lax.sort((sort_key, values), num_keys=2)
+    mn = sorted_v[start_pos]          # value at segment start = min
+    mx = sorted_v                     # value at own row; at END = max
+    if mode == "min":
+        return mn
+    if mode == "max":
+        return mx
+    return mn, mx

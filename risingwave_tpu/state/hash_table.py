@@ -233,8 +233,13 @@ class HashTable:
             jnp.zeros((cap,), jnp.int32),
             jnp.int32(0),
         )
+        # the first probe round is unrolled into the enclosing program:
+        # at sane load factors most rows resolve immediately, and a
+        # while_loop iteration carries fixed launch overhead (~0.5ms on
+        # the dev chip) that the common case should not pay
+        carry = body(init)
         occupied, key_store, slots, done, inserted, _, _ = jax.lax.while_loop(
-            cond, body, init
+            cond, body, carry
         )
         overflow = ~done
         found = valid & done & ~inserted & (slots < size)

@@ -44,6 +44,8 @@ from risingwave_tpu.common.chunk import (
     OP_UPDATE_INSERT,
     StrCol,
 )
+from risingwave_tpu.common.compact import mask_indices
+from risingwave_tpu.common.hash import hash64_columns
 from risingwave_tpu.common.types import Field, Schema
 from risingwave_tpu.expr.node import Expr
 from risingwave_tpu.expr.agg import AggCall
@@ -185,14 +187,58 @@ class HashAggExecutor(Executor):
 
     # ------------------------------------------------------------------
     def apply(self, state: AggState, chunk: Chunk):
+        """Chunk-local pre-aggregation, then one sparse scatter per prim.
+
+        TPU scatters serialize over LIVE updates (~0.25µs/row), so a
+        full-chunk scatter costs milliseconds while sort + segmented
+        scan cost ~20µs.  The chunk is sorted by key hash, adjacent
+        equal keys form segments, each primitive contribution is
+        segment-reduced, and only each segment's END row (its
+        "representative") probes the table and scatters — O(distinct
+        keys) serialized work instead of O(chunk)."""
+        from risingwave_tpu.common.compact import (
+            segment_start_positions,
+            segmented_minmax_at_ends,
+            segmented_sum,
+        )
+        from risingwave_tpu.state.hash_table import _gather_key, _keys_equal
+
         key_cols = [e.eval(chunk) for _, e in self.group_by]
         signs = chunk.signs()
         valid = chunk.valid
-        table, slots, inserted, overflow = state.table.lookup_or_insert(
-            key_cols, valid
+        cap = valid.shape[0]
+
+        h = hash64_columns(key_cols)
+        # invalid rows sort to the very end under the all-ones key; keep
+        # valid hashes strictly below it so no valid row lands there
+        h = jnp.where(h == ~jnp.uint64(0), ~jnp.uint64(1), h)
+        sort_key = jnp.where(valid, h, ~jnp.uint64(0))
+        s_h, perm = jax.lax.sort_key_val(
+            sort_key, jnp.arange(cap, dtype=jnp.int32)
         )
-        # overflowed rows are dropped from slots (sentinel) — count them
-        n_over = jnp.sum((overflow & valid).astype(jnp.int64))
+        s_valid = valid[perm]
+        s_signs = signs[perm]
+        s_keys = [_gather_key(c, perm) for c in key_cols]
+        # segment boundary: hash differs OR any key column differs
+        # (hash collisions between distinct keys stay distinct segments)
+        neq = s_h[1:] != s_h[:-1]
+        for c in s_keys:
+            neq = neq | ~_keys_equal(_gather_key(c, jnp.arange(1, cap)),
+                                     _gather_key(c, jnp.arange(0, cap - 1)))
+        starts = jnp.concatenate([jnp.ones((1,), jnp.bool_), neq])
+        ends = jnp.concatenate([neq, jnp.ones((1,), jnp.bool_)])
+        rep = ends & s_valid
+        start_pos = segment_start_positions(starts)
+        # unique, monotone segment id (hash-collision-split segments of
+        # equal s_h must not merge in the min/max secondary sort)
+        seg_id = jnp.cumsum(starts.astype(jnp.int32))
+        seg_rows = segmented_sum(s_valid.astype(jnp.int64), start_pos)
+
+        table, slots, inserted, overflow = state.table.lookup_or_insert(
+            s_keys, rep
+        )
+        # overflowed representatives drop their whole segment — count rows
+        n_over = jnp.sum(jnp.where(rep & overflow, seg_rows, 0))
         # freshly claimed slots may be reclaimed after state cleaning —
         # reset their (stale) primitive state before applying updates
         ins_pos = jnp.where(inserted, slots, jnp.int32(self.table_size))
@@ -211,18 +257,29 @@ class HashAggExecutor(Executor):
             prims[pi] = prims[pi].at[ins_pos].set(
                 ps.init(st_dt), mode="drop"
             )
-            contrib = ps.lift(col, signs)
+            # per-row lift in sorted order, then segment-reduce: the
+            # value at each segment END is the whole segment's update
+            contrib = ps.lift(
+                col[perm] if not isinstance(col, StrCol)
+                else _gather_key(col, perm),
+                s_signs,
+            )
             if ps.mode == "add":
-                # invalid rows have sign 0 ⇒ contribute nothing
-                prims[pi] = prims[pi].at[slots].add(contrib, mode="drop")
-            elif ps.mode == "min":
-                prims[pi] = prims[pi].at[slots].min(contrib, mode="drop")
+                seg = segmented_sum(contrib, start_pos)
             else:
-                prims[pi] = prims[pi].at[slots].max(contrib, mode="drop")
+                seg = segmented_minmax_at_ends(
+                    seg_id, contrib, start_pos, ps.mode
+                )
+            # non-representative rows carry sentinel slots (dropped)
+            if ps.mode == "add":
+                prims[pi] = prims[pi].at[slots].add(seg, mode="drop")
+            elif ps.mode == "min":
+                prims[pi] = prims[pi].at[slots].min(seg, mode="drop")
+            else:
+                prims[pi] = prims[pi].at[slots].max(seg, mode="drop")
+        seg_signs = segmented_sum(s_signs.astype(jnp.int64), start_pos)
         row_count = state.row_count.at[ins_pos].set(0, mode="drop")
-        row_count = row_count.at[slots].add(
-            signs.astype(jnp.int64), mode="drop"
-        )
+        row_count = row_count.at[slots].add(seg_signs, mode="drop")
         dirty = state.dirty.at[slots].set(True, mode="drop")
         n_bad = jnp.zeros((), jnp.int64)
         if any(not a.spec().retractable for a in self.aggs):
@@ -261,7 +318,7 @@ class HashAggExecutor(Executor):
             return self._flush_eowc(state)
         cap = self.emit_capacity
         size = self.table_size
-        (slots,) = jnp.nonzero(state.dirty, size=cap, fill_value=size)
+        slots = mask_indices(state.dirty, cap, size)
         slot_live = slots < size
         safe = jnp.minimum(slots, size - 1)
 
@@ -326,7 +383,7 @@ class HashAggExecutor(Executor):
         cap = self.emit_capacity
         size = self.table_size
         closed = self._closed_mask(state)
-        (slots,) = jnp.nonzero(closed, size=cap, fill_value=size)
+        slots = mask_indices(closed, cap, size)
         slot_live = slots < size
         safe = jnp.minimum(slots, size - 1)
         live = slot_live & (state.row_count[safe] > 0)

@@ -37,6 +37,7 @@ from risingwave_tpu.common.chunk import (
     StrCol,
     decode_strings,
 )
+from risingwave_tpu.common.compact import mask_indices
 from risingwave_tpu.common.types import Schema
 from risingwave_tpu.state.hash_table import HashTable
 from risingwave_tpu.stream.executor import Executor
@@ -205,7 +206,7 @@ class AppendOnlyMaterialize(Executor):
     def apply(self, state: RingState, chunk: Chunk):
         cap = chunk.capacity
         # compact visible rows to the front (fixed-size nonzero)
-        (idx,) = jnp.nonzero(chunk.valid, size=cap, fill_value=cap)
+        idx = mask_indices(chunk.valid, cap, cap)
         n = chunk.cardinality().astype(jnp.int64)
         k = jnp.arange(cap, dtype=jnp.int64)
         pos = ((state.cursor + k) % self.ring_size).astype(jnp.int32)

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from risingwave_tpu.common.chunk import Chunk, StrCol, decode_strings
+from risingwave_tpu.common.compact import mask_indices
 from risingwave_tpu.common.types import DataType, Schema
 from risingwave_tpu.stream.executor import Executor
 from risingwave_tpu.stream.materialize import _empty_value_col, _scatter_col
@@ -60,7 +61,7 @@ class SinkExecutor(Executor):
 
     def apply(self, state: SinkState, chunk: Chunk):
         cap = chunk.capacity
-        (idx,) = jnp.nonzero(chunk.valid, size=cap, fill_value=cap)
+        idx = mask_indices(chunk.valid, cap, cap)
         n = chunk.cardinality().astype(jnp.int64)
         k = jnp.arange(cap, dtype=jnp.int64)
         pos = ((state.cursor + k) % self.ring_size).astype(jnp.int32)

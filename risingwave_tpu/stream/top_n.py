@@ -39,6 +39,7 @@ from risingwave_tpu.common.chunk import (
     OP_INSERT,
     StrCol,
 )
+from risingwave_tpu.common.compact import mask_indices
 from risingwave_tpu.common.hash import hash64_columns
 from risingwave_tpu.common.types import Schema
 from risingwave_tpu.expr.node import Expr
@@ -313,7 +314,7 @@ class GroupTopNExecutor(Executor):
         S, E = self.pool_size, self.emit_capacity
         band = self._band_mask(state)
         # compact current band to [E]
-        (cur_idx,) = jnp.nonzero(band, size=E, fill_value=S)
+        cur_idx = mask_indices(band, E, S)
         cur_live = cur_idx < S
         safe = jnp.minimum(cur_idx, S - 1)
         cur_rows = tuple(_gather(c, safe) for c in state.rows)
