@@ -54,7 +54,48 @@ class StrCol(NamedTuple):
     lens: jnp.ndarray  # [cap] int32
 
 
+class NCol(NamedTuple):
+    """A nullable column: payload + per-row null mask (True = NULL).
+
+    The reference gives EVERY array a null bitmap
+    (src/common/src/array/mod.rs:279); here nullability is static per
+    column — columns that cannot hold NULLs stay bare arrays/StrCols and
+    compile to exactly the pre-null programs.  ``data`` at null rows is
+    unspecified (kernels mask it out)."""
+
+    data: Any          # [cap] array or StrCol
+    null: jnp.ndarray  # bool [cap], True = NULL
+
+
+def split_col(col):
+    """(payload, null-mask-or-None) view of any column value."""
+    if isinstance(col, NCol):
+        return col.data, col.null
+    return col, None
+
+
+def make_col(data, null):
+    """Wrap payload + optional mask back into a column value."""
+    if null is None:
+        return data
+    return NCol(data, null)
+
+
+def conform_col(col, nullable: bool, cap: int):
+    """Make a column's runtime representation match its STATIC field
+    nullability (state tables fix their pytree structure at creation,
+    so a nullable field must always arrive as an NCol)."""
+    if nullable and not isinstance(col, NCol):
+        return NCol(col, jnp.zeros((cap,), jnp.bool_))
+    if not nullable and isinstance(col, NCol):
+        # statically non-nullable: the mask is provably all-false
+        return col.data
+    return col
+
+
 def _leaf_shape_cap(col) -> int:
+    if isinstance(col, NCol):
+        col = col.data
     return (col.data if isinstance(col, StrCol) else col).shape[0]
 
 
@@ -215,18 +256,25 @@ class Chunk:
                 raise ValueError(f"row {ln!r} arity != {len(fields)}")
             rows.append(parts[1:])
         arrays: list[np.ndarray] = []
+        final_fields = list(fields)
         for ci, f in enumerate(fields):
             raw = [r[ci] for r in rows]
-            arrays.append(_parse_pretty_col(f, raw))
+            arr = _parse_pretty_col(f, raw)
+            if arr.dtype == object and any(v is None for v in arr):
+                final_fields[ci] = f.with_nullable()
+            arrays.append(arr)
         return Chunk.from_numpy(
-            schema, arrays, np.asarray(ops_l, np.int8), capacity=capacity
+            Schema(tuple(final_fields)), arrays,
+            np.asarray(ops_l, np.int8), capacity=capacity,
         )
 
     def to_pretty(self) -> str:
         ops, cols, _ = self.to_host()
         out = []
         for i in range(len(ops)):
-            vals = " ".join(str(c[i]) for c in cols)
+            vals = " ".join(
+                "." if c[i] is None else str(c[i]) for c in cols
+            )
             out.append(f"{_OP_PRETTY[int(ops[i])]:>2} {vals}")
         return "\n".join(out)
 
@@ -252,16 +300,25 @@ _PRETTY_TYPES = {
 
 
 def _parse_pretty_col(f: Field, raw: list[str]) -> np.ndarray:
+    """Parse one pretty-DSL column; ``.`` (ref from_pretty) or ``NULL``
+    denote SQL NULL and yield an object array with None entries."""
     t = f.data_type
-    if t.is_string:
-        return np.asarray(raw, object)
-    if t == DataType.BOOLEAN:
-        return np.asarray([v in ("t", "true", "1") for v in raw])
-    if t == DataType.DECIMAL:
-        return np.asarray([float(v) for v in raw])
-    if t in (DataType.FLOAT32, DataType.FLOAT64):
-        return np.asarray([float(v) for v in raw])
-    return np.asarray([int(v) for v in raw])
+
+    def scalar(v: str):
+        if v == "." or v.lower() == "null":
+            return None
+        if t.is_string:
+            return v
+        if t == DataType.BOOLEAN:
+            return v in ("t", "true", "1")
+        if t == DataType.DECIMAL or t in (DataType.FLOAT32, DataType.FLOAT64):
+            return float(v)
+        return int(v)
+
+    vals = [scalar(v) for v in raw]
+    if any(v is None for v in vals) or t.is_string:
+        return np.asarray(vals, object)
+    return np.asarray(vals)
 
 
 def encode_strings(values: Sequence, width: int) -> tuple[np.ndarray, np.ndarray]:
@@ -277,6 +334,15 @@ def encode_strings(values: Sequence, width: int) -> tuple[np.ndarray, np.ndarray
     return data, lens
 
 
+def apply_null_mask(out: np.ndarray, nulls: np.ndarray | None) -> np.ndarray:
+    """Replace masked entries of a decoded host column with None."""
+    if nulls is None or not nulls.any():
+        return out
+    out = np.asarray(list(out), object)
+    out[nulls] = None
+    return out
+
+
 def decode_strings(data: np.ndarray, lens: np.ndarray) -> np.ndarray:
     out = np.empty(len(lens), object)
     for i in range(len(lens)):
@@ -286,34 +352,65 @@ def decode_strings(data: np.ndarray, lens: np.ndarray) -> np.ndarray:
 
 def _encode_column(f: Field, arr: np.ndarray, cap: int):
     t = f.data_type
+    # None entries (SQL NULL) in object arrays become an NCol mask
+    null_mask = None
+    if arr.dtype == object:
+        nulls = np.asarray([v is None for v in arr], np.bool_)
+        if nulls.any():
+            if not f.nullable:
+                raise ValueError(
+                    f"NULL value for NOT NULL column {f.name!r} "
+                    "(declare the column `NULL` to allow NULLs)"
+                )
+            null_mask = np.zeros(cap, np.bool_)
+            null_mask[: len(arr)] = nulls
+            fill = "" if t.is_string else 0
+            repl = [fill if v is None else v for v in arr]
+            arr = np.asarray(repl, object) if t.is_string \
+                else np.asarray(repl)
+        elif not t.is_string:
+            arr = np.asarray(list(arr))
     if t.is_string:
         data, lens = encode_strings(list(arr), f.str_width)
         full = np.zeros((cap, f.str_width), np.uint8)
         full[: len(arr)] = data
         lfull = np.zeros(cap, np.int32)
         lfull[: len(arr)] = lens
-        return StrCol(jnp.asarray(full), jnp.asarray(lfull))
-    dtype = np.dtype(t.physical_dtype)
-    if t == DataType.DECIMAL:
-        # inputs are logical values; the device representation is scaled int64
-        arr = np.round(arr.astype(np.float64) * 10**f.decimal_scale).astype(np.int64)
-    full = np.zeros(cap, dtype)
-    full[: len(arr)] = arr.astype(dtype)
-    return jnp.asarray(full)
+        col = StrCol(jnp.asarray(full), jnp.asarray(lfull))
+    else:
+        dtype = np.dtype(t.physical_dtype)
+        if t == DataType.DECIMAL:
+            # logical values; device representation is scaled int64
+            arr = np.round(
+                arr.astype(np.float64) * 10**f.decimal_scale
+            ).astype(np.int64)
+        full = np.zeros(cap, dtype)
+        full[: len(arr)] = arr.astype(dtype)
+        col = jnp.asarray(full)
+    if null_mask is not None or f.nullable:
+        mask = null_mask if null_mask is not None else np.zeros(cap, np.bool_)
+        return NCol(col, jnp.asarray(mask))
+    return col
 
 
 def _decode_column(f: Field, col, valid: np.ndarray) -> np.ndarray:
     t = f.data_type
+    col, null = split_col(col)
     if isinstance(col, StrCol):
         data = np.asarray(col.data)[valid]
         lens = np.asarray(col.lens)[valid]
-        return decode_strings(data, lens)
-    arr = np.asarray(col)[valid]
-    if t == DataType.DECIMAL:
-        return arr.astype(np.float64) / 10**f.decimal_scale
-    if t == DataType.BOOLEAN:
-        return arr.astype(bool)
-    return arr
+        out = decode_strings(data, lens)
+    else:
+        arr = np.asarray(col)[valid]
+        if t == DataType.DECIMAL:
+            out = arr.astype(np.float64) / 10**f.decimal_scale
+        elif t == DataType.BOOLEAN:
+            out = arr.astype(bool)
+        else:
+            out = arr
+    if null is not None:
+        out = apply_null_mask(out, np.asarray(null)[valid])
+    return out
 
 
 def concat_chunks(chunks: Sequence[Chunk], capacity: int) -> list[Chunk]:

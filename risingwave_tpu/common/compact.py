@@ -50,21 +50,18 @@ def segmented_sum(values: jnp.ndarray, start_pos: jnp.ndarray) -> jnp.ndarray:
     return c - base
 
 
-def segmented_minmax_at_ends(sort_key: jnp.ndarray, values: jnp.ndarray,
+def segmented_minmax_at_ends(seg_id: jnp.ndarray, values: jnp.ndarray,
                              start_pos: jnp.ndarray, mode: str):
-    """Per-segment min AND max of ``values``, both available at every
-    row of the segment (in particular its END, where the representative
-    row lives).
+    """Per-segment min or max of ``values``, available at every row of
+    the segment (in particular its END, where the representative row
+    lives).
 
-    One secondary sort by (segment key, value): the segment's min lands
+    One secondary sort by (segment id, value): the segment's min lands
     on its start row and its max on its end row.  ``mode`` selects
-    which to return ("min" | "max" | "both")."""
-    n = values.shape[0]
-    _, sorted_v = jax.lax.sort((sort_key, values), num_keys=2)
-    mn = sorted_v[start_pos]          # value at segment start = min
-    mx = sorted_v                     # value at own row; at END = max
+    which to return ("min" | "max")."""
+    _, sorted_v = jax.lax.sort((seg_id, values), num_keys=2)
     if mode == "min":
-        return mn
+        return sorted_v[start_pos]    # value at segment start = min
     if mode == "max":
-        return mx
-    return mn, mx
+        return sorted_v               # value at own row; at END = max
+    raise ValueError(mode)

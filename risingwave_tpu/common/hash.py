@@ -27,7 +27,26 @@ from typing import Sequence
 import jax.numpy as jnp
 import numpy as np
 
-from risingwave_tpu.common.chunk import StrCol
+from risingwave_tpu.common.chunk import NCol, StrCol
+
+def normalize_null_col(col) -> list:
+    """Flatten a possibly-nullable column into hashable plain columns.
+
+    An ``NCol`` becomes [payload-with-nulls-zeroed, null-flag]: equal
+    values (including NULL==NULL, the *grouping* equality the reference
+    uses for GROUP BY/DISTINCT keys) produce equal words, regardless of
+    whatever garbage the payload held at null rows."""
+    if not isinstance(col, NCol):
+        return [col]
+    data, null = col.data, col.null
+    if isinstance(data, StrCol):
+        zeroed = StrCol(
+            jnp.where(null[:, None], jnp.uint8(0), data.data),
+            jnp.where(null, 0, data.lens),
+        )
+    else:
+        zeroed = jnp.where(null, jnp.zeros((), data.dtype), data)
+    return [zeroed, null]
 
 #: Default number of virtual nodes (ref vnode.rs:62 COUNT_FOR_COMPAT).
 VNODE_COUNT = 256
@@ -142,31 +161,42 @@ def hash64_columns(columns: Sequence, seed: int = 0) -> jnp.ndarray:
 
     Used for open-addressing state-table slot selection (the analog of
     the reference's ``HashKey`` + hasher in hash_join/hash_agg).
+
+    The all-ones value is never returned (remapped to ~1): callers use
+    ~0 as an "invalid row" sort sentinel, and the remap here keeps that
+    convention consistent between chunk pre-aggregation sorts and the
+    hash table's own probe hashing.
     """
     state = None
-    for col in columns:
-        if isinstance(col, StrCol):
-            cap, width = col.data.shape
-            if state is None:
-                state = jnp.full((cap,), np.uint64(seed) ^ _MIX_K1, jnp.uint64)
-            # fold 8-byte words; bytes at/after lens are masked to zero so
-            # slot reuse with stale padding can never split equal strings
-            words = width // 8 + (1 if width % 8 else 0)
-            padded = jnp.pad(col.data, ((0, 0), (0, words * 8 - width)))
-            byte_idx = jnp.arange(words * 8, dtype=jnp.int32)
-            masked = jnp.where(byte_idx[None, :] < col.lens[:, None], padded, 0)
-            w64 = masked.reshape(cap, words, 8).astype(jnp.uint64)
-            shifts = (np.arange(8, dtype=np.uint64) * 8)
-            folded = jnp.sum(w64 << shifts[None, None, :], axis=-1, dtype=jnp.uint64)
-            for k in range(words):
-                state = _mix64(state ^ folded[:, k] * _MIX_K1)
-            state = _mix64(state ^ col.lens.astype(jnp.uint64))
-        else:
-            for w in _key_words(col):
-                u = w.astype(jnp.uint64)
-                if state is None:
-                    state = jnp.full(u.shape, np.uint64(seed) ^ _MIX_K1, jnp.uint64)
-                state = _mix64(state ^ u * _MIX_K1)
+    for raw in columns:
+        for col in normalize_null_col(raw):
+            state = _hash64_one(col, state, seed)
     if state is None:
         raise ValueError("no key columns")
+    return jnp.where(state == ~np.uint64(0), ~np.uint64(1), state)
+
+
+def _hash64_one(col, state, seed):
+    if isinstance(col, StrCol):
+        cap, width = col.data.shape
+        if state is None:
+            state = jnp.full((cap,), np.uint64(seed) ^ _MIX_K1, jnp.uint64)
+        # fold 8-byte words; bytes at/after lens are masked to zero so
+        # slot reuse with stale padding can never split equal strings
+        words = width // 8 + (1 if width % 8 else 0)
+        padded = jnp.pad(col.data, ((0, 0), (0, words * 8 - width)))
+        byte_idx = jnp.arange(words * 8, dtype=jnp.int32)
+        masked = jnp.where(byte_idx[None, :] < col.lens[:, None], padded, 0)
+        w64 = masked.reshape(cap, words, 8).astype(jnp.uint64)
+        shifts = (np.arange(8, dtype=np.uint64) * 8)
+        folded = jnp.sum(w64 << shifts[None, None, :], axis=-1, dtype=jnp.uint64)
+        for k in range(words):
+            state = _mix64(state ^ folded[:, k] * _MIX_K1)
+        state = _mix64(state ^ col.lens.astype(jnp.uint64))
+    else:
+        for w in _key_words(col):
+            u = w.astype(jnp.uint64)
+            if state is None:
+                state = jnp.full(u.shape, np.uint64(seed) ^ _MIX_K1, jnp.uint64)
+            state = _mix64(state ^ u * _MIX_K1)
     return state

@@ -159,9 +159,18 @@ class Field:
     str_width: int = DEFAULT_STR_WIDTH
     #: power-of-ten scale for DECIMAL columns
     decimal_scale: int = DEFAULT_DECIMAL_SCALE
+    #: column may contain NULLs (ref: every reference array carries a
+    #: null bitmap, src/common/src/array/mod.rs:279; here nullability is
+    #: STATIC per column so non-nullable plans compile with zero masks)
+    nullable: bool = False
+
+    def with_nullable(self, nullable: bool = True) -> "Field":
+        from dataclasses import replace
+        return replace(self, nullable=nullable)
 
     def __repr__(self) -> str:  # compact for plan display
-        return f"{self.name}:{self.data_type.name.lower()}"
+        mark = "?" if self.nullable else ""
+        return f"{self.name}:{self.data_type.name.lower()}{mark}"
 
 
 @dataclass(frozen=True)

@@ -185,11 +185,18 @@ class AggCall:
         if self.arg is None:
             in_t = None
             scale = 6
+            nullable = False
         else:
             f = self.arg.return_field(input_schema)
             in_t, scale = f.data_type, f.decimal_scale
+            # sum/min/max/avg over a nullable argument are NULL when
+            # every argument row in the group is NULL; count never is
+            nullable = f.nullable and self.kind not in (
+                "count", "count_star"
+            )
         t = spec.return_type(in_t)
-        return Field(self.alias or self.kind, t, decimal_scale=scale)
+        return Field(self.alias or self.kind, t, decimal_scale=scale,
+                     nullable=nullable)
 
 
 def count_star(alias: str = "count") -> AggCall:

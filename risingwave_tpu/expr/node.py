@@ -145,11 +145,22 @@ class Literal(Expr):
     data_type: DataType
 
     def return_field(self, schema: Schema) -> Field:
-        return Field("?const", self.data_type)
+        return Field("?const", self.data_type, nullable=self.value is None)
 
     def eval(self, chunk: Chunk):
         cap = chunk.capacity
         t = self.data_type
+        if self.value is None:
+            # NULL literal: zero payload, all-null mask
+            from risingwave_tpu.common.chunk import NCol
+            if t.is_string:
+                data = StrCol(
+                    jnp.zeros((cap, DEFAULT_STR_WIDTH), jnp.uint8),
+                    jnp.zeros((cap,), jnp.int32),
+                )
+            else:
+                data = jnp.zeros((cap,), t.physical_dtype)
+            return NCol(data, jnp.ones((cap,), jnp.bool_))
         if t.is_string:
             data, lens = encode_strings([self.value], DEFAULT_STR_WIDTH)
             return StrCol(

@@ -96,11 +96,39 @@ class FuncSig:
     impl: Callable
     #: impl declares a trailing ``fields`` kwarg for logical-type context
     takes_fields: bool = False
+    #: impl handles NULL masks itself (receives NCol args as-is):
+    #: Kleene AND/OR, IS NULL, COALESCE, CASE
+    null_aware: bool = False
+    #: result can never be NULL regardless of inputs (IS NULL, count)
+    never_null: bool = False
 
     def call(self, cols: Sequence, arg_fields: Sequence[Field]):
+        """Evaluate with SQL null semantics.
+
+        Strict functions (the default, matching the reference's
+        #[function] strictness) see only payloads; the result's null
+        mask is the OR of the argument masks — one fused ``where``-free
+        mask op, so non-nullable plans pay nothing."""
+        from risingwave_tpu.common.chunk import make_col, split_col
+
+        if self.null_aware:
+            if self.takes_fields:
+                return self.impl(*cols, fields=list(arg_fields))
+            return self.impl(*cols)
+        datas = []
+        null = None
+        for c in cols:
+            d, n = split_col(c)
+            datas.append(d)
+            if n is not None:
+                null = n if null is None else (null | n)
         if self.takes_fields:
-            return self.impl(*cols, fields=list(arg_fields))
-        return self.impl(*cols)
+            out = self.impl(*datas, fields=list(arg_fields))
+        else:
+            out = self.impl(*datas)
+        if self.never_null:
+            return out
+        return make_col(out, null)
 
     def matches(self, arg_fields: Sequence[Field]) -> int:
         """Score the match: -1 no match; higher = more specific."""
@@ -114,6 +142,14 @@ class FuncSig:
         return score
 
     def return_field(self, arg_fields: Sequence[Field]) -> Field:
+        base = self._base_return_field(arg_fields)
+        if self.never_null:
+            return base.with_nullable(False) if base.nullable else base
+        if any(f.nullable for f in arg_fields) and not base.nullable:
+            return base.with_nullable()
+        return base
+
+    def _base_return_field(self, arg_fields: Sequence[Field]) -> Field:
         if self.ret == "same":
             return Field("?expr", arg_fields[0].data_type,
                          str_width=arg_fields[0].str_width,
@@ -150,7 +186,9 @@ class _Registry:
     def __init__(self):
         self._by_name: dict[str, list[FuncSig]] = {}
 
-    def register(self, spec: str, impl: Callable) -> FuncSig:
+    def register(self, spec: str, impl: Callable,
+                 null_aware: bool = False,
+                 never_null: bool = False) -> FuncSig:
         m = _SIG_RE.match(spec)
         if not m:
             raise ValueError(f"bad signature {spec!r}")
@@ -159,7 +197,8 @@ class _Registry:
             _parse_type(tok) for tok in args.split(",") if tok.strip()
         )
         takes_fields = "fields" in inspect.signature(impl).parameters
-        sig = FuncSig(name, matchers, ret.strip().lower(), impl, takes_fields)
+        sig = FuncSig(name, matchers, ret.strip().lower(), impl,
+                      takes_fields, null_aware, never_null)
         self._by_name.setdefault(name, []).append(sig)
         return sig
 
@@ -188,11 +227,14 @@ class _Registry:
 FUNCTION_REGISTRY = _Registry()
 
 
-def function(spec: str):
-    """Decorator mirroring the reference's ``#[function(...)]`` macro."""
+def function(spec: str, null_aware: bool = False, never_null: bool = False):
+    """Decorator mirroring the reference's ``#[function(...)]`` macro.
+
+    ``null_aware`` impls receive NCol arguments and own their null
+    semantics; ``never_null`` marks results that cannot be NULL."""
 
     def deco(fn: Callable) -> Callable:
-        FUNCTION_REGISTRY.register(spec, fn)
+        FUNCTION_REGISTRY.register(spec, fn, null_aware, never_null)
         return fn
 
     return deco

@@ -16,7 +16,7 @@ from typing import Sequence
 import jax.numpy as jnp
 import numpy as np
 
-from risingwave_tpu.common.chunk import StrCol
+from risingwave_tpu.common.chunk import NCol, StrCol, make_col, split_col
 from risingwave_tpu.common.types import (
     DEFAULT_DECIMAL_SCALE,
     DataType,
@@ -243,14 +243,37 @@ _make_cmp("greater_than_or_equal", lambda a, b: a >= b, "ge")
 # logical
 
 
-@function("and(boolean, boolean) -> boolean")
+@function("and(boolean, boolean) -> boolean", null_aware=True)
 def _and(a, b):
-    return a & b
+    """Kleene AND: FALSE dominates NULL (ref three-valued logic)."""
+    ad, an = split_col(a)
+    bd, bn = split_col(b)
+    if an is None and bn is None:
+        return ad & bd
+    a_known_false = (~ad) & (~an if an is not None else True)
+    b_known_false = (~bd) & (~bn if bn is not None else True)
+    some_null = (an if an is not None else False) | (
+        bn if bn is not None else False
+    )
+    # NULL iff neither side is definitively FALSE and either is NULL
+    null = some_null & ~a_known_false & ~b_known_false
+    return NCol(ad & bd & ~null, null)
 
 
-@function("or(boolean, boolean) -> boolean")
+@function("or(boolean, boolean) -> boolean", null_aware=True)
 def _or(a, b):
-    return a | b
+    """Kleene OR: TRUE dominates NULL."""
+    ad, an = split_col(a)
+    bd, bn = split_col(b)
+    if an is None and bn is None:
+        return ad | bd
+    a_known_true = ad & (~an if an is not None else True)
+    b_known_true = bd & (~bn if bn is not None else True)
+    some_null = (an if an is not None else False) | (
+        bn if bn is not None else False
+    )
+    null = some_null & ~a_known_true & ~b_known_true
+    return NCol((a_known_true | b_known_true) & ~null, null)
 
 
 @function("not(boolean) -> boolean")
@@ -258,20 +281,78 @@ def _not(a):
     return ~a
 
 
-@function("case(boolean, any, any) -> same_branch")  # CASE WHEN c THEN t ELSE e
-def _case(c, t, e, fields: Sequence[Field]):
-    if isinstance(t, StrCol):
-        w = max(t.data.shape[1], e.data.shape[1])
-        td = jnp.pad(t.data, ((0, 0), (0, w - t.data.shape[1])))
-        ed = jnp.pad(e.data, ((0, 0), (0, w - e.data.shape[1])))
-        return StrCol(
-            jnp.where(c[:, None], td, ed), jnp.where(c, t.lens, e.lens)
+@function("is_null(any) -> boolean", null_aware=True, never_null=True)
+def _is_null(a):
+    d, n = split_col(a)
+    if n is None:
+        ref = d.lens if isinstance(d, StrCol) else d
+        return jnp.zeros(ref.shape[:1], jnp.bool_)
+    return n
+
+
+@function("is_not_null(any) -> boolean", null_aware=True, never_null=True)
+def _is_not_null(a):
+    d, n = split_col(a)
+    if n is None:
+        ref = d.lens if isinstance(d, StrCol) else d
+        return jnp.ones(ref.shape[:1], jnp.bool_)
+    return ~n
+
+
+@function("coalesce(any, any) -> same", null_aware=True)
+def _coalesce(a, b):
+    ad, an = split_col(a)
+    bd, bn = split_col(b)
+    if an is None:
+        return a
+    if isinstance(ad, StrCol):
+        w = max(ad.data.shape[1], bd.data.shape[1])
+        add = jnp.pad(ad.data, ((0, 0), (0, w - ad.data.shape[1])))
+        bdd = jnp.pad(bd.data, ((0, 0), (0, w - bd.data.shape[1])))
+        data = StrCol(
+            jnp.where(an[:, None], bdd, add),
+            jnp.where(an, bd.lens, ad.lens),
         )
-    if fields[1].data_type != fields[2].data_type:
-        target = promote_numeric([fields[1].data_type, fields[2].data_type])
-        t = coerce(t, fields[1], target)
-        e = coerce(e, fields[2], target)
-    return jnp.where(c, t, e)
+    else:
+        data = jnp.where(an, bd, ad)
+    null = (an & bn) if bn is not None else None
+    return make_col(data, null)
+
+
+@function("case(boolean, any, any) -> same_branch",
+          null_aware=True)  # CASE WHEN c THEN t ELSE e
+def _case(c, t, e, fields: Sequence[Field]):
+    """NULL condition selects the ELSE branch (SQL: WHEN does not
+    match); branch NULLs flow through to the chosen side."""
+    cd, cn = split_col(c)
+    take_then = cd if cn is None else (cd & ~cn)
+    td, tn = split_col(t)
+    ed, en = split_col(e)
+    if isinstance(td, StrCol):
+        w = max(td.data.shape[1], ed.data.shape[1])
+        tdd = jnp.pad(td.data, ((0, 0), (0, w - td.data.shape[1])))
+        edd = jnp.pad(ed.data, ((0, 0), (0, w - ed.data.shape[1])))
+        data = StrCol(
+            jnp.where(take_then[:, None], tdd, edd),
+            jnp.where(take_then, td.lens, ed.lens),
+        )
+    else:
+        if fields[1].data_type != fields[2].data_type:
+            target = promote_numeric(
+                [fields[1].data_type, fields[2].data_type]
+            )
+            td = coerce(td, fields[1], target)
+            ed = coerce(ed, fields[2], target)
+        data = jnp.where(take_then, td, ed)
+    if tn is None and en is None:
+        return data
+    zeros = jnp.zeros_like(take_then)
+    null = jnp.where(
+        take_then,
+        tn if tn is not None else zeros,
+        en if en is not None else zeros,
+    )
+    return NCol(data, null)
 
 
 # ---------------------------------------------------------------------------

@@ -146,6 +146,10 @@ class Select:
 class ColumnDef:
     name: str
     type_name: str
+    #: columns are NOT NULL by default (deviation from the reference's
+    #: nullable default: keeps the non-null fast path for generated
+    #: sources); declare ``col type NULL`` to opt in
+    nullable: bool = False
 
 
 @dataclass(frozen=True)

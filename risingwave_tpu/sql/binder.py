@@ -76,6 +76,9 @@ class Binder:
                 return ELiteral(e.value, DataType.FLOAT64)
             if e.type_name == "int":
                 return as_expr(e.value)
+            if e.type_name == "null":
+                # untyped NULL defaults to int64; casts/CASE re-type it
+                return ELiteral(None, DataType.INT64)
             raise BindError(f"unsupported literal {e}")
         if isinstance(e, ast.IntervalLit):
             return ELiteral(e.micros, DataType.INTERVAL)
@@ -88,11 +91,13 @@ class Binder:
             return EFuncCall(f"cast_{t.name.lower()}", (self.bind(e.operand),))
         if isinstance(e, ast.Case):
             if e.else_result is None:
-                raise BindError(
-                    "CASE without ELSE yields NULL; NULL columns land "
-                    "with the validity-bitmap round — add an ELSE branch"
-                )
-            out = self.bind(e.else_result)
+                # CASE without ELSE yields NULL (SQL); type follows the
+                # first THEN branch
+                then0 = self.bind(e.conditions[0][1])
+                t = then0.return_field(self.scope.schema).data_type
+                out: Expr = ELiteral(None, t)
+            else:
+                out = self.bind(e.else_result)
             for c, r in reversed(e.conditions):
                 out = EFuncCall("case", (self.bind(c), self.bind(r), out))
             return out
@@ -102,6 +107,17 @@ class Binder:
             if e.name == "like":
                 return self._bind_like(e)
             args = tuple(self.bind(a) for a in e.args)
+            # untyped NULL literals adopt the type of a typed sibling
+            # (COALESCE(x, NULL), CASE branches, IS NULL over NULL...)
+            typed = [a for a in args
+                     if not (isinstance(a, ELiteral) and a.value is None)]
+            if typed and len(typed) != len(args):
+                t_field = typed[0].return_field(self.scope.schema)
+                args = tuple(
+                    ELiteral(None, t_field.data_type)
+                    if isinstance(a, ELiteral) and a.value is None else a
+                    for a in args
+                )
             return EFuncCall(e.name, args)
         raise BindError(f"cannot bind {e!r}")
 

@@ -166,10 +166,13 @@ class Engine:
         schema = entry.schema
         if stmt.columns:
             order = [schema.index_of(c) for c in stmt.columns]
-            if sorted(order) != list(range(len(schema))):
-                raise ValueError(
-                    "INSERT must provide every column this round"
-                )
+            if len(set(order)) != len(order):
+                raise ValueError("INSERT lists a column twice")
+            for i in set(range(len(schema))) - set(order):
+                if not schema[i].nullable:
+                    raise ValueError(
+                        f"INSERT omits NOT NULL column {schema[i].name}"
+                    )
         else:
             order = list(range(len(schema)))
         rows = []
@@ -271,7 +274,8 @@ class Engine:
     def _declared_schema(stmt: ast.CreateSource):
         """(schema, watermark) from a CREATE SOURCE/TABLE statement."""
         schema = Schema(tuple(
-            Field(c.name, DataType.from_sql(c.type_name))
+            Field(c.name, DataType.from_sql(c.type_name),
+                  nullable=c.nullable)
             for c in stmt.columns
         ))
         wm = None
@@ -389,6 +393,11 @@ class Engine:
                 return None
         agg = execs[agg_idx]
         if agg.watermark_group_idx is not None:
+            return None
+        # the two-phase partial agg has no NCol handling yet: nullable
+        # group keys or arguments keep the plan on the linear path
+        if any(f.nullable for f in agg.in_schema) \
+                or any(f.nullable for f in agg.out_schema):
             return None
         n = min(par, len(jax.devices()))
         if n < 2:
@@ -861,6 +870,13 @@ def _coerce_const(v, field: Field):
     time — a bad constant must fail the INSERT, never poison the queue
     for every downstream job."""
     t = field.data_type
+    if v is None:
+        if not field.nullable:
+            raise ValueError(
+                f"NULL value for NOT NULL column {field.name} "
+                "(declare the column `NULL` to allow NULLs)"
+            )
+        return None
     try:
         if t.is_string:
             return str(v)

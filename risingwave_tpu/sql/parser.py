@@ -237,7 +237,14 @@ class Parser:
                     else:
                         cname = self.ident()
                         ctype = self._type_name()
-                        columns.append(ast.ColumnDef(cname, ctype))
+                        nullable = False
+                        if self.accept_word("null"):
+                            nullable = True
+                        elif self.accept_word("not"):
+                            self.expect_word("null")
+                        columns.append(
+                            ast.ColumnDef(cname, ctype, nullable)
+                        )
                     if not self.accept_op(","):
                         break
                 self.expect_op(")")
@@ -503,11 +510,10 @@ class Parser:
                 eq = ast.BinaryOp("equal", left, it)
                 out = eq if out is None else ast.BinaryOp("or", out, eq)
         elif w == "is":
-            self.accept_word("not")
+            neg_is = self.accept_word("not")
             self.expect_word("null")
-            raise ParseError(
-                "IS [NOT] NULL requires NULL columns (validity-bitmap "
-                "round)"
+            out = ast.FuncCall(
+                "is_not_null" if neg_is else "is_null", (left,)
             )
         else:
             raise ParseError(f"unexpected {w}")

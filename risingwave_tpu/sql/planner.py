@@ -243,6 +243,12 @@ class Planner:
         b = Binder(scope)
         partition = [b.bind(e) for e in spec[0]]
         order = [(b.bind(oi.expr), oi.descending) for oi in spec[1]]
+        for e in partition + [oe for oe, _ in order]:
+            if e.return_field(scope.schema).nullable:
+                raise PlanError(
+                    "OVER (...) on nullable partition/order columns: "
+                    "next round"
+                )
         calls = []
         supported = {"row_number", "rank", "dense_rank", "lag", "lead",
                      "sum", "count", "min", "max"}
@@ -321,8 +327,13 @@ class Planner:
             ob = []
             b = Binder(Scope.of(out_schema))
             for oi in select.order_by:
-                ob.append((self._bind_order_key(oi.expr, b, out_schema),
-                           oi.descending))
+                ke = self._bind_order_key(oi.expr, b, out_schema)
+                if ke.return_field(out_schema).nullable:
+                    raise PlanError(
+                        "ORDER BY on a nullable column in TopN "
+                        "(NULLS FIRST/LAST ordering): next round"
+                    )
+                ob.append((ke, oi.descending))
             # append-only up to here ⇒ the TopN can evict non-band rows
             pool = max(self.config.topn_pool_size,
                        2 * self.config.chunk_capacity)

@@ -40,11 +40,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from risingwave_tpu.common.chunk import StrCol
+from risingwave_tpu.common.chunk import NCol, StrCol
 from risingwave_tpu.common.hash import hash64_columns
 
 
 def _gather_key(col, idx):
+    if isinstance(col, NCol):
+        return NCol(_gather_key(col.data, idx), col.null[idx])
     if isinstance(col, StrCol):
         return StrCol(col.data[idx], col.lens[idx])
     return col[idx]
@@ -52,6 +54,11 @@ def _gather_key(col, idx):
 
 def _scatter_key(col, pos, values, size):
     """Write values at pos (mode=drop for sentinel positions)."""
+    if isinstance(col, NCol):
+        return NCol(
+            _scatter_key(col.data, pos, values.data, size),
+            col.null.at[pos].set(values.null, mode="drop"),
+        )
     if isinstance(col, StrCol):
         return StrCol(
             col.data.at[pos].set(values.data, mode="drop"),
@@ -61,10 +68,29 @@ def _scatter_key(col, pos, values, size):
 
 
 def _keys_equal(a, b) -> jnp.ndarray:
-    """Rowwise equality of two same-width key column values."""
+    """Rowwise *grouping* equality of two key column values.
+
+    NULL == NULL here (GROUP BY/DISTINCT semantics, matching the
+    reference's HashKey serde); join executors mask null keys out
+    BEFORE key lookup, so join equality never reaches this."""
+    if isinstance(a, NCol) or isinstance(b, NCol):
+        ad, an = (a.data, a.null) if isinstance(a, NCol) else (a, None)
+        bd, bn = (b.data, b.null) if isinstance(b, NCol) else (b, None)
+        data_eq = _keys_equal(ad, bd)
+        if an is None:
+            an = jnp.zeros_like(bn)
+        if bn is None:
+            bn = jnp.zeros_like(an)
+        return (an & bn) | (~an & ~bn & data_eq)
     if isinstance(a, StrCol):
         return jnp.all(a.data == b.data, axis=-1) & (a.lens == b.lens)
     return a == b
+
+
+# public aliases for executors that pre-sort/compare key columns
+# (chunk pre-aggregation in hash_agg, join bucket paths)
+gather_key = _gather_key
+keys_equal = _keys_equal
 
 
 def permute_dense(arr, moved: jnp.ndarray, init=None):
@@ -74,6 +100,10 @@ def permute_dense(arr, moved: jnp.ndarray, init=None):
     drop sentinel.  ``init`` fills untouched slots (monoid identity for
     min/max states; zero otherwise).
     """
+    if isinstance(arr, NCol):
+        return NCol(
+            permute_dense(arr.data, moved), permute_dense(arr.null, moved)
+        )
     if isinstance(arr, StrCol):
         return StrCol(
             permute_dense(arr.data, moved), permute_dense(arr.lens, moved)
@@ -86,6 +116,11 @@ def permute_dense(arr, moved: jnp.ndarray, init=None):
 
 
 def _empty_key_col(col_proto, size: int):
+    if isinstance(col_proto, NCol):
+        return NCol(
+            _empty_key_col(col_proto.data, size),
+            jnp.zeros((size,), jnp.bool_),
+        )
     if isinstance(col_proto, StrCol):
         return StrCol(
             jnp.zeros((size, col_proto.data.shape[1]), jnp.uint8),
@@ -138,17 +173,25 @@ class HashTable:
         return jnp.sum(self.occupied.astype(jnp.int32))
 
     # ------------------------------------------------------------------
-    def lookup(self, key_cols: Sequence, valid: jnp.ndarray):
+    def lookup(self, key_cols: Sequence, valid: jnp.ndarray,
+               hashes: jnp.ndarray | None = None):
         """Find slots without inserting.
 
         Returns ``(slots int32 [cap], found bool [cap])``; unfound/invalid
         rows get slot == size (a drop sentinel for downstream gathers).
         """
-        table, slots, found, _ = self._probe(key_cols, valid, insert=False)
+        table, slots, found, _ = self._probe(
+            key_cols, valid, insert=False, hashes=hashes
+        )
         return slots, found
 
-    def lookup_or_insert(self, key_cols: Sequence, valid: jnp.ndarray):
+    def lookup_or_insert(self, key_cols: Sequence, valid: jnp.ndarray,
+                         hashes: jnp.ndarray | None = None):
         """Find-or-claim slots for a chunk of keys.
+
+        ``hashes`` optionally supplies precomputed ``hash64_columns``
+        values (callers that already hashed for a pre-aggregation sort
+        avoid a second full-chunk hash pass).
 
         Returns ``(table', slots, inserted, overflow)``:
         - ``slots int32 [cap]`` — resolved slot per row (size if overflow
@@ -156,13 +199,16 @@ class HashTable:
         - ``inserted bool [cap]`` — row claimed a fresh slot;
         - ``overflow bool [cap]`` — table was full for this row.
         """
-        return self._probe(key_cols, valid, insert=True)
+        return self._probe(key_cols, valid, insert=True, hashes=hashes)
 
     # ------------------------------------------------------------------
-    def _probe(self, key_cols: Sequence, valid: jnp.ndarray, insert: bool):
+    def _probe(self, key_cols: Sequence, valid: jnp.ndarray, insert: bool,
+               hashes: jnp.ndarray | None = None):
         size = self.size
         cap = valid.shape[0]
-        h = (hash64_columns(key_cols) % np.uint64(size)).astype(jnp.int32)
+        if hashes is None:
+            hashes = hash64_columns(key_cols)
+        h = (hashes % np.uint64(size)).astype(jnp.int32)
         row_idx = jnp.arange(cap, dtype=jnp.int32)
         sentinel = jnp.int32(size)
 

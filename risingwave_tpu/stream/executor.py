@@ -89,6 +89,7 @@ class ProjectExecutor(Executor):
                     e.return_field(in_schema).data_type,
                     str_width=e.return_field(in_schema).str_width,
                     decimal_scale=e.return_field(in_schema).decimal_scale,
+                    nullable=e.return_field(in_schema).nullable,
                 )
                 for name, e in self.exprs
             )
@@ -99,7 +100,13 @@ class ProjectExecutor(Executor):
         return self._out_schema
 
     def apply(self, state, chunk: Chunk):
-        cols = [e.eval(chunk) for _, e in self.exprs]
+        from risingwave_tpu.common.chunk import conform_col
+        # runtime representation follows the STATIC field nullability so
+        # downstream state pytrees keep a fixed structure
+        cols = [
+            conform_col(e.eval(chunk), f.nullable, chunk.capacity)
+            for (_, e), f in zip(self.exprs, self._out_schema)
+        ]
         return state, chunk.with_columns(cols, self._out_schema)
 
 
@@ -130,11 +137,13 @@ class HopWindowExecutor(Executor):
         return self._out_schema
 
     def apply(self, state, chunk: Chunk):
-        from risingwave_tpu.common.chunk import StrCol
+        from risingwave_tpu.common.chunk import NCol, StrCol
 
         cap, k = chunk.capacity, self.k
 
         def rep(col):
+            if isinstance(col, NCol):
+                return NCol(rep(col.data), rep(col.null))
             if isinstance(col, StrCol):
                 return StrCol(rep(col.data), rep(col.lens))
             return jnp.repeat(col, k, axis=0)
@@ -172,6 +181,10 @@ class FilterExecutor(Executor):
 
     def apply(self, state, chunk: Chunk):
         keep = self.predicate.eval(chunk)
+        from risingwave_tpu.common.chunk import split_col
+        keep, null = split_col(keep)
+        if null is not None:
+            keep = keep & ~null  # SQL WHERE: NULL predicate drops the row
         keep = keep & chunk.valid
         # Update-pair degradation: U- at i pairs with U+ at i+1.
         is_ud = chunk.ops == OP_UPDATE_DELETE

@@ -38,18 +38,27 @@ import numpy as np
 
 from risingwave_tpu.common.chunk import (
     Chunk,
+    NCol,
     OP_DELETE,
     OP_INSERT,
     OP_UPDATE_DELETE,
     OP_UPDATE_INSERT,
     StrCol,
+    conform_col,
+    split_col,
 )
-from risingwave_tpu.common.compact import mask_indices
+from risingwave_tpu.common.compact import (
+    mask_indices,
+    segment_start_positions,
+    segment_starts,
+    segmented_minmax_at_ends,
+    segmented_sum,
+)
 from risingwave_tpu.common.hash import hash64_columns
 from risingwave_tpu.common.types import Field, Schema
 from risingwave_tpu.expr.node import Expr
 from risingwave_tpu.expr.agg import AggCall
-from risingwave_tpu.state.hash_table import HashTable
+from risingwave_tpu.state.hash_table import HashTable, gather_key, keys_equal
 from risingwave_tpu.stream.executor import Executor
 
 
@@ -73,6 +82,10 @@ class AggState(NamedTuple):
 
 def _interleave(old, new):
     """[n] + [n] -> [2n] with old at even, new at odd positions."""
+    if isinstance(old, NCol):
+        return NCol(
+            _interleave(old.data, new.data), _interleave(old.null, new.null)
+        )
     if isinstance(old, StrCol):
         return StrCol(
             _interleave(old.data, new.data), _interleave(old.lens, new.lens)
@@ -123,7 +136,8 @@ class HashAggExecutor(Executor):
         key_fields = tuple(
             Field(name, e.return_field(in_schema).data_type,
                   str_width=e.return_field(in_schema).str_width,
-                  decimal_scale=e.return_field(in_schema).decimal_scale)
+                  decimal_scale=e.return_field(in_schema).decimal_scale,
+                  nullable=e.return_field(in_schema).nullable)
             for name, e in self.group_by
         )
         agg_fields = tuple(a.out_field(in_schema) for a in self.aggs)
@@ -133,6 +147,18 @@ class HashAggExecutor(Executor):
         for ai, a in enumerate(self.aggs):
             for ps in a.spec().states:
                 self._prim_specs.append((ai, ps))
+        # hidden non-null-count prims: an aggregate over a NULLABLE
+        # argument yields SQL NULL when every argument row in the group
+        # is NULL (ref AggregateFunction semantics); count() needs no
+        # helper (its own state IS the non-null count)
+        from risingwave_tpu.expr.agg import _ADD_COUNT
+        self._nn_prim: dict[int, int] = {}
+        for ai, a in enumerate(self.aggs):
+            if a.arg is None or a.kind in ("count", "count_star"):
+                continue
+            if a.arg.return_field(in_schema).nullable:
+                self._nn_prim[ai] = len(self._prim_specs)
+                self._prim_specs.append((ai, _ADD_COUNT))
 
     @property
     def out_schema(self) -> Schema:
@@ -140,17 +166,24 @@ class HashAggExecutor(Executor):
 
     # ------------------------------------------------------------------
     def _key_protos(self):
-        """Zero-row prototypes of the key columns for table creation."""
+        """Zero-row prototypes of the key columns for table creation.
+
+        Nullable group keys store as NCol (payload + null plane): the
+        table's grouping equality treats NULL == NULL, so NULLs form
+        one group like the reference's GROUP BY."""
         protos = []
         for _, e in self.group_by:
             f = e.return_field(self.in_schema)
             if f.data_type.is_string:
-                protos.append(StrCol(
+                p = StrCol(
                     jnp.zeros((1, f.str_width), jnp.uint8),
                     jnp.zeros((1,), jnp.int32),
-                ))
+                )
             else:
-                protos.append(jnp.zeros((1,), f.data_type.physical_dtype))
+                p = jnp.zeros((1,), f.data_type.physical_dtype)
+            if f.nullable:
+                p = NCol(p, jnp.zeros((1,), jnp.bool_))
+            protos.append(p)
         return protos
 
     def _input_dtype(self, agg_idx: int):
@@ -196,36 +229,32 @@ class HashAggExecutor(Executor):
         segment-reduced, and only each segment's END row (its
         "representative") probes the table and scatters — O(distinct
         keys) serialized work instead of O(chunk)."""
-        from risingwave_tpu.common.compact import (
-            segment_start_positions,
-            segmented_minmax_at_ends,
-            segmented_sum,
-        )
-        from risingwave_tpu.state.hash_table import _gather_key, _keys_equal
-
-        key_cols = [e.eval(chunk) for _, e in self.group_by]
         signs = chunk.signs()
         valid = chunk.valid
         cap = valid.shape[0]
+        key_cols = [
+            conform_col(e.eval(chunk),
+                        e.return_field(self.in_schema).nullable, cap)
+            for _, e in self.group_by
+        ]
 
+        # invalid rows sort to the very end under the all-ones sentinel
+        # (hash64_columns never returns ~0, so no valid row lands there)
         h = hash64_columns(key_cols)
-        # invalid rows sort to the very end under the all-ones key; keep
-        # valid hashes strictly below it so no valid row lands there
-        h = jnp.where(h == ~jnp.uint64(0), ~jnp.uint64(1), h)
         sort_key = jnp.where(valid, h, ~jnp.uint64(0))
         s_h, perm = jax.lax.sort_key_val(
             sort_key, jnp.arange(cap, dtype=jnp.int32)
         )
         s_valid = valid[perm]
         s_signs = signs[perm]
-        s_keys = [_gather_key(c, perm) for c in key_cols]
+        s_keys = [gather_key(c, perm) for c in key_cols]
         # segment boundary: hash differs OR any key column differs
         # (hash collisions between distinct keys stay distinct segments)
         neq = s_h[1:] != s_h[:-1]
         for c in s_keys:
-            neq = neq | ~_keys_equal(_gather_key(c, jnp.arange(1, cap)),
-                                     _gather_key(c, jnp.arange(0, cap - 1)))
-        starts = jnp.concatenate([jnp.ones((1,), jnp.bool_), neq])
+            neq = neq | ~keys_equal(gather_key(c, jnp.arange(1, cap)),
+                                    gather_key(c, jnp.arange(0, cap - 1)))
+        starts = segment_starts(neq)
         ends = jnp.concatenate([neq, jnp.ones((1,), jnp.bool_)])
         rep = ends & s_valid
         start_pos = segment_start_positions(starts)
@@ -235,7 +264,7 @@ class HashAggExecutor(Executor):
         seg_rows = segmented_sum(s_valid.astype(jnp.int64), start_pos)
 
         table, slots, inserted, overflow = state.table.lookup_or_insert(
-            s_keys, rep
+            s_keys, rep, hashes=s_h
         )
         # overflowed representatives drop their whole segment — count rows
         n_over = jnp.sum(jnp.where(rep & overflow, seg_rows, 0))
@@ -257,13 +286,20 @@ class HashAggExecutor(Executor):
             prims[pi] = prims[pi].at[ins_pos].set(
                 ps.init(st_dt), mode="drop"
             )
+            # NULL arguments contribute nothing (SQL: aggregates skip
+            # NULLs): zero the sign, which every lift mode maps to its
+            # identity element.  The payload is zeroed too — a NULL
+            # row's payload is unspecified (e.g. inf from x/NULL) and
+            # inf * 0 would poison additive states with NaN.
+            col, col_null = split_col(col)
+            if col_null is not None and not isinstance(col, StrCol):
+                col = jnp.where(col_null, jnp.zeros((), col.dtype), col)
+            prim_signs = s_signs if col_null is None else jnp.where(
+                col_null[perm], 0, s_signs
+            )
             # per-row lift in sorted order, then segment-reduce: the
             # value at each segment END is the whole segment's update
-            contrib = ps.lift(
-                col[perm] if not isinstance(col, StrCol)
-                else _gather_key(col, perm),
-                s_signs,
-            )
+            contrib = ps.lift(gather_key(col, perm), prim_signs)
             if ps.mode == "add":
                 seg = segmented_sum(contrib, start_pos)
             else:
@@ -310,7 +346,12 @@ class HashAggExecutor(Executor):
             st = tuple(prims[pi + k][safe] for k in range(n))
             pi += n
             out_f = self._out_schema[len(self.group_by) + ai]
-            cols.append(spec.output(st, row_count[safe], out_f))
+            out = spec.output(st, row_count[safe], out_f)
+            if ai in self._nn_prim:
+                # all argument rows NULL -> SQL NULL result
+                nn = prims[self._nn_prim[ai]][safe]
+                out = NCol(out, nn == 0)
+            cols.append(out)
         return cols
 
     def flush(self, state: AggState, epoch):
@@ -371,11 +412,15 @@ class HashAggExecutor(Executor):
         ), out
 
     def _closed_mask(self, state: AggState) -> jnp.ndarray:
-        key = state.table.key_cols[self.watermark_group_idx]
+        key, key_null = split_col(
+            state.table.key_cols[self.watermark_group_idx]
+        )
         no_wm = state.wm == np.iinfo(np.int64).min
         closed = state.table.occupied & (
             key + self.watermark_lag <= state.wm
         )
+        if key_null is not None:
+            closed = closed & ~key_null  # a NULL window never closes
         return closed & ~no_wm
 
     def _flush_eowc(self, state: AggState):
@@ -485,8 +530,10 @@ class HashAggExecutor(Executor):
         Watermark-driven state cleaning (ref state_table.rs:223): used by
         windowed aggregations once a window can no longer change.
         """
-        key = state.table.key_cols[key_col_idx]
+        key, key_null = split_col(state.table.key_cols[key_col_idx])
         stale = state.table.occupied & (key < threshold)
+        if key_null is not None:
+            stale = stale & ~key_null  # NULL keys are never below a wm
         table = state.table.clear_where(stale)
         return AggState(
             table=table,

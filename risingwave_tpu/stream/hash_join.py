@@ -40,8 +40,24 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from risingwave_tpu.common.chunk import Chunk, StrCol
+from risingwave_tpu.common.chunk import Chunk, NCol, StrCol, split_col
 from risingwave_tpu.common.hash import hash64_columns
+
+
+def _null_stripped_keys(key_cols):
+    """(bare key cols, any-key-null mask | None).
+
+    SQL join equality: NULL matches nothing (unlike grouping equality),
+    so rows with a NULL key are masked out of both updates and probes
+    and the stored key columns stay bare arrays."""
+    null_any = None
+    bare = []
+    for c in key_cols:
+        d, n = split_col(c)
+        bare.append(d)
+        if n is not None:
+            null_any = n if null_any is None else (null_any | n)
+    return bare, null_any
 from risingwave_tpu.common.types import Field, Schema
 from risingwave_tpu.expr.node import Expr
 from risingwave_tpu.state.hash_table import HashTable
@@ -49,15 +65,21 @@ from risingwave_tpu.state.hash_table import HashTable
 
 def _empty_store(f: Field, size: int, bucket: int):
     if f.data_type.is_string:
-        return StrCol(
+        col = StrCol(
             jnp.zeros((size, bucket, f.str_width), jnp.uint8),
             jnp.zeros((size, bucket), jnp.int32),
         )
-    return jnp.zeros((size, bucket), f.data_type.physical_dtype)
+    else:
+        col = jnp.zeros((size, bucket), f.data_type.physical_dtype)
+    if f.nullable:
+        return NCol(col, jnp.zeros((size, bucket), jnp.bool_))
+    return col
 
 
 def _gather_bucket(store, slots):
     """[size, B, ...] gathered at [cap] slots -> [cap, B, ...]."""
+    if isinstance(store, NCol):
+        return NCol(_gather_bucket(store.data, slots), store.null[slots])
     if isinstance(store, StrCol):
         return StrCol(store.data[slots], store.lens[slots])
     return store[slots]
@@ -66,6 +88,11 @@ def _gather_bucket(store, slots):
 def _scatter_rows(store, pos, col):
     """Write row values col[[cap]] at flat positions pos[[cap]] into the
     flattened [size*B, ...] view of the store."""
+    if isinstance(store, NCol):
+        null_flat = store.null.reshape(-1).at[pos].set(
+            col.null, mode="drop"
+        ).reshape(store.null.shape)
+        return NCol(_scatter_rows(store.data, pos, col.data), null_flat)
     if isinstance(store, StrCol):
         flat_d = store.data.reshape((-1,) + store.data.shape[2:])
         flat_l = store.lens.reshape((-1,))
@@ -224,10 +251,14 @@ class HashJoinExecutor:
         """
         B = side.occupied.shape[1]
         size = side.key_table.size
-        key_cols = [e.eval(chunk) for e in keys]
+        key_cols, null_keys = _null_stripped_keys(
+            [e.eval(chunk) for e in keys]
+        )
         signs = chunk.signs()
-        is_ins = chunk.valid & (signs > 0)
-        is_del = chunk.valid & (signs < 0)
+        joinable = chunk.valid if null_keys is None \
+            else chunk.valid & ~null_keys
+        is_ins = joinable & (signs > 0)
+        is_del = joinable & (signs < 0)
 
         # ---- in-chunk annihilation ------------------------------------
         # a +row and a -row of the same value inside one chunk cancel:
@@ -317,16 +348,19 @@ class HashJoinExecutor:
 
     def _bucket_row_hash(self, side: SideState, safe_slots) -> jnp.ndarray:
         """Row hashes of a side's buckets gathered at [cap] slots."""
-        cols = []
-        for store in side.rows:
-            g = _gather_bucket(store, safe_slots)  # [cap, B, ...]
+
+        def flat(g):
+            if isinstance(g, NCol):
+                return NCol(flat(g.data), g.null.reshape(-1))
             if isinstance(g, StrCol):
                 cap, B, w = g.data.shape
-                cols.append(StrCol(
+                return StrCol(
                     g.data.reshape(cap * B, w), g.lens.reshape(cap * B)
-                ))
-            else:
-                cols.append(g.reshape(-1))
+                )
+            return g.reshape(-1)
+
+        cols = [flat(_gather_bucket(store, safe_slots))
+                for store in side.rows]
         h = hash64_columns(cols)
         cap = safe_slots.shape[0]
         return h.reshape(cap, side.occupied.shape[1])
@@ -338,8 +372,12 @@ class HashJoinExecutor:
         B = build.occupied.shape[1]
         size = build.key_table.size
         out_cap = self.out_capacity
-        key_cols = [e.eval(probe_chunk) for e in probe_keys]
-        slots, found = build.key_table.lookup(key_cols, probe_chunk.valid)
+        key_cols, null_keys = _null_stripped_keys(
+            [e.eval(probe_chunk) for e in probe_keys]
+        )
+        probe_valid = probe_chunk.valid if null_keys is None \
+            else probe_chunk.valid & ~null_keys
+        slots, found = build.key_table.lookup(key_cols, probe_valid)
         safe_slots = jnp.minimum(slots, size - 1)
         occ = build.occupied[safe_slots] & found[:, None]  # [cap, B]
 
@@ -354,6 +392,14 @@ class HashJoinExecutor:
 
         def scatter_probe_col(col):
             # broadcast probe value across its bucket row then compact
+            if isinstance(col, NCol):
+                cap = col.null.shape[0]
+                nb = jnp.broadcast_to(col.null[:, None], (cap, B))
+                return NCol(
+                    scatter_probe_col(col.data),
+                    jnp.zeros((out_cap + 1,), jnp.bool_).at[flat_pos].set(
+                        nb.reshape(-1), mode="drop")[:out_cap],
+                )
             if isinstance(col, StrCol):
                 cap, w = col.data.shape
                 d = jnp.broadcast_to(col.data[:, None, :], (cap, B, w))
@@ -370,8 +416,14 @@ class HashJoinExecutor:
                 v.reshape(-1), mode="drop"
             )[:out_cap]
 
-        def scatter_build_col(store):
-            g = _gather_bucket(store, safe_slots)  # [cap, B, ...]
+        def scatter_gathered(g):
+            """[cap, B, ...] gathered bucket values -> compacted out."""
+            if isinstance(g, NCol):
+                return NCol(
+                    scatter_gathered(g.data),
+                    jnp.zeros((out_cap + 1,), jnp.bool_).at[flat_pos].set(
+                        g.null.reshape(-1), mode="drop")[:out_cap],
+                )
             if isinstance(g, StrCol):
                 cap, Bb, w = g.data.shape
                 return StrCol(
@@ -384,6 +436,9 @@ class HashJoinExecutor:
             return jnp.zeros((out_cap + 1,), g.dtype).at[flat_pos].set(
                 g.reshape(-1), mode="drop"
             )[:out_cap]
+
+        def scatter_build_col(store):
+            return scatter_gathered(_gather_bucket(store, safe_slots))
 
         probe_cols = [scatter_probe_col(c) for c in probe_chunk.columns]
         build_cols = [scatter_build_col(s) for s in build.rows]

@@ -30,12 +30,15 @@ import numpy as np
 
 from risingwave_tpu.common.chunk import (
     Chunk,
+    NCol,
     OP_DELETE,
     OP_INSERT,
     OP_UPDATE_DELETE,
     OP_UPDATE_INSERT,
     StrCol,
+    apply_null_mask,
     decode_strings,
+    split_col,
 )
 from risingwave_tpu.common.compact import mask_indices
 from risingwave_tpu.common.types import Schema
@@ -45,14 +48,23 @@ from risingwave_tpu.stream.executor import Executor
 
 def _empty_value_col(f, size: int):
     if f.data_type.is_string:
-        return StrCol(
+        col = StrCol(
             jnp.zeros((size, f.str_width), jnp.uint8),
             jnp.zeros((size,), jnp.int32),
         )
-    return jnp.zeros((size,), f.data_type.physical_dtype)
+    else:
+        col = jnp.zeros((size,), f.data_type.physical_dtype)
+    if getattr(f, "nullable", False):
+        return NCol(col, jnp.zeros((size,), jnp.bool_))
+    return col
 
 
 def _scatter_col(store, pos, values):
+    if isinstance(store, NCol):
+        return NCol(
+            _scatter_col(store.data, pos, values.data),
+            store.null.at[pos].set(values.null, mode="drop"),
+        )
     if isinstance(store, StrCol):
         return StrCol(
             store.data.at[pos].set(values.data, mode="drop"),
@@ -158,18 +170,21 @@ class MaterializeExecutor(Executor):
     def to_host(self, state: MvState) -> list[tuple]:
         """Read the MV as python rows (batch serving path)."""
         occ = np.asarray(state.table.occupied)
-        rows: list[list] = []
         cols = []
         for f, store in zip(self.in_schema, state.values):
+            store, null = split_col(store)
             if isinstance(store, StrCol):
-                cols.append(decode_strings(
+                out = decode_strings(
                     np.asarray(store.data)[occ], np.asarray(store.lens)[occ]
-                ))
+                )
             else:
                 arr = np.asarray(store)[occ]
                 if f.data_type.value == "numeric":
                     arr = arr.astype(np.float64) / 10**f.decimal_scale
-                cols.append(arr)
+                out = arr
+            if null is not None:
+                out = apply_null_mask(out, np.asarray(null)[occ])
+            cols.append(out)
         n = int(occ.sum())
         return [tuple(c[i] for c in cols) for i in range(n)]
 
@@ -212,13 +227,10 @@ class AppendOnlyMaterialize(Executor):
         pos = ((state.cursor + k) % self.ring_size).astype(jnp.int32)
         pos = jnp.where(k < n, pos, jnp.int32(self.ring_size))
         safe_idx = jnp.minimum(idx, cap - 1)
+        from risingwave_tpu.state.hash_table import gather_key
         values = []
         for store, col in zip(state.values, chunk.columns):
-            if isinstance(col, StrCol):
-                gathered = StrCol(col.data[safe_idx], col.lens[safe_idx])
-            else:
-                gathered = col[safe_idx]
-            values.append(_scatter_col(store, pos, gathered))
+            values.append(_scatter_col(store, pos, gather_key(col, safe_idx)))
         # ring laps silently overwrite the oldest MV rows — count them as
         # overflow so maintenance fails loudly instead of serving a
         # truncated MV (history beyond ring_size needs the SST spill path)
@@ -236,10 +248,14 @@ class AppendOnlyMaterialize(Executor):
         sel = (np.arange(start, start + n) % self.ring_size).astype(np.int64)
         cols = []
         for f, store in zip(self.in_schema, state.values):
+            store, null = split_col(store)
             if isinstance(store, StrCol):
-                cols.append(decode_strings(
+                out = decode_strings(
                     np.asarray(store.data)[sel], np.asarray(store.lens)[sel]
-                ))
+                )
             else:
-                cols.append(np.asarray(store)[sel])
+                out = np.asarray(store)[sel]
+            if null is not None:
+                out = apply_null_mask(out, np.asarray(null)[sel])
+            cols.append(out)
         return [tuple(c[i] for c in cols) for i in range(n)]

@@ -1,0 +1,241 @@
+"""NULL support: validity planes, three-valued logic, null-aware state.
+
+Reference counterpart: every array carries a null bitmap
+(src/common/src/array/mod.rs:279-296); expression strictness and
+Kleene logic mirror src/expr semantics.
+"""
+
+import numpy as np
+import pytest
+
+from risingwave_tpu.common.chunk import Chunk, NCol
+from risingwave_tpu.common.types import DataType, Field, Schema
+from risingwave_tpu.expr.node import FuncCall, InputRef, Literal
+from risingwave_tpu.sql import Engine
+from risingwave_tpu.sql.planner import PlannerConfig
+
+
+def _engine(cap=64):
+    return Engine(PlannerConfig(
+        chunk_capacity=cap, agg_table_size=256, agg_emit_capacity=64,
+        mv_table_size=256, mv_ring_size=1 << 12, topn_pool_size=256,
+        topn_emit_capacity=64, join_table_size=256, join_bucket_cap=8,
+        join_out_capacity=256,
+    ))
+
+
+# ---------------------------------------------------------------------------
+# chunk plumbing
+
+
+def test_pretty_dsl_null_round_trip():
+    ch = Chunk.from_pretty(
+        """
+        i I
+        +  1 10
+        +  . 20
+        +  3  .
+        """
+    )
+    assert isinstance(ch.columns[0], NCol)
+    assert isinstance(ch.columns[1], NCol)
+    rows = ch.to_rows()
+    assert rows == [(0, 1, 10), (0, None, 20), (0, 3, None)]
+    assert ch.schema[0].nullable and ch.schema[1].nullable
+
+
+def test_null_into_not_null_column_raises():
+    schema = Schema((Field("a", DataType.INT64),))
+    with pytest.raises(ValueError, match="NOT NULL"):
+        Chunk.from_numpy(schema, [np.asarray([1, None], object)])
+
+
+# ---------------------------------------------------------------------------
+# expression three-valued logic
+
+
+def _eval(expr, chunk):
+    from risingwave_tpu.common.chunk import split_col
+
+    col = expr.eval(chunk)
+    data, null = split_col(col)
+    d = np.asarray(data)
+    if null is None:
+        return [bool(v) if d.dtype == np.bool_ else v for v in d]
+    n = np.asarray(null)
+    return [None if n[i] else (bool(d[i]) if d.dtype == np.bool_ else d[i])
+            for i in range(len(d))]
+
+
+def test_strict_propagation_and_is_null():
+    ch = Chunk.from_pretty(
+        """
+        I I
+        + 1 10
+        + . 20
+        + 3  .
+        """
+    )
+    s = _eval(InputRef(0) + InputRef(1), ch)
+    assert s[0] == 11 and s[1] is None and s[2] is None
+    assert _eval(FuncCall("is_null", (InputRef(0),)), ch) == \
+        [False, True, False]
+    assert _eval(FuncCall("is_not_null", (InputRef(1),)), ch) == \
+        [True, True, False]
+    cmp = _eval(InputRef(0) < InputRef(1), ch)
+    assert cmp == [True, None, None]
+
+
+def test_kleene_and_or():
+    # a: T, F, NULL in all combinations against b
+    ch = Chunk.from_pretty(
+        """
+        b b
+        + t t
+        + t f
+        + t .
+        + f t
+        + f f
+        + f .
+        + . t
+        + . f
+        + . .
+        """
+    )
+    a, b = InputRef(0), InputRef(1)
+    assert _eval(a & b, ch) == [
+        True, False, None, False, False, False, None, False, None
+    ]
+    assert _eval(a | b, ch) == [
+        True, True, True, True, False, None, True, None, None
+    ]
+
+
+def test_coalesce_and_case_null():
+    ch = Chunk.from_pretty(
+        """
+        I I
+        + 1 10
+        + . 20
+        """
+    )
+    assert _eval(FuncCall("coalesce", (InputRef(0), InputRef(1))), ch) == \
+        [1, 20]
+    # CASE WHEN a IS NULL THEN b (no else) -> NULL for first row
+    cond = FuncCall("is_null", (InputRef(0),))
+    e = FuncCall("case", (cond, InputRef(1),
+                          Literal(None, DataType.INT64)))
+    assert _eval(e, ch) == [None, 20]
+
+
+# ---------------------------------------------------------------------------
+# SQL end-to-end
+
+
+def test_sql_nullable_agg_and_filter():
+    eng = _engine()
+    eng.execute("""
+        CREATE TABLE t (k BIGINT, v BIGINT NULL);
+        CREATE MATERIALIZED VIEW m AS
+        SELECT k, count(*) AS n, count(v) AS nv, sum(v) AS sv
+        FROM t GROUP BY k;
+    """)
+    eng.execute(
+        "INSERT INTO t VALUES (1, 10), (1, NULL), (2, NULL), (2, 5), "
+        "(2, 7)"
+    )
+    eng.tick(barriers=1, chunks_per_barrier=1)
+    rows = {int(r[0]): (int(r[1]), int(r[2]), int(r[3]))
+            for r in eng.execute("SELECT k, n, nv, sv FROM m")}
+    # count(*) counts NULL rows; count(v)/sum(v) skip them
+    assert rows == {1: (2, 1, 10), 2: (3, 2, 12)}
+
+
+def test_sql_where_null_and_is_null():
+    eng = _engine()
+    eng.execute("""
+        CREATE TABLE t (k BIGINT, v BIGINT NULL);
+        CREATE MATERIALIZED VIEW big AS
+        SELECT k FROM t WHERE v > 5;
+        CREATE MATERIALIZED VIEW missing AS
+        SELECT k FROM t WHERE v IS NULL;
+    """)
+    eng.execute("INSERT INTO t VALUES (1, 10), (2, NULL), (3, 3)")
+    eng.tick(barriers=1, chunks_per_barrier=1)
+    # NULL > 5 is NULL -> row dropped (not an error, not kept)
+    assert [int(r[0]) for r in eng.execute("SELECT k FROM big")] == [1]
+    assert [int(r[0]) for r in eng.execute("SELECT k FROM missing")] == [2]
+
+
+def test_sql_group_by_nullable_key():
+    eng = _engine()
+    eng.execute("""
+        CREATE TABLE t (g BIGINT NULL, v BIGINT);
+        CREATE MATERIALIZED VIEW m AS
+        SELECT g, count(*) AS n FROM t GROUP BY g;
+    """)
+    eng.execute(
+        "INSERT INTO t VALUES (1, 1), (NULL, 2), (NULL, 3), (1, 4)"
+    )
+    eng.tick(barriers=1, chunks_per_barrier=1)
+    rows = {r[0]: int(r[1]) for r in eng.execute("SELECT g, n FROM m")}
+    # SQL GROUP BY: all NULL keys form ONE group
+    assert rows == {1: 2, None: 2}
+
+
+def test_sql_case_without_else_and_projection_null():
+    eng = _engine()
+    eng.execute("""
+        CREATE TABLE t (v BIGINT);
+        CREATE MATERIALIZED VIEW m AS
+        SELECT v, CASE WHEN v > 2 THEN v END AS big FROM t;
+    """)
+    eng.execute("INSERT INTO t VALUES (1), (5)")
+    eng.tick(barriers=1, chunks_per_barrier=1)
+    rows = sorted(
+        ((int(r[0]), None if r[1] is None else int(r[1]))
+         for r in eng.execute("SELECT v, big FROM m")),
+    )
+    assert rows == [(1, None), (5, 5)]
+
+
+def test_insert_omitting_nullable_column():
+    eng = _engine()
+    eng.execute("CREATE TABLE t (a BIGINT, b BIGINT NULL)")
+    eng.execute("INSERT INTO t (a) VALUES (7)")
+    with pytest.raises(ValueError, match="NOT NULL"):
+        eng.execute("INSERT INTO t (b) VALUES (1)")
+    with pytest.raises(ValueError, match="NOT NULL"):
+        eng.execute("INSERT INTO t VALUES (NULL, 1)")
+
+
+def test_sql_join_null_keys_never_match():
+    eng = _engine()
+    eng.execute("""
+        CREATE TABLE l (k BIGINT NULL, lv BIGINT);
+        CREATE TABLE r (k BIGINT NULL, rv BIGINT);
+        CREATE MATERIALIZED VIEW j AS
+        SELECT l.lv AS lv, r.rv AS rv FROM l JOIN r ON l.k = r.k;
+    """)
+    eng.execute("INSERT INTO l VALUES (1, 10), (NULL, 20)")
+    eng.execute("INSERT INTO r VALUES (1, 100), (NULL, 200)")
+    eng.tick(barriers=1, chunks_per_barrier=1)
+    rows = [(int(a), int(b)) for a, b in eng.execute(
+        "SELECT lv, rv FROM j")]
+    # SQL join equality: NULL = NULL is NOT a match
+    assert rows == [(10, 100)]
+
+
+def test_sql_all_null_group_sum_is_null():
+    eng = _engine()
+    eng.execute("""
+        CREATE TABLE t (g BIGINT, v BIGINT NULL);
+        CREATE MATERIALIZED VIEW m AS
+        SELECT g, sum(v) AS sv, min(v) AS mv FROM t GROUP BY g;
+    """)
+    eng.execute("INSERT INTO t VALUES (1, NULL), (1, NULL), (2, 5)")
+    eng.tick(barriers=1, chunks_per_barrier=1)
+    rows = {int(r[0]): (r[1], r[2])
+            for r in eng.execute("SELECT g, sv, mv FROM m")}
+    assert rows[1] == (None, None)
+    assert (int(rows[2][0]), int(rows[2][1])) == (5, 5)
