@@ -364,7 +364,10 @@ class Planner:
         # materialize (EOWC output is final append-only rows)
         retractable = (has_agg or has_topn) and not eowc
         if retractable:
-            # pk: group keys for aggs; whole row for TopN output
+            # pk: group keys for aggs; whole row for TopN output.
+            # KNOWN GAP (advisor r1, low): two identical rows in a TopN
+            # band collapse into one MV slot — multiset parity needs a
+            # rank column from the TopN state appended to the pk.
             pk = pk_positions if (has_agg and not has_topn) \
                 else list(range(len(out_schema)))
             execs.append(MaterializeExecutor(

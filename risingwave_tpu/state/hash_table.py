@@ -180,10 +180,22 @@ class HashTable:
         Returns ``(slots int32 [cap], found bool [cap])``; unfound/invalid
         rows get slot == size (a drop sentinel for downstream gathers).
         """
-        table, slots, found, _ = self._probe(
+        slots, found, _ = self.lookup_counted(key_cols, valid, hashes)
+        return slots, found
+
+    def lookup_counted(self, key_cols: Sequence, valid: jnp.ndarray,
+                       hashes: jnp.ndarray | None = None):
+        """``lookup`` that also returns the probe-bound overflow count.
+
+        A probe chain exhausting the iteration bound reports found=False
+        for a key that may be present; callers on correctness-critical
+        paths (join probes) must accumulate the count into an error
+        counter so maintenance fails loudly instead of silently
+        dropping matches."""
+        table, slots, found, overflow = self._probe(
             key_cols, valid, insert=False, hashes=hashes
         )
-        return slots, found
+        return slots, found, jnp.sum((overflow & valid).astype(jnp.int64))
 
     def lookup_or_insert(self, key_cols: Sequence, valid: jnp.ndarray,
                          hashes: jnp.ndarray | None = None):

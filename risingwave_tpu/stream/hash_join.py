@@ -280,7 +280,9 @@ class HashJoinExecutor:
             key_cols, is_ins
         )
         is_ins = is_ins & ~overflow
-        slots_del, found_del = key_table.lookup(key_cols, is_del)
+        slots_del, found_del, probe_over = key_table.lookup_counted(
+            key_cols, is_del
+        )
         n_missing = jnp.sum((is_del & ~found_del).astype(jnp.int64))
         is_del = is_del & found_del
         safe_ins = jnp.minimum(slots_ins, size - 1)
@@ -342,7 +344,7 @@ class HashJoinExecutor:
             rows=rows,
             occupied=occupied,
             count=count,
-            overflow=side.overflow + n_over,
+            overflow=side.overflow + n_over + probe_over,
             inconsistency=side.inconsistency + n_missing,
         )
 
@@ -377,7 +379,9 @@ class HashJoinExecutor:
         )
         probe_valid = probe_chunk.valid if null_keys is None \
             else probe_chunk.valid & ~null_keys
-        slots, found = build.key_table.lookup(key_cols, probe_valid)
+        slots, found, probe_over = build.key_table.lookup_counted(
+            key_cols, probe_valid
+        )
         safe_slots = jnp.minimum(slots, size - 1)
         occ = build.occupied[safe_slots] & found[:, None]  # [cap, B]
 
@@ -455,7 +459,10 @@ class HashJoinExecutor:
             True, mode="drop"
         )[:out_cap]
         out = Chunk(out_cols, ops, valid, self._out_schema)
-        return out, n_drop
+        # probe-bound overflow may have hidden real matches: surface it
+        # through the same dropped-matches counter so maintenance raises
+        # instead of silently missing join output
+        return out, n_drop + probe_over
 
     # ------------------------------------------------------------------
     def apply(self, state: JoinState, chunk: Chunk, side: str):

@@ -32,6 +32,10 @@ class SinkState(NamedTuple):
     ops: jnp.ndarray       # int8 [ring]
     cursor: jnp.ndarray    # int64 rows written (total)
     overflow: jnp.ndarray  # rows dropped because the ring lapped
+    #: rows already delivered to the connector — PART OF THE CHECKPOINT
+    #: (a host attribute would reset on restart and re-deliver the
+    #: retained ring: duplicate sink rows)
+    read_cursor: jnp.ndarray  # int64
 
 
 class SinkExecutor(Executor):
@@ -44,10 +48,6 @@ class SinkExecutor(Executor):
             raise ValueError("ring_size must be a power of two")
         self.sink = sink
         self.ring_size = ring_size
-        #: host read cursor (persisted via source-style state on the
-        #: job's checkpoint; exactly-once across restarts lands with
-        #: sink coordination next round)
-        self.read_cursor = 0
 
     def init_state(self) -> SinkState:
         return SinkState(
@@ -57,6 +57,7 @@ class SinkExecutor(Executor):
             ops=jnp.zeros((self.ring_size,), jnp.int8),
             cursor=jnp.zeros((), jnp.int64),
             overflow=jnp.zeros((), jnp.int64),
+            read_cursor=jnp.zeros((), jnp.int64),
         )
 
     def apply(self, state: SinkState, chunk: Chunk):
@@ -67,23 +68,25 @@ class SinkExecutor(Executor):
         pos = ((state.cursor + k) % self.ring_size).astype(jnp.int32)
         pos = jnp.where(k < n, pos, jnp.int32(self.ring_size))
         safe_idx = jnp.minimum(idx, cap - 1)
-        values = []
-        for store, col in zip(state.values, chunk.columns):
-            if isinstance(col, StrCol):
-                gathered = StrCol(col.data[safe_idx], col.lens[safe_idx])
-            else:
-                gathered = col[safe_idx]
-            values.append(_scatter_col(store, pos, gathered))
+        from risingwave_tpu.state.hash_table import gather_key
+        values = [
+            _scatter_col(store, pos, gather_key(col, safe_idx))
+            for store, col in zip(state.values, chunk.columns)
+        ]
         ops = state.ops.at[pos].set(chunk.ops[safe_idx], mode="drop")
         return SinkState(
-            tuple(values), ops, state.cursor + n, state.overflow
+            tuple(values), ops, state.cursor + n, state.overflow,
+            state.read_cursor,
         ), None
 
     # -- host barrier hook ----------------------------------------------
     def deliver(self, state: SinkState, epoch: int) -> SinkState:
         """Drain new rows to the connector; commit the epoch."""
+        from risingwave_tpu.common.chunk import apply_null_mask, split_col
+
         total = int(state.cursor)
-        n = total - self.read_cursor
+        read = int(state.read_cursor)
+        n = total - read
         if n > self.ring_size:
             # ring lapped: the oldest rows are lost — surface loudly
             raise RuntimeError(
@@ -91,23 +94,27 @@ class SinkExecutor(Executor):
                 "increase ring_size or checkpoint more often"
             )
         if n > 0:
-            sel = (np.arange(self.read_cursor, total)
+            sel = (np.arange(read, total)
                    % self.ring_size).astype(np.int64)
             cols = []
             for f, store in zip(self.in_schema, state.values):
+                store, null = split_col(store)
                 if isinstance(store, StrCol):
-                    cols.append(decode_strings(
+                    out = decode_strings(
                         np.asarray(store.data)[sel],
                         np.asarray(store.lens)[sel],
-                    ))
+                    )
                 else:
                     arr = np.asarray(store)[sel]
                     if f.data_type == DataType.DECIMAL:
                         arr = arr.astype(np.float64) / 10**f.decimal_scale
-                    cols.append(arr)
+                    out = arr
+                if null is not None:
+                    out = apply_null_mask(out, np.asarray(null)[sel])
+                cols.append(out)
             ops = np.asarray(state.ops)[sel]
             rows = [tuple(c[i] for c in cols) for i in range(n)]
             self.sink.write_batch(self.in_schema.names(), ops, rows)
-            self.read_cursor = total
+            state = state._replace(read_cursor=jnp.int64(total))
         self.sink.commit(epoch)
         return state
