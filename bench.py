@@ -140,9 +140,17 @@ def measure(query: str) -> float:
     return rows / dt
 
 
-def _cpu_baseline(query: str) -> float:
+def _subprocess_measure(query: str, cpu: bool) -> float:
+    """Measure one query in a fresh process.
+
+    Each query gets its own process even on the accelerator: the
+    post-window consistency audit performs a device readback, and on the
+    tunneled chip one readback permanently degrades async dispatch for
+    the remainder of the process (~50x) — a second query measured in the
+    same process reports the degraded number, not its own."""
     env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
+    if cpu:
+        env["JAX_PLATFORMS"] = "cpu"
     env["RWT_BENCH_RAW"] = "1"
     env["RWT_BENCH_QUERY"] = query
     out = subprocess.run(
@@ -153,7 +161,13 @@ def _cpu_baseline(query: str) -> float:
     for line in out.stdout.splitlines():
         if line.startswith("RAW "):
             return float(line.split()[1])
-    raise RuntimeError(f"cpu baseline failed: {out.stderr[-500:]}")
+    raise RuntimeError(
+        f"{'cpu' if cpu else 'device'} measure failed: {out.stderr[-500:]}"
+    )
+
+
+def _cpu_baseline(query: str) -> float:
+    return _subprocess_measure(query, cpu=True)
 
 
 def _ensure_backend(timeout_s: float = 240.0) -> None:
@@ -202,7 +216,10 @@ def main() -> None:
     queries = list(QUERIES) if query == "all" else [query]
     results = {}
     for q in queries:
-        results[q] = measure(q)
+        # "all" isolates each query in a subprocess (see
+        # _subprocess_measure); single-query mode measures in-process
+        results[q] = _subprocess_measure(q, cpu=False) \
+            if query == "all" else measure(q)
         if q != "q7" or query != "all":
             print(f"# {q}: {results[q]:,.0f} rows/s", file=sys.stderr)
     headline = "q7" if query == "all" else query

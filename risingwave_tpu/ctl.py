@@ -57,7 +57,8 @@ def _state_gauges(executor, state) -> dict:
 
 def describe_job(job) -> dict:
     """Executor tree + state gauges for one streaming job."""
-    from risingwave_tpu.stream.runtime import BinaryJob, StreamingJob
+    from risingwave_tpu.stream.dag import DagJob, FragNode
+    from risingwave_tpu.stream.runtime import StreamingJob
     from risingwave_tpu.stream.sharded import ShardedStreamingJob
 
     info: dict[str, Any] = {
@@ -73,26 +74,27 @@ def describe_job(job) -> dict:
             {"executor": repr(ex), **_state_gauges(ex, job.states[i])}
             for i, ex in enumerate(job.fragment.executors)
         ]
-    elif isinstance(job, BinaryJob):
+    elif isinstance(job, DagJob):
+        info["sources"] = {
+            name: getattr(src, "offset", None)
+            for name, src in job.sources.items()
+        }
         info["executors"] = []
-        lstate, rstate, jstate, pstate = job.states
-        for label, frag, states in (
-            ("left", job.left_frag, lstate), ("right", job.right_frag, rstate)
-        ):
-            if frag is not None:
-                for i, ex in enumerate(frag.executors):
+        for idx, node in enumerate(job.nodes):
+            if node is None:
+                continue
+            if isinstance(node, FragNode):
+                for i, ex in enumerate(node.fragment.executors):
                     info["executors"].append({
-                        "executor": f"[{label}] {ex!r}",
-                        **_state_gauges(ex, states[i]),
+                        "executor": f"[n{idx}<-{node.input}] {ex!r}",
+                        **_state_gauges(ex, job.states[idx][i]),
                     })
-        info["executors"].append({
-            "executor": "HashJoinExecutor", **_state_gauges(job.join, jstate)
-        })
-        for i, ex in enumerate(job.post.executors):
-            info["executors"].append({
-                "executor": f"[post] {ex!r}",
-                **_state_gauges(ex, pstate[i]),
-            })
+            else:
+                info["executors"].append({
+                    "executor": f"[n{idx}<-{node.left},{node.right}] "
+                                "HashJoinExecutor",
+                    **_state_gauges(node.join, job.states[idx]),
+                })
     elif isinstance(job, ShardedStreamingJob):
         info["n_shards"] = job.sharded.n_shards
         info["source_offset"] = getattr(job.reader, "offset", None)

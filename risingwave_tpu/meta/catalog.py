@@ -30,6 +30,9 @@ class CatalogEntry:
     mv_state_index: Any = None  # index path to the MV state in job.states
     #: DML-fed tables: the TableDmlManager feeding all readers
     dml: Any = None
+    #: mview/sink on a DagJob: the node ids this entry contributed
+    #: (removed together on DROP)
+    dag_nodes: Any = None
     definition: str = ""
 
 

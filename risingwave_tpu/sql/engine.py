@@ -36,12 +36,15 @@ from risingwave_tpu.sql import ast
 from risingwave_tpu.sql.binder import Binder, Scope
 from risingwave_tpu.sql.parser import parse
 from risingwave_tpu.sql.planner import (
+    DagPlan,
+    MvTap,
     PlanError,
     Planner,
     PlannerConfig,
     UnaryPlan,
 )
-from risingwave_tpu.stream.runtime import BinaryJob, StreamingJob
+from risingwave_tpu.stream.dag import DagJob, FragNode, JoinNode
+from risingwave_tpu.stream.runtime import StreamingJob
 
 
 class Engine:
@@ -123,7 +126,17 @@ class Engine:
                         f"{stmt.name} is a {entry.kind}, not a {want}"
                     )
                 if entry.job is not None:
-                    self.jobs.remove(entry.job)
+                    job = entry.job
+                    shared = isinstance(job, DagJob) and any(
+                        e is not entry and e.job is job
+                        for e in self.catalog.list()
+                    )
+                    if shared:
+                        # removing only this MV's nodes; raises while
+                        # dependent (cascaded) MVs still consume them
+                        job.remove_nodes(entry.dag_nodes)
+                    else:
+                        self.jobs.remove(job)
                 if entry.kind == "sink" and entry.mv_executor is not None:
                     entry.mv_executor.sink.close()
             self.catalog.drop(stmt.name, stmt.if_exists)
@@ -204,15 +217,21 @@ class Engine:
             for ex in plan.fragment.executors:
                 lines.append((f"  {ex!r}",))
         else:
-            lines.append(("StreamJob (two-input)",))
-            for side, frag in (("left", plan.left_fragment),
-                               ("right", plan.right_fragment)):
-                if frag:
-                    for ex in frag.executors:
-                        lines.append((f"  [{side}] {ex!r}",))
-            lines.append((f"  HashJoin(keys={len(plan.join.left_keys)})",))
-            for ex in plan.post_fragment.executors:
-                lines.append((f"  {ex!r}",))
+            lines.append(("StreamJob (dataflow graph)",))
+            for name, reader in plan.sources.items():
+                kind = "MvTap" if isinstance(reader, MvTap) \
+                    else type(reader).__name__
+                lines.append((f"  source {name}: {kind}",))
+            for i, node in enumerate(plan.nodes):
+                if isinstance(node, JoinNode):
+                    lines.append((
+                        f"  node {i} <- {node.left}, {node.right}: "
+                        f"HashJoin(keys={len(node.join.left_keys)})",
+                    ))
+                    continue
+                lines.append((f"  node {i} <- {node.input}:",))
+                for ex in node.fragment.executors:
+                    lines.append((f"    {ex!r}",))
         return lines
 
     # -- DDL -------------------------------------------------------------
@@ -318,7 +337,10 @@ class Engine:
 
         When the session sets ``streaming_parallelism`` > 1, eligible
         aggregation plans run vnode-sharded over the device mesh
-        (ref: adaptive parallelism, ADAPTIVE streaming jobs)."""
+        (ref: adaptive parallelism, ADAPTIVE streaming jobs).
+
+        Returns (job, terminal_executor, state_index, dag_node_ids,
+        is_new_job)."""
         ckpt_freq = int(self.system_params.get("checkpoint_frequency"))
         par = int(self.session_config.get("streaming_parallelism"))
         if par == 0:  # adaptive: all devices (ref ADAPTIVE parallelism)
@@ -327,7 +349,8 @@ class Engine:
         if par > 1 and isinstance(plan, UnaryPlan):
             sharded = self._try_sharded_job(plan, name, par, ckpt_freq)
             if sharded is not None:
-                return sharded
+                job, terminal, state_index = sharded
+                return job, terminal, state_index, None, True
         if isinstance(plan, UnaryPlan):
             job = StreamingJob(
                 plan.reader, plan.fragment, name,
@@ -335,20 +358,210 @@ class Engine:
                 checkpoint_store=self.checkpoint_store,
             )
             terminal = plan.fragment.executors[plan.mv_index]
-            state_index = (plan.mv_index,)
+            return job, terminal, (plan.mv_index,), None, True
+        return self._build_dag_job(plan, name, ckpt_freq)
+
+    # -- DAG jobs: joins, cascades, shared upstreams ---------------------
+    def _ensure_dag(self, entry: CatalogEntry) -> tuple[DagJob, int]:
+        """Upgrade an MV's job to a DagJob in place (states preserved) so
+        downstream MVs can attach; returns (job, materialize node id).
+
+        Ref: the reference's jobs are always graph-shaped; here linear
+        jobs use the leaner StreamingJob until something taps them."""
+        job = entry.job
+        if isinstance(job, DagJob):
+            return job, entry.mv_state_index[0]
+        if not isinstance(job, StreamingJob):
+            raise PlanError(
+                f"MV-on-MV over {type(job).__name__} (sharded upstream): "
+                "next round"
+            )
+        src_name = f"_src_{entry.name}"
+        dag = DagJob(
+            {src_name: job.source},
+            [FragNode(job.fragment, ("source", src_name))],
+            name=job.name,
+            checkpoint_frequency=job.checkpoint_frequency,
+            checkpoint_store=job.checkpoint_store,
+        )
+        dag.states = (job.states,)
+        dag.epoch = job.epoch
+        dag.barriers_seen = job.barriers_seen
+        dag.committed_epoch = job.committed_epoch
+        dag.maintenance_interval = job.maintenance_interval
+        dag.snapshot_interval = job.snapshot_interval
+        self.jobs[self.jobs.index(job)] = dag
+        entry.job = dag
+        entry.mv_state_index = (0,) + tuple(entry.mv_state_index)
+        entry.dag_nodes = [0]
+        return dag, 0
+
+    def _mv_snapshot_chunk(self, entry: CatalogEntry):
+        """The upstream MV's current rows as ONE insert chunk (device-
+        resident — backfill never leaves HBM).  Ref: arrangement
+        backfill reads the upstream state table's snapshot."""
+        import jax.numpy as jnp
+
+        from risingwave_tpu.stream.materialize import (
+            AppendOnlyMaterialize,
+            MaterializeExecutor,
+        )
+
+        st = entry.job.states
+        for i in entry.mv_state_index:
+            st = st[i]
+        ex = entry.mv_executor
+        if isinstance(ex, MaterializeExecutor):
+            valid = st.table.occupied
+            cap = ex.table_size
+        elif isinstance(ex, AppendOnlyMaterialize):
+            valid = jnp.arange(ex.ring_size, dtype=jnp.int64) < st.cursor
+            cap = ex.ring_size
         else:
-            job = BinaryJob(
-                plan.left_reader, plan.right_reader, plan.join,
-                plan.post_fragment,
-                left_fragment=plan.left_fragment,
-                right_fragment=plan.right_fragment,
-                name=name,
+            raise PlanError("cannot backfill from a sink")
+        return Chunk(
+            tuple(st.values),
+            jnp.zeros((cap,), jnp.int8),  # all inserts
+            valid,
+            ex.in_schema,
+        )
+
+    def _build_dag_job(self, plan: DagPlan, name: str, ckpt_freq: int):
+        import dataclasses
+
+        taps = {n: r for n, r in plan.sources.items()
+                if isinstance(r, MvTap)}
+        if not taps:
+            job = DagJob(
+                plan.sources, plan.nodes, name,
                 checkpoint_frequency=ckpt_freq,
                 checkpoint_store=self.checkpoint_store,
             )
-            terminal = plan.post_fragment.executors[plan.mv_index]
-            state_index = (3, plan.mv_index)
-        return job, terminal, state_index
+            terminal = plan.nodes[plan.mv_node].fragment.executors[
+                plan.mv_index
+            ]
+            return job, terminal, (plan.mv_node, plan.mv_index), \
+                list(range(len(plan.nodes))), True
+
+        # attach: resolve every tap to its upstream job's MV node
+        tap_refs: dict[str, int] = {}
+        tap_entries: dict[str, CatalogEntry] = {}
+        target: DagJob | None = None
+        for sname, tap in taps.items():
+            entry = self.catalog.get(tap.name)
+            ujob, unode = self._ensure_dag(entry)
+            if target is None:
+                target = ujob
+            elif ujob is not target:
+                target = self._merge_dag_jobs(target, ujob)
+            tap_entries[sname] = entry
+        # tap node ids read after all merges (merges remap them)
+        for sname, tap in taps.items():
+            tap_refs[sname] = self.catalog.get(tap.name).mv_state_index[0]
+
+        base = len(target.nodes)
+        src_rename: dict[str, str] = {}
+        for sname, reader in plan.sources.items():
+            if sname in taps:
+                continue
+            new_name = sname
+            i = 1
+            while new_name in target.sources:
+                new_name = f"{sname}_{i}"
+                i += 1
+            src_rename[sname] = new_name
+            target.add_source(new_name, reader)
+
+        def remap(ref):
+            kind, key = ref
+            if kind == "node":
+                return ("node", base + key)
+            if key in tap_refs:
+                return ("node", tap_refs[key])
+            return ("source", src_rename[key])
+
+        rewritten = []
+        for n in plan.nodes:
+            if isinstance(n, FragNode):
+                rewritten.append(dataclasses.replace(
+                    n, input=remap(n.input)
+                ))
+            else:
+                rewritten.append(dataclasses.replace(
+                    n, left=remap(n.left), right=remap(n.right)
+                ))
+        ids = target.add_nodes(rewritten)
+
+        # backfill: new nodes directly consuming a tapped MV replay its
+        # current snapshot before going live (device-side, one chunk)
+        for sname, entry in tap_entries.items():
+            tap_node = tap_refs[sname]
+            snapshot = None
+            for nid in ids:
+                node = target.nodes[nid]
+                if isinstance(node, FragNode):
+                    consumes = node.input == ("node", tap_node)
+                    side = None
+                else:
+                    consumes = ("node", tap_node) in (node.left, node.right)
+                    side = "left" if node.left == ("node", tap_node) \
+                        else "right"
+                if consumes:
+                    if snapshot is None:
+                        snapshot = self._mv_snapshot_chunk(entry)
+                    target.backfill_node(nid, [snapshot], side=side)
+
+        terminal = rewritten[plan.mv_node].fragment.executors[plan.mv_index]
+        return target, terminal, (ids[plan.mv_node], plan.mv_index), \
+            ids, False
+
+    def _merge_dag_jobs(self, a: DagJob, b: DagJob) -> DagJob:
+        """Fuse job ``b`` into ``a`` (a join of MVs living in different
+        jobs): sources and nodes move over with remapped ids; catalog
+        entries follow."""
+        offset = len(a.nodes)
+        rename: dict[str, str] = {}
+        for sname, reader in b.sources.items():
+            new_name = sname
+            i = 1
+            while new_name in a.sources:
+                new_name = f"{sname}_{i}"
+                i += 1
+            rename[sname] = new_name
+            a.sources[new_name] = reader
+
+        import dataclasses
+
+        def remap(ref):
+            kind, key = ref
+            if kind == "node":
+                return ("node", offset + key)
+            return ("source", rename[key])
+
+        moved = []
+        for n in b.nodes:
+            if n is None:
+                moved.append(None)
+            elif isinstance(n, FragNode):
+                moved.append(dataclasses.replace(n, input=remap(n.input)))
+            else:
+                moved.append(dataclasses.replace(
+                    n, left=remap(n.left), right=remap(n.right)
+                ))
+        a.nodes.extend(moved)
+        a.states = tuple(list(a.states) + list(b.states))
+        a._rebuild()
+        for entry in self.catalog.list():
+            if entry.job is b:
+                entry.job = a
+                entry.mv_state_index = (
+                    offset + entry.mv_state_index[0],
+                ) + tuple(entry.mv_state_index[1:])
+                if entry.dag_nodes is not None:
+                    entry.dag_nodes = [offset + i for i in entry.dag_nodes]
+        if b in self.jobs:
+            self.jobs.remove(b)
+        return a
 
     def _try_sharded_job(self, plan, name: str, par: int, ckpt_freq: int):
         import jax
@@ -458,22 +671,32 @@ class Engine:
         return job, terminal, (len(local_execs) + len(keyed_execs) - 1,)
 
     def _create_mview(self, stmt: ast.CreateMaterializedView):
+        from risingwave_tpu.stream.materialize import AppendOnlyMaterialize
+
+        if stmt.name in self.catalog and stmt.if_not_exists:
+            return None
         plan = self.planner.plan(stmt.query,
                                  eowc=stmt.emit_on_window_close)
-        job, mv_exec, state_index = self._build_job(plan, stmt.name)
+        job, mv_exec, state_index, dag_nodes, is_new = self._build_job(
+            plan, stmt.name
+        )
         entry = CatalogEntry(
             stmt.name, "mview", mv_exec.in_schema,
             job=job, mv_executor=mv_exec, mv_state_index=state_index,
+            append_only=isinstance(mv_exec, AppendOnlyMaterialize),
+            dag_nodes=dag_nodes,
             definition=str(stmt),
         )
-        created = self.catalog.create(entry, stmt.if_not_exists)
-        if created:
+        self.catalog.create(entry, stmt.if_not_exists)
+        if is_new:
             self.jobs.append(job)
         return None
 
     def _create_sink(self, stmt: ast.CreateSink):
         from risingwave_tpu.connector.sinks import create_sink
 
+        if stmt.name in self.catalog and stmt.if_not_exists:
+            return None
         if stmt.query is not None:
             query = stmt.query
         else:
@@ -483,13 +706,16 @@ class Engine:
             )
         sink = create_sink(stmt.with_options)
         plan = self.planner.plan(query, sink=sink)
-        job, sink_exec, _ = self._build_job(plan, stmt.name)
+        job, sink_exec, _, dag_nodes, is_new = self._build_job(
+            plan, stmt.name
+        )
         entry = CatalogEntry(
             stmt.name, "sink", sink_exec.in_schema,
-            job=job, mv_executor=sink_exec, definition=str(stmt),
+            job=job, mv_executor=sink_exec, dag_nodes=dag_nodes,
+            definition=str(stmt),
         )
-        created = self.catalog.create(entry, stmt.if_not_exists)
-        if created:
+        self.catalog.create(entry, stmt.if_not_exists)
+        if is_new:
             self.jobs.append(job)
         return None
 
@@ -516,16 +742,8 @@ class Engine:
                 job.snapshot_interval = snap_iv
                 t0 = time.perf_counter()
                 rows = 0
-                if isinstance(job, BinaryJob):
-                    l, r = job.chunk_ratio
-                    for _ in range(chunks_per_barrier):
-                        for _ in range(l):
-                            rows += job.run_chunk("left")
-                        for _ in range(r):
-                            rows += job.run_chunk("right")
-                else:
-                    for _ in range(chunks_per_barrier):
-                        rows += job.run_chunk()
+                for _ in range(chunks_per_barrier):
+                    rows += job.chunk_round()
                 job.inject_barrier()
                 dt = time.perf_counter() - t0
                 self.metrics.inc("stream_rows_total", rows, job=job.name)

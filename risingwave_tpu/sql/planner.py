@@ -69,14 +69,29 @@ class UnaryPlan:
 
 
 @dataclass
-class JoinPlan:
-    left_reader: Any
-    right_reader: Any
-    left_fragment: Fragment | None
-    right_fragment: Fragment | None
-    join: HashJoinExecutor
-    post_fragment: Fragment
-    mv_index: int                # index in post fragment
+class MvTap:
+    """A FROM item that is an existing MV: the plan consumes that MV's
+    output changelog (ref: MV-on-MV via the upstream materialize
+    fragment's dispatcher).  The engine resolves the tap to the running
+    job's materialize node at CREATE time."""
+
+    name: str
+
+
+@dataclass
+class DagPlan:
+    """A dataflow graph plan: joins (possibly nested), cascades, shared
+    inputs (ref stream_fragmenter/mod.rs:388 building a fragment graph).
+
+    ``nodes`` uses the runtime's FragNode/JoinNode with plan-local refs:
+    ("source", name) keys into ``sources`` (a reader or an MvTap);
+    ("node", i) indexes ``nodes``.
+    """
+
+    sources: dict[str, Any]
+    nodes: list
+    mv_node: int                 # node holding the terminal executor
+    mv_index: int                # executor index within that node
 
 
 @dataclass
@@ -105,23 +120,41 @@ class Planner:
 
     # ------------------------------------------------------------------
     def plan(self, select: ast.Select, sink=None,
-             eowc: bool = False) -> UnaryPlan | JoinPlan:
+             eowc: bool = False) -> "UnaryPlan | DagPlan":
         """``sink`` replaces the MV terminal; ``eowc`` = EMIT ON WINDOW
         CLOSE (final append-only rows when windows close)."""
         if eowc and isinstance(select.from_, ast.Join):
             raise PlanError("EMIT ON WINDOW CLOSE on joins: next round")
         if isinstance(select.from_, ast.Join):
             return self._plan_join(select, sink)
-        return self._plan_unary(select, sink, eowc)
+        plan = self._plan_unary(select, sink, eowc)
+        if isinstance(plan.reader, MvTap):
+            # cascade: a single fragment node tapping the upstream MV
+            from risingwave_tpu.stream.dag import FragNode
+            return DagPlan(
+                sources={plan.reader.name: plan.reader},
+                nodes=[FragNode(plan.fragment,
+                                ("source", plan.reader.name))],
+                mv_node=0, mv_index=plan.mv_index,
+            )
+        return plan
 
     # -- FROM resolution ------------------------------------------------
     def _resolve_input(self, from_) -> PlannedInput:
         if isinstance(from_, ast.TableRef):
             entry = self.catalog.get(from_.name)
+            if entry.kind == "mview":
+                # MV-on-MV: consume the upstream MV's output changelog
+                qual = from_.alias or from_.name
+                return PlannedInput(
+                    MvTap(from_.name), [],
+                    Scope.of(entry.schema, qual), entry.schema,
+                    None, None, entry.append_only,
+                )
             if entry.kind != "source":
                 raise PlanError(
-                    f"{from_.name} is not a streaming source (MV-on-MV "
-                    "cascades land with the graph scheduler)"
+                    f"{from_.name} is not a streaming source or "
+                    "materialized view"
                 )
             reader = entry.reader_factory()
             qual = from_.alias or from_.name
@@ -559,59 +592,107 @@ class Planner:
         return False
 
     # -- join pipelines ---------------------------------------------------
-    def _plan_join(self, select: ast.Select, sink=None) -> JoinPlan:
+    def _plan_join(self, select: ast.Select, sink=None) -> DagPlan:
+        """Joins — including nested (multi-way) trees — as a DagPlan.
+
+        Each base input becomes a source (+ optional prep fragment
+        node); each ast.Join becomes a JoinNode over the resolved
+        refs (ref: the fragmenter cutting a join plan into exchange-
+        separated fragments, stream_fragmenter/mod.rs:388)."""
+        from risingwave_tpu.stream.dag import FragNode, JoinNode
+
         cfg = self.config
-        jn: ast.Join = select.from_
-        if jn.kind != "inner":
-            raise PlanError("only INNER JOIN is supported this round")
-        if isinstance(jn.left, ast.Join) or isinstance(jn.right, ast.Join):
-            raise PlanError("multi-way joins land with the graph scheduler")
-        left = self._resolve_input(jn.left)
-        right = self._resolve_input(jn.right)
-        both = left.scope.concat(right.scope)
-        n_left = len(left.schema)
+        sources: dict[str, Any] = {}
+        nodes: list = []
 
-        # split ON into equi-conjuncts and residual filters
-        left_keys: list[Expr] = []
-        right_keys: list[Expr] = []
-        residual: list = []
-        for conj in self._conjuncts(jn.on):
-            keypair = self._equi_pair(conj, left.scope, right.scope, n_left)
-            if keypair is not None:
-                lk, rk = keypair
-                left_keys.append(lk)
-                right_keys.append(rk)
+        def resolve(from_):
+            if isinstance(from_, ast.Join):
+                return resolve_join(from_)
+            pin = self._resolve_input(from_)
+            if isinstance(from_, ast.TableRef):
+                base = from_.alias or from_.name
             else:
-                residual.append(conj)
-        if not left_keys:
-            raise PlanError("JOIN requires at least one equality condition")
+                base = from_.alias or from_.table.name
+            name = base
+            i = 1
+            while name in sources:
+                name = f"{base}_{i}"
+                i += 1
+            sources[name] = pin.reader
+            ref = ("source", name)
+            if pin.executors:
+                nodes.append(FragNode(Fragment(pin.executors), ref))
+                ref = ("node", len(nodes) - 1)
+            return ref, pin
 
-        join = HashJoinExecutor(
-            left.schema, right.schema, left_keys, right_keys,
-            table_size=cfg.join_table_size,
-            bucket_cap=cfg.join_bucket_cap,
-            out_capacity=cfg.join_out_capacity,
-            left_table_size=cfg.join_left_table_size,
-            right_table_size=cfg.join_right_table_size,
-            left_bucket_cap=cfg.join_left_bucket_cap,
-            right_bucket_cap=cfg.join_right_bucket_cap,
-        )
-        # window-keyed joins over watermarked sources clean closed
-        # windows at barriers (bounded state, ref q8 pattern)
-        for side_name, pin, keys in (("left", left, left_keys),
-                                     ("right", right, right_keys)):
-            if pin.window_size is None or pin.watermark_col is None:
-                continue
-            window_idx = len(pin.schema) - 1  # hop appends window_start
-            for ki, ke in enumerate(keys):
-                if isinstance(ke, InputRef) and ke.index == window_idx:
-                    setattr(join, f"{side_name}_clean",
-                            (ki, pin.window_size, pin.watermark_col))
-                    break
+        def resolve_join(jn: ast.Join):
+            if jn.kind != "inner":
+                raise PlanError("only INNER JOIN is supported this round")
+            lref, left = resolve(jn.left)
+            rref, right = resolve(jn.right)
+            both = left.scope.concat(right.scope)
+            n_left = len(left.schema)
+
+            # split ON into equi-conjuncts and residual filters
+            left_keys: list[Expr] = []
+            right_keys: list[Expr] = []
+            residual: list = []
+            for conj in self._conjuncts(jn.on):
+                keypair = self._equi_pair(
+                    conj, left.scope, right.scope, n_left
+                )
+                if keypair is not None:
+                    lk, rk = keypair
+                    left_keys.append(lk)
+                    right_keys.append(rk)
+                else:
+                    residual.append(conj)
+            if not left_keys:
+                raise PlanError(
+                    "JOIN requires at least one equality condition"
+                )
+
+            join = HashJoinExecutor(
+                left.schema, right.schema, left_keys, right_keys,
+                table_size=cfg.join_table_size,
+                bucket_cap=cfg.join_bucket_cap,
+                out_capacity=cfg.join_out_capacity,
+                left_table_size=cfg.join_left_table_size,
+                right_table_size=cfg.join_right_table_size,
+                left_bucket_cap=cfg.join_left_bucket_cap,
+                right_bucket_cap=cfg.join_right_bucket_cap,
+            )
+            # window-keyed joins over watermarked sources clean closed
+            # windows at barriers (bounded state, ref q8 pattern)
+            for side_name, pin, keys in (("left", left, left_keys),
+                                         ("right", right, right_keys)):
+                if pin.window_size is None or pin.watermark_col is None:
+                    continue
+                window_idx = len(pin.schema) - 1  # hop appends window_start
+                for ki, ke in enumerate(keys):
+                    if isinstance(ke, InputRef) and ke.index == window_idx:
+                        setattr(join, f"{side_name}_clean",
+                                (ki, pin.window_size, pin.watermark_col))
+                        break
+            nodes.append(JoinNode(join, lref, rref))
+            ref = ("node", len(nodes) - 1)
+            if residual:
+                b = Binder(both)
+                nodes.append(FragNode(Fragment([
+                    FilterExecutor(both.schema, b.bind(c))
+                    for c in residual
+                ]), ref))
+                ref = ("node", len(nodes) - 1)
+            info = PlannedInput(
+                None, [], both, both.schema, None, None,
+                left.append_only and right.append_only,
+            )
+            return ref, info
+
+        root_ref, root = resolve(select.from_)
+        both = root.scope
         post_execs: list[Executor] = []
         b = Binder(both)
-        for conj in residual:
-            post_execs.append(FilterExecutor(both.schema, b.bind(conj)))
         if select.where is not None:
             post_execs.append(
                 FilterExecutor(both.schema, b.bind(select.where))
@@ -621,12 +702,8 @@ class Planner:
         if has_agg:
             # aggregation over the joined stream (TPC-H/q4 shape): the
             # join's retractions flow into the agg, which handles them
-            dummy_pin = PlannedInput(
-                None, [], both, both.schema, None, None,
-                left.append_only and right.append_only,
-            )
             execs2, out_schema, pk_pos = self._plan_agg(
-                select, both, dummy_pin
+                select, both, root
             )
             post_execs.extend(execs2)
             self._append_terminal(
@@ -634,40 +711,28 @@ class Planner:
                 input_append_only=False, has_agg=True,
                 pk_positions=pk_pos, sink=sink, eowc=False,
             )
-            return JoinPlan(
-                left.reader, right.reader,
-                Fragment(left.executors) if left.executors else None,
-                Fragment(right.executors) if right.executors else None,
-                join,
-                Fragment(post_execs),
-                len(post_execs) - 1,
-            )
-
-        items = self._expand_items(select.items, both)
-        proj = [(name, b.bind(e)) for name, e in items]
-        post_execs.append(ProjectExecutor(both.schema, proj))
-        out_schema = post_execs[-1].out_schema
-        if sink is not None:
-            from risingwave_tpu.stream.sink import SinkExecutor
-            post_execs.append(SinkExecutor(
-                out_schema, sink, ring_size=cfg.mv_ring_size
-            ))
         else:
-            if not (left.append_only and right.append_only):
-                raise PlanError(
-                    "join MVs over retractable inputs need keyed "
-                    "materialization (next round)"
-                )
-            post_execs.append(
-                AppendOnlyMaterialize(out_schema, ring_size=cfg.mv_ring_size)
-            )
-        return JoinPlan(
-            left.reader, right.reader,
-            Fragment(left.executors) if left.executors else None,
-            Fragment(right.executors) if right.executors else None,
-            join,
-            Fragment(post_execs),
-            len(post_execs) - 1,
+            items = self._expand_items(select.items, both)
+            proj = [(name, b.bind(e)) for name, e in items]
+            post_execs.append(ProjectExecutor(both.schema, proj))
+            out_schema = post_execs[-1].out_schema
+            if sink is not None:
+                from risingwave_tpu.stream.sink import SinkExecutor
+                post_execs.append(SinkExecutor(
+                    out_schema, sink, ring_size=cfg.mv_ring_size
+                ))
+            else:
+                if not root.append_only:
+                    raise PlanError(
+                        "join MVs over retractable inputs need keyed "
+                        "materialization (next round)"
+                    )
+                post_execs.append(AppendOnlyMaterialize(
+                    out_schema, ring_size=cfg.mv_ring_size
+                ))
+        nodes.append(FragNode(Fragment(post_execs), root_ref))
+        return DagPlan(
+            sources, nodes, len(nodes) - 1, len(post_execs) - 1
         )
 
     def _conjuncts(self, e) -> list:

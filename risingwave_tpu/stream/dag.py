@@ -1,0 +1,668 @@
+"""DAG streaming runtime: arbitrary fragment graphs under one barrier loop.
+
+Reference counterparts:
+- the stream fragmenter cuts any plan into a *graph* of fragments
+  (src/frontend/src/stream_fragmenter/mod.rs:388), instantiated as
+  actors wired by dispatch/exchange edges
+  (src/stream/src/executor/dispatch.rs:62);
+- merges align barriers at every fan-in
+  (src/stream/src/executor/merge.rs:161, barrier_align.rs:44);
+- MV-on-MV: a downstream job consumes the upstream MaterializeExecutor's
+  output changelog.
+
+TPU-first design (SURVEY.md §7.1): the DAG is *compiled*, not threaded.
+Instead of one actor task per fragment connected by channels, the whole
+reachable subgraph of a source becomes ONE jitted step program (XLA
+fuses across fragment boundaries — a cascade of MVs costs the same as
+one fused chain), and the whole graph's barrier crossing is ONE jitted
+program.  Barrier alignment at fan-in is implicit: barriers are host
+control flow between dispatches, so every node sees the same epoch
+boundary by construction — the alignment buffers of ``merge.rs`` have
+no analog because there is nothing to align.
+
+Node inputs always reference earlier nodes (list order = topological
+order), so in-order traversal is dataflow-correct.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from fractions import Fraction
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from risingwave_tpu.common.epoch import EpochPair
+from risingwave_tpu.stream.fragment import (
+    COUNTER_ATTRS,
+    Fragment,
+    WM_NONE,
+    WM_SAFE_FLOOR,
+    collect_counters,
+)
+from risingwave_tpu.stream.runtime import (
+    CheckpointSnapshot,
+    _snapshot_copy,
+    check_counter_values,
+    deliver_sinks,
+    restore_source,
+)
+
+#: a dataflow edge endpoint: ("source", name) or ("node", node_id)
+Ref = tuple
+
+
+@dataclass
+class FragNode:
+    """A fragment (executor chain) with one upstream input."""
+
+    fragment: Fragment
+    input: Ref
+
+    def init_state(self):
+        return self.fragment.init_states()
+
+
+@dataclass
+class JoinNode:
+    """A two-input hash join (ref hash_join.rs:158 as a DAG vertex)."""
+
+    join: Any
+    left: Ref
+    right: Ref
+
+    def init_state(self):
+        return self.join.init_state()
+
+
+class DagJob:
+    """A streaming job over an arbitrary DAG of fragments and joins.
+
+    ``sources`` maps names to chunk readers; ``nodes`` is a topological
+    list (a node's inputs only reference sources or earlier nodes).
+    Dropped nodes become ``None`` tombstones so node ids stay stable for
+    catalog references.
+    """
+
+    def __init__(
+        self,
+        sources: dict[str, Any],
+        nodes: list,
+        name: str = "dag_job",
+        checkpoint_frequency: int = 1,
+        checkpoint_store=None,
+    ):
+        self.sources = dict(sources)
+        self.nodes: list = list(nodes)
+        self.name = name
+        self.checkpoint_frequency = checkpoint_frequency
+        self.checkpoint_store = checkpoint_store
+        self.maintenance_interval = 1
+        self._ckpts_since_maintain = 0
+        self.snapshot_interval = 1
+        self._ckpts_since_snapshot = 0
+        self.states = tuple(
+            n.init_state() if n is not None else None for n in self.nodes
+        )
+        self.epoch = EpochPair.first()
+        self.barriers_seen = 0
+        self.checkpoints: list[CheckpointSnapshot] = []
+        self.committed_epoch = 0
+        self.paused = False
+        self._counters = None
+        self.counter_labels: list[str] = []
+        self._rebuild()
+
+    # -- topology -------------------------------------------------------
+    def _rebuild(self) -> None:
+        """Recompute consumer maps + drop compiled programs (called after
+        any topology change; programs re-jit lazily on next use)."""
+        self._consumers: dict[Ref, list[int]] = {}
+        for idx, node in enumerate(self.nodes):
+            if node is None:
+                continue
+            refs = [node.input] if isinstance(node, FragNode) \
+                else [node.left, node.right]
+            for ref in refs:
+                self._validate_ref(ref, idx)
+                self._consumers.setdefault(ref, []).append(idx)
+        self._step_programs: dict[str, Any] = {}
+        self._barrier_prog = None
+        self._maintain_prog = None
+        self._pulls = self._compute_pulls()
+
+    def _validate_ref(self, ref: Ref, at: int) -> None:
+        kind, key = ref
+        if kind == "source":
+            if key not in self.sources:
+                raise ValueError(f"node {at} references unknown source {key!r}")
+        elif kind == "node":
+            if not (0 <= key < at) or self.nodes[key] is None:
+                raise ValueError(
+                    f"node {at} must reference an earlier live node, got {key}"
+                )
+        else:
+            raise ValueError(f"bad ref {ref!r}")
+
+    def add_source(self, name: str, reader) -> None:
+        if name in self.sources:
+            raise ValueError(f"source {name!r} already attached")
+        self.sources[name] = reader
+        self._rebuild()
+
+    def add_nodes(self, nodes: list) -> list[int]:
+        """Attach new nodes (e.g. a cascaded MV's fragment); returns their
+        ids.  Existing states are preserved; new nodes start empty —
+        callers backfill upstream history explicitly (see
+        ``backfill_node``)."""
+        ids = []
+        states = list(self.states)
+        for n in nodes:
+            self.nodes.append(n)
+            states.append(n.init_state())
+            ids.append(len(self.nodes) - 1)
+        self.states = tuple(states)
+        self._rebuild()
+        return ids
+
+    def remove_nodes(self, ids: list[int]) -> None:
+        """Tombstone nodes (a dropped MV).  Refuses while live consumers
+        remain — the reference likewise rejects dropping an MV with
+        dependents."""
+        drop = set(ids)
+        for idx, node in enumerate(self.nodes):
+            if node is None or idx in drop:
+                continue
+            refs = [node.input] if isinstance(node, FragNode) \
+                else [node.left, node.right]
+            for kind, key in refs:
+                if kind == "node" and key in drop:
+                    raise ValueError(
+                        f"node {key} still feeds node {idx} (drop dependents "
+                        "first)"
+                    )
+        states = list(self.states)
+        for i in drop:
+            self.nodes[i] = None
+            states[i] = None
+        self.states = tuple(states)
+        self._rebuild()
+
+    def downstream_closure(self, ref: Ref) -> list[int]:
+        """All node ids transitively consuming ``ref`` (topo order)."""
+        out: list[int] = []
+        seen = set()
+        frontier = [ref]
+        while frontier:
+            r = frontier.pop()
+            for idx in self._consumers.get(r, ()):
+                if idx in seen:
+                    continue
+                seen.add(idx)
+                frontier.append(("node", idx))
+        return sorted(seen)
+
+    # -- chunk path -----------------------------------------------------
+    def _propagate(self, new_states: list, injections) -> None:
+        """Push chunks through the DAG in topological order.
+
+        ``injections`` is a list of (ref, chunk).  Mutates new_states.
+        A source feeding both sides of a join (self-join) delivers to
+        the left side first, then the right — one deterministic order,
+        like the reference's dispatcher duplicating a chunk."""
+        inbox: dict[int, list] = {}
+
+        def enqueue(ref, chunk):
+            for idx in self._consumers.get(ref, ()):
+                node = self.nodes[idx]
+                if isinstance(node, FragNode):
+                    inbox.setdefault(idx, []).append((chunk, None))
+                else:
+                    if node.left == ref:
+                        inbox.setdefault(idx, []).append((chunk, "left"))
+                    if node.right == ref:
+                        inbox.setdefault(idx, []).append((chunk, "right"))
+
+        for ref, chunk in injections:
+            enqueue(ref, chunk)
+        for idx in range(len(self.nodes)):
+            node = self.nodes[idx]
+            if node is None or idx not in inbox:
+                continue
+            for chunk, side in inbox[idx]:
+                if isinstance(node, FragNode):
+                    new_states[idx], out = node.fragment._step_impl(
+                        new_states[idx], chunk
+                    )
+                else:
+                    new_states[idx], out = node.join.apply(
+                        new_states[idx], chunk, side
+                    )
+                if out is not None:
+                    enqueue(("node", idx), out)
+
+    def _make_step(self, src_name: str):
+        reader = self.sources[src_name]
+        fused = hasattr(reader, "impl") and hasattr(reader, "next_base")
+        if fused:
+            # traceable source: generation fuses into the step program
+            def fn(states, k0):
+                chunk = reader.impl(k0, reader.cap)
+                new_states = list(states)
+                self._propagate(new_states, [(("source", src_name), chunk)])
+                return tuple(new_states)
+        else:
+            def fn(states, chunk):
+                new_states = list(states)
+                self._propagate(new_states, [(("source", src_name), chunk)])
+                return tuple(new_states)
+        return jax.jit(fn, donate_argnums=(0,)), fused
+
+    def run_chunk(self, src_name: str) -> int:
+        """Pull one chunk from one source through its reachable subgraph."""
+        if self.paused:
+            return 0
+        if src_name not in self._step_programs:
+            self._step_programs[src_name] = self._make_step(src_name)
+        prog, fused = self._step_programs[src_name]
+        reader = self.sources[src_name]
+        if fused:
+            self.states = prog(self.states, jnp.int64(reader.next_base()))
+            return reader.cap
+        chunk = reader.next_chunk()
+        self.states = prog(self.states, chunk)
+        return chunk.capacity
+
+    def _compute_pulls(self) -> list[tuple[str, int]]:
+        """Chunks pulled per scheduling round per source: sources whose
+        rows sweep event time faster pull proportionally fewer chunks so
+        no watermark runs unboundedly ahead (ref: per-source rate
+        limits; BinaryJob.chunk_ratio generalized to N sources)."""
+        names = list(self.sources)
+        eprs = []
+        for n in names:
+            epr = getattr(self.sources[n], "events_per_row", None)
+            if epr is None:
+                return [(n, 1) for n in names]
+            eprs.append(Fraction(epr))
+        inv = [1 / e for e in eprs]
+        lo = min(inv)
+        pulls = []
+        for n, f in zip(names, inv):
+            ratio = f / lo
+            if ratio.denominator != 1 or ratio.numerator > 16:
+                return [(n, 1) for n in names]
+            pulls.append((n, int(ratio)))
+        return pulls
+
+    def chunk_round(self) -> int:
+        """One scheduling round: pull each source by its pacing ratio."""
+        rows = 0
+        for name, k in self._pulls:
+            for _ in range(k):
+                rows += self.run_chunk(name)
+        return rows
+
+    # -- barrier program ------------------------------------------------
+    def _flush_node(self, new_states: list, idx: int, epoch) -> None:
+        """Flush one fragment node; emissions cross downstream nodes.
+        Drains on device while the node reports pending output."""
+        node = self.nodes[idx]
+        frag = node.fragment
+        st, outs = frag._flush_impl(new_states[idx], epoch)
+        new_states[idx] = st
+        for out in outs:
+            self._propagate(new_states, [(("node", idx), out)])
+        if not frag.has_pending_protocol():
+            return
+
+        def cond(carry):
+            sts, it = carry
+            return (frag.pending_total(sts[idx]) > 0) & (
+                it < frag.MAX_DRAIN_ROUNDS
+            )
+
+        def body(carry):
+            sts, it = carry
+            lst = list(sts)
+            st2, outs2 = frag._flush_impl(lst[idx], epoch)
+            lst[idx] = st2
+            for out in outs2:
+                self._propagate(lst, [(("node", idx), out)])
+            return tuple(lst), it + 1
+
+        sts, _ = jax.lax.while_loop(
+            cond, body, (tuple(new_states), jnp.int32(0))
+        )
+        new_states[:] = list(sts)
+
+    def _flush_all(self, new_states: list, epoch) -> None:
+        for idx, node in enumerate(self.nodes):
+            if isinstance(node, FragNode):
+                self._flush_node(new_states, idx, epoch)
+
+    def _node_watermarks(self, new_states: list, idx: int):
+        """(Watermark, has) pairs produced by a fragment node's wm
+        filters (device scalars)."""
+        from risingwave_tpu.stream.message import Watermark
+        from risingwave_tpu.stream.watermark import WatermarkFilterExecutor
+
+        node = self.nodes[idx]
+        out = []
+        for i, ex in enumerate(node.fragment.executors):
+            if not isinstance(ex, WatermarkFilterExecutor):
+                continue
+            raw = new_states[idx][i].max_ts
+            has = raw != WM_NONE
+            val = jnp.where(has, raw - ex.delay_us, jnp.int64(WM_SAFE_FLOOR))
+            out.append((Watermark(ex.ts_col, val), has))
+        return out
+
+    def _wm_all(self, new_states: list) -> None:
+        """Propagate watermarks: within each fragment, then across node
+        boundaries to downstream FRAGMENT nodes (cascaded MVs).  Joins
+        block propagation — their two-sided min semantics are handled by
+        ``_clean_joins``."""
+        for idx, node in enumerate(self.nodes):
+            if not isinstance(node, FragNode):
+                continue
+            new_states[idx] = node.fragment._wm_impl(new_states[idx])
+            for wm, _ in self._node_watermarks(new_states, idx):
+                for j in self.downstream_closure(("node", idx)):
+                    dn = self.nodes[j]
+                    if not isinstance(dn, FragNode):
+                        continue
+                    lst = list(new_states[j])
+                    for k, ex2 in enumerate(dn.fragment.executors):
+                        lst[k] = ex2.on_watermark(lst[k], wm)
+                    new_states[j] = tuple(lst)
+
+    def _upstream_wm(self, new_states: list, ref: Ref, src_col: int):
+        """Walk a join input upstream to its wm filter for ``src_col``;
+        (value, has) device scalars or None when absent."""
+        from risingwave_tpu.stream.watermark import WatermarkFilterExecutor
+
+        while True:
+            kind, key = ref
+            if kind == "source":
+                return None
+            node = self.nodes[key]
+            if not isinstance(node, FragNode):
+                return None  # joins don't forward watermarks (yet)
+            for i, ex in enumerate(node.fragment.executors):
+                if isinstance(ex, WatermarkFilterExecutor) \
+                        and ex.ts_col == src_col:
+                    raw = new_states[key][i].max_ts
+                    has = raw != WM_NONE
+                    val = jnp.where(
+                        has, raw - ex.delay_us, jnp.int64(WM_SAFE_FLOOR)
+                    )
+                    return val, has
+            ref = node.input
+
+    def _clean_joins(self, new_states: list) -> None:
+        """Watermark-driven join state cleaning (windowed joins): each
+        side is cleaned by the MIN watermark across both inputs — a
+        build row for window W serves the other side's future probes
+        (BinaryJob._clean_join_state, generalized to DAG refs)."""
+        for idx, node in enumerate(self.nodes):
+            if not isinstance(node, JoinNode):
+                continue
+            join = node.join
+            wms = []
+            ok = True
+            for side, ref in (("left", node.left), ("right", node.right)):
+                clean = getattr(join, f"{side}_clean", None)
+                if clean is None:
+                    continue
+                wm = self._upstream_wm(new_states, ref, clean[2])
+                if wm is None:
+                    ok = False
+                    break
+                wms.append(wm)
+            if not ok or not wms:
+                continue
+            has_all = wms[0][1]
+            min_wm = wms[0][0]
+            for val, has in wms[1:]:
+                has_all = has_all & has
+                min_wm = jnp.minimum(min_wm, val)
+
+            def do_clean(jstate, join=join, min_wm=min_wm):
+                for side in ("left", "right"):
+                    clean = getattr(join, f"{side}_clean", None)
+                    if clean is None:
+                        continue
+                    key_idx, lag, _ = clean
+                    jstate = join.clean_below(
+                        jstate, side, key_idx, min_wm - lag
+                    )
+                if hasattr(join, "maybe_rehash"):
+                    jstate = join.maybe_rehash(jstate)
+                return jstate
+
+            new_states[idx] = jax.lax.cond(
+                has_all, do_clean, lambda j: j, new_states[idx]
+            )
+
+    def _collect_counters(self, new_states: list):
+        labels: list[str] = []
+        vals: list[jnp.ndarray] = []
+        for idx, node in enumerate(self.nodes):
+            if node is None:
+                continue
+            if isinstance(node, FragNode):
+                sub_labels, sub = collect_counters(
+                    node.fragment.executors, new_states[idx]
+                )
+                labels.extend(f"n{idx}.{x}" for x in sub_labels)
+                if sub.shape[0]:
+                    vals.append(sub)
+                continue
+            jstate = new_states[idx]
+            for side_name in ("left", "right"):
+                s = getattr(jstate, side_name)
+                for attr in COUNTER_ATTRS:
+                    if hasattr(s, attr):
+                        labels.append(f"n{idx}.join.{side_name}.{attr}")
+                        vals.append(getattr(s, attr).astype(jnp.int64)[None])
+            labels.append(f"n{idx}.join.emit_overflow")
+            vals.append(jstate.emit_overflow.astype(jnp.int64)[None])
+        counters = jnp.concatenate(vals) if vals \
+            else jnp.zeros((0,), jnp.int64)
+        return labels, counters
+
+    def _barrier_impl(self, states, epoch):
+        new_states = list(states)
+        self._flush_all(new_states, epoch)
+        # watermarks advance, then a second flush pass emits rows the
+        # new watermark closed (EOWC) at THIS barrier
+        self._wm_all(new_states)
+        self._flush_all(new_states, epoch)
+        self._clean_joins(new_states)
+        labels, counters = self._collect_counters(new_states)
+        self.counter_labels = labels
+        return tuple(new_states), counters
+
+    def inject_barrier(self) -> None:
+        self.barriers_seen += 1
+        sealed = self.epoch.curr.value
+        if self._barrier_prog is None:
+            self._barrier_prog = jax.jit(
+                self._barrier_impl, donate_argnums=(0,)
+            )
+        self.states, self._counters = self._barrier_prog(self.states, sealed)
+
+        if self.barriers_seen % self.checkpoint_frequency == 0:
+            self._ckpts_since_maintain += 1
+            if self._ckpts_since_maintain >= self.maintenance_interval:
+                self._maintain(sealed)
+                self._ckpts_since_maintain = 0
+            self._ckpts_since_snapshot += 1
+            if self._ckpts_since_snapshot >= self.snapshot_interval:
+                self._ckpts_since_snapshot = 0
+                self._commit_checkpoint(sealed)
+        self.epoch = self.epoch.bump()
+
+    # -- maintenance ----------------------------------------------------
+    def _maintain_impl(self, states):
+        new_states = list(states)
+        for idx, node in enumerate(self.nodes):
+            if isinstance(node, FragNode):
+                new_states[idx] = node.fragment._maintain_impl(
+                    new_states[idx]
+                )
+            elif isinstance(node, JoinNode) \
+                    and hasattr(node.join, "maybe_rehash"):
+                new_states[idx] = node.join.maybe_rehash(new_states[idx])
+        return tuple(new_states)
+
+    def _maintain(self, sealed) -> None:
+        if self._maintain_prog is None:
+            self._maintain_prog = jax.jit(
+                self._maintain_impl, donate_argnums=(0,)
+            )
+        self.states = self._maintain_prog(self.states)
+        if self._counters is None:
+            return
+        values = np.asarray(self._counters)  # THE one device sync
+        residual = check_counter_values(
+            self.name, self.counter_labels, values
+        )
+        for _ in range(64):
+            if not residual:
+                break
+            self.states, self._counters = self._barrier_prog(
+                self.states, sealed
+            )
+            residual = check_counter_values(
+                self.name, self.counter_labels, np.asarray(self._counters)
+            )
+
+    # -- checkpoint / recovery ------------------------------------------
+    def _commit_checkpoint(self, sealed) -> None:
+        new_states = list(self.states)
+        for idx, node in enumerate(self.nodes):
+            if isinstance(node, FragNode):
+                new_states[idx] = deliver_sinks(
+                    node.fragment, new_states[idx], sealed
+                )
+        self.states = tuple(new_states)
+        self.committed_epoch = sealed
+        src_state = {
+            name: (src.state() if hasattr(src, "state") else {})
+            for name, src in self.sources.items()
+        }
+        snap = CheckpointSnapshot(
+            epoch=sealed,
+            states=_snapshot_copy(self.states),
+            source_state=src_state,
+        )
+        self.checkpoints = [snap]
+        if self.checkpoint_store is not None:
+            self.checkpoint_store.save(
+                self.name, sealed, jax.device_get(snap.states), src_state
+            )
+
+    def recover(self) -> None:
+        """Reset to the last committed checkpoint (ref §3.5)."""
+        self._counters = None
+        if self.checkpoint_store is not None:
+            loaded = self.checkpoint_store.load(self.name)
+            if loaded is not None:
+                epoch, states, src_state = loaded
+                self.states = jax.device_put(states)
+                self.committed_epoch = epoch
+                for name, src in self.sources.items():
+                    restore_source(src, src_state.get(name, {}))
+                return
+        if not self.checkpoints:
+            self.states = tuple(
+                n.init_state() if n is not None else None
+                for n in self.nodes
+            )
+            for src in self.sources.values():
+                if hasattr(src, "offset"):
+                    src.offset = 0
+            return
+        snap = self.checkpoints[-1]
+        self.states = _snapshot_copy(snap.states)
+        for name, src in self.sources.items():
+            restore_source(src, snap.source_state.get(name, {}))
+
+    # -- backfill -------------------------------------------------------
+    def backfill_node(self, node_id: int, chunks, side: str | None = None,
+                      ) -> None:
+        """Feed snapshot chunks through ONE node's subtree (a freshly
+        attached cascade MV consuming the upstream MV's existing rows —
+        ref arrangement_backfill.rs, collapsed to snapshot replay since
+        the upstream MV is device-resident).  ``side`` targets a join
+        node's build/probe side.
+
+        NOT donated: the snapshot chunk aliases the upstream MV's state
+        buffers (it is built zero-copy from them), so donating the state
+        tree would donate the chunk's own storage."""
+        prog = jax.jit(
+            lambda states, chunk: self._backfill_impl(
+                states, chunk, node_id, side
+            ),
+        )
+        for chunk in chunks:
+            self.states = prog(self.states, chunk)
+
+    def _backfill_impl(self, states, chunk, node_id: int,
+                       side: str | None):
+        new_states = list(states)
+        node = self.nodes[node_id]
+        if isinstance(node, FragNode):
+            new_states[node_id], out = node.fragment._step_impl(
+                new_states[node_id], chunk
+            )
+        else:
+            new_states[node_id], out = node.join.apply(
+                new_states[node_id], chunk, side
+            )
+        if out is not None:
+            self._propagate(new_states, [(("node", node_id), out)])
+        return tuple(new_states)
+
+    # -- driving --------------------------------------------------------
+    def run(self, barriers: int, chunks_per_barrier: int) -> None:
+        for _ in range(barriers):
+            for _ in range(chunks_per_barrier):
+                self.chunk_round()
+            self.inject_barrier()
+
+    @classmethod
+    def binary(
+        cls,
+        left_source,
+        right_source,
+        join,
+        post_fragment: Fragment,
+        left_fragment: Fragment | None = None,
+        right_fragment: Fragment | None = None,
+        checkpoint_frequency: int = 1,
+        name: str = "join_job",
+        checkpoint_store=None,
+    ) -> "DagJob":
+        """Two sources → per-side prep → join → post chain (the former
+        BinaryJob shape as a DAG)."""
+        nodes: list = []
+        lref: Ref = ("source", "left")
+        rref: Ref = ("source", "right")
+        if left_fragment is not None:
+            nodes.append(FragNode(left_fragment, lref))
+            lref = ("node", len(nodes) - 1)
+        if right_fragment is not None:
+            nodes.append(FragNode(right_fragment, rref))
+            rref = ("node", len(nodes) - 1)
+        nodes.append(JoinNode(join, lref, rref))
+        nodes.append(FragNode(post_fragment, ("node", len(nodes) - 1)))
+        return cls(
+            {"left": left_source, "right": right_source}, nodes,
+            name=name, checkpoint_frequency=checkpoint_frequency,
+            checkpoint_store=checkpoint_store,
+        )

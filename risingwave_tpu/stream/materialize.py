@@ -147,7 +147,10 @@ class MaterializeExecutor(Executor):
             _scatter_col(store, ins_pos, col)
             for store, col in zip(state.values, chunk.columns)
         )
-        return MvState(table, values, state.overflow + n_over), None
+        # pass the changelog through: downstream (cascaded) MVs consume
+        # this MV's change stream, exactly as the reference's dispatcher
+        # forwards the materialize fragment's output to dependent jobs
+        return MvState(table, values, state.overflow + n_over), chunk
 
     # -- maintenance ----------------------------------------------------
     def maybe_rehash(self, state: MvState) -> MvState:
@@ -239,7 +242,7 @@ class AppendOnlyMaterialize(Executor):
         return RingState(
             tuple(values), state.cursor + n,
             state.overflow + (lost_after - lost_before),
-        ), None
+        ), chunk  # pass-through: cascaded MVs tap this changelog
 
     def to_host(self, state: RingState, limit: int | None = None) -> list[tuple]:
         total = int(state.cursor)

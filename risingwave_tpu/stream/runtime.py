@@ -326,6 +326,11 @@ class StreamingJob:
         restore_source(self.source, snap.source_state)
 
     # ------------------------------------------------------------------
+    def chunk_round(self) -> int:
+        """Uniform driving interface shared with DagJob (one scheduling
+        round = one chunk for a single-source linear job)."""
+        return self.run_chunk()
+
     def run(self, barriers: int, chunks_per_barrier: int) -> None:
         """The steady-state loop (ref §3.3)."""
         for _ in range(barriers):
@@ -335,356 +340,3 @@ class StreamingJob:
 
     def executor_state(self, idx: int):
         return self.states[idx]
-
-
-class BinaryJob:
-    """Two sources → per-side fragments → join → post fragment.
-
-    The reference runs a join as one actor whose two upstream inputs are
-    barrier-aligned by ``barrier_align.rs:44``; here alignment is the
-    host loop pulling both sides before each barrier, and the whole
-    per-chunk path (side fragment + join update/probe + post fragment)
-    is one jitted program per side.  The barrier crossing — side
-    flushes + drains feeding the join, watermark propagation, join
-    state cleaning, counters — is ONE jitted program, so the loop stays
-    fully asynchronous like ``StreamingJob``.
-    """
-
-    def __init__(
-        self,
-        left_source,
-        right_source,
-        join,
-        post_fragment: Fragment,
-        left_fragment: Fragment | None = None,
-        right_fragment: Fragment | None = None,
-        checkpoint_frequency: int = 1,
-        name: str = "join_job",
-        checkpoint_store=None,
-    ):
-        self.checkpoint_store = checkpoint_store
-        self.maintenance_interval = 1
-        self._ckpts_since_maintain = 0
-        self.snapshot_interval = 1
-        self._ckpts_since_snapshot = 0
-        #: chunks pulled per scheduling unit (left, right) — sides whose
-        #: rows represent different event-time spans pace proportionally
-        #: so neither watermark runs unboundedly ahead (nexmark persons
-        #: sweep event time 3x faster per row than auctions)
-        self.chunk_ratio = self._compute_ratio(left_source, right_source)
-        self.left_source = left_source
-        self.right_source = right_source
-        self.join = join
-        self.post = post_fragment
-        self.left_frag = left_fragment
-        self.right_frag = right_fragment
-        self.name = name
-        self.checkpoint_frequency = checkpoint_frequency
-        self.states = (
-            left_fragment.init_states() if left_fragment else (),
-            right_fragment.init_states() if right_fragment else (),
-            join.init_state(),
-            post_fragment.init_states(),
-        )
-        self.epoch = EpochPair.first()
-        self.barriers_seen = 0
-        self.checkpoints: list[CheckpointSnapshot] = []
-        self.committed_epoch = 0
-        self._counters = None
-        self.counter_labels: list[str] = []
-        self._step = {
-            "left": jax.jit(lambda st, ch: self._side_step(st, ch, "left"),
-                            donate_argnums=(0,)),
-            "right": jax.jit(lambda st, ch: self._side_step(st, ch, "right"),
-                             donate_argnums=(0,)),
-        }
-        self._barrier = jax.jit(self._barrier_impl, donate_argnums=(0,))
-        self._maintain_prog = jax.jit(
-            self._maintain_impl, donate_argnums=(0,)
-        )
-
-    @staticmethod
-    def _compute_ratio(left_source, right_source) -> tuple[int, int]:
-        try:
-            from fractions import Fraction
-            frac = Fraction(left_source.events_per_row) / Fraction(
-                right_source.events_per_row
-            )
-            if frac.numerator <= 16 and frac.denominator <= 16:
-                return (frac.denominator, frac.numerator)
-        except AttributeError:
-            pass
-        return (1, 1)
-
-    def _side_step(self, states, chunk, side: str):
-        lstate, rstate, jstate, pstate = states
-        frag = self.left_frag if side == "left" else self.right_frag
-        if frag is not None:
-            if side == "left":
-                lstate, chunk = frag._step_impl(lstate, chunk)
-            else:
-                rstate, chunk = frag._step_impl(rstate, chunk)
-        if chunk is not None:
-            jstate, out = self.join.apply(jstate, chunk, side)
-            if out is not None:
-                pstate, _ = self.post._step_impl(pstate, out)
-        return (lstate, rstate, jstate, pstate)
-
-    def run_chunk(self, side: str) -> int:
-        source = self.left_source if side == "left" else self.right_source
-        chunk = source.next_chunk()
-        self.states = self._step[side](self.states, chunk)
-        return chunk.capacity
-
-    # -- the single-dispatch barrier program ----------------------------
-    def _feed(self, jstate, pstate, chunk, side: str):
-        jstate, out = self.join.apply(jstate, chunk, side)
-        if out is not None:
-            pstate, _ = self.post._step_impl(pstate, out)
-        return jstate, pstate
-
-    def _flush_side(self, frag, st, jstate, pstate, side: str, epoch):
-        """Flush one side fragment; its emissions cross the join and the
-        post fragment.  Drains on device when the side has pending."""
-        st, outs = frag._flush_impl(st, epoch)
-        for out in outs:
-            jstate, pstate = self._feed(jstate, pstate, out, side)
-        if frag.has_pending_protocol():
-
-            def cond(carry):
-                st, jstate, pstate, it = carry
-                return (frag.pending_total(st) > 0) & (
-                    it < frag.MAX_DRAIN_ROUNDS
-                )
-
-            def body(carry):
-                st, jstate, pstate, it = carry
-                st, outs = frag._flush_impl(st, epoch)
-                for out in outs:
-                    jstate, pstate = self._feed(jstate, pstate, out, side)
-                return st, jstate, pstate, it + 1
-
-            st, jstate, pstate, _ = jax.lax.while_loop(
-                cond, body, (st, jstate, pstate, jnp.int32(0))
-            )
-        return st, jstate, pstate
-
-    def _side_wm_device(self, frag, st, src_col):
-        """(value, has) device watermark from a side's wm filter, or
-        None when the side has no matching generator (static)."""
-        from risingwave_tpu.stream.watermark import WatermarkFilterExecutor
-
-        if frag is None:
-            return None
-        for i, ex in enumerate(frag.executors):
-            if isinstance(ex, WatermarkFilterExecutor) \
-                    and ex.ts_col == src_col:
-                raw = st[i].max_ts
-                has = raw != WM_NONE
-                val = jnp.where(
-                    has, raw - ex.delay_us, jnp.int64(WM_SAFE_FLOOR)
-                )
-                return val, has
-        return None
-
-    def _clean_join_state(self, lstate, rstate, jstate):
-        """Watermark-driven join state cleaning (windowed joins).
-
-        A build-side row for window W serves the OTHER side's future
-        probes, so each side is cleaned by the MINIMUM watermark across
-        both inputs (one side's event time may run far ahead — e.g.
-        nexmark persons sweep event numbers ~3x faster than auctions).
-        Fully on device: values are traced scalars, the clean+rehash is
-        gated by ``lax.cond`` on watermark presence."""
-        wms = []
-        for side, frag, st in (("left", self.left_frag, lstate),
-                               ("right", self.right_frag, rstate)):
-            clean = getattr(self.join, f"{side}_clean", None)
-            if clean is None:
-                continue
-            wm = self._side_wm_device(frag, st, clean[2])
-            if wm is None:
-                return jstate  # side lacks a wm generator (static)
-            wms.append(wm)
-        if not wms:
-            return jstate
-        has_all = wms[0][1]
-        min_wm = wms[0][0]
-        for val, has in wms[1:]:
-            has_all = has_all & has
-            min_wm = jnp.minimum(min_wm, val)
-
-        def do_clean(jstate):
-            for side in ("left", "right"):
-                clean = getattr(self.join, f"{side}_clean", None)
-                if clean is None:
-                    continue
-                key_idx, lag, _ = clean
-                jstate = self.join.clean_below(
-                    jstate, side, key_idx, min_wm - lag
-                )
-            # cleaning tombstones slots; reclaim promptly (self-gated on
-            # tombstone fraction) or the table starves within barriers
-            if hasattr(self.join, "maybe_rehash"):
-                jstate = self.join.maybe_rehash(jstate)
-            return jstate
-
-        return jax.lax.cond(has_all, do_clean, lambda j: j, jstate)
-
-    def _barrier_impl(self, states, epoch):
-        lstate, rstate, jstate, pstate = states
-
-        # side fragments flush first; their emissions cross the join
-        if self.left_frag is not None:
-            lstate, jstate, pstate = self._flush_side(
-                self.left_frag, lstate, jstate, pstate, "left", epoch
-            )
-        if self.right_frag is not None:
-            rstate, jstate, pstate = self._flush_side(
-                self.right_frag, rstate, jstate, pstate, "right", epoch
-            )
-        pstate = self.post._flush_states_only(pstate, epoch)
-        pstate = self.post._drain_impl(pstate, epoch)
-
-        # watermarks propagate within each fragment, then re-drain:
-        # EOWC rows closed by THIS barrier's watermark emit now
-        if self.left_frag is not None:
-            lstate = self.left_frag._wm_impl(lstate)
-            lstate, jstate, pstate = self._flush_side(
-                self.left_frag, lstate, jstate, pstate, "left", epoch
-            )
-        if self.right_frag is not None:
-            rstate = self.right_frag._wm_impl(rstate)
-            rstate, jstate, pstate = self._flush_side(
-                self.right_frag, rstate, jstate, pstate, "right", epoch
-            )
-        pstate = self.post._wm_impl(pstate)
-        pstate = self.post._drain_impl(pstate, epoch)
-        jstate = self._clean_join_state(lstate, rstate, jstate)
-
-        # one counters vector for the whole job
-        labels: list[str] = []
-        vals: list[jnp.ndarray] = []
-        for tag, frag, st in (("left", self.left_frag, lstate),
-                              ("right", self.right_frag, rstate),
-                              ("post", self.post, pstate)):
-            if frag is None:
-                continue
-            sub_labels, sub = collect_counters(frag.executors, st)
-            labels.extend(f"{tag}.{x}" for x in sub_labels)
-            vals.append(sub)
-        for side_name in ("left", "right"):
-            s = getattr(jstate, side_name)
-            for attr in COUNTER_ATTRS:
-                if hasattr(s, attr):
-                    labels.append(f"join.{side_name}.{attr}")
-                    vals.append(getattr(s, attr).astype(jnp.int64)[None])
-        labels.append("join.emit_overflow")
-        vals.append(jstate.emit_overflow.astype(jnp.int64)[None])
-        counters = jnp.concatenate(vals) if vals \
-            else jnp.zeros((0,), jnp.int64)
-        self.counter_labels = labels
-        return (lstate, rstate, jstate, pstate), counters
-
-    def inject_barrier(self) -> None:
-        self.barriers_seen += 1
-        sealed = self.epoch.curr.value
-        self.states, self._counters = self._barrier(self.states, sealed)
-
-        if self.barriers_seen % self.checkpoint_frequency == 0:
-            self._ckpts_since_maintain += 1
-            if self._ckpts_since_maintain >= self.maintenance_interval:
-                self._maintain(sealed)
-                self._ckpts_since_maintain = 0
-            self._ckpts_since_snapshot += 1
-            if self._ckpts_since_snapshot >= self.snapshot_interval:
-                self._ckpts_since_snapshot = 0
-                lstate, rstate, jstate, pstate = self.states
-                pstate = deliver_sinks(self.post, pstate, sealed)
-                self.states = (lstate, rstate, jstate, pstate)
-                self.committed_epoch = sealed
-                src_state = {
-                    "left": self.left_source.state()
-                    if hasattr(self.left_source, "state") else {},
-                    "right": self.right_source.state()
-                    if hasattr(self.right_source, "state") else {},
-                }
-                snap = CheckpointSnapshot(
-                    epoch=sealed,
-                    states=_snapshot_copy(self.states),
-                    source_state=src_state,
-                )
-                self.checkpoints = [snap]
-                if self.checkpoint_store is not None:
-                    self.checkpoint_store.save(
-                        self.name, sealed, jax.device_get(snap.states),
-                        src_state,
-                    )
-        self.epoch = self.epoch.bump()
-
-    def _maintain_impl(self, states):
-        lstate, rstate, jstate, pstate = states
-        if self.left_frag is not None:
-            lstate = self.left_frag._maintain_impl(lstate)
-        if self.right_frag is not None:
-            rstate = self.right_frag._maintain_impl(rstate)
-        if hasattr(self.join, "maybe_rehash"):
-            jstate = self.join.maybe_rehash(jstate)
-        pstate = self.post._maintain_impl(pstate)
-        return (lstate, rstate, jstate, pstate)
-
-    def _maintain(self, sealed) -> None:
-        self.states = self._maintain_prog(self.states)
-        if self._counters is None:
-            return
-        values = np.asarray(self._counters)  # THE one device sync
-        residual = check_counter_values(
-            self.name, self.counter_labels, values
-        )
-        for _ in range(64):
-            if not residual:
-                break
-            self.states, self._counters = self._barrier(self.states, sealed)
-            residual = check_counter_values(
-                self.name, self.counter_labels, np.asarray(self._counters)
-            )
-
-    def recover(self) -> None:
-        """Reset to the last committed checkpoint (ref §3.5)."""
-        self._counters = None
-        if self.checkpoint_store is not None:
-            loaded = self.checkpoint_store.load(self.name)
-            if loaded is not None:
-                epoch, states, src_state = loaded
-                self.states = jax.device_put(states)
-                self.committed_epoch = epoch
-                for side, src in (("left", self.left_source),
-                                  ("right", self.right_source)):
-                    restore_source(src, src_state.get(side, {}))
-                return
-        if not self.checkpoints:
-            self.states = (
-                self.left_frag.init_states() if self.left_frag else (),
-                self.right_frag.init_states() if self.right_frag else (),
-                self.join.init_state(),
-                self.post.init_states(),
-            )
-            for src in (self.left_source, self.right_source):
-                if hasattr(src, "offset"):
-                    src.offset = 0
-            return
-        snap = self.checkpoints[-1]
-        self.states = _snapshot_copy(snap.states)
-        for side, src in (("left", self.left_source),
-                          ("right", self.right_source)):
-            restore_source(src, snap.source_state.get(side, {}))
-
-    def run(self, barriers: int, chunks_per_barrier: int) -> None:
-        l, r = self.chunk_ratio
-        for _ in range(barriers):
-            for _ in range(chunks_per_barrier):
-                for _ in range(l):
-                    self.run_chunk("left")
-                for _ in range(r):
-                    self.run_chunk("right")
-            self.inject_barrier()

@@ -244,6 +244,10 @@ class ShardedStreamingJob:
         self.paused = False
         self._mem_snapshot = None
 
+    def chunk_round(self) -> int:
+        """Uniform driving interface shared with DagJob."""
+        return self.run_chunk()
+
     def run_chunk(self) -> int:
         if self.paused:
             return 0

@@ -1,0 +1,182 @@
+"""DAG scheduler tests: MV-on-MV cascades, multi-way joins, shared jobs.
+
+Reference counterparts: fragment-graph jobs
+(src/frontend/src/stream_fragmenter/mod.rs:388), MV-on-MV via the
+materialize fragment's dispatcher (dispatch.rs:62), backfill of the
+upstream snapshot (backfill/arrangement_backfill.rs).
+"""
+
+import numpy as np
+import pytest
+
+from risingwave_tpu.sql import Engine
+from risingwave_tpu.sql.planner import PlannerConfig
+
+
+def small_engine():
+    return Engine(PlannerConfig(
+        chunk_capacity=64,
+        agg_table_size=1 << 10,
+        agg_emit_capacity=256,
+        join_table_size=1 << 9,
+        join_out_capacity=1 << 11,
+        mv_table_size=1 << 10,
+        mv_ring_size=1 << 12,
+        topn_pool_size=256,
+        topn_emit_capacity=128,
+    ))
+
+
+BID = """
+CREATE SOURCE bid (
+    auction BIGINT, bidder BIGINT, price BIGINT, date_time TIMESTAMP
+) WITH (connector = 'nexmark', nexmark.table = 'bid',
+        nexmark.event.rate = '1000000');
+"""
+
+
+def test_cascade_mv_on_mv():
+    """v2 = filter over v1 (project): rows flow through the cascade."""
+    eng = small_engine()
+    eng.execute(BID)
+    eng.execute("""
+        CREATE MATERIALIZED VIEW v1 AS
+        SELECT auction, price * 2 AS p2 FROM bid;
+    """)
+    eng.execute("""
+        CREATE MATERIALIZED VIEW v2 AS
+        SELECT auction, p2 FROM v1 WHERE p2 > 1000;
+    """)
+    eng.tick(barriers=3, chunks_per_barrier=2)
+    v1 = eng.execute("SELECT * FROM v1")
+    v2 = eng.execute("SELECT * FROM v2")
+    want = sorted(r for r in v1 if r[1] > 1000)
+    assert sorted(v2) == want
+    assert len(v2) > 0
+
+
+def test_cascade_backfill_history():
+    """An MV created AFTER the upstream has run serves upstream history
+    (ref arrangement backfill)."""
+    eng = small_engine()
+    eng.execute(BID)
+    eng.execute("""
+        CREATE MATERIALIZED VIEW v1 AS
+        SELECT auction, price FROM bid;
+    """)
+    eng.tick(barriers=3, chunks_per_barrier=2)  # v1 accumulates history
+    before = len(eng.execute("SELECT * FROM v1"))
+    assert before > 0
+    eng.execute("CREATE MATERIALIZED VIEW v2 AS SELECT auction FROM v1;")
+    eng.execute("FLUSH")
+    v2 = eng.execute("SELECT * FROM v2")
+    assert len(v2) >= before  # history backfilled, not started from now
+
+
+def test_cascade_agg_over_agg():
+    """Retractable cascade: agg over an agg MV's changelog."""
+    eng = small_engine()
+    eng.execute(BID)
+    eng.execute("""
+        CREATE MATERIALIZED VIEW per_auction AS
+        SELECT auction, count(*) AS bids FROM bid GROUP BY auction;
+    """)
+    eng.execute("""
+        CREATE MATERIALIZED VIEW total AS
+        SELECT count(*) AS auctions, sum(bids) AS bids
+        FROM per_auction;
+    """)
+    eng.tick(barriers=4, chunks_per_barrier=2)
+    per = eng.execute("SELECT * FROM per_auction")
+    tot = eng.execute("SELECT * FROM total")
+    assert len(tot) == 1
+    assert tot[0][0] == len(per)
+    assert tot[0][1] == sum(r[1] for r in per)
+
+
+def test_three_way_join():
+    """Nested (left-deep) join tree plans and runs end-to-end."""
+    eng = small_engine()
+    eng.execute("""
+        CREATE TABLE t1 (k BIGINT, a BIGINT);
+        CREATE TABLE t2 (k BIGINT, b BIGINT);
+        CREATE TABLE t3 (k BIGINT, c BIGINT);
+    """)
+    eng.execute("""
+        CREATE MATERIALIZED VIEW j3 AS
+        SELECT t1.a AS a, t2.b AS b, t3.c AS c
+        FROM t1 JOIN t2 ON t1.k = t2.k JOIN t3 ON t1.k = t3.k;
+    """)
+    eng.execute("INSERT INTO t1 VALUES (1, 10), (2, 20), (3, 30)")
+    eng.execute("INSERT INTO t2 VALUES (1, 100), (2, 200)")
+    eng.execute("INSERT INTO t3 VALUES (1, 1000), (9, 9000)")
+    eng.tick(barriers=2, chunks_per_barrier=1)
+    rows = eng.execute("SELECT * FROM j3")
+    assert sorted(rows) == [(10, 100, 1000)]
+
+
+def test_join_of_two_mvs_merges_jobs():
+    """SELECT from mv JOIN mv: upstream jobs fuse into one DAG."""
+    eng = small_engine()
+    eng.execute("""
+        CREATE TABLE l (k BIGINT, a BIGINT);
+        CREATE TABLE r (k BIGINT, b BIGINT);
+    """)
+    eng.execute(
+        "CREATE MATERIALIZED VIEW lv AS SELECT k, a FROM l;"
+    )
+    eng.execute(
+        "CREATE MATERIALIZED VIEW rv AS SELECT k, b * 2 AS b2 FROM r;"
+    )
+    eng.execute("INSERT INTO l VALUES (1, 10), (2, 20)")
+    eng.execute("INSERT INTO r VALUES (2, 200), (3, 300)")
+    eng.tick(barriers=2, chunks_per_barrier=1)  # history before the join MV
+    eng.execute("""
+        CREATE MATERIALIZED VIEW joined AS
+        SELECT lv.a AS a, rv.b2 AS b2
+        FROM lv JOIN rv ON lv.k = rv.k;
+    """)
+    eng.execute("FLUSH")
+    rows = eng.execute("SELECT * FROM joined")
+    assert sorted(rows) == [(20, 400)]  # history joined via backfill
+    # live updates keep flowing after the merge
+    eng.execute("INSERT INTO l VALUES (3, 30)")
+    eng.tick(barriers=2, chunks_per_barrier=1)
+    rows = eng.execute("SELECT * FROM joined")
+    assert sorted(rows) == [(20, 400), (30, 600)]
+    assert len(eng.jobs) == 1  # everything fused into one DAG job
+
+
+def test_drop_rejects_dependents_then_cascade_drop():
+    eng = small_engine()
+    eng.execute(BID)
+    eng.execute(
+        "CREATE MATERIALIZED VIEW v1 AS SELECT auction, price FROM bid;"
+    )
+    eng.execute("CREATE MATERIALIZED VIEW v2 AS SELECT auction FROM v1;")
+    eng.tick(barriers=1, chunks_per_barrier=1)
+    with pytest.raises(ValueError):
+        eng.execute("DROP MATERIALIZED VIEW v1")
+    eng.execute("DROP MATERIALIZED VIEW v2")
+    eng.execute("DROP MATERIALIZED VIEW v1")  # now allowed
+    eng.tick(barriers=1, chunks_per_barrier=1)
+    assert eng.execute("SHOW MATERIALIZED VIEWS") == []
+
+
+def test_cascade_survives_recovery():
+    """Cascaded jobs recover from the shared checkpoint."""
+    eng = small_engine()
+    eng.execute(BID)
+    eng.execute(
+        "CREATE MATERIALIZED VIEW v1 AS SELECT auction, price FROM bid;"
+    )
+    eng.execute("CREATE MATERIALIZED VIEW v2 AS SELECT auction FROM v1;")
+    eng.tick(barriers=3, chunks_per_barrier=2)
+    v2_committed = eng.execute("SELECT count(*) FROM v2")[0][0]
+    # uncommitted progress is rolled back by recovery
+    eng.jobs[0].run_chunk(next(iter(eng.jobs[0].sources)))
+    eng.recover()
+    assert eng.execute("SELECT count(*) FROM v2")[0][0] == v2_committed
+    # and the cascade keeps running after recovery
+    eng.tick(barriers=2, chunks_per_barrier=2)
+    assert eng.execute("SELECT count(*) FROM v2")[0][0] > v2_committed

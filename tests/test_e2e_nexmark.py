@@ -22,7 +22,8 @@ from risingwave_tpu.stream.materialize import (
     AppendOnlyMaterialize,
     MaterializeExecutor,
 )
-from risingwave_tpu.stream.runtime import BinaryJob, StreamingJob
+from risingwave_tpu.stream.dag import DagJob
+from risingwave_tpu.stream.runtime import StreamingJob
 
 WINDOW_US = 10_000_000
 
@@ -105,7 +106,7 @@ def test_q8_style_windowed_join():
         right_bucket_cap=512,   # hot sellers concentrate auctions
     )
     mv = AppendOnlyMaterialize(join.out_schema, ring_size=1 << 15)
-    job = BinaryJob(persons, auctions, join, Fragment([mv]),
+    job = DagJob.binary(persons, auctions, join, Fragment([mv]),
                     left_fragment=Fragment([p_proj]),
                     right_fragment=Fragment([a_proj]))
     job.run(barriers=2, chunks_per_barrier=1)

@@ -8,7 +8,7 @@ from risingwave_tpu.expr.node import col
 from risingwave_tpu.stream.fragment import Fragment
 from risingwave_tpu.stream.hash_join import HashJoinExecutor
 from risingwave_tpu.stream.materialize import AppendOnlyMaterialize
-from risingwave_tpu.stream.runtime import BinaryJob
+from risingwave_tpu.stream.dag import DagJob
 
 L = Schema.of(("k", DataType.INT64), ("a", DataType.INT64))
 R = Schema.of(("k", DataType.INT64), ("b", DataType.INT64))
@@ -163,7 +163,7 @@ def test_binary_job_end_to_end():
 
     j = _join()
     mv = AppendOnlyMaterialize(j.out_schema, ring_size=256)
-    job = BinaryJob(
+    job = DagJob.binary(
         ListSource([_lc("""
             I I
             + 1 10
@@ -182,7 +182,8 @@ def test_binary_job_end_to_end():
         Fragment([mv]),
     )
     job.run(barriers=1, chunks_per_barrier=2)
-    rows = mv.to_host(job.states[3][0])
+    # nodes: [join, post] — the post fragment holds the MV
+    rows = mv.to_host(job.states[1][0])
     assert sorted(rows) == [(1, 10, 1, 100), (2, 20, 2, 200)]
     assert job.committed_epoch > 0
 
@@ -233,7 +234,7 @@ def test_binary_job_recover():
 
     j = _join()
     mv = AppendOnlyMaterialize(j.out_schema, ring_size=256)
-    job = BinaryJob(
+    job = DagJob.binary(
         ReplaySource([_lc("""
             I I
             + 1 10
@@ -246,10 +247,10 @@ def test_binary_job_recover():
     )
     job.run(barriers=1, chunks_per_barrier=1)
     committed = job.committed_epoch
-    n_rows = len(mv.to_host(job.states[3][0]))
+    n_rows = len(mv.to_host(job.states[1][0]))
     # process more, then crash before the barrier
     job.run_chunk("left")
     job.recover()
-    assert job.left_source.offset == 1
-    assert len(mv.to_host(job.states[3][0])) == n_rows
+    assert job.sources["left"].offset == 1
+    assert len(mv.to_host(job.states[1][0])) == n_rows
     assert job.committed_epoch == committed
