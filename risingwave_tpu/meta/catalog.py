@@ -33,6 +33,12 @@ class CatalogEntry:
     #: mview/sink on a DagJob: the node ids this entry contributed
     #: (removed together on DROP)
     dag_nodes: Any = None
+    #: source names this entry attached to a shared DagJob (detached on
+    #: DROP so dropped MVs' private readers stop being pulled)
+    dag_sources: Any = None
+    #: mview: pk column positions in ``schema`` (the stream key exposed
+    #: to downstream cascaded plans); None for append-only ring MVs
+    stream_key: Any = None
     definition: str = ""
 
 

@@ -135,6 +135,10 @@ class Engine:
                         # removing only this MV's nodes; raises while
                         # dependent (cascaded) MVs still consume them
                         job.remove_nodes(entry.dag_nodes)
+                        # this MV's private readers must stop being
+                        # pulled once nothing consumes them
+                        job.remove_sources(entry.dag_sources or [])
+                        job.reseed_checkpoint()
                     else:
                         self.jobs.remove(job)
                 if entry.kind == "sink" and entry.mv_executor is not None:
@@ -394,6 +398,10 @@ class Engine:
         entry.job = dag
         entry.mv_state_index = (0,) + tuple(entry.mv_state_index)
         entry.dag_nodes = [0]
+        entry.dag_sources = [src_name]
+        # retained checkpoints hold the StreamingJob-shaped state tree;
+        # re-snapshot so recover() sees the DagJob shape
+        dag.reseed_checkpoint()
         return dag, 0
 
     def _mv_snapshot_chunk(self, entry: CatalogEntry):
@@ -441,7 +449,17 @@ class Engine:
                 plan.mv_index
             ]
             return job, terminal, (plan.mv_node, plan.mv_index), \
-                list(range(len(plan.nodes))), True
+                (list(range(len(plan.nodes))), list(plan.sources)), True
+
+        # validate every tap BEFORE mutating any live job: a failure
+        # mid-attach would otherwise leave half-merged jobs behind
+        for sname, tap in taps.items():
+            entry = self.catalog.get(tap.name)
+            if not isinstance(entry.job, (DagJob, StreamingJob)):
+                raise PlanError(
+                    f"MV-on-MV over {type(entry.job).__name__} (sharded "
+                    "upstream): next round"
+                )
 
         # attach: resolve every tap to its upstream job's MV node
         tap_refs: dict[str, int] = {}
@@ -492,28 +510,38 @@ class Engine:
                 ))
         ids = target.add_nodes(rewritten)
 
-        # backfill: new nodes directly consuming a tapped MV replay its
-        # current snapshot before going live (device-side, one chunk)
-        for sname, entry in tap_entries.items():
-            tap_node = tap_refs[sname]
-            snapshot = None
-            for nid in ids:
-                node = target.nodes[nid]
-                if isinstance(node, FragNode):
-                    consumes = node.input == ("node", tap_node)
-                    side = None
-                else:
-                    consumes = ("node", tap_node) in (node.left, node.right)
-                    side = "left" if node.left == ("node", tap_node) \
-                        else "right"
-                if consumes:
-                    if snapshot is None:
-                        snapshot = self._mv_snapshot_chunk(entry)
-                    target.backfill_node(nid, [snapshot], side=side)
+        # backfill: every NEW input slot that consumes a tapped MV
+        # replays its current snapshot before going live (device-side,
+        # one chunk).  Per input SLOT, not per tap — a self-join of one
+        # MV taps it on both sides and each side backfills exactly once
+        # (left before right: the right pass probes the filled left
+        # side, producing the complete snapshot x snapshot join).
+        tap_by_node = {tap_refs[s]: e for s, e in tap_entries.items()}
+        snapshots: dict[int, Any] = {}
 
+        def snap_for(tap_node: int):
+            if tap_node not in snapshots:
+                snapshots[tap_node] = self._mv_snapshot_chunk(
+                    tap_by_node[tap_node]
+                )
+            return snapshots[tap_node]
+
+        for nid in ids:
+            node = target.nodes[nid]
+            if isinstance(node, FragNode):
+                slots = [(node.input, None)]
+            else:
+                slots = [(node.left, "left"), (node.right, "right")]
+            for ref, side in slots:
+                if ref[0] == "node" and ref[1] in tap_by_node:
+                    target.backfill_node(
+                        nid, [snap_for(ref[1])], side=side
+                    )
+
+        target.reseed_checkpoint()
         terminal = rewritten[plan.mv_node].fragment.executors[plan.mv_index]
         return target, terminal, (ids[plan.mv_node], plan.mv_index), \
-            ids, False
+            (ids, list(src_rename.values())), False
 
     def _merge_dag_jobs(self, a: DagJob, b: DagJob) -> DagJob:
         """Fuse job ``b`` into ``a`` (a join of MVs living in different
@@ -673,21 +701,28 @@ class Engine:
     def _create_mview(self, stmt: ast.CreateMaterializedView):
         from risingwave_tpu.stream.materialize import AppendOnlyMaterialize
 
-        if stmt.name in self.catalog and stmt.if_not_exists:
-            return None
+        if stmt.name in self.catalog:
+            # checked BEFORE building: _build_job mutates live shared
+            # jobs (attach/merge), which must not happen for a
+            # doomed-to-fail duplicate
+            if stmt.if_not_exists:
+                return None
+            raise ValueError(f"{stmt.name!r} already exists")
         plan = self.planner.plan(stmt.query,
                                  eowc=stmt.emit_on_window_close)
-        job, mv_exec, state_index, dag_nodes, is_new = self._build_job(
+        job, mv_exec, state_index, dag_meta, is_new = self._build_job(
             plan, stmt.name
         )
         entry = CatalogEntry(
             stmt.name, "mview", mv_exec.in_schema,
             job=job, mv_executor=mv_exec, mv_state_index=state_index,
             append_only=isinstance(mv_exec, AppendOnlyMaterialize),
-            dag_nodes=dag_nodes,
+            dag_nodes=dag_meta[0] if dag_meta else None,
+            dag_sources=dag_meta[1] if dag_meta else None,
+            stream_key=list(getattr(mv_exec, "pk_indices", [])) or None,
             definition=str(stmt),
         )
-        self.catalog.create(entry, stmt.if_not_exists)
+        self.catalog.create(entry)
         if is_new:
             self.jobs.append(job)
         return None
@@ -695,8 +730,10 @@ class Engine:
     def _create_sink(self, stmt: ast.CreateSink):
         from risingwave_tpu.connector.sinks import create_sink
 
-        if stmt.name in self.catalog and stmt.if_not_exists:
-            return None
+        if stmt.name in self.catalog:
+            if stmt.if_not_exists:
+                return None
+            raise ValueError(f"{stmt.name!r} already exists")
         if stmt.query is not None:
             query = stmt.query
         else:
@@ -706,15 +743,17 @@ class Engine:
             )
         sink = create_sink(stmt.with_options)
         plan = self.planner.plan(query, sink=sink)
-        job, sink_exec, _, dag_nodes, is_new = self._build_job(
+        job, sink_exec, _, dag_meta, is_new = self._build_job(
             plan, stmt.name
         )
         entry = CatalogEntry(
             stmt.name, "sink", sink_exec.in_schema,
-            job=job, mv_executor=sink_exec, dag_nodes=dag_nodes,
+            job=job, mv_executor=sink_exec,
+            dag_nodes=dag_meta[0] if dag_meta else None,
+            dag_sources=dag_meta[1] if dag_meta else None,
             definition=str(stmt),
         )
-        self.catalog.create(entry, stmt.if_not_exists)
+        self.catalog.create(entry)
         if is_new:
             self.jobs.append(job)
         return None

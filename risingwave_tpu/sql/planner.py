@@ -57,6 +57,10 @@ class PlannedInput:
     watermark_col: int | None    # col idx in `schema` carrying event time
     window_size: int | None      # tumble/hop size (for cleaning lag)
     append_only: bool
+    #: column positions uniquely identifying a row of this input's
+    #: changelog (the reference's *stream key*) — required to key the
+    #: materialization of retractable non-agg plans
+    stream_key: "list[int] | None" = None
 
 
 @dataclass
@@ -150,6 +154,7 @@ class Planner:
                     MvTap(from_.name), [],
                     Scope.of(entry.schema, qual), entry.schema,
                     None, None, entry.append_only,
+                    stream_key=entry.stream_key,
                 )
             if entry.kind != "source":
                 raise PlanError(
@@ -235,6 +240,29 @@ class Planner:
             items = self._expand_items(select.items, scope)
             b = Binder(scope)
             proj = [(name, b.bind(e)) for name, e in items]
+            if not pin.append_only:
+                # retractable input without aggregation: the output must
+                # stay keyed by the upstream STREAM KEY so deletes hit
+                # the right MV row — append the key columns (hidden if
+                # unselected) and remember their positions as the pk
+                if pin.stream_key is None:
+                    raise PlanError(
+                        "retractable input without a stream key cannot "
+                        "be materialized"
+                    )
+                for ki in pin.stream_key:
+                    pos = next(
+                        (pi for pi, (_, e) in enumerate(proj)
+                         if isinstance(e, InputRef) and e.index == ki),
+                        None,
+                    )
+                    if pos is None:
+                        proj.append((
+                            f"_hidden_{scope.schema[ki].name}",
+                            InputRef(ki),
+                        ))
+                        pos = len(proj) - 1
+                    pk_positions.append(pos)
             execs.append(ProjectExecutor(scope.schema, proj))
             out_schema = execs[-1].out_schema
 
@@ -395,14 +423,20 @@ class Planner:
             return
 
         # materialize (EOWC output is final append-only rows)
-        retractable = (has_agg or has_topn) and not eowc
+        retractable = (has_agg or has_topn or not input_append_only) \
+            and not eowc
         if retractable:
-            # pk: group keys for aggs; whole row for TopN output.
+            # pk: group keys for aggs; the propagated stream key for
+            # retractable projections; whole row for TopN output.
             # KNOWN GAP (advisor r1, low): two identical rows in a TopN
             # band collapse into one MV slot — multiset parity needs a
             # rank column from the TopN state appended to the pk.
-            pk = pk_positions if (has_agg and not has_topn) \
-                else list(range(len(out_schema)))
+            if has_topn:
+                pk = list(range(len(out_schema)))
+            elif pk_positions:
+                pk = pk_positions
+            else:
+                pk = list(range(len(out_schema)))
             execs.append(MaterializeExecutor(
                 out_schema, pk_indices=pk,
                 table_size=self.config.mv_table_size,
@@ -768,6 +802,10 @@ class Planner:
         for idx, item in enumerate(items):
             if isinstance(item.expr, ast.Star):
                 for ci, f in enumerate(scope.schema):
+                    # pk bookkeeping columns of an upstream MV are not
+                    # user-visible (each plan re-derives its own)
+                    if f.name.startswith("_hidden_"):
+                        continue
                     out.append((f.name, ast.ColumnRef(f.name,
                                                       scope.qualifiers[ci])))
                 continue

@@ -127,7 +127,11 @@ class DagJob:
                 else [node.left, node.right]
             for ref in refs:
                 self._validate_ref(ref, idx)
-                self._consumers.setdefault(ref, []).append(idx)
+                lst = self._consumers.setdefault(ref, [])
+                # once per node even when both join sides share the ref
+                # (a self-join): enqueue() already fans out per side
+                if idx not in lst:
+                    lst.append(idx)
         self._step_programs: dict[str, Any] = {}
         self._barrier_prog = None
         self._maintain_prog = None
@@ -150,6 +154,15 @@ class DagJob:
         if name in self.sources:
             raise ValueError(f"source {name!r} already attached")
         self.sources[name] = reader
+        self._rebuild()
+
+    def remove_sources(self, names: list[str]) -> None:
+        """Detach sources (a dropped MV's private readers).  Refuses
+        while any live node still consumes one."""
+        for name in names:
+            if self._consumers.get(("source", name)):
+                raise ValueError(f"source {name!r} still has consumers")
+            self.sources.pop(name, None)
         self._rebuild()
 
     def add_nodes(self, nodes: list) -> list[int]:
@@ -190,9 +203,35 @@ class DagJob:
         self.states = tuple(states)
         self._rebuild()
 
-    def downstream_closure(self, ref: Ref) -> list[int]:
-        """All node ids transitively consuming ``ref`` (topo order)."""
-        out: list[int] = []
+    def reseed_checkpoint(self) -> None:
+        """Re-snapshot after a topology change: retained checkpoints
+        hold the OLD state-tree shape (and old source-name keys), so a
+        recover() between the change and the next commit would restore
+        a structurally incompatible tree.  Callers invoke this once the
+        change (attach/merge/remove + backfill) is complete."""
+        src_state = {
+            name: (src.state() if hasattr(src, "state") else {})
+            for name, src in self.sources.items()
+        }
+        snap = CheckpointSnapshot(
+            epoch=self.committed_epoch,
+            states=_snapshot_copy(self.states),
+            source_state=src_state,
+        )
+        self.checkpoints = [snap]
+        if self.checkpoint_store is not None:
+            self.checkpoint_store.save(
+                self.name, self.committed_epoch,
+                jax.device_get(snap.states), src_state,
+            )
+
+    def downstream_closure(self, ref: Ref,
+                           through_joins: bool = True) -> list[int]:
+        """All node ids transitively consuming ``ref`` (topo order).
+
+        With ``through_joins=False`` the traversal includes a JoinNode
+        consumer but does not continue past it (a join's downstream sees
+        the MIN of both inputs' watermarks, not either one alone)."""
         seen = set()
         frontier = [ref]
         while frontier:
@@ -201,7 +240,8 @@ class DagJob:
                 if idx in seen:
                     continue
                 seen.add(idx)
-                frontier.append(("node", idx))
+                if through_joins or isinstance(self.nodes[idx], FragNode):
+                    frontier.append(("node", idx))
         return sorted(seen)
 
     # -- chunk path -----------------------------------------------------
@@ -370,7 +410,8 @@ class DagJob:
                 continue
             new_states[idx] = node.fragment._wm_impl(new_states[idx])
             for wm, _ in self._node_watermarks(new_states, idx):
-                for j in self.downstream_closure(("node", idx)):
+                for j in self.downstream_closure(("node", idx),
+                                                 through_joins=False):
                     dn = self.nodes[j]
                     if not isinstance(dn, FragNode):
                         continue

@@ -180,3 +180,90 @@ def test_cascade_survives_recovery():
     # and the cascade keeps running after recovery
     eng.tick(barriers=2, chunks_per_barrier=2)
     assert eng.execute("SELECT count(*) FROM v2")[0][0] > v2_committed
+
+
+def test_self_join_of_one_mv_backfills_both_sides():
+    """Regression: duplicate taps of one MV must backfill each join
+    side exactly once (left first, then right probing the filled left)."""
+    eng = small_engine()
+    eng.execute("CREATE TABLE t (k BIGINT, v BIGINT);")
+    eng.execute("CREATE MATERIALIZED VIEW m AS SELECT k, v FROM t;")
+    eng.execute("INSERT INTO t VALUES (1, 10), (1, 11), (2, 20)")
+    eng.tick(barriers=2, chunks_per_barrier=1)  # history before the join
+    eng.execute("""
+        CREATE MATERIALIZED VIEW sj AS
+        SELECT a.v AS va, b.v AS vb FROM m a JOIN m b ON a.k = b.k;
+    """)
+    eng.execute("FLUSH")
+    rows = eng.execute("SELECT * FROM sj")
+    # snapshot x snapshot: k=1 yields 2x2 pairs, k=2 yields 1
+    assert sorted(rows) == [(10, 10), (10, 11), (11, 10), (11, 11),
+                            (20, 20)]
+    # live rows join against both history and themselves
+    eng.execute("INSERT INTO t VALUES (2, 21)")
+    eng.tick(barriers=2, chunks_per_barrier=1)
+    rows = eng.execute("SELECT * FROM sj")
+    assert sorted(r for r in rows if r[0] >= 20) == [
+        (20, 20), (20, 21), (21, 20), (21, 21)]
+
+
+def test_duplicate_create_does_not_mutate_shared_job():
+    """Regression: a doomed duplicate CREATE must not attach ghost
+    nodes to the running upstream job."""
+    eng = small_engine()
+    eng.execute("CREATE TABLE t (k BIGINT, v BIGINT);")
+    eng.execute("CREATE MATERIALIZED VIEW m AS SELECT k, v FROM t;")
+    eng.execute("CREATE MATERIALIZED VIEW m2 AS SELECT k FROM m;")
+    n_nodes = len(eng.jobs[0].nodes)
+    with pytest.raises(ValueError):
+        eng.execute("CREATE MATERIALIZED VIEW m2 AS SELECT v FROM m;")
+    assert len(eng.jobs[0].nodes) == n_nodes
+    eng.execute(
+        "CREATE MATERIALIZED VIEW IF NOT EXISTS m2 AS SELECT v FROM m;"
+    )
+    assert len(eng.jobs[0].nodes) == n_nodes
+
+
+def test_drop_detaches_private_sources():
+    """Regression: dropping a join MV detaches the source readers it
+    added to the shared job."""
+    eng = small_engine()
+    eng.execute("CREATE TABLE t (k BIGINT, v BIGINT);")
+    eng.execute("CREATE TABLE u (k BIGINT, w BIGINT);")
+    eng.execute("CREATE MATERIALIZED VIEW m AS SELECT k, v FROM t;")
+    eng.execute("""
+        CREATE MATERIALIZED VIEW j AS
+        SELECT m.v AS v, u.w AS w FROM m JOIN u ON m.k = u.k;
+    """)
+    job = eng.jobs[0]
+    n_sources = len(job.sources)
+    eng.execute("DROP MATERIALIZED VIEW j")
+    assert len(job.sources) == n_sources - 1
+    eng.tick(barriers=2, chunks_per_barrier=1)  # keeps running
+    eng.recover()                               # reseeded checkpoint loads
+    eng.tick(barriers=1, chunks_per_barrier=1)
+
+
+def test_retractable_cascade_applies_deletes():
+    """Regression: a non-agg cascade over a RETRACTABLE MV must key its
+    materialization by the upstream stream key, or every intermediate
+    version of a group accumulates."""
+    eng = small_engine()
+    eng.execute("CREATE TABLE t (k BIGINT, v BIGINT);")
+    eng.execute("""
+        CREATE MATERIALIZED VIEW counts AS
+        SELECT k, count(*) AS n FROM t GROUP BY k;
+    """)
+    eng.execute("CREATE MATERIALIZED VIEW big AS "
+                "SELECT k, n FROM counts WHERE n >= 2;")
+    for _ in range(3):
+        eng.execute("INSERT INTO t VALUES (1, 0)")
+        eng.tick(barriers=1, chunks_per_barrier=1)
+    eng.execute("INSERT INTO t VALUES (2, 0)")
+    eng.tick(barriers=2, chunks_per_barrier=1)
+    # counts: k=1 -> 3, k=2 -> 1; big keeps ONE row for k=1 (latest),
+    # not one per intermediate count
+    assert sorted(eng.execute("SELECT * FROM counts")) == [(1, 3), (2, 1)]
+    assert eng.execute("SELECT * FROM big") == [(1, 3)]
+    # SELECT * must not leak the hidden pk bookkeeping columns
+    assert all(len(r) == 2 for r in eng.execute("SELECT * FROM big"))
