@@ -155,9 +155,15 @@ def _subprocess_measure(query: str, cpu: bool) -> float:
     env["RWT_BENCH_QUERY"] = query
     out = subprocess.run(
         [sys.executable, os.path.abspath(__file__)],
-        env=env, capture_output=True, text=True, timeout=1200,
+        env=env, capture_output=True, text=True, timeout=2000,
         cwd=os.path.dirname(os.path.abspath(__file__)),
     )
+    if not cpu and "accelerator unavailable" in out.stderr:
+        # the child fell back to CPU — its number is NOT a device
+        # number; surface loudly so a degraded tunnel can't masquerade
+        # as a TPU result
+        print(f"warning: {query} device subprocess fell back to CPU",
+              file=sys.stderr)
     for line in out.stdout.splitlines():
         if line.startswith("RAW "):
             return float(line.split()[1])
@@ -208,16 +214,21 @@ def _ensure_backend(timeout_s: float = 240.0) -> None:
 
 
 def main() -> None:
-    _ensure_backend()
     query = os.environ.get("RWT_BENCH_QUERY", "q7")
     if os.environ.get("RWT_BENCH_RAW"):
+        _ensure_backend()
         print(f"RAW {measure(query)}")
         return
     queries = list(QUERIES) if query == "all" else [query]
     results = {}
+    if query != "all":
+        _ensure_backend()
+    # "all" isolates each query in a subprocess (a post-window device
+    # readback degrades async dispatch for the rest of a process on the
+    # tunneled chip) and the PARENT never claims the accelerator — a
+    # parent claim could starve the children's claims on a one-chip
+    # tunnel
     for q in queries:
-        # "all" isolates each query in a subprocess (see
-        # _subprocess_measure); single-query mode measures in-process
         results[q] = _subprocess_measure(q, cpu=False) \
             if query == "all" else measure(q)
         if q != "q7" or query != "all":

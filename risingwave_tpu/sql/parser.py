@@ -400,6 +400,14 @@ class Parser:
                 self.accept_word("outer")
                 self.expect_word("join")
                 kind = "left"
+            elif self.accept_word("right"):
+                self.accept_word("outer")
+                self.expect_word("join")
+                kind = "right"
+            elif self.accept_word("full"):
+                self.accept_word("outer")
+                self.expect_word("join")
+                kind = "full"
             else:
                 break
             right = self._table_factor()
@@ -428,8 +436,9 @@ class Parser:
                 alias = self.ident()
             elif (self.peek() and self.peek().kind == "word"
                   and self.peek().value not in (
-                      "join", "inner", "left", "on", "where", "group",
-                      "having", "order", "limit", "offset", "emit",
+                      "join", "inner", "left", "right", "full", "on",
+                      "where", "group", "having", "order", "limit",
+                      "offset", "emit",
                   )):
                 alias = self.ident()
             if fn == "tumble":
@@ -441,8 +450,8 @@ class Parser:
             alias = self.ident()
         elif (self.peek() and self.peek().kind == "word"
               and self.peek().value not in (
-                  "join", "inner", "left", "on", "where", "group", "having",
-                  "order", "limit", "offset", "emit",
+                  "join", "inner", "left", "right", "full", "on", "where",
+                  "group", "having", "order", "limit", "offset", "emit",
               )):
             alias = self.ident()
         return ast.TableRef(name, alias)

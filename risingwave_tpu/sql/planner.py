@@ -114,6 +114,8 @@ class PlannerConfig:
     mv_table_size: int = 1 << 16
     mv_ring_size: int = 1 << 20
     chunk_capacity: int = 4096
+    #: per-group value capacity for retractable min/max (ref minput.rs)
+    minput_bucket_cap: int = 64
 
 
 class Planner:
@@ -556,6 +558,11 @@ class Planner:
             watermark_lag=lag,
             watermark_src_col=pin.watermark_col,
             emit_on_window_close=eowc,
+            # retractable inputs (join outputs, cascades over
+            # retractable MVs) switch min/max to materialized-input
+            # state (ref minput.rs) instead of crash-on-delete
+            retractable_input=not pin.append_only,
+            minput_bucket_cap=cfg.minput_bucket_cap,
         )
         execs.append(agg)
 
@@ -659,12 +666,15 @@ class Planner:
                 ref = ("node", len(nodes) - 1)
             return ref, pin
 
+        KIND_MAP = {"inner": "inner", "left": "left_outer",
+                    "right": "right_outer", "full": "full_outer"}
+
         def resolve_join(jn: ast.Join):
-            if jn.kind != "inner":
-                raise PlanError("only INNER JOIN is supported this round")
+            join_type = KIND_MAP.get(jn.kind)
+            if join_type is None:
+                raise PlanError(f"unsupported join kind {jn.kind!r}")
             lref, left = resolve(jn.left)
             rref, right = resolve(jn.right)
-            both = left.scope.concat(right.scope)
             n_left = len(left.schema)
 
             # split ON into equi-conjuncts and residual filters
@@ -685,6 +695,14 @@ class Planner:
                 raise PlanError(
                     "JOIN requires at least one equality condition"
                 )
+            if residual and join_type != "inner":
+                # the count-based degree design assumes match == key
+                # equality; a residual predicate would need in-executor
+                # filtering (ref non-equi join conditions)
+                raise PlanError(
+                    "outer joins with non-equality ON conditions: "
+                    "next round"
+                )
 
             join = HashJoinExecutor(
                 left.schema, right.schema, left_keys, right_keys,
@@ -695,6 +713,12 @@ class Planner:
                 right_table_size=cfg.join_right_table_size,
                 left_bucket_cap=cfg.join_left_bucket_cap,
                 right_bucket_cap=cfg.join_right_bucket_cap,
+                join_type=join_type,
+            )
+            # the join's OUTPUT schema carries the pad nullability
+            both = Scope(
+                join.out_schema,
+                tuple(left.scope.qualifiers) + tuple(right.scope.qualifiers),
             )
             # window-keyed joins over watermarked sources clean closed
             # windows at barriers (bounded state, ref q8 pattern)
@@ -717,9 +741,12 @@ class Planner:
                     for c in residual
                 ]), ref))
                 ref = ("node", len(nodes) - 1)
+            # outer-join transitions retract pads even over append-only
+            # inputs, so only an inner join preserves append-only-ness
             info = PlannedInput(
                 None, [], both, both.schema, None, None,
-                left.append_only and right.append_only,
+                left.append_only and right.append_only
+                and join_type == "inner",
             )
             return ref, info
 
@@ -755,14 +782,20 @@ class Planner:
                 post_execs.append(SinkExecutor(
                     out_schema, sink, ring_size=cfg.mv_ring_size
                 ))
-            else:
-                if not root.append_only:
-                    raise PlanError(
-                        "join MVs over retractable inputs need keyed "
-                        "materialization (next round)"
-                    )
+            elif root.append_only:
                 post_execs.append(AppendOnlyMaterialize(
                     out_schema, ring_size=cfg.mv_ring_size
+                ))
+            else:
+                # retractable join output (outer joins, retractable
+                # inputs): keyed materialization on the whole row.
+                # KNOWN GAP (mirrors the TopN pk note): identical
+                # duplicate output rows collapse into one MV slot —
+                # set, not multiset, semantics for exact-duplicate rows.
+                post_execs.append(MaterializeExecutor(
+                    out_schema,
+                    pk_indices=list(range(len(out_schema))),
+                    table_size=cfg.mv_table_size,
                 ))
         nodes.append(FragNode(Fragment(post_execs), root_ref))
         return DagPlan(

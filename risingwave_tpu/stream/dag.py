@@ -209,20 +209,24 @@ class DagJob:
         recover() between the change and the next commit would restore
         a structurally incompatible tree.  Callers invoke this once the
         change (attach/merge/remove + backfill) is complete."""
+        self._snapshot_and_save(self.committed_epoch)
+
+    def _snapshot_and_save(self, epoch: int) -> None:
+        """The shared checkpoint tail: in-memory snapshot + durable
+        save (used by both the barrier commit and topology reseeds)."""
         src_state = {
             name: (src.state() if hasattr(src, "state") else {})
             for name, src in self.sources.items()
         }
         snap = CheckpointSnapshot(
-            epoch=self.committed_epoch,
+            epoch=epoch,
             states=_snapshot_copy(self.states),
             source_state=src_state,
         )
         self.checkpoints = [snap]
         if self.checkpoint_store is not None:
             self.checkpoint_store.save(
-                self.name, self.committed_epoch,
-                jax.device_get(snap.states), src_state,
+                self.name, epoch, jax.device_get(snap.states), src_state
             )
 
     def downstream_closure(self, ref: Ref,
@@ -276,12 +280,57 @@ class DagJob:
                     new_states[idx], out = node.fragment._step_impl(
                         new_states[idx], chunk
                     )
+                    if out is not None:
+                        enqueue(("node", idx), out)
                 else:
-                    new_states[idx], out = node.join.apply(
-                        new_states[idx], chunk, side
-                    )
-                if out is not None:
-                    enqueue(("node", idx), out)
+                    self._apply_join_windowed(new_states, idx, chunk,
+                                              side, enqueue)
+
+    def _apply_join_windowed(self, new_states: list, idx: int, chunk,
+                             side: str, enqueue) -> None:
+        """Drive a join with WINDOWED emission: window 0 propagates via
+        the normal traversal; any further windows (high-amplification
+        probes) drain through the downstream subgraph inside a device
+        ``while_loop`` — matches dropped by a fixed out buffer in the
+        old design now always reach downstream (ref hash_join.rs
+        chunk-sized yielding under amplification)."""
+        node = self.nodes[idx]
+        join = node.join
+        if not hasattr(join, "apply_begin"):
+            new_states[idx], out = join.apply(new_states[idx], chunk, side)
+            if out is not None:
+                enqueue(("node", idx), out)
+            return
+        new_states[idx], pending = join.apply_begin(
+            new_states[idx], chunk, side
+        )
+        if not self._consumers.get(("node", idx)):
+            return  # terminal join: emissions have no consumers
+        build_rows = join.build_rows_of(new_states[idx], side)
+        # window 0 propagates directly (NOT via the inbox) so windows
+        # stay in emission order downstream — a +pair in window 0 must
+        # land before its -pair in window 1
+        first = join.emit_window(build_rows, pending, jnp.int32(0), side)
+        self._propagate(new_states, [(("node", idx), first)])
+        max_w = join.max_windows(chunk.capacity)
+        if max_w <= 1:
+            return
+
+        def cond(carry):
+            sts, w = carry
+            return (w * join.out_capacity < pending.total) & (w < max_w)
+
+        def body(carry):
+            sts, w = carry
+            window = join.emit_window(build_rows, pending, w, side)
+            lst = list(sts)
+            self._propagate(lst, [(("node", idx), window)])
+            return tuple(lst), w + 1
+
+        sts, _ = jax.lax.while_loop(
+            cond, body, (tuple(new_states), jnp.int32(1))
+        )
+        new_states[:] = list(sts)
 
     def _make_step(self, src_name: str):
         reader = self.sources[src_name]
@@ -592,20 +641,7 @@ class DagJob:
                 )
         self.states = tuple(new_states)
         self.committed_epoch = sealed
-        src_state = {
-            name: (src.state() if hasattr(src, "state") else {})
-            for name, src in self.sources.items()
-        }
-        snap = CheckpointSnapshot(
-            epoch=sealed,
-            states=_snapshot_copy(self.states),
-            source_state=src_state,
-        )
-        self.checkpoints = [snap]
-        if self.checkpoint_store is not None:
-            self.checkpoint_store.save(
-                self.name, sealed, jax.device_get(snap.states), src_state
-            )
+        self._snapshot_and_save(sealed)
 
     def recover(self) -> None:
         """Reset to the last committed checkpoint (ref §3.5)."""
@@ -661,12 +697,17 @@ class DagJob:
             new_states[node_id], out = node.fragment._step_impl(
                 new_states[node_id], chunk
             )
+            if out is not None:
+                self._propagate(new_states, [(("node", node_id), out)])
         else:
-            new_states[node_id], out = node.join.apply(
-                new_states[node_id], chunk, side
+            # joins drain with WINDOWED emission: an MV snapshot is one
+            # big chunk, its self-join easily exceeds out_capacity
+            def direct(ref, out):
+                self._propagate(new_states, [(ref, out)])
+
+            self._apply_join_windowed(
+                new_states, node_id, chunk, side, direct
             )
-        if out is not None:
-            self._propagate(new_states, [(("node", node_id), out)])
         return tuple(new_states)
 
     # -- driving --------------------------------------------------------

@@ -23,9 +23,18 @@ Retraction semantics (Insert if group appears, Update pair if it
 changes, Delete if its row count reaches zero) mirror
 ``AggGroup::build_change``.
 
-min/max here are monotone monoids (exact for append-only inputs — the
-windowed Nexmark aggregations); retractable min/max needs the
-materialized-input state (ref minput.rs), queued for a later round.
+min/max over APPEND-ONLY inputs are monotone monoids (one scatter-min/
+max per chunk).  Over RETRACTABLE inputs (``retractable_input=True``)
+they switch to a **materialized-input state** — the reference's
+``minput.rs`` (src/stream/src/executor/aggregate/minput.rs) re-imagined
+for slot-aligned HBM: each such aggregate owns a ``[table_size,
+minput_bucket_cap]`` value multi-map aligned to the group table's
+slots (no second key table).  Inserts claim free bucket positions by
+rank, deletes clear value-equal entries by rank, and the aggregate's
+``[size]`` prim array becomes a flush-time CACHE recomputed from the
+bucket for dirty groups — so the prev-snapshot / U-pair machinery is
+untouched.  Bucket overflow is counted loudly (raise at maintenance),
+the analog of the reference's bounded cache + state-table fallback.
 """
 
 from __future__ import annotations
@@ -78,6 +87,11 @@ class AggState(NamedTuple):
     inconsistency: jnp.ndarray  # int64 scalar
     #: latest watermark received (EOWC emission; INT64_MIN = none)
     wm: jnp.ndarray             # int64 scalar
+    #: materialized-input values per retractable min/max agg (ref
+    #: minput.rs): ([size, B] values, [size, B] occupied) pairs,
+    #: slot-aligned with ``table``
+    minput_vals: tuple = ()
+    minput_occ: tuple = ()
 
 
 def _interleave(old, new):
@@ -112,6 +126,8 @@ class HashAggExecutor(Executor):
         watermark_lag: int = 0,
         watermark_src_col: int | None = None,
         emit_on_window_close: bool = False,
+        retractable_input: bool = False,
+        minput_bucket_cap: int = 64,
     ):
         super().__init__(in_schema)
         #: EOWC (ref emit_on_window_close plan property): flush emits
@@ -147,6 +163,18 @@ class HashAggExecutor(Executor):
         for ai, a in enumerate(self.aggs):
             for ps in a.spec().states:
                 self._prim_specs.append((ai, ps))
+        #: retractable min/max via materialized-input buckets (ref
+        #: minput.rs); their prim arrays become flush-time caches
+        self.minput_bucket_cap = minput_bucket_cap
+        self._minput_aggs: list[int] = [
+            ai for ai, a in enumerate(self.aggs)
+            if retractable_input and a.kind in ("min", "max")
+        ]
+        #: prim indices whose arrays are minput caches (no apply scatter)
+        self._cache_prims = {
+            pi for pi, (ai, _) in enumerate(self._prim_specs)
+            if ai in self._minput_aggs
+        }
         # hidden non-null-count prims: an aggregate over a NULLABLE
         # argument yields SQL NULL when every argument row in the group
         # is NULL (ref AggregateFunction semantics); count() needs no
@@ -203,6 +231,7 @@ class HashAggExecutor(Executor):
                 out.append(jnp.full((size,), ps.init(st_dt), st_dt))
             return tuple(out)
 
+        B = self.minput_bucket_cap
         return AggState(
             table=table,
             # prev_prims must be INDEPENDENT buffers (donation forbids
@@ -216,6 +245,14 @@ class HashAggExecutor(Executor):
             overflow=jnp.zeros((), jnp.int64),
             inconsistency=jnp.zeros((), jnp.int64),
             wm=jnp.asarray(np.iinfo(np.int64).min, jnp.int64),
+            minput_vals=tuple(
+                jnp.zeros((size, B), self._input_dtype(ai))
+                for ai in self._minput_aggs
+            ),
+            minput_occ=tuple(
+                jnp.zeros((size, B), jnp.bool_)
+                for ai in self._minput_aggs
+            ),
         )
 
     # ------------------------------------------------------------------
@@ -276,6 +313,8 @@ class HashAggExecutor(Executor):
         arg_cache: dict[int, jnp.ndarray] = {}
         for pi, (agg_idx, ps) in enumerate(self._prim_specs):
             a = self.aggs[agg_idx]
+            if pi in self._cache_prims:
+                continue  # minput cache: recomputed at flush
             if a.arg is None:
                 col = jnp.ones_like(signs, jnp.int64)
             else:
@@ -317,8 +356,48 @@ class HashAggExecutor(Executor):
         row_count = state.row_count.at[ins_pos].set(0, mode="drop")
         row_count = row_count.at[slots].add(seg_signs, mode="drop")
         dirty = state.dirty.at[slots].set(True, mode="drop")
+
+        # materialized-input updates (retractable min/max): every SORTED
+        # row lands in its group's value bucket — per-row slots come
+        # from scattering each segment representative's slot over its
+        # segment id
+        minput_vals = list(state.minput_vals)
+        minput_occ = list(state.minput_occ)
+        n_over_mi = jnp.zeros((), jnp.int64)
+        n_miss_mi = jnp.zeros((), jnp.int64)
+        if self._minput_aggs:
+            # per-row slot = its segment representative's slot (seg ids
+            # start at 1, so index 0 is a safe dump for non-rep rows);
+            # segments whose representative overflowed keep the `size`
+            # sentinel and their rows are skipped (already counted in
+            # n_over)
+            seg_slot = jnp.full((cap + 1,), self.table_size, jnp.int32)
+            seg_slot = seg_slot.at[jnp.where(rep, seg_id, 0)].set(
+                jnp.where(rep, slots, self.table_size), mode="drop"
+            )
+            row_slots = seg_slot[seg_id]
+            row_ok = s_valid & (row_slots < self.table_size)
+            for mi, agg_idx in enumerate(self._minput_aggs):
+                a = self.aggs[agg_idx]
+                if agg_idx not in arg_cache:
+                    arg_cache[agg_idx] = a.arg.eval(chunk)
+                vcol, vnull = split_col(arg_cache[agg_idx])
+                v_sorted = gather_key(vcol, perm)
+                active = row_ok & (s_signs != 0)
+                if vnull is not None:
+                    active = active & ~vnull[perm]
+                vals, occ, over, miss = self._minput_update(
+                    minput_vals[mi], minput_occ[mi], row_slots,
+                    v_sorted, s_signs, active, ins_pos,
+                )
+                minput_vals[mi] = vals
+                minput_occ[mi] = occ
+                n_over_mi = n_over_mi + over
+                n_miss_mi = n_miss_mi + miss
+
         n_bad = jnp.zeros((), jnp.int64)
-        if any(not a.spec().retractable for a in self.aggs):
+        if any(not a.spec().retractable and ai not in self._minput_aggs
+               for ai, a in enumerate(self.aggs)):
             n_bad = jnp.sum((valid & (signs < 0)).astype(jnp.int64))
         return AggState(
             table=table,
@@ -328,10 +407,80 @@ class HashAggExecutor(Executor):
             prev_prims=state.prev_prims,
             prev_row_count=state.prev_row_count,
             emitted=state.emitted,
-            overflow=state.overflow + n_over,
-            inconsistency=state.inconsistency + n_bad,
+            overflow=state.overflow + n_over + n_over_mi,
+            inconsistency=state.inconsistency + n_bad + n_miss_mi,
             wm=state.wm,
+            minput_vals=tuple(minput_vals),
+            minput_occ=tuple(minput_occ),
         ), None
+
+    def _minput_update(self, vals, occ, row_slots, v_sorted, s_signs,
+                       active, ins_pos):
+        """Apply one chunk's (sorted) rows to a value bucket multi-map.
+
+        Same rank-claim/rank-clear mechanics as the join's bucketed
+        multi-map (hash_join._update_side), specialized to one scalar
+        value column keyed by the group slot."""
+        from risingwave_tpu.stream.hash_join import (
+            _group_totals,
+            _rank_by,
+        )
+
+        B = occ.shape[1]
+        size = self.table_size
+        # reclaimed slots start with an empty bucket
+        occ = occ.at[ins_pos].set(False, mode="drop")
+        is_ins = active & (s_signs > 0)
+        is_del = active & (s_signs < 0)
+        # in-chunk annihilation on (slot, value): a +v/-v pair inside
+        # one chunk must cancel (the delete pass only sees pre-chunk
+        # state)
+        pair_h = hash64_columns([
+            row_slots.astype(jnp.int64),
+            v_sorted,
+        ])
+        ins_rank_h = _rank_by(pair_h, is_ins)
+        del_rank_h = _rank_by(pair_h, is_del)
+        n_ins_h = _group_totals(pair_h, is_ins)
+        n_del_h = _group_totals(pair_h, is_del)
+        is_ins = is_ins & ~(ins_rank_h < n_del_h)
+        is_del = is_del & ~(del_rank_h < n_ins_h)
+
+        safe = jnp.minimum(row_slots, size - 1)
+        # deletes: clear the rank-th value-equal occupied entry
+        del_rank = _rank_by(pair_h, is_del)
+        occ_rows = occ[safe]
+        val_match = occ_rows & (vals[safe] == v_sorted[:, None])
+        match_rank = jnp.cumsum(val_match, axis=1) - 1
+        clear_onehot = val_match & (match_rank == del_rank[:, None]) & \
+            is_del[:, None]
+        any_clear = jnp.any(clear_onehot, axis=1)
+        miss = jnp.sum((is_del & ~any_clear).astype(jnp.int64))
+        j_clear = jnp.argmax(clear_onehot, axis=1).astype(jnp.int32)
+        flat_clear = jnp.where(
+            any_clear, safe * B + j_clear, jnp.int32(size * B)
+        )
+        occ = occ.reshape(-1).at[flat_clear].set(
+            False, mode="drop"
+        ).reshape(size, B)
+        # inserts: claim the rank-th free position of the slot's bucket
+        ins_rank = _rank_by(row_slots.astype(jnp.uint64), is_ins)
+        free = ~occ[safe]
+        free_rank = jnp.cumsum(free, axis=1) - 1
+        take = free & (free_rank == ins_rank[:, None]) & is_ins[:, None]
+        got = jnp.any(take, axis=1)
+        j_take = jnp.argmax(take, axis=1).astype(jnp.int32)
+        flat_take = jnp.where(
+            got, safe * B + j_take, jnp.int32(size * B)
+        )
+        occ = occ.reshape(-1).at[flat_take].set(
+            True, mode="drop"
+        ).reshape(size, B)
+        vals = vals.reshape(-1).at[flat_take].set(
+            v_sorted, mode="drop"
+        ).reshape(size, B)
+        over = jnp.sum((is_ins & ~got).astype(jnp.int64))
+        return vals, occ, over, miss
 
     # ------------------------------------------------------------------
     def _outputs(self, prims: tuple, row_count, slots):
@@ -354,6 +503,36 @@ class HashAggExecutor(Executor):
             cols.append(out)
         return cols
 
+    def _refresh_minput_caches(self, state: AggState, slots,
+                               safe) -> AggState:
+        """Recompute retractable min/max outputs for the emitted slots
+        from their materialized-input buckets (the prim array is just a
+        cache of this reduction)."""
+        if not self._minput_aggs:
+            return state
+        prims = list(state.prims)
+        for mi, agg_idx in enumerate(self._minput_aggs):
+            pi = next(p for p, (ai, _) in enumerate(self._prim_specs)
+                      if ai == agg_idx)
+            mode = self.aggs[agg_idx].kind
+            vals = state.minput_vals[mi][safe]     # [cap, B]
+            occ = state.minput_occ[mi][safe]
+            dt = vals.dtype
+            if jnp.issubdtype(dt, jnp.floating):
+                ident = jnp.asarray(
+                    jnp.inf if mode == "min" else -jnp.inf, dt
+                )
+            else:
+                info = jnp.iinfo(dt)
+                ident = jnp.asarray(
+                    info.max if mode == "min" else info.min, dt
+                )
+            masked = jnp.where(occ, vals, ident)
+            red = masked.min(axis=1) if mode == "min" \
+                else masked.max(axis=1)
+            prims[pi] = prims[pi].at[slots].set(red, mode="drop")
+        return state._replace(prims=tuple(prims))
+
     def flush(self, state: AggState, epoch):
         if self.emit_on_window_close:
             return self._flush_eowc(state)
@@ -362,6 +541,7 @@ class HashAggExecutor(Executor):
         slots = mask_indices(state.dirty, cap, size)
         slot_live = slots < size
         safe = jnp.minimum(slots, size - 1)
+        state = self._refresh_minput_caches(state, slots, safe)
 
         old_nonempty = state.prev_row_count[safe] > 0
         new_nonempty = state.row_count[safe] > 0
@@ -398,17 +578,11 @@ class HashAggExecutor(Executor):
         )
         emitted = state.emitted.at[slots].set(new_nonempty, mode="drop")
         dirty = state.dirty.at[slots].set(False, mode="drop")
-        return AggState(
-            table=state.table,
-            prims=state.prims,
-            row_count=state.row_count,
+        return state._replace(
             dirty=dirty,
             prev_prims=prev_prims,
             prev_row_count=prev_row_count,
             emitted=emitted,
-            overflow=state.overflow,
-            inconsistency=state.inconsistency,
-            wm=state.wm,
         ), out
 
     def _closed_mask(self, state: AggState) -> jnp.ndarray:
@@ -447,17 +621,10 @@ class HashAggExecutor(Executor):
             slot_live, mode="drop"
         )
         table = state.table.clear_where(emitted_mask)
-        return AggState(
+        return state._replace(
             table=table,
-            prims=state.prims,
             row_count=jnp.where(emitted_mask, 0, state.row_count),
             dirty=state.dirty & ~emitted_mask,
-            prev_prims=state.prev_prims,
-            prev_row_count=state.prev_row_count,
-            emitted=state.emitted,
-            overflow=state.overflow,
-            inconsistency=state.inconsistency,
-            wm=state.wm,
         ), out
 
     def pending_dirty(self, state: AggState) -> jnp.ndarray:
@@ -505,7 +672,7 @@ class HashAggExecutor(Executor):
                 prev_prims.append(
                     permute_dense(state.prev_prims[pi], moved, init)
                 )
-            return AggState(
+            return state._replace(
                 table=fresh,
                 prims=tuple(prims),
                 row_count=permute_dense(state.row_count, moved),
@@ -513,9 +680,12 @@ class HashAggExecutor(Executor):
                 prev_prims=tuple(prev_prims),
                 prev_row_count=permute_dense(state.prev_row_count, moved),
                 emitted=permute_dense(state.emitted, moved),
-                overflow=state.overflow,
-                inconsistency=state.inconsistency,
-                wm=state.wm,
+                minput_vals=tuple(
+                    permute_dense(v, moved) for v in state.minput_vals
+                ),
+                minput_occ=tuple(
+                    permute_dense(o, moved) for o in state.minput_occ
+                ),
             )
 
         return jax.lax.cond(
@@ -535,15 +705,13 @@ class HashAggExecutor(Executor):
         if key_null is not None:
             stale = stale & ~key_null  # NULL keys are never below a wm
         table = state.table.clear_where(stale)
-        return AggState(
+        return state._replace(
             table=table,
-            prims=state.prims,
             row_count=jnp.where(stale, 0, state.row_count),
             dirty=state.dirty & ~stale,
-            prev_prims=state.prev_prims,
             prev_row_count=jnp.where(stale, 0, state.prev_row_count),
             emitted=state.emitted & ~stale,
-            overflow=state.overflow,
-            inconsistency=state.inconsistency,
-            wm=state.wm,
+            minput_occ=tuple(
+                o & ~stale[:, None] for o in state.minput_occ
+            ),
         )

@@ -1,9 +1,10 @@
-"""Streaming hash join (inner), device-resident two-sided state.
+"""Streaming hash join — the full matrix: inner / left / right / full
+outer / semi / anti — with device-resident two-sided state.
 
 Reference counterpart: ``HashJoinExecutor`` (src/stream/src/executor/
-hash_join.rs:158) with ``JoinHashMap`` state+degree tables
-(join/hash_join.rs:169) and the probe loop ``eq_join_oneside``
-(hash_join.rs:949).
+hash_join.rs:158, 6 join types via const-generic ``JoinTypePrimitive``)
+with ``JoinHashMap`` state+degree tables (join/hash_join.rs:169) and
+the probe loop ``eq_join_oneside`` (hash_join.rs:949).
 
 TPU-first design
 ----------------
@@ -15,21 +16,35 @@ Each side's state is a *bucketed multi-map* in HBM:
 - ``count``:    ``int32 [size]`` live rows per key.
 
 A chunk applies as a handful of gathers/scatters over the whole chunk
-(vs the reference's per-row HashMap + Vec walk):
+(vs the reference's per-row HashMap + Vec walk): inserts claim free
+bucket positions by rank-among-equal-keys, deletes match value-equal
+entries by rank (row-hash disambiguated) and clear them.
 
-- inserts claim free bucket positions by rank-among-equal-keys
-  (cumsum-of-free one-hot), deletes match value-equal entries by rank
-  (row-hash disambiguated) and clear them;
-- probe gathers the *entire* opposite bucket per row — every entry in a
-  bucket shares the join key, so the match mask is just occupancy — and
-  compacts all (probe-row × bucket-entry) pairs into a fixed-capacity
-  output chunk via prefix sums.
+**Degrees are per-KEY, not per-row** (unlike the reference's degree
+table): a stored row's degree — its number of matches on the other
+side — is fully determined by its join key, so the other side's
+``count[slot]`` IS the degree.  Outer/semi/anti transitions fall out of
+comparing a key's own-side count before/after a chunk: 0→n retracts the
+NULL-padded (or emits the semi / retracts the anti) rows, n→0 restores
+them.  No extra state.
 
-Emitted ops: +/- matching the probe row's changelog sign (the
-reference's U-pair reconstruction is a planner nicety, deferred).
-Outer joins need degree-tracking NULL rows (ref degree table) — next
-round.  State cleaning for window joins (Nexmark q8) is the same
-vectorized sweep as hash_agg's ``clean_below``.
+**Emission is output-centric and windowed**: instead of materializing
+the (probe-row × bucket-entry) grid and compacting it (O(cap×B) per
+chunk), every output slot *gathers* its source via searchsorted over
+per-row prefix sums — O(out_capacity) regardless of bucket depth.  One
+logical emission space [pairs | self-rows | transition-rows] is cut
+into fixed out_capacity windows; ``emit_window(pending, w)`` produces
+window ``w``, so the runtime drains arbitrarily amplified joins without
+dropping matches (``DagJob`` loops windows on device; the plain
+``apply`` emits window 0 and counts the remainder as emit_overflow).
+
+U-pair note: a key's transition emits UPDATE_DELETE/UPDATE_INSERT op
+codes, but pads land in the transitions section rather than physically
+adjacent to their replacement pair — every consumer in this codebase is
+slot-keyed or sign-based, so only the op *codes* carry the pairing.
+
+State cleaning for window joins (Nexmark q8) is the same vectorized
+sweep as hash_agg's ``clean_below``.
 """
 
 from __future__ import annotations
@@ -58,9 +73,10 @@ def _null_stripped_keys(key_cols):
         if n is not None:
             null_any = n if null_any is None else (null_any | n)
     return bare, null_any
+from risingwave_tpu.common.compact import mask_indices
 from risingwave_tpu.common.types import Field, Schema
 from risingwave_tpu.expr.node import Expr
-from risingwave_tpu.state.hash_table import HashTable
+from risingwave_tpu.state.hash_table import HashTable, gather_key
 
 
 def _empty_store(f: Field, size: int, bucket: int):
@@ -154,12 +170,54 @@ class JoinState(NamedTuple):
     emit_overflow: jnp.ndarray  # int64 — matches dropped by out capacity
 
 
-class HashJoinExecutor:
-    """Inner equi-join of two changelog streams.
+class JoinEmit(NamedTuple):
+    """One chunk's staged emission space (all device arrays; light
+    enough to ride a ``lax.while_loop`` carry).
 
-    Not a linear-``Fragment`` executor: it has two inputs.  The runtime
-    (``BinaryJob``) or a graph scheduler calls ``apply(state, chunk,
-    side)``; output schema is left columns ++ right columns.
+    The logical emission array is ordered
+    ``[up-transitions | pairs | self rows | down-transitions]`` —
+    a key's first match retracts its pads BEFORE the replacement pairs
+    land, and its last unmatch deletes the pairs BEFORE the pads
+    return.  The order matters downstream: a projection may collapse a
+    pad row and a pair row to identical values, and slot-keyed
+    materialization resolves same-slot conflicts by LAST op in row
+    order (the reference's U-pair adjacency contract, expressed as
+    section order).  ``emit_window`` gathers any out_capacity-sized
+    window of it.
+    """
+
+    probe_cols: tuple        # the probe chunk's columns
+    signs: jnp.ndarray       # int32 [cap]
+    slots: jnp.ndarray       # int32 [cap] clamped build-side key slots
+    rank_to_idx: jnp.ndarray  # int32 [cap, B] k-th live row -> bucket idx
+    m: jnp.ndarray           # int32 [cap] live build rows per probe row
+    up_cnt: jnp.ndarray      # int32 [cap] up-transition rows per probe row
+    up_end: jnp.ndarray      # int32 [cap] inclusive cumsum
+    U: jnp.ndarray           # int32 total up-transition rows
+    pair_end: jnp.ndarray    # int32 [cap] inclusive cumsum of pair counts
+    P: jnp.ndarray           # int32 total pairs
+    self_sel: jnp.ndarray    # int32 [cap] compacted self-row indices
+    S: jnp.ndarray           # int32 total self rows
+    down_cnt: jnp.ndarray    # int32 [cap] down-transition rows per row
+    down_end: jnp.ndarray    # int32 [cap] inclusive cumsum
+    total: jnp.ndarray       # int32 U + P + S + D
+
+
+#: the join matrix (ref hash_join.rs JoinTypePrimitive + semi/anti)
+JOIN_TYPES = (
+    "inner", "left_outer", "right_outer", "full_outer",
+    "left_semi", "left_anti", "right_semi", "right_anti",
+)
+
+
+class HashJoinExecutor:
+    """Equi-join of two changelog streams (full join-type matrix).
+
+    Not a linear-``Fragment`` executor: it has two inputs.  The DAG
+    runtime calls ``apply(state, chunk, side)`` (single-window) or the
+    windowed pair ``apply_begin`` / ``emit_window``.  Output schema is
+    left ++ right columns (NULL-padded side nullable) for inner/outer,
+    or the preserved side alone for semi/anti.
     """
 
     def __init__(
@@ -175,7 +233,11 @@ class HashJoinExecutor:
         right_bucket_cap: int | None = None,
         left_table_size: int | None = None,
         right_table_size: int | None = None,
+        join_type: str = "inner",
     ):
+        if join_type not in JOIN_TYPES:
+            raise ValueError(f"unknown join type {join_type!r}")
+        self.join_type = join_type
         self.left_schema = left_schema
         self.right_schema = right_schema
         self.left_keys = tuple(left_keys)
@@ -191,7 +253,29 @@ class HashJoinExecutor:
         self.left_table_size = left_table_size or table_size
         self.right_table_size = right_table_size or table_size
         self.out_capacity = out_capacity
-        self._out_schema = left_schema.concat(right_schema)
+        #: preserved sides: rows survive unmatched (as NULL-padded rows
+        #: for outer, as the output itself for semi, inverted for anti)
+        self.preserve_left = join_type in (
+            "left_outer", "full_outer", "left_semi", "left_anti"
+        )
+        self.preserve_right = join_type in (
+            "right_outer", "full_outer", "right_semi", "right_anti"
+        )
+        self.is_semi = join_type.endswith("_semi")
+        self.is_anti = join_type.endswith("_anti")
+        #: inner/outer emit (probe × build) pairs; semi/anti never do
+        self.emit_pairs = not (self.is_semi or self.is_anti)
+        if self.emit_pairs:
+            left_out = left_schema if not self.preserve_right else Schema(
+                tuple(f.with_nullable() for f in left_schema)
+            )
+            right_out = right_schema if not self.preserve_left else Schema(
+                tuple(f.with_nullable() for f in right_schema)
+            )
+            self._out_schema = left_out.concat(right_out)
+        else:
+            self._out_schema = left_schema if self.preserve_left \
+                else right_schema
         #: per-side watermark cleaning: (key_idx, lag_us, src_col) —
         #: at barriers the runtime evicts keys whose key_idx-th join key
         #: < watermark(src_col) - lag (windowed joins, nexmark q8)
@@ -201,6 +285,9 @@ class HashJoinExecutor:
     @property
     def out_schema(self) -> Schema:
         return self._out_schema
+
+    def _preserved(self, side: str) -> bool:
+        return self.preserve_left if side == "left" else self.preserve_right
 
     # ------------------------------------------------------------------
     def _key_protos(self, schema: Schema, keys: Sequence[Expr]):
@@ -367,125 +454,260 @@ class HashJoinExecutor:
         cap = safe_slots.shape[0]
         return h.reshape(cap, side.occupied.shape[1])
 
-    # ------------------------------------------------------------------
-    def _probe(self, probe_chunk: Chunk, build: SideState,
-               probe_is_left: bool, probe_keys: Sequence[Expr]):
-        """Emit (probe row × build bucket entry) pairs, compacted."""
-        B = build.occupied.shape[1]
-        size = build.key_table.size
-        out_cap = self.out_capacity
+    # -- output-centric windowed emission --------------------------------
+    def apply_begin(self, state: JoinState, chunk: Chunk, side: str):
+        """Update own-side state and stage the emission space.
+
+        Returns (state, pending): ``pending`` describes one logical
+        emission array [pairs | self rows | transition rows]; windows
+        of it are produced by ``emit_window`` — O(out_capacity) gathers
+        each, independent of bucket depth.
+        """
+        own = state.left if side == "left" else state.right
+        other = state.right if side == "left" else state.left
+        keys = self.left_keys if side == "left" else self.right_keys
+        cap = chunk.capacity
+
+        old_count = own.count  # own per-key row counts BEFORE the chunk
+        own2 = self._update_side(own, chunk, keys)
+
         key_cols, null_keys = _null_stripped_keys(
-            [e.eval(probe_chunk) for e in probe_keys]
+            [e.eval(chunk) for e in keys]
         )
-        probe_valid = probe_chunk.valid if null_keys is None \
-            else probe_chunk.valid & ~null_keys
-        slots, found, probe_over = build.key_table.lookup_counted(
-            key_cols, probe_valid
+        signs = chunk.signs()
+        active = chunk.valid & (signs != 0)
+        joinable = active if null_keys is None else active & ~null_keys
+
+        # probe the build (other) side: per-row key slot + live rows
+        bsize = other.key_table.size
+        B = other.occupied.shape[1]
+        slots, found, probe_over = other.key_table.lookup_counted(
+            key_cols, joinable
         )
-        safe_slots = jnp.minimum(slots, size - 1)
-        occ = build.occupied[safe_slots] & found[:, None]  # [cap, B]
+        safe = jnp.minimum(slots, bsize - 1)
+        occ = other.occupied[safe] & found[:, None]        # [cap, B]
+        m = jnp.sum(occ, axis=1).astype(jnp.int32)
+        # rank -> bucket index of the k-th live row (occupied first,
+        # stable: bool sort of the gathered occupancy bitmap only)
+        rank_to_idx = jnp.argsort(~occ, axis=1, stable=True) \
+            .astype(jnp.int32)
 
-        matches_per_row = jnp.sum(occ, axis=1).astype(jnp.int32)
-        row_start = jnp.cumsum(matches_per_row) - matches_per_row
-        within = jnp.cumsum(occ, axis=1) - 1               # [cap, B]
-        out_pos = row_start[:, None] + within              # [cap, B]
-        emit = occ & (out_pos < out_cap)
-        flat_pos = jnp.where(emit, out_pos, out_cap).reshape(-1)
-        total = row_start[-1] + matches_per_row[-1]
-        n_drop = jnp.maximum(total - out_cap, 0).astype(jnp.int64)
+        # section 1: (probe × build) pairs
+        pair_cnt = m if self.emit_pairs else jnp.zeros_like(m)
+        pair_end = jnp.cumsum(pair_cnt)
+        P = pair_end[-1]
 
-        def scatter_probe_col(col):
-            # broadcast probe value across its bucket row then compact
+        # section 2: self rows (A preserved: pads for outer, the row
+        # itself for semi/anti).  NULL-key rows match nothing, so they
+        # count as zero-match rows here — SQL outer/anti semantics.
+        if self._preserved(side):
+            if self.is_semi:
+                self_mask = active & (m > 0)
+            else:  # outer pad or anti
+                self_mask = active & (m == 0)
+        else:
+            self_mask = jnp.zeros((cap,), jnp.bool_)
+        self_sel = mask_indices(self_mask, cap, cap)
+        S = jnp.sum(self_mask).astype(jnp.int32)
+
+        # section 3: transitions of the OTHER side's stored rows.  A
+        # stored row's degree is its key's count on THIS side, so the
+        # chunk flips other-side rows exactly when a key's own count
+        # crosses 0 (ref: degree table 0<->1 transitions).
+        other_pres = self._preserved(
+            "right" if side == "left" else "left"
+        )
+        if other_pres:
+            oslots, ofound, _ = own2.key_table.lookup_counted(
+                key_cols, joinable
+            )
+            osafe = jnp.minimum(oslots, own2.key_table.size - 1)
+            oldc = old_count[osafe]
+            newc = own2.count[osafe]
+            eligible = joinable & ofound
+            up = eligible & (oldc == 0) & (newc > 0)
+            down = eligible & (oldc > 0) & (newc == 0)
+            first = _rank_by(oslots.astype(jnp.uint64), up | down) == 0
+            up_cnt = jnp.where(up & first, m, 0)
+            down_cnt = jnp.where(down & first, m, 0)
+        else:
+            up_cnt = jnp.zeros((cap,), jnp.int32)
+            down_cnt = jnp.zeros((cap,), jnp.int32)
+        up_end = jnp.cumsum(up_cnt)
+        U = up_end[-1]
+        down_end = jnp.cumsum(down_cnt)
+        D = down_end[-1]
+
+        pending = JoinEmit(
+            probe_cols=chunk.columns,
+            signs=signs,
+            slots=safe,
+            rank_to_idx=rank_to_idx,
+            m=m,
+            up_cnt=up_cnt,
+            up_end=up_end,
+            U=U,
+            pair_end=pair_end,
+            P=P,
+            self_sel=self_sel,
+            S=S,
+            down_cnt=down_cnt,
+            down_end=down_end,
+            total=U + P + S + D,
+        )
+        new_state = JoinState(
+            left=own2 if side == "left" else state.left,
+            right=own2 if side == "right" else state.right,
+            emit_overflow=state.emit_overflow
+            + probe_over.astype(jnp.int64),
+        )
+        return new_state, pending
+
+    def emit_window(self, build_rows: tuple, p: JoinEmit, w,
+                    side: str) -> Chunk:
+        """Materialize window ``w`` of the pending emission space.
+
+        ``build_rows`` is the build (non-arriving) side's row stores —
+        taken from the CURRENT state so the while_loop carry holds the
+        stores once, not per-window copies."""
+        out_cap = self.out_capacity
+        cap = p.signs.shape[0]
+        gpos = w * out_cap + jnp.arange(out_cap, dtype=jnp.int32)
+        valid_out = gpos < p.total
+        # section layout: [up-transitions | pairs | self | down-trans]
+        in_up = valid_out & (gpos < p.U)
+        ppos = gpos - p.U
+        in_pairs = valid_out & (gpos >= p.U) & (ppos < p.P)
+        spos = ppos - p.P
+        in_self = valid_out & (ppos >= p.P) & (spos < p.S)
+        dpos = spos - p.S
+        in_down = valid_out & (spos >= p.S)
+        in_trans = in_up | in_down
+
+        def decode(end, cnt, pos):
+            """row index + within-row offset for a cumsum section."""
+            r_ = jnp.minimum(
+                jnp.searchsorted(end, pos, side="right"), cap - 1
+            ).astype(jnp.int32)
+            return r_, pos - (end[r_] - cnt[r_])
+
+        pair_cnt = p.m if self.emit_pairs else jnp.zeros_like(p.m)
+        ur, uj = decode(p.up_end, p.up_cnt, gpos)
+        pr, pj = decode(p.pair_end, pair_cnt, ppos)
+        sr = p.self_sel[jnp.clip(spos, 0, cap - 1)]
+        dr, dj = decode(p.down_end, p.down_cnt, dpos)
+
+        r = jnp.where(in_up, ur,
+                      jnp.where(in_pairs, pr,
+                                jnp.where(in_self, sr, dr)))
+        j = jnp.where(in_up, uj,
+                      jnp.where(in_pairs, pj,
+                                jnp.where(in_down, dj, 0)))
+        bidx = p.rank_to_idx[
+            r, jnp.clip(j, 0, p.rank_to_idx.shape[1] - 1)
+        ]
+        slot = p.slots[r]
+
+        def probe_val(col):
+            return gather_key(col, r)
+
+        def build_val(store):
+            if isinstance(store, NCol):
+                return NCol(build_val(store.data), store.null[slot, bidx])
+            if isinstance(store, StrCol):
+                return StrCol(store.data[slot, bidx], store.lens[slot, bidx])
+            return store[slot, bidx]
+
+        def pad_null(col, is_pad):
+            """Wrap/extend a column with pad-row null flags."""
             if isinstance(col, NCol):
-                cap = col.null.shape[0]
-                nb = jnp.broadcast_to(col.null[:, None], (cap, B))
-                return NCol(
-                    scatter_probe_col(col.data),
-                    jnp.zeros((out_cap + 1,), jnp.bool_).at[flat_pos].set(
-                        nb.reshape(-1), mode="drop")[:out_cap],
-                )
-            if isinstance(col, StrCol):
-                cap, w = col.data.shape
-                d = jnp.broadcast_to(col.data[:, None, :], (cap, B, w))
-                l = jnp.broadcast_to(col.lens[:, None], (cap, B))
-                return StrCol(
-                    jnp.zeros((out_cap + 1, w), jnp.uint8).at[flat_pos].set(
-                        d.reshape(cap * B, w), mode="drop")[:out_cap],
-                    jnp.zeros((out_cap + 1,), jnp.int32).at[flat_pos].set(
-                        l.reshape(-1), mode="drop")[:out_cap],
-                )
-            cap = col.shape[0]
-            v = jnp.broadcast_to(col[:, None], (cap, B))
-            return jnp.zeros((out_cap + 1,), col.dtype).at[flat_pos].set(
-                v.reshape(-1), mode="drop"
-            )[:out_cap]
+                return NCol(col.data, col.null | is_pad)
+            return NCol(col, is_pad)
 
-        def scatter_gathered(g):
-            """[cap, B, ...] gathered bucket values -> compacted out."""
-            if isinstance(g, NCol):
-                return NCol(
-                    scatter_gathered(g.data),
-                    jnp.zeros((out_cap + 1,), jnp.bool_).at[flat_pos].set(
-                        g.null.reshape(-1), mode="drop")[:out_cap],
-                )
-            if isinstance(g, StrCol):
-                cap, Bb, w = g.data.shape
-                return StrCol(
-                    jnp.zeros((out_cap + 1, w), jnp.uint8).at[flat_pos].set(
-                        g.data.reshape(cap * Bb, w), mode="drop")[:out_cap],
-                    jnp.zeros((out_cap + 1,), jnp.int32).at[flat_pos].set(
-                        g.lens.reshape(-1), mode="drop")[:out_cap],
-                )
-            cap = g.shape[0]
-            return jnp.zeros((out_cap + 1,), g.dtype).at[flat_pos].set(
-                g.reshape(-1), mode="drop"
-            )[:out_cap]
+        out_cols = []
+        if self.emit_pairs:
+            # left ++ right; probe side real except transitions, build
+            # side real except self pads
+            for src_side in ("left", "right"):
+                schema = self.left_schema if src_side == "left" \
+                    else self.right_schema
+                from_probe = src_side == side
+                for ci, f in enumerate(schema):
+                    if from_probe:
+                        col = probe_val(p.probe_cols[ci])
+                        pad = in_trans
+                    else:
+                        col = build_val(build_rows[ci])
+                        pad = in_self
+                    nullable = (self.preserve_left
+                                if src_side == "right"
+                                else self.preserve_right)
+                    out_cols.append(
+                        pad_null(col, pad) if nullable else col
+                    )
+        else:
+            # semi/anti: preserved side only — self rows come from the
+            # probe chunk, transition rows from the build store
+            pres = "left" if self.preserve_left else "right"
+            schema = self.left_schema if pres == "left" \
+                else self.right_schema
+            for ci in range(len(schema)):
+                if pres == side:
+                    out_cols.append(probe_val(p.probe_cols[ci]))
+                else:
+                    out_cols.append(build_val(build_rows[ci]))
 
-        def scatter_build_col(store):
-            return scatter_gathered(_gather_bucket(store, safe_slots))
+        from risingwave_tpu.common.chunk import (
+            OP_DELETE,
+            OP_INSERT,
+            OP_UPDATE_DELETE,
+            OP_UPDATE_INSERT,
+        )
 
-        probe_cols = [scatter_probe_col(c) for c in probe_chunk.columns]
-        build_cols = [scatter_build_col(s) for s in build.rows]
-        out_cols = probe_cols + build_cols if probe_is_left \
-            else build_cols + probe_cols
+        sign_r = p.signs[r]
+        base_op = jnp.where(
+            sign_r > 0, jnp.int8(OP_INSERT), jnp.int8(OP_DELETE)
+        )
+        if self.is_semi:
+            up_op, down_op = OP_INSERT, OP_DELETE
+        elif self.is_anti:
+            up_op, down_op = OP_DELETE, OP_INSERT
+        else:  # outer pads retract on first match, return on last unmatch
+            up_op, down_op = OP_UPDATE_DELETE, OP_UPDATE_INSERT
+        ops = jnp.where(
+            in_up, jnp.int8(up_op),
+            jnp.where(in_down, jnp.int8(down_op), base_op),
+        )
+        return Chunk(out_cols, ops, valid_out, self._out_schema)
 
-        signs = probe_chunk.signs()
-        sign_b = jnp.broadcast_to(signs[:, None], signs.shape + (B,))
-        out_sign = jnp.zeros((out_cap + 1,), jnp.int32).at[flat_pos].set(
-            sign_b.reshape(-1), mode="drop"
-        )[:out_cap]
-        ops = jnp.where(out_sign > 0, jnp.int8(0), jnp.int8(1))
-        valid = jnp.zeros((out_cap + 1,), jnp.bool_).at[flat_pos].set(
-            True, mode="drop"
-        )[:out_cap]
-        out = Chunk(out_cols, ops, valid, self._out_schema)
-        # probe-bound overflow may have hidden real matches: surface it
-        # through the same dropped-matches counter so maintenance raises
-        # instead of silently missing join output
-        return out, n_drop + probe_over
+    def build_rows_of(self, state: JoinState, side: str) -> tuple:
+        """The build (non-arriving) side's row stores for emit_window."""
+        return (state.right if side == "left" else state.left).rows
 
     # ------------------------------------------------------------------
     def apply(self, state: JoinState, chunk: Chunk, side: str):
-        """Process one chunk from ``side`` ("left"|"right").
+        """Process one chunk from ``side`` ("left"|"right"), emitting
+        window 0 of the staged emissions; the remainder counts into
+        ``emit_overflow`` (the windowed DAG path loses nothing —
+        ``apply_begin``/``emit_window``).
 
         Order (matching the reference's update-then-probe for correct
         self-consistency): update own side, then probe the other side.
         """
-        if side == "left":
-            left = self._update_side(state.left, chunk, self.left_keys)
-            out, dropped = self._probe(
-                chunk, state.right, True, self.left_keys
-            )
-            return JoinState(
-                left, state.right, state.emit_overflow + dropped
-            ), out
-        right = self._update_side(state.right, chunk, self.right_keys)
-        out, dropped = self._probe(
-            chunk, state.left, False, self.right_keys
+        state, pending = self.apply_begin(state, chunk, side)
+        out = self.emit_window(
+            self.build_rows_of(state, side), pending, jnp.int32(0), side
         )
-        return JoinState(
-            state.left, right, state.emit_overflow + dropped
+        dropped = jnp.maximum(pending.total - self.out_capacity, 0)
+        return state._replace(
+            emit_overflow=state.emit_overflow + dropped.astype(jnp.int64)
         ), out
+
+    def max_windows(self, chunk_cap: int) -> int:
+        """Static bound on emission windows for one chunk."""
+        worst = chunk_cap * max(self.left_bucket_cap,
+                                self.right_bucket_cap) * 2 + chunk_cap
+        return -(-worst // self.out_capacity)
 
     # ------------------------------------------------------------------
     def maybe_rehash(self, state: JoinState) -> JoinState:
