@@ -58,6 +58,52 @@ def _norm(v) -> str:
     return s
 
 
+def _render(engine, rows) -> list[tuple]:
+    """Type-aware value rendering for comparisons: TIMESTAMP columns
+    print as pg text ('2015-07-15 00:00:00.005'), using the serving
+    read's bound fields when available."""
+    fields = getattr(engine, "_last_fields", None)
+    if not fields or not rows:
+        return rows
+    from risingwave_tpu.common.types import DataType
+
+    ts_cols = [
+        i for i, f in enumerate(fields)
+        if f.data_type in (DataType.TIMESTAMP, DataType.TIMESTAMPTZ)
+    ]
+    date_cols = [
+        i for i, f in enumerate(fields) if f.data_type == DataType.DATE
+    ]
+    if not ts_cols and not date_cols:
+        return rows
+    from datetime import datetime, timedelta
+
+    def fmt_ts(us):
+        if us is None:
+            return None
+        dt = datetime(1970, 1, 1) + timedelta(microseconds=int(us))
+        s = dt.isoformat(sep=" ")
+        if "." in s:
+            s = s.rstrip("0").rstrip(".")
+        return s
+
+    def fmt_date(days):
+        if days is None:
+            return None
+        from datetime import date
+        return (date(1970, 1, 1) + timedelta(days=int(days))).isoformat()
+
+    out = []
+    for r in rows:
+        r = list(r)
+        for i in ts_cols:
+            r[i] = fmt_ts(r[i])
+        for i in date_cols:
+            r[i] = fmt_date(r[i])
+        out.append(tuple(r))
+    return out
+
+
 def run_slt(engine, path: str, tick_between: int = 1) -> int:
     """Execute an .slt file against an Engine; returns #directives run.
 
@@ -65,6 +111,8 @@ def run_slt(engine, path: str, tick_between: int = 1) -> int:
     streaming MVs catch up before queries (the reference harness relies
     on wall-clock barrier cadence; ticks are its deterministic analog).
     """
+    import os
+
     with open(path) as f:
         lines = f.read().splitlines()
     i = 0
@@ -72,6 +120,15 @@ def run_slt(engine, path: str, tick_between: int = 1) -> int:
     while i < len(lines):
         line = lines[i].strip()
         if not line or line.startswith("#"):
+            i += 1
+            continue
+        if line.startswith("include "):
+            target = line.split(None, 1)[1].strip()
+            n_run += run_slt(
+                engine,
+                os.path.join(os.path.dirname(path), target),
+                tick_between=tick_between,
+            )
             i += 1
             continue
         if line.startswith("sleep"):
@@ -130,7 +187,12 @@ def run_slt(engine, path: str, tick_between: int = 1) -> int:
                 rows = engine.execute(sql) or []
             except Exception as e:
                 raise SltError(path, i + 1, f"query failed: {e}")
-            got = [" ".join(_norm(v) for v in r) for r in rows]
+            rows = _render(engine, rows)
+            # sqllogictest convention: whitespace inside TEXT values
+            # collapses for comparison (the corpus writes rows
+            # whitespace-split), so collapse the whole line
+            got = [" ".join((" ".join(_norm(v) for v in r)).split())
+                   for r in rows]
             # normalize the expected side too: corpus files write floats
             # as e.g. '1.5' while _norm canonicalizes to 3 decimals
             want = [" ".join(_norm(t) for t in row.split())

@@ -93,6 +93,14 @@ class TableRef:
 
 
 @dataclass(frozen=True)
+class SubqueryRef:
+    """A derived table: ``FROM (SELECT ...) alias``."""
+
+    select: "Select"
+    alias: str | None = None
+
+
+@dataclass(frozen=True)
 class Tumble:
     """TUMBLE(table, time_col, interval) table function in FROM."""
 
@@ -166,6 +174,9 @@ class CreateSource:
     with_options: dict
     if_not_exists: bool = False
     is_table: bool = False
+    #: declared PRIMARY KEY column names (metadata; DML tables use it
+    #: as the stream key exposed to downstream plans)
+    primary_key: tuple = ()
 
 
 @dataclass(frozen=True)

@@ -108,6 +108,10 @@ class Engine:
         # column names are per-statement: a trailing non-SELECT must not
         # inherit an earlier SELECT's RowDescription
         self._last_columns = None
+        #: bound Fields of the last SELECT's output (type-aware result
+        #: rendering, e.g. timestamps in the slt runner); None when the
+        #: serving path doesn't track them
+        self._last_fields = None
         if isinstance(stmt, ast.CreateSource):
             return self._create_source(stmt)
         if isinstance(stmt, ast.CreateMaterializedView):
@@ -318,10 +322,12 @@ class Engine:
         def factory(split_id: int = 0, num_splits: int = 1):
             return dml.new_reader(cap)
 
+        pk = [schema.index_of(c) for c in stmt.primary_key] \
+            if stmt.primary_key else None
         return CatalogEntry(
             stmt.name, "source", schema, reader_factory=factory,
             watermark=wm, append_only=True, definition=str(stmt),
-            dml=dml,
+            dml=dml, stream_key=pk,
         )
 
     def _datagen_source(self, stmt: ast.CreateSource) -> CatalogEntry:
@@ -1069,6 +1075,7 @@ class Engine:
                 decimal_scale=f.decimal_scale,
             ))
         self._last_columns = [f.name for f in bound_fields]
+        self._last_fields = bound_fields
         out_chunk = chunk.with_columns(out_cols, Schema(tuple(bound_fields)))
         _, cols, _ = out_chunk.to_host()
         result = [tuple(c[i] for c in cols) for i in range(len(cols[0]))] \
@@ -1143,6 +1150,20 @@ def _coerce_const(v, field: Field):
             if isinstance(v, str):
                 raise ValueError(v)
             return bool(v)
+        if isinstance(v, str) and t in (
+            DataType.TIMESTAMP, DataType.TIMESTAMPTZ, DataType.DATE
+        ):
+            # '2015-07-15 00:00:00.005' literals (pg-style)
+            from datetime import date, datetime, timezone
+
+            if t == DataType.DATE:
+                return (date.fromisoformat(v) - date(1970, 1, 1)).days
+            dt = datetime.fromisoformat(v.replace("Z", "+00:00"))
+            if dt.tzinfo is not None:
+                dt = dt.astimezone(timezone.utc).replace(tzinfo=None)
+            from datetime import timedelta
+            # exact integer microseconds (float total_seconds() rounds)
+            return (dt - datetime(1970, 1, 1)) // timedelta(microseconds=1)
         if isinstance(v, float):
             return int(round(v))  # SQL casts round, not truncate
         return int(v)

@@ -224,6 +224,7 @@ class Parser:
             name = self.ident()
             columns: list[ast.ColumnDef] = []
             watermark = None
+            primary_key: tuple[str, ...] = ()
             if self.accept_op("("):
                 while True:
                     if self.accept_word("watermark"):
@@ -234,6 +235,15 @@ class Parser:
                         watermark = ast.WatermarkDef(
                             wcol, self._watermark_delay(expr, wcol)
                         )
+                    elif self.accept_word("primary"):
+                        # table constraint: PRIMARY KEY (col, ...)
+                        self.expect_word("key")
+                        self.expect_op("(")
+                        pk = [self.ident()]
+                        while self.accept_op(","):
+                            pk.append(self.ident())
+                        self.expect_op(")")
+                        primary_key = tuple(pk)
                     else:
                         cname = self.ident()
                         ctype = self._type_name()
@@ -242,6 +252,9 @@ class Parser:
                             nullable = True
                         elif self.accept_word("not"):
                             self.expect_word("null")
+                        if self.accept_word("primary"):
+                            self.expect_word("key")
+                            primary_key = (cname,)
                         columns.append(
                             ast.ColumnDef(cname, ctype, nullable)
                         )
@@ -250,7 +263,7 @@ class Parser:
                 self.expect_op(")")
             options = self._with_options()
             return ast.CreateSource(name, tuple(columns), watermark, options,
-                                    ine, is_table)
+                                    ine, is_table, primary_key)
         if self.accept_word("sink"):
             ine = self._if_not_exists()
             name = self.ident()
@@ -392,6 +405,12 @@ class Parser:
         left = self._table_factor()
         while True:
             kind = None
+            if self.accept_op(","):
+                # comma join: equi-conditions live in WHERE (the
+                # planner mines them — classic implicit-join rewrite)
+                right = self._table_factor()
+                left = ast.Join(left, right, None, "cross")
+                continue
             if self.accept_word("join") or self.accept_word("inner"):
                 if self.peek() and self.peek().value == "join":
                     self.next()
@@ -418,6 +437,22 @@ class Parser:
 
     def _table_factor(self):
         t = self.peek()
+        if t and t.kind == "op" and t.value == "(":
+            # derived table: ( SELECT ... ) [AS] alias
+            self.expect_op("(")
+            select = self._select()
+            self.expect_op(")")
+            alias = None
+            if self.accept_word("as"):
+                alias = self.ident()
+            elif (self.peek() and self.peek().kind == "word"
+                  and self.peek().value not in (
+                      "join", "inner", "left", "right", "full", "on",
+                      "where", "group", "having", "order", "limit",
+                      "offset", "emit",
+                  )):
+                alias = self.ident()
+            return ast.SubqueryRef(select, alias)
         if t and t.value in ("tumble", "hop"):
             fn = self.next().value
             self.expect_op("(")

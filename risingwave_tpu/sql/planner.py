@@ -129,9 +129,18 @@ class Planner:
              eowc: bool = False) -> "UnaryPlan | DagPlan":
         """``sink`` replaces the MV terminal; ``eowc`` = EMIT ON WINDOW
         CLOSE (final append-only rows when windows close)."""
-        if eowc and isinstance(select.from_, ast.Join):
-            raise PlanError("EMIT ON WINDOW CLOSE on joins: next round")
-        if isinstance(select.from_, ast.Join):
+        def has_subquery(f) -> bool:
+            if isinstance(f, ast.SubqueryRef):
+                return True
+            if isinstance(f, ast.Join):
+                return has_subquery(f.left) or has_subquery(f.right)
+            return False
+
+        if isinstance(select.from_, ast.Join) or has_subquery(select.from_):
+            if eowc:
+                raise PlanError(
+                    "EMIT ON WINDOW CLOSE on joins/subqueries: next round"
+                )
             return self._plan_join(select, sink)
         plan = self._plan_unary(select, sink, eowc)
         if isinstance(plan.reader, MvTap):
@@ -176,6 +185,8 @@ class Planner:
             return PlannedInput(
                 reader, execs, Scope.of(entry.schema, qual), entry.schema,
                 wm_col, None, entry.append_only,
+                stream_key=list(entry.stream_key)
+                if entry.stream_key else None,
             )
         if isinstance(from_, (ast.Tumble, ast.Hop)):
             inner = self._resolve_input(from_.table)
@@ -192,7 +203,7 @@ class Planner:
                 # an aliased window table re-qualifies EVERY column
                 quals = tuple(qual for _ in hop.out_schema)
             else:
-                quals = tuple(inner.scope.qualifiers) + (qual,)
+                quals = tuple(inner.scope.qualifiers) + (qual, qual)
             scope = Scope(hop.out_schema, quals)
             # window_start is addressable by the window alias OR the
             # underlying table name (postgres-ish leniency)
@@ -204,6 +215,25 @@ class Planner:
         raise PlanError(f"unsupported FROM clause {from_!r}")
 
     # -- unary pipelines -------------------------------------------------
+    @staticmethod
+    def _stream_key_projection(proj: list, schema: Schema,
+                               stream_key) -> list[int]:
+        """Ensure the stream-key columns survive a projection (hidden if
+        unselected); returns their output positions (the materialize
+        pk).  Ref: stream-key derivation through project nodes."""
+        pk_positions: list[int] = []
+        for ki in stream_key:
+            pos = next(
+                (pi for pi, (_, e) in enumerate(proj)
+                 if isinstance(e, InputRef) and e.index == ki),
+                None,
+            )
+            if pos is None:
+                proj.append((f"_hidden_{schema[ki].name}", InputRef(ki)))
+                pos = len(proj) - 1
+            pk_positions.append(pos)
+        return pk_positions
+
     def _plan_unary(self, select: ast.Select, sink=None,
                     eowc: bool = False) -> UnaryPlan:
         if select.from_ is None:
@@ -252,19 +282,9 @@ class Planner:
                         "retractable input without a stream key cannot "
                         "be materialized"
                     )
-                for ki in pin.stream_key:
-                    pos = next(
-                        (pi for pi, (_, e) in enumerate(proj)
-                         if isinstance(e, InputRef) and e.index == ki),
-                        None,
-                    )
-                    if pos is None:
-                        proj.append((
-                            f"_hidden_{scope.schema[ki].name}",
-                            InputRef(ki),
-                        ))
-                        pos = len(proj) - 1
-                    pk_positions.append(pos)
+                pk_positions = self._stream_key_projection(
+                    proj, scope.schema, pin.stream_key
+                )
             execs.append(ProjectExecutor(scope.schema, proj))
             out_schema = execs[-1].out_schema
 
@@ -509,6 +529,9 @@ class Planner:
                 if (isinstance(ga, ast.ColumnRef)
                         and ga.name == "window_start"):
                     wm_idx, lag = ki, pin.window_size
+                elif (isinstance(ga, ast.ColumnRef)
+                        and ga.name == "window_end"):
+                    wm_idx, lag = ki, 0  # closes when wm >= window_end
         if eowc and wm_idx is None:
             raise PlanError(
                 "EMIT ON WINDOW CLOSE needs GROUP BY window_start over a "
@@ -649,6 +672,8 @@ class Planner:
         def resolve(from_):
             if isinstance(from_, ast.Join):
                 return resolve_join(from_)
+            if isinstance(from_, ast.SubqueryRef):
+                return resolve_subquery(from_)
             pin = self._resolve_input(from_)
             if isinstance(from_, ast.TableRef):
                 base = from_.alias or from_.name
@@ -662,12 +687,87 @@ class Planner:
             sources[name] = pin.reader
             ref = ("source", name)
             if pin.executors:
+                # window columns shift stream-key positions? no — hop
+                # APPENDS columns, existing indices hold
                 nodes.append(FragNode(Fragment(pin.executors), ref))
                 ref = ("node", len(nodes) - 1)
             return ref, pin
 
+        def resolve_subquery(sq: ast.SubqueryRef):
+            """A derived table becomes its own fragment node chain —
+            structurally an anonymous inlined MV (ref: the optimizer
+            plans subqueries as shared sub-plans)."""
+            nonlocal where_conjs
+            inner = sq.select
+            if inner.order_by or inner.limit is not None or inner.offset:
+                raise PlanError(
+                    "ORDER BY/LIMIT in FROM subqueries: next round"
+                )
+            if any(isinstance(i.expr, ast.WindowCall)
+                   for i in inner.items):
+                raise PlanError(
+                    "window functions in FROM subqueries: next round"
+                )
+            # WHERE conjuncts are scoped per SELECT: the subquery's own
+            # comma-joins mine the subquery's WHERE, not the outer one
+            saved_conjs = where_conjs
+            where_conjs = (
+                self._conjuncts(inner.where)
+                if inner.where is not None else []
+            )
+            iref, iinfo = resolve(inner.from_)
+            execs: list[Executor] = []
+            scope = iinfo.scope
+            for conj in where_conjs:  # filters not consumed by joins
+                execs.append(FilterExecutor(
+                    scope.schema, Binder(scope).bind(conj)
+                ))
+            where_conjs = saved_conjs
+            has_agg = bool(inner.group_by) or self._has_agg(inner)
+            pk_positions: list[int] = []
+            if has_agg:
+                execs2, out_schema, pk_positions = self._plan_agg(
+                    inner, scope, iinfo
+                )
+                execs.extend(execs2)
+                append_only = False
+            else:
+                items = self._expand_items(inner.items, scope)
+                b = Binder(scope)
+                proj = [(nm, b.bind(e)) for nm, e in items]
+                if not iinfo.append_only:
+                    if iinfo.stream_key is None:
+                        raise PlanError(
+                            "retractable subquery input without a "
+                            "stream key"
+                        )
+                    pk_positions = self._stream_key_projection(
+                        proj, scope.schema, iinfo.stream_key
+                    )
+                execs.append(ProjectExecutor(scope.schema, proj))
+                out_schema = execs[-1].out_schema
+                append_only = iinfo.append_only
+            ref = iref
+            if execs:
+                nodes.append(FragNode(Fragment(execs), ref))
+                ref = ("node", len(nodes) - 1)
+            info = PlannedInput(
+                None, [], Scope.of(out_schema, sq.alias), out_schema,
+                None, None, append_only,
+                stream_key=pk_positions or None,
+            )
+            return ref, info
+
         KIND_MAP = {"inner": "inner", "left": "left_outer",
-                    "right": "right_outer", "full": "full_outer"}
+                    "right": "right_outer", "full": "full_outer",
+                    "cross": "inner"}
+        #: WHERE conjuncts; comma-joins mine their equi-conditions from
+        #: here (the classic implicit-join rewrite), the rest become
+        #: post-join filters
+        where_conjs: list = (
+            self._conjuncts(select.where)
+            if select.where is not None else []
+        )
 
         def resolve_join(jn: ast.Join):
             join_type = KIND_MAP.get(jn.kind)
@@ -677,11 +777,18 @@ class Planner:
             rref, right = resolve(jn.right)
             n_left = len(left.schema)
 
-            # split ON into equi-conjuncts and residual filters
+            # split ON into equi-conjuncts and residual filters; a
+            # comma join (no ON) pulls its equi-conditions out of WHERE
+            if jn.on is not None:
+                candidates = self._conjuncts(jn.on)
+                from_where = False
+            else:
+                candidates = list(where_conjs)
+                from_where = True
             left_keys: list[Expr] = []
             right_keys: list[Expr] = []
             residual: list = []
-            for conj in self._conjuncts(jn.on):
+            for conj in candidates:
                 keypair = self._equi_pair(
                     conj, left.scope, right.scope, n_left
                 )
@@ -689,7 +796,9 @@ class Planner:
                     lk, rk = keypair
                     left_keys.append(lk)
                     right_keys.append(rk)
-                else:
+                    if from_where:
+                        where_conjs.remove(conj)
+                elif not from_where:
                     residual.append(conj)
             if not left_keys:
                 raise PlanError(
@@ -726,9 +835,12 @@ class Planner:
                                          ("right", right, right_keys)):
                 if pin.window_size is None or pin.watermark_col is None:
                     continue
-                window_idx = len(pin.schema) - 1  # hop appends window_start
+                window_idxs = [
+                    i for i, f in enumerate(pin.schema)
+                    if f.name in ("window_start", "window_end")
+                ]
                 for ki, ke in enumerate(keys):
-                    if isinstance(ke, InputRef) and ke.index == window_idx:
+                    if isinstance(ke, InputRef) and ke.index in window_idxs:
                         setattr(join, f"{side_name}_clean",
                                 (ki, pin.window_size, pin.watermark_col))
                         break
@@ -743,10 +855,20 @@ class Planner:
                 ref = ("node", len(nodes) - 1)
             # outer-join transitions retract pads even over append-only
             # inputs, so only an inner join preserves append-only-ness
+            if join.emit_pairs:
+                skey = None
+                if left.stream_key is not None \
+                        and right.stream_key is not None:
+                    skey = list(left.stream_key) + [
+                        n_left + k for k in right.stream_key
+                    ]
+            else:
+                skey = (left if join.preserve_left else right).stream_key
             info = PlannedInput(
                 None, [], both, both.schema, None, None,
                 left.append_only and right.append_only
                 and join_type == "inner",
+                stream_key=skey,
             )
             return ref, info
 
@@ -754,9 +876,10 @@ class Planner:
         both = root.scope
         post_execs: list[Executor] = []
         b = Binder(both)
-        if select.where is not None:
+        # WHERE conjuncts not consumed as comma-join equi-conditions
+        for conj in where_conjs:
             post_execs.append(
-                FilterExecutor(both.schema, b.bind(select.where))
+                FilterExecutor(both.schema, b.bind(conj))
             )
 
         has_agg = bool(select.group_by) or self._has_agg(select)
@@ -775,6 +898,16 @@ class Planner:
         else:
             items = self._expand_items(select.items, both)
             proj = [(name, b.bind(e)) for name, e in items]
+            pk_positions: list[int] = []
+            if sink is None and not root.append_only \
+                    and root.stream_key is not None:
+                # keyed by the join output's stream key (left ++ right
+                # input keys) so duplicate projected rows keep multiset
+                # semantics (e.g. nexmark q5: identical (auction, num)
+                # rows from different windows)
+                pk_positions = self._stream_key_projection(
+                    proj, both.schema, root.stream_key
+                )
             post_execs.append(ProjectExecutor(both.schema, proj))
             out_schema = post_execs[-1].out_schema
             if sink is not None:
@@ -787,14 +920,14 @@ class Planner:
                     out_schema, ring_size=cfg.mv_ring_size
                 ))
             else:
-                # retractable join output (outer joins, retractable
-                # inputs): keyed materialization on the whole row.
-                # KNOWN GAP (mirrors the TopN pk note): identical
-                # duplicate output rows collapse into one MV slot —
-                # set, not multiset, semantics for exact-duplicate rows.
+                # retractable join output: keyed materialization on the
+                # stream key when derivable, else the whole row.
+                # KNOWN GAP in the fallback (mirrors the TopN pk note):
+                # identical duplicate rows collapse into one MV slot.
                 post_execs.append(MaterializeExecutor(
                     out_schema,
-                    pk_indices=list(range(len(out_schema))),
+                    pk_indices=pk_positions
+                    or list(range(len(out_schema))),
                     table_size=cfg.mv_table_size,
                 ))
         nodes.append(FragNode(Fragment(post_execs), root_ref))

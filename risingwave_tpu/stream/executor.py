@@ -128,8 +128,11 @@ class HopWindowExecutor(Executor):
         self.size_us = size_us
         self.k = size_us // slide_us
         from risingwave_tpu.common.types import DataType as DT
+        # window_start AND window_end (= start + size), matching the
+        # reference's TUMBLE/HOP output (hop_window.rs)
         self._out_schema = Schema(
-            in_schema.fields + (Field(window_col, DT.TIMESTAMP),)
+            in_schema.fields + (Field(window_col, DT.TIMESTAMP),
+                                Field("window_end", DT.TIMESTAMP))
         )
 
     @property
@@ -150,10 +153,10 @@ class HopWindowExecutor(Executor):
 
         ts = chunk.column(self.ts_col)
         ws0 = ts - ts % self.slide_us           # latest window start
-        if k == 1:  # TUMBLE: append the window column, no expansion
+        if k == 1:  # TUMBLE: append the window columns, no expansion
             return state, Chunk(
-                chunk.columns + (ws0,), chunk.ops, chunk.valid,
-                self._out_schema,
+                chunk.columns + (ws0, ws0 + self.size_us),
+                chunk.ops, chunk.valid, self._out_schema,
             )
         offs = jnp.tile(
             jnp.arange(k, dtype=jnp.int64) * self.slide_us, (cap,)
@@ -161,7 +164,7 @@ class HopWindowExecutor(Executor):
         # every generated window contains its row: ws = ws0 - i*slide
         # with i < k gives ts - ws < slide + (k-1)*slide = size
         ws = rep(ws0) - offs
-        cols = tuple(rep(c) for c in chunk.columns) + (ws,)
+        cols = tuple(rep(c) for c in chunk.columns) + (ws, ws + self.size_us)
         return state, Chunk(
             cols, rep(chunk.ops), rep(chunk.valid), self._out_schema,
         )

@@ -105,7 +105,7 @@ def _interleave(old, new):
             _interleave(old.data, new.data), _interleave(old.lens, new.lens)
         )
     return jnp.stack([old, new], axis=1).reshape(
-        (old.shape[0] * 2,) + old.shape[2:]
+        (old.shape[0] * 2,) + old.shape[1:]
     )
 
 
