@@ -1,25 +1,46 @@
-"""Mask compaction primitives tuned for TPU.
+"""Mask compaction primitives, backend-adaptive.
 
-``jnp.nonzero(mask, size=k)`` lowers to a cumsum + full-size scatter,
-which on TPU costs ~milliseconds for table-sized masks (measured 18.6ms
-for 2^18 — the single hottest op in barrier flush).  ``lax.top_k`` is a
-tuned TPU primitive (~0.02ms for the same shape), and its tie-breaking
-(equal values ordered by ascending index) makes it a drop-in
-replacement for nonzero's ascending index order.
+The same logical op has opposite cost profiles per backend (all
+measured, see ARCHITECTURE.md perf notes):
+
+- ``jnp.nonzero(mask, size=k)`` lowers to a cumsum + full-size
+  scatter: ~18.6ms on TPU for a 2^18 mask (the single hottest op in
+  barrier flush) but only ~2.7ms on CPU.
+- ``lax.top_k`` is a tuned TPU primitive (~0.02ms for the same shape)
+  but on CPU costs ~34ms (it lowers to a full variadic sort per call).
+
+Round 2 switched everything to top_k and silently made the CPU path
+~6x slower (the round-2 q7 "4x regression"); the strategy is now
+selected once per process from ``jax.default_backend()`` — a
+trace-time Python branch, so each backend compiles only its fast op.
 """
 
 from __future__ import annotations
+
+import functools
 
 import jax
 import jax.numpy as jnp
 
 
+@functools.cache
+def accel_tuned() -> bool:
+    """True when compiling for an accelerator (TPU tunings apply)."""
+    return jax.default_backend() != "cpu"
+
+
 def mask_indices(mask: jnp.ndarray, k: int, fill) -> jnp.ndarray:
     """Indices of up to ``k`` set bits of ``mask`` (ascending), ``fill``
-    for the rest — the fast equivalent of
-    ``jnp.nonzero(mask, size=k, fill_value=fill)[0]``."""
-    vals, idx = jax.lax.top_k(mask.astype(jnp.int32), k)
-    return jnp.where(vals > 0, idx, jnp.asarray(fill, idx.dtype))
+    for the rest.
+
+    TPU: ``lax.top_k`` (tie-break = ascending index, a drop-in for
+    nonzero's order).  CPU: ``jnp.nonzero`` (top_k is ~13x slower
+    there)."""
+    if accel_tuned():
+        vals, idx = jax.lax.top_k(mask.astype(jnp.int32), k)
+        return jnp.where(vals > 0, idx, jnp.asarray(fill, idx.dtype))
+    (idx,) = jnp.nonzero(mask, size=k, fill_value=fill)
+    return idx.astype(jnp.int32)
 
 
 def segment_starts(sorted_neq: jnp.ndarray) -> jnp.ndarray:

@@ -57,6 +57,7 @@ from risingwave_tpu.common.chunk import (
     split_col,
 )
 from risingwave_tpu.common.compact import (
+    accel_tuned,
     mask_indices,
     segment_start_positions,
     segment_starts,
@@ -257,15 +258,21 @@ class HashAggExecutor(Executor):
 
     # ------------------------------------------------------------------
     def apply(self, state: AggState, chunk: Chunk):
-        """Chunk-local pre-aggregation, then one sparse scatter per prim.
+        """Apply one chunk of updates; backend-adaptive strategy.
 
-        TPU scatters serialize over LIVE updates (~0.25µs/row), so a
-        full-chunk scatter costs milliseconds while sort + segmented
-        scan cost ~20µs.  The chunk is sorted by key hash, adjacent
-        equal keys form segments, each primitive contribution is
-        segment-reduced, and only each segment's END row (its
+        TPU: chunk-local pre-aggregation, then one sparse scatter per
+        prim.  TPU scatters serialize over LIVE updates (~0.25µs/row),
+        so a full-chunk scatter costs milliseconds while sort +
+        segmented scan cost ~20µs.  The chunk is sorted by key hash,
+        adjacent equal keys form segments, each primitive contribution
+        is segment-reduced, and only each segment's END row (its
         "representative") probes the table and scatters — O(distinct
-        keys) serialized work instead of O(chunk)."""
+        keys) serialized work instead of O(chunk).
+
+        CPU: scatters are cheap (~0.3ms for a full chunk into 2^18)
+        while each 8k-row sort costs ~1.6ms, so the chunk probes and
+        scatters per-row with no sort at all (the round-1 shape; the
+        round-2 always-sort version was the "4x q7 regression")."""
         signs = chunk.signs()
         valid = chunk.valid
         cap = valid.shape[0]
@@ -275,36 +282,49 @@ class HashAggExecutor(Executor):
             for _, e in self.group_by
         ]
 
-        # invalid rows sort to the very end under the all-ones sentinel
-        # (hash64_columns never returns ~0, so no valid row lands there)
         h = hash64_columns(key_cols)
-        sort_key = jnp.where(valid, h, ~jnp.uint64(0))
-        s_h, perm = jax.lax.sort_key_val(
-            sort_key, jnp.arange(cap, dtype=jnp.int32)
-        )
-        s_valid = valid[perm]
-        s_signs = signs[perm]
-        s_keys = [gather_key(c, perm) for c in key_cols]
-        # segment boundary: hash differs OR any key column differs
-        # (hash collisions between distinct keys stay distinct segments)
-        neq = s_h[1:] != s_h[:-1]
-        for c in s_keys:
-            neq = neq | ~keys_equal(gather_key(c, jnp.arange(1, cap)),
-                                    gather_key(c, jnp.arange(0, cap - 1)))
-        starts = segment_starts(neq)
-        ends = jnp.concatenate([neq, jnp.ones((1,), jnp.bool_)])
-        rep = ends & s_valid
-        start_pos = segment_start_positions(starts)
-        # unique, monotone segment id (hash-collision-split segments of
-        # equal s_h must not merge in the min/max secondary sort)
-        seg_id = jnp.cumsum(starts.astype(jnp.int32))
-        seg_rows = segmented_sum(s_valid.astype(jnp.int64), start_pos)
+        preagg = accel_tuned()
+        if preagg:
+            # invalid rows sort to the very end under the all-ones
+            # sentinel (hash64_columns never returns ~0, so no valid
+            # row lands there)
+            sort_key = jnp.where(valid, h, ~jnp.uint64(0))
+            s_h, perm = jax.lax.sort_key_val(
+                sort_key, jnp.arange(cap, dtype=jnp.int32)
+            )
+            s_valid = valid[perm]
+            s_signs = signs[perm]
+            s_keys = [gather_key(c, perm) for c in key_cols]
+            # segment boundary: hash differs OR any key column differs
+            # (hash collisions between distinct keys stay distinct)
+            neq = s_h[1:] != s_h[:-1]
+            for c in s_keys:
+                neq = neq | ~keys_equal(
+                    gather_key(c, jnp.arange(1, cap)),
+                    gather_key(c, jnp.arange(0, cap - 1)))
+            starts = segment_starts(neq)
+            ends = jnp.concatenate([neq, jnp.ones((1,), jnp.bool_)])
+            rep = ends & s_valid
+            start_pos = segment_start_positions(starts)
+            # unique, monotone segment id (hash-collision-split
+            # segments of equal s_h must not merge in the min/max
+            # secondary sort)
+            seg_id = jnp.cumsum(starts.astype(jnp.int32))
+            seg_rows = segmented_sum(s_valid.astype(jnp.int64), start_pos)
 
-        table, slots, inserted, overflow = state.table.lookup_or_insert(
-            s_keys, rep, hashes=s_h
-        )
-        # overflowed representatives drop their whole segment — count rows
-        n_over = jnp.sum(jnp.where(rep & overflow, seg_rows, 0))
+            table, slots, inserted, overflow = state.table.lookup_or_insert(
+                s_keys, rep, hashes=s_h
+            )
+            # overflowed representatives drop their whole segment —
+            # count rows
+            n_over = jnp.sum(jnp.where(rep & overflow, seg_rows, 0))
+        else:
+            perm = None
+            s_signs = signs
+            table, slots, inserted, overflow = state.table.lookup_or_insert(
+                key_cols, valid, hashes=h
+            )
+            n_over = jnp.sum((overflow & valid).astype(jnp.int64))
         # freshly claimed slots may be reclaimed after state cleaning —
         # reset their (stale) primitive state before applying updates
         ins_pos = jnp.where(inserted, slots, jnp.int32(self.table_size))
@@ -333,18 +353,26 @@ class HashAggExecutor(Executor):
             col, col_null = split_col(col)
             if col_null is not None and not isinstance(col, StrCol):
                 col = jnp.where(col_null, jnp.zeros((), col.dtype), col)
-            prim_signs = s_signs if col_null is None else jnp.where(
-                col_null[perm], 0, s_signs
-            )
-            # per-row lift in sorted order, then segment-reduce: the
-            # value at each segment END is the whole segment's update
-            contrib = ps.lift(gather_key(col, perm), prim_signs)
-            if ps.mode == "add":
-                seg = segmented_sum(contrib, start_pos)
-            else:
-                seg = segmented_minmax_at_ends(
-                    seg_id, contrib, start_pos, ps.mode
+            if perm is None:
+                prim_signs = signs if col_null is None else jnp.where(
+                    col_null, 0, signs
                 )
+                # per-row update scattered directly (invalid rows carry
+                # sign 0 ⇒ identity, and sentinel slots drop)
+                seg = ps.lift(col, prim_signs)
+            else:
+                prim_signs = s_signs if col_null is None else jnp.where(
+                    col_null[perm], 0, s_signs
+                )
+                # per-row lift in sorted order, then segment-reduce:
+                # the value at each segment END is the segment's update
+                contrib = ps.lift(gather_key(col, perm), prim_signs)
+                if ps.mode == "add":
+                    seg = segmented_sum(contrib, start_pos)
+                else:
+                    seg = segmented_minmax_at_ends(
+                        seg_id, contrib, start_pos, ps.mode
+                    )
             # non-representative rows carry sentinel slots (dropped)
             if ps.mode == "add":
                 prims[pi] = prims[pi].at[slots].add(seg, mode="drop")
@@ -352,40 +380,48 @@ class HashAggExecutor(Executor):
                 prims[pi] = prims[pi].at[slots].min(seg, mode="drop")
             else:
                 prims[pi] = prims[pi].at[slots].max(seg, mode="drop")
-        seg_signs = segmented_sum(s_signs.astype(jnp.int64), start_pos)
+        if perm is None:
+            seg_signs = signs.astype(jnp.int64)
+        else:
+            seg_signs = segmented_sum(s_signs.astype(jnp.int64), start_pos)
         row_count = state.row_count.at[ins_pos].set(0, mode="drop")
         row_count = row_count.at[slots].add(seg_signs, mode="drop")
         dirty = state.dirty.at[slots].set(True, mode="drop")
 
-        # materialized-input updates (retractable min/max): every SORTED
-        # row lands in its group's value bucket — per-row slots come
-        # from scattering each segment representative's slot over its
-        # segment id
+        # materialized-input updates (retractable min/max): every row
+        # lands in its group's value bucket — per-row slots come from
+        # the per-row probe (CPU) or from scattering each segment
+        # representative's slot over its segment id (TPU)
         minput_vals = list(state.minput_vals)
         minput_occ = list(state.minput_occ)
         n_over_mi = jnp.zeros((), jnp.int64)
         n_miss_mi = jnp.zeros((), jnp.int64)
         if self._minput_aggs:
-            # per-row slot = its segment representative's slot (seg ids
-            # start at 1, so index 0 is a safe dump for non-rep rows);
-            # segments whose representative overflowed keep the `size`
-            # sentinel and their rows are skipped (already counted in
-            # n_over)
-            seg_slot = jnp.full((cap + 1,), self.table_size, jnp.int32)
-            seg_slot = seg_slot.at[jnp.where(rep, seg_id, 0)].set(
-                jnp.where(rep, slots, self.table_size), mode="drop"
-            )
-            row_slots = seg_slot[seg_id]
-            row_ok = s_valid & (row_slots < self.table_size)
+            if perm is None:
+                row_slots = slots
+                row_ok = valid & (row_slots < self.table_size)
+            else:
+                # seg ids start at 1, so index 0 is a safe dump for
+                # non-rep rows; segments whose representative
+                # overflowed keep the `size` sentinel and their rows
+                # are skipped (already counted in n_over)
+                seg_slot = jnp.full((cap + 1,), self.table_size, jnp.int32)
+                seg_slot = seg_slot.at[jnp.where(rep, seg_id, 0)].set(
+                    jnp.where(rep, slots, self.table_size), mode="drop"
+                )
+                row_slots = seg_slot[seg_id]
+                row_ok = s_valid & (row_slots < self.table_size)
             for mi, agg_idx in enumerate(self._minput_aggs):
                 a = self.aggs[agg_idx]
                 if agg_idx not in arg_cache:
                     arg_cache[agg_idx] = a.arg.eval(chunk)
                 vcol, vnull = split_col(arg_cache[agg_idx])
-                v_sorted = gather_key(vcol, perm)
+                v_sorted = vcol if perm is None else gather_key(vcol, perm)
                 active = row_ok & (s_signs != 0)
                 if vnull is not None:
-                    active = active & ~vnull[perm]
+                    active = active & ~(
+                        vnull if perm is None else vnull[perm]
+                    )
                 vals, occ, over, miss = self._minput_update(
                     minput_vals[mi], minput_occ[mi], row_slots,
                     v_sorted, s_signs, active, ins_pos,

@@ -1,0 +1,74 @@
+"""Recurring accelerator probe: forensic record of chip availability.
+
+Round-2 verdict demanded either device numbers or a blocker record.
+This script attempts backend init with a hard timeout and appends one
+JSON line per attempt to TPU_PROBE_LOG.jsonl (repo root): timestamp,
+outcome, init seconds, and a sanity-matmul time when the chip is up.
+Run as a loop (scripts/tpu_probe_loop.sh) or one-shot.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+LOG = os.path.join(os.path.dirname(__file__), "..", "TPU_PROBE_LOG.jsonl")
+
+CHILD = r'''
+import json, time
+t0 = time.time()
+import jax
+devs = jax.devices()
+rec = {"devices": [str(d) for d in devs], "platform": devs[0].platform,
+       "init_seconds": round(time.time() - t0, 1)}
+if devs[0].platform == "cpu":
+    # sitecustomize pins jax_platforms to "axon,cpu": a fast axon
+    # failure silently falls through to CPU — that is NOT a chip
+    print("PROBE_CPU_FALLBACK " + json.dumps(rec))
+    raise SystemExit(0)
+import jax.numpy as jnp
+x = jnp.ones((4096, 4096), dtype=jnp.bfloat16)
+t1 = time.time()
+y = (x @ x).block_until_ready()
+rec["matmul_4k_ms_incl_compile"] = round((time.time() - t1) * 1e3, 1)
+t2 = time.time()
+for _ in range(10):
+    y = (y @ x)
+y.block_until_ready()
+rec["matmul_4k_ms_steady"] = round((time.time() - t2) * 1e2, 2)
+print("PROBE_OK " + json.dumps(rec))
+'''
+
+def probe(timeout_s: float = 600.0) -> dict:
+    rec = {"t": time.strftime("%Y-%m-%dT%H:%M:%S"), "timeout_s": timeout_s}
+    t0 = time.time()
+    try:
+        out = subprocess.run([sys.executable, "-c", CHILD],
+                             capture_output=True, text=True,
+                             timeout=timeout_s)
+        for line in out.stdout.splitlines():
+            if line.startswith("PROBE_OK "):
+                rec.update(json.loads(line[len("PROBE_OK "):]))
+                rec["ok"] = True
+                break
+            if line.startswith("PROBE_CPU_FALLBACK "):
+                rec.update(json.loads(line.split(" ", 1)[1]))
+                rec["ok"] = False
+                rec["error"] = ("backend init fell back to CPU "
+                                "(accelerator claim failed fast)")
+                break
+        else:
+            rec["ok"] = False
+            rec["error"] = (out.stderr or out.stdout)[-400:]
+    except subprocess.TimeoutExpired:
+        rec["ok"] = False
+        rec["error"] = f"backend init hung > {timeout_s:.0f}s (killed)"
+    rec["elapsed_s"] = round(time.time() - t0, 1)
+    return rec
+
+if __name__ == "__main__":
+    timeout_s = float(sys.argv[1]) if len(sys.argv) > 1 else 600.0
+    rec = probe(timeout_s)
+    with open(LOG, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec))

@@ -1,0 +1,6 @@
+#!/bin/bash
+# Probe the accelerator every ~20 min, forever; log to TPU_PROBE_LOG.jsonl
+while true; do
+  python "$(dirname "$0")/tpu_probe.py" 600 >/dev/null 2>&1
+  sleep 1200
+done
