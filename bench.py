@@ -64,9 +64,9 @@ CREATE SOURCE auction (
         nexmark.event.rate = '{rate}');
 """
 
-# q8 event rate is capped until degree-adaptive join storage lands
-# (dense buckets overflow on hot sellers at the full rate)
-RATES: dict = {"q8": "2000"}
+#: per-query event-rate overrides (none: the degree-adaptive pool join
+#: runs q8 at the same full rate as every other query)
+RATES: dict = {}
 
 QUERIES = {
     "q1": """
@@ -101,15 +101,16 @@ def measure(query: str) -> float:
         chunk_capacity=CHUNK_CAP,
         agg_table_size=1 << 18,
         agg_emit_capacity=4096,
-        join_table_size=1 << 13,
-        join_bucket_cap=64,
-        join_out_capacity=1 << 18,
-        # q8: persons are (window, id)-unique — many keys; auctions
-        # concentrate on hot sellers — fewer keys, deeper pool
-        join_left_table_size=1 << 18,
-        join_left_bucket_cap=4,
-        join_right_table_size=1 << 14,
-        join_right_bucket_cap=128,
+        # q8 state is rate x live-window-span rows per side (~2.7M in
+        # the measured window before the watermark closes anything):
+        # the shared pool holds them with NO per-key cap — hot sellers
+        # need no hand-tuned bucket depths and no rate limiting
+        join_left_table_size=1 << 22,
+        join_right_table_size=1 << 18,
+        join_pool_size=1 << 22,
+        # out_capacity sizes every emission window chunk; oversizing
+        # it taxes every chunk with dead rows (measured 3.6x on q8)
+        join_out_capacity=1 << 15,
         mv_table_size=1 << 18,
         # q1/q8 materialize every output row; the ring must hold the
         # whole warmup+measured window (the lap counter voids lossy runs)

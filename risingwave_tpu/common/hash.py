@@ -140,8 +140,15 @@ def compute_vnodes(
     """Vectorized vnode assignment for a chunk (ref vnode.rs:151).
 
     vnode = crc32(dist key) % vnode_count, returned as ``int32 [cap]``.
+
+    Nullable (``NCol``) keys route by grouping equality: NULLs hash as
+    a zeroed payload + null flag, so all NULL keys land on one vnode —
+    exactly the reference's NULL-is-one-group GROUP BY routing.
     """
-    h = crc32_columns(key_columns)
+    flat: list = []
+    for c in key_columns:
+        flat.extend(normalize_null_col(c))
+    h = crc32_columns(flat)
     return (h % jnp.uint32(vnode_count)).astype(jnp.int32)
 
 

@@ -30,7 +30,7 @@ from typing import Sequence
 import jax
 import jax.numpy as jnp
 
-from risingwave_tpu.common.chunk import Chunk, StrCol
+from risingwave_tpu.common.chunk import Chunk, NCol, StrCol
 from risingwave_tpu.common.hash import VNODE_COUNT, compute_vnodes
 
 
@@ -48,6 +48,15 @@ def shard_of_vnode(vnodes: jnp.ndarray, n_shards: int,
 
 def _bucketize(col, dest_slot: jnp.ndarray, n_shards: int, cap: int):
     """Scatter a [cap] column into [n_shards*cap] bucket-major layout."""
+    if isinstance(col, NCol):
+        return NCol(
+            _bucketize(col.data, dest_slot, n_shards, cap),
+            # unfilled bucket slots read as NULL (their validity is
+            # False anyway, but NULL is the safe default payload)
+            jnp.ones((n_shards * cap,), jnp.bool_).at[dest_slot].set(
+                col.null, mode="drop"
+            ),
+        )
     if isinstance(col, StrCol):
         return StrCol(
             _bucketize(col.data, dest_slot, n_shards, cap),
@@ -112,6 +121,8 @@ def shuffle_chunk(
         return r.reshape((n_shards * cap,) + x.shape[1:])
 
     def a2a_col(c):
+        if isinstance(c, NCol):
+            return NCol(a2a_col(c.data), a2a(c.null))
         if isinstance(c, StrCol):
             return StrCol(a2a(c.data), a2a(c.lens))
         return a2a(c)

@@ -361,6 +361,11 @@ class Engine:
             if sharded is not None:
                 job, terminal, state_index = sharded
                 return job, terminal, state_index, None, True
+        if par > 1 and isinstance(plan, DagPlan):
+            sharded = self._try_sharded_dag_plan(plan, name, par, ckpt_freq)
+            if sharded is not None:
+                job, terminal, state_index, dag_meta = sharded
+                return job, terminal, state_index, dag_meta, True
         if isinstance(plan, UnaryPlan):
             job = StreamingJob(
                 plan.reader, plan.fragment, name,
@@ -380,6 +385,10 @@ class Engine:
         jobs use the leaner StreamingJob until something taps them."""
         job = entry.job
         if isinstance(job, DagJob):
+            if job.mesh is not None:
+                raise PlanError(
+                    "MV-on-MV over a sharded join job: next round"
+                )
             return job, entry.mv_state_index[0]
         if not isinstance(job, StreamingJob):
             raise PlanError(
@@ -619,6 +628,10 @@ class Engine:
             MaterializeExecutor as _M,
         )
 
+        from risingwave_tpu.stream.watermark import (
+            WatermarkFilterExecutor as _W,
+        )
+
         execs = plan.fragment.executors
         agg_idx = None
         for i, ex in enumerate(execs):
@@ -628,10 +641,11 @@ class Engine:
                 agg_idx = i
         if agg_idx is None:
             return None
-        # prefix must be stateless; watermark cleaning in the sharded
-        # path lands next round
+        # prefix: stateless ops + watermark filters (each shard filters
+        # its own substream; barrier-time pmin aligns the global
+        # watermark — ShardedJob._wm_pass)
         prefix = execs[:agg_idx]
-        if any(not isinstance(ex, (_F, _H, _P)) for ex in prefix):
+        if any(not isinstance(ex, (_F, _H, _P, _W)) for ex in prefix):
             return None
         # suffix after the agg: only per-key-safe operators (a TopN or
         # sink here would compute per-SHARD results — stays linear)
@@ -639,13 +653,6 @@ class Engine:
             if not isinstance(ex, (_F, _P, _M, _AOM)):
                 return None
         agg = execs[agg_idx]
-        if agg.watermark_group_idx is not None:
-            return None
-        # the two-phase partial agg has no NCol handling yet: nullable
-        # group keys or arguments keep the plan on the linear path
-        if any(f.nullable for f in agg.in_schema) \
-                or any(f.nullable for f in agg.out_schema):
-            return None
         n = min(par, len(jax.devices()))
         if n < 2:
             return None
@@ -680,6 +687,12 @@ class Engine:
                 translated_global_calls(agg.aggs, n_keys),
                 table_size=agg.table_size,
                 emit_capacity=agg.emit_capacity,
+                # group-key positions are identical in the partial
+                # output, so window cleaning/EOWC carry over directly
+                watermark_group_idx=agg.watermark_group_idx,
+                watermark_lag=agg.watermark_lag,
+                watermark_src_col=agg.watermark_src_col,
+                emit_on_window_close=agg.emit_on_window_close,
             )
             local_execs = local_execs + [partial]
             keyed_execs = [global_agg] + list(execs[agg_idx + 1:])
@@ -703,6 +716,89 @@ class Engine:
         # inserts a partial agg, shifting positions vs the linear plan)
         terminal = keyed_execs[-1]
         return job, terminal, (len(local_execs) + len(keyed_execs) - 1,)
+
+    def _try_sharded_dag_plan(self, plan: DagPlan, name: str, par: int,
+                              ckpt_freq: int):
+        """Shard a join-shaped DAG plan over the device mesh.
+
+        Ref: every stateful fragment is vnode-parallel with hash
+        exchanges on its inputs (src/meta/src/stream/stream_graph/
+        actor.rs:435, dispatch.rs:949).  Here: the whole DAG runs
+        per-shard inside one shard_map, with all_to_all exchanges on
+        each join input edge routing rows by that side's equi keys.
+        Join OUTPUT rows stay shard-local for the downstream
+        materialize — a joined row's stream key contains its join key,
+        so a given key's changelog always lands on the owning shard.
+
+        Eligible: traceable sources (no MvTaps), stateless(+watermark)
+        prefixes, joins, and a per-key-safe post chain (project/filter/
+        materialize — no sinks/TopN, which need host delivery or global
+        order)."""
+        import jax
+        from risingwave_tpu.stream.executor import (
+            FilterExecutor as _F,
+            HopWindowExecutor as _H,
+            ProjectExecutor as _P,
+        )
+        from risingwave_tpu.stream.materialize import (
+            AppendOnlyMaterialize as _AOM,
+            MaterializeExecutor as _M,
+        )
+        from risingwave_tpu.stream.sharded import make_mesh
+        from risingwave_tpu.stream.watermark import (
+            WatermarkFilterExecutor as _W,
+        )
+
+        if any(isinstance(r, MvTap) for r in plan.sources.values()):
+            return None
+        if any(not (hasattr(r, "impl") and hasattr(r, "next_base"))
+               for r in plan.sources.values()):
+            return None
+        joins = [i for i, n in enumerate(plan.nodes)
+                 if isinstance(n, JoinNode)]
+        if not joins:
+            return None
+        join_inputs: set = set()
+        for i in joins:
+            join_inputs.add(plan.nodes[i].left)
+            join_inputs.add(plan.nodes[i].right)
+        for i, n in enumerate(plan.nodes):
+            if isinstance(n, JoinNode):
+                continue
+            if ("node", i) in join_inputs or n.input[0] == "source":
+                # pre-join prefix: stateless + watermark filters
+                if any(not isinstance(ex, (_F, _H, _P, _W))
+                       for ex in n.fragment.executors):
+                    return None
+            else:
+                # post-join chain: per-key-safe only
+                if any(not isinstance(ex, (_F, _P, _M, _AOM))
+                       for ex in n.fragment.executors):
+                    return None
+        n = min(par, len(jax.devices()))
+        if n < 2:
+            return None
+        exchanges = {}
+        for i in joins:
+            join = plan.nodes[i].join
+            exchanges[(i, "left")] = (
+                lambda c, ks=join.left_keys: [e.eval(c) for e in ks]
+            )
+            exchanges[(i, "right")] = (
+                lambda c, ks=join.right_keys: [e.eval(c) for e in ks]
+            )
+        job = DagJob(
+            plan.sources, plan.nodes, name,
+            checkpoint_frequency=ckpt_freq,
+            checkpoint_store=self.checkpoint_store,
+            mesh=make_mesh(n),
+            exchanges=exchanges,
+        )
+        terminal = plan.nodes[plan.mv_node].fragment.executors[
+            plan.mv_index
+        ]
+        return job, terminal, (plan.mv_node, plan.mv_index), \
+            (list(range(len(plan.nodes))), list(plan.sources))
 
     def _create_mview(self, stmt: ast.CreateMaterializedView):
         from risingwave_tpu.stream.materialize import AppendOnlyMaterialize
@@ -1029,11 +1125,21 @@ class Engine:
             st = states
             for i in entry.mv_state_index:
                 st = st[i]
+            if getattr(entry.job, "mesh", None) is not None:
+                import jax as _jax
+                rows = []
+                for shard in range(entry.job.n_shards):
+                    rows.extend(entry.mv_executor.to_host(
+                        _jax.tree.map(lambda x: x[shard], st)
+                    ))
+                return rows
             return entry.mv_executor.to_host(st)
 
         idx = entry.mv_state_index
         if isinstance(entry.job, ShardedStreamingJob):
             return entry.job.mv_rows(entry.mv_executor, idx[0])
+        if getattr(entry.job, "mesh", None) is not None:
+            return entry.job.mv_rows(entry.mv_executor, idx)
         state = entry.job.states
         for i in idx:
             state = state[i]

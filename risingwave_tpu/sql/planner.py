@@ -109,6 +109,10 @@ class PlannerConfig:
     join_right_table_size: int | None = None
     join_left_bucket_cap: int | None = None
     join_right_bucket_cap: int | None = None
+    #: shared row-pool capacity for degree-adaptive (append-only) join
+    #: sides — replaces dense [size, bucket] buckets so hot keys have
+    #: no per-key cap (ref JoinHashMap's unbounded per-key rows)
+    join_pool_size: int = 1 << 16
     topn_pool_size: int = 4096
     topn_emit_capacity: int = 1024
     mv_table_size: int = 1 << 16
@@ -823,6 +827,13 @@ class Planner:
                 left_bucket_cap=cfg.join_left_bucket_cap,
                 right_bucket_cap=cfg.join_right_bucket_cap,
                 join_type=join_type,
+                # append-only sides take the degree-adaptive shared
+                # pool (no per-key cap for hot-skew keys); retractable
+                # sides need delete-by-value and keep dense buckets
+                left_storage="pool" if left.append_only else "dense",
+                right_storage="pool" if right.append_only else "dense",
+                left_pool_size=cfg.join_pool_size,
+                right_pool_size=cfg.join_pool_size,
             )
             # the join's OUTPUT schema carries the pad nullability
             both = Scope(

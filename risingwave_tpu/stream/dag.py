@@ -50,6 +50,11 @@ from risingwave_tpu.stream.runtime import (
     restore_source,
 )
 
+try:  # jax >= 0.8
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 #: a dataflow edge endpoint: ("source", name) or ("node", node_id)
 Ref = tuple
 
@@ -86,6 +91,9 @@ class DagJob:
     catalog references.
     """
 
+    #: mesh axis name for sharded DAGs
+    AXIS = "shard"
+
     def __init__(
         self,
         sources: dict[str, Any],
@@ -93,19 +101,28 @@ class DagJob:
         name: str = "dag_job",
         checkpoint_frequency: int = 1,
         checkpoint_store=None,
+        mesh=None,
+        exchanges: dict | None = None,
     ):
         self.sources = dict(sources)
         self.nodes: list = list(nodes)
         self.name = name
         self.checkpoint_frequency = checkpoint_frequency
         self.checkpoint_store = checkpoint_store
+        #: sharded execution (ref: every stateful op is vnode-parallel,
+        #: src/meta/src/stream/stream_graph/actor.rs:435): the whole
+        #: reachable subgraph runs per-shard inside shard_map, with
+        #: ``exchanges[(node_id, side)] -> key_fn`` marking the edges
+        #: where chunks re-route to their key-owning shard via
+        #: all_to_all (the reference's hash dispatchers)
+        self.mesh = mesh
+        self.exchanges = dict(exchanges or {})
+        self.n_shards = int(mesh.devices.size) if mesh is not None else 1
         self.maintenance_interval = 1
         self._ckpts_since_maintain = 0
         self.snapshot_interval = 1
         self._ckpts_since_snapshot = 0
-        self.states = tuple(
-            n.init_state() if n is not None else None for n in self.nodes
-        )
+        self.states = self._init_states()
         self.epoch = EpochPair.first()
         self.barriers_seen = 0
         self.checkpoints: list[CheckpointSnapshot] = []
@@ -114,6 +131,29 @@ class DagJob:
         self._counters = None
         self.counter_labels: list[str] = []
         self._rebuild()
+
+    def _init_states(self):
+        if self.mesh is None:
+            return tuple(
+                n.init_state() if n is not None else None
+                for n in self.nodes
+            )
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def one_shard(_):
+            return tuple(
+                n.init_state() if n is not None else None
+                for n in self.nodes
+            )
+
+        stacked = jax.vmap(one_shard)(jnp.arange(self.n_shards))
+        return jax.device_put(
+            stacked, NamedSharding(self.mesh, P(self.AXIS))
+        )
+
+    def _sharding_spec(self):
+        from jax.sharding import PartitionSpec as P
+        return P(self.AXIS)
 
     # -- topology -------------------------------------------------------
     def _rebuild(self) -> None:
@@ -262,12 +302,18 @@ class DagJob:
             for idx in self._consumers.get(ref, ()):
                 node = self.nodes[idx]
                 if isinstance(node, FragNode):
-                    inbox.setdefault(idx, []).append((chunk, None))
+                    inbox.setdefault(idx, []).append(
+                        (self._exchange(idx, None, chunk), None)
+                    )
                 else:
                     if node.left == ref:
-                        inbox.setdefault(idx, []).append((chunk, "left"))
+                        inbox.setdefault(idx, []).append(
+                            (self._exchange(idx, "left", chunk), "left")
+                        )
                     if node.right == ref:
-                        inbox.setdefault(idx, []).append((chunk, "right"))
+                        inbox.setdefault(idx, []).append(
+                            (self._exchange(idx, "right", chunk), "right")
+                        )
 
         for ref, chunk in injections:
             enqueue(ref, chunk)
@@ -285,6 +331,15 @@ class DagJob:
                 else:
                     self._apply_join_windowed(new_states, idx, chunk,
                                               side, enqueue)
+
+    def _exchange(self, idx: int, side, chunk):
+        """Route a chunk across the vnode exchange on a marked edge
+        (sharded DAGs only; linear DAGs deliver in place)."""
+        fn = self.exchanges.get((idx, side))
+        if fn is None or self.mesh is None:
+            return chunk
+        from risingwave_tpu.parallel.exchange import shuffle_chunk
+        return shuffle_chunk(chunk, fn(chunk), self.AXIS, self.n_shards)
 
     def _apply_join_windowed(self, new_states: list, idx: int, chunk,
                              side: str, enqueue) -> None:
@@ -316,9 +371,17 @@ class DagJob:
         if max_w <= 1:
             return
 
+        # sharded: the loop body may contain collectives (downstream
+        # exchanges), so every shard must run the same trip count —
+        # bound by the max pending across shards (extra windows emit
+        # empty chunks, which are harmless)
+        total = pending.total
+        if self.mesh is not None:
+            total = jax.lax.pmax(total, self.AXIS)
+
         def cond(carry):
             sts, w = carry
-            return (w * join.out_capacity < pending.total) & (w < max_w)
+            return (w * join.out_capacity < total) & (w < max_w)
 
         def body(carry):
             sts, w = carry
@@ -335,6 +398,27 @@ class DagJob:
     def _make_step(self, src_name: str):
         reader = self.sources[src_name]
         fused = hasattr(reader, "impl") and hasattr(reader, "next_base")
+        if self.mesh is not None:
+            if not fused:
+                raise ValueError(
+                    "sharded DAGs need traceable sources (impl/next_base)"
+                )
+            spec = self._sharding_spec()
+
+            def body(states, k0):
+                local = jax.tree.map(lambda x: x[0], states)
+                new_states = list(local)
+                chunk = reader.impl(k0[0], reader.cap)
+                self._propagate(
+                    new_states, [(("source", src_name), chunk)]
+                )
+                return jax.tree.map(lambda x: x[None], tuple(new_states))
+
+            prog = jax.jit(_shard_map(
+                body, mesh=self.mesh, in_specs=(spec, spec),
+                out_specs=spec, check_vma=False,
+            ))
+            return prog, fused
         if fused:
             # traceable source: generation fuses into the step program
             def fn(states, k0):
@@ -357,6 +441,16 @@ class DagJob:
             self._step_programs[src_name] = self._make_step(src_name)
         prog, fused = self._step_programs[src_name]
         reader = self.sources[src_name]
+        if self.mesh is not None:
+            # one cap-stride ordinal block per shard (split readers own
+            # disjoint ordinal ranges, like the reference's source
+            # splits)
+            k0 = jnp.asarray(
+                [reader.next_base() for _ in range(self.n_shards)],
+                jnp.int64,
+            )
+            self.states = prog(self.states, k0)
+            return reader.cap * self.n_shards
         if fused:
             self.states = prog(self.states, jnp.int64(reader.next_base()))
             return reader.cap
@@ -407,23 +501,32 @@ class DagJob:
         if not frag.has_pending_protocol():
             return
 
+        def _more(states_idx):
+            # sharded: the drain body may cross exchanges (collectives),
+            # so shards must agree on the trip count — any shard with
+            # pending keeps every shard in the loop (idle shards flush
+            # empty, which is harmless)
+            p = frag.pending_total(states_idx)
+            if self.mesh is not None:
+                p = jax.lax.pmax(p, self.AXIS)
+            return p > 0
+
         def cond(carry):
-            sts, it = carry
-            return (frag.pending_total(sts[idx]) > 0) & (
-                it < frag.MAX_DRAIN_ROUNDS
-            )
+            sts, it, more = carry
+            return more & (it < frag.MAX_DRAIN_ROUNDS)
 
         def body(carry):
-            sts, it = carry
+            sts, it, _ = carry
             lst = list(sts)
             st2, outs2 = frag._flush_impl(lst[idx], epoch)
             lst[idx] = st2
             for out in outs2:
                 self._propagate(lst, [(("node", idx), out)])
-            return tuple(lst), it + 1
+            return tuple(lst), it + 1, _more(lst[idx])
 
-        sts, _ = jax.lax.while_loop(
-            cond, body, (tuple(new_states), jnp.int32(0))
+        sts, _, _ = jax.lax.while_loop(
+            cond, body,
+            (tuple(new_states), jnp.int32(0), _more(new_states[idx])),
         )
         new_states[:] = list(sts)
 
@@ -444,6 +547,10 @@ class DagJob:
             if not isinstance(ex, WatermarkFilterExecutor):
                 continue
             raw = new_states[idx][i].max_ts
+            if self.mesh is not None:
+                # global watermark = min over shards (the reference's
+                # min-of-upstream-actors alignment, as ONE ICI pmin)
+                raw = jax.lax.pmin(raw, self.AXIS)
             has = raw != WM_NONE
             val = jnp.where(has, raw - ex.delay_us, jnp.int64(WM_SAFE_FLOOR))
             out.append((Watermark(ex.ts_col, val), has))
@@ -457,7 +564,10 @@ class DagJob:
         for idx, node in enumerate(self.nodes):
             if not isinstance(node, FragNode):
                 continue
-            new_states[idx] = node.fragment._wm_impl(new_states[idx])
+            new_states[idx] = node.fragment._wm_impl(
+                new_states[idx],
+                axis=self.AXIS if self.mesh is not None else None,
+            )
             for wm, _ in self._node_watermarks(new_states, idx):
                 for j in self.downstream_closure(("node", idx),
                                                  through_joins=False):
@@ -485,6 +595,8 @@ class DagJob:
                 if isinstance(ex, WatermarkFilterExecutor) \
                         and ex.ts_col == src_col:
                     raw = new_states[key][i].max_ts
+                    if self.mesh is not None:
+                        raw = jax.lax.pmin(raw, self.AXIS)
                     has = raw != WM_NONE
                     val = jnp.where(
                         has, raw - ex.delay_us, jnp.int64(WM_SAFE_FLOOR)
@@ -576,14 +688,39 @@ class DagJob:
         self.counter_labels = labels
         return tuple(new_states), counters
 
+    def _make_barrier_prog(self):
+        if self.mesh is None:
+            return jax.jit(self._barrier_impl, donate_argnums=(0,))
+        from jax.sharding import PartitionSpec as P
+        spec = self._sharding_spec()
+
+        def body(states, epoch):
+            local = jax.tree.map(lambda x: x[0], states)
+            new_states, counters = self._barrier_impl(
+                tuple(local), epoch[0]
+            )
+            # shard-summed counters, replicated (ONE host readback later)
+            counters = jax.lax.psum(counters, self.AXIS)
+            return jax.tree.map(lambda x: x[None], new_states), counters
+
+        return jax.jit(_shard_map(
+            body, mesh=self.mesh, in_specs=(spec, spec),
+            out_specs=(spec, P()), check_vma=False,
+        ))
+
+    def _barrier_epoch_arg(self, sealed):
+        if self.mesh is None:
+            return sealed
+        return jnp.full((self.n_shards,), sealed, jnp.int64)
+
     def inject_barrier(self) -> None:
         self.barriers_seen += 1
         sealed = self.epoch.curr.value
         if self._barrier_prog is None:
-            self._barrier_prog = jax.jit(
-                self._barrier_impl, donate_argnums=(0,)
-            )
-        self.states, self._counters = self._barrier_prog(self.states, sealed)
+            self._barrier_prog = self._make_barrier_prog()
+        self.states, self._counters = self._barrier_prog(
+            self.states, self._barrier_epoch_arg(sealed)
+        )
 
         if self.barriers_seen % self.checkpoint_frequency == 0:
             self._ckpts_since_maintain += 1
@@ -611,9 +748,22 @@ class DagJob:
 
     def _maintain(self, sealed) -> None:
         if self._maintain_prog is None:
-            self._maintain_prog = jax.jit(
-                self._maintain_impl, donate_argnums=(0,)
-            )
+            if self.mesh is None:
+                self._maintain_prog = jax.jit(
+                    self._maintain_impl, donate_argnums=(0,)
+                )
+            else:
+                spec = self._sharding_spec()
+
+                def body(states):
+                    local = jax.tree.map(lambda x: x[0], states)
+                    out = self._maintain_impl(tuple(local))
+                    return jax.tree.map(lambda x: x[None], out)
+
+                self._maintain_prog = jax.jit(_shard_map(
+                    body, mesh=self.mesh, in_specs=(spec,),
+                    out_specs=spec, check_vma=False,
+                ))
         self.states = self._maintain_prog(self.states)
         if self._counters is None:
             return
@@ -625,7 +775,7 @@ class DagJob:
             if not residual:
                 break
             self.states, self._counters = self._barrier_prog(
-                self.states, sealed
+                self.states, self._barrier_epoch_arg(sealed)
             )
             residual = check_counter_values(
                 self.name, self.counter_labels, np.asarray(self._counters)
@@ -633,13 +783,14 @@ class DagJob:
 
     # -- checkpoint / recovery ------------------------------------------
     def _commit_checkpoint(self, sealed) -> None:
-        new_states = list(self.states)
-        for idx, node in enumerate(self.nodes):
-            if isinstance(node, FragNode):
-                new_states[idx] = deliver_sinks(
-                    node.fragment, new_states[idx], sealed
-                )
-        self.states = tuple(new_states)
+        if self.mesh is None:  # sink delivery is a host-side read;
+            new_states = list(self.states)  # sharded plans exclude sinks
+            for idx, node in enumerate(self.nodes):
+                if isinstance(node, FragNode):
+                    new_states[idx] = deliver_sinks(
+                        node.fragment, new_states[idx], sealed
+                    )
+            self.states = tuple(new_states)
         self.committed_epoch = sealed
         self._snapshot_and_save(sealed)
 
@@ -650,16 +801,21 @@ class DagJob:
             loaded = self.checkpoint_store.load(self.name)
             if loaded is not None:
                 epoch, states, src_state = loaded
-                self.states = jax.device_put(states)
+                if self.mesh is not None:
+                    from jax.sharding import (
+                        NamedSharding, PartitionSpec as P,
+                    )
+                    self.states = jax.device_put(
+                        states, NamedSharding(self.mesh, P(self.AXIS))
+                    )
+                else:
+                    self.states = jax.device_put(states)
                 self.committed_epoch = epoch
                 for name, src in self.sources.items():
                     restore_source(src, src_state.get(name, {}))
                 return
         if not self.checkpoints:
-            self.states = tuple(
-                n.init_state() if n is not None else None
-                for n in self.nodes
-            )
+            self.states = self._init_states()
             for src in self.sources.values():
                 if hasattr(src, "offset"):
                     src.offset = 0
@@ -668,6 +824,21 @@ class DagJob:
         self.states = _snapshot_copy(snap.states)
         for name, src in self.sources.items():
             restore_source(src, snap.source_state.get(name, {}))
+
+    # -- serving (sharded) ----------------------------------------------
+    def mv_rows(self, mv_executor, state_index):
+        """Host view of a sharded MV: per-shard partitions merged (the
+        serving analog of ShardedStreamingJob.mv_rows)."""
+        st = self.states
+        for i in state_index:
+            st = st[i]
+        host = jax.device_get(st)  # one transfer
+        rows = []
+        for shard in range(self.n_shards):
+            rows.extend(mv_executor.to_host(
+                jax.tree.map(lambda x: x[shard], host)
+            ))
+        return rows
 
     # -- backfill -------------------------------------------------------
     def backfill_node(self, node_id: int, chunks, side: str | None = None,

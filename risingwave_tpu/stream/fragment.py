@@ -176,11 +176,13 @@ class Fragment:
         states, _ = jax.lax.while_loop(cond, body, (states, jnp.int32(0)))
         return states
 
-    def _wm_impl(self, states):
+    def _wm_impl(self, states, axis: str | None = None):
         """Propagate watermarks from generator executors through the
         chain, entirely on device (no scalar readback).  The "no
         watermark yet" sentinel maps to WM_SAFE_FLOOR so downstream
-        cleaning predicates match nothing."""
+        cleaning predicates match nothing.  Under a sharded runtime
+        (``axis``) the watermark is the pmin across shards — one ICI
+        collective, the reference's min-of-upstream-actors rule."""
         from risingwave_tpu.stream.message import Watermark
         from risingwave_tpu.stream.watermark import WatermarkFilterExecutor
 
@@ -189,6 +191,8 @@ class Fragment:
             if not isinstance(ex, WatermarkFilterExecutor):
                 continue
             raw = new_states[i].max_ts
+            if axis is not None:
+                raw = jax.lax.pmin(raw, axis)
             val = jnp.where(
                 raw == WM_NONE,
                 jnp.int64(WM_SAFE_FLOOR),

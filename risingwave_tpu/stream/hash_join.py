@@ -76,7 +76,11 @@ def _null_stripped_keys(key_cols):
 from risingwave_tpu.common.compact import mask_indices
 from risingwave_tpu.common.types import Field, Schema
 from risingwave_tpu.expr.node import Expr
-from risingwave_tpu.state.hash_table import HashTable, gather_key
+from risingwave_tpu.state.hash_table import (
+    HashTable,
+    _scatter_key,
+    gather_key,
+)
 
 
 def _empty_store(f: Field, size: int, bucket: int):
@@ -164,6 +168,42 @@ class SideState(NamedTuple):
     inconsistency: jnp.ndarray
 
 
+class PoolSideState(NamedTuple):
+    """Degree-adaptive side storage: a SHARED row pool instead of dense
+    per-key buckets.
+
+    The reference stores unbounded rows per key behind ``JoinHashMap``
+    (src/stream/src/executor/join/hash_join.rs:169); dense
+    ``[size, bucket_cap]`` buckets cap hot keys (nexmark's hot sellers)
+    and waste HBM on cold ones.  TPU-first re-design: rows live in ONE
+    flat ``[pool]`` store addressed by an open-addressed INDEX keyed by
+    ``(join-key-hash, rank)`` — rank r of key k sits wherever the index
+    hashes (hash(k), r).  Properties:
+
+    - no per-key cap: a hot key may fill the whole pool;
+    - O(1) vectorized random access by (key, rank) — exactly what the
+      output-centric windowed emission gathers — with no chain walks
+      (pointer chasing is TPU-hostile; open addressing is one hash +
+      bounded vectorized probe);
+    - stable under key-table rehash (the index is keyed by the key's
+      HASH, not its slot);
+    - watermark cleaning via a per-row ``clean_vals`` copy of the
+      window key: closed windows clear by ONE vectorized mask.
+
+    Append-only sides only (the bench/windowed-join shape): deletes
+    would need value→rank search; retractable sides keep the dense
+    bucket layout.
+    """
+
+    key_table: HashTable   # join key -> slot; degree = count[slot]
+    count: jnp.ndarray     # int32 [size] live rows per key
+    index: HashTable       # (key-hash u64, rank i32) -> pool position
+    rows: tuple            # [pool] stores, one per input column
+    clean_vals: jnp.ndarray  # int64 [pool] watermark-cleaning key value
+    overflow: jnp.ndarray  # int64 — rows that found no pool space
+    inconsistency: jnp.ndarray  # int64 — retractions on append-only side
+
+
 class JoinState(NamedTuple):
     left: SideState
     right: SideState
@@ -190,6 +230,9 @@ class JoinEmit(NamedTuple):
     signs: jnp.ndarray       # int32 [cap]
     slots: jnp.ndarray       # int32 [cap] clamped build-side key slots
     rank_to_idx: jnp.ndarray  # int32 [cap, B] k-th live row -> bucket idx
+    #: probe rows' join-key hashes (pool build sides: the emission
+    #: addresses build rows by (key-hash, rank) index lookups)
+    probe_hash: jnp.ndarray  # uint64 [cap]
     m: jnp.ndarray           # int32 [cap] live build rows per probe row
     up_cnt: jnp.ndarray      # int32 [cap] up-transition rows per probe row
     up_end: jnp.ndarray      # int32 [cap] inclusive cumsum
@@ -234,6 +277,10 @@ class HashJoinExecutor:
         left_table_size: int | None = None,
         right_table_size: int | None = None,
         join_type: str = "inner",
+        left_storage: str = "dense",
+        right_storage: str = "dense",
+        left_pool_size: int | None = None,
+        right_pool_size: int | None = None,
     ):
         if join_type not in JOIN_TYPES:
             raise ValueError(f"unknown join type {join_type!r}")
@@ -253,6 +300,20 @@ class HashJoinExecutor:
         self.left_table_size = left_table_size or table_size
         self.right_table_size = right_table_size or table_size
         self.out_capacity = out_capacity
+        #: per-side storage: "dense" [size, B] buckets (general; caps
+        #: hot keys) or "pool" shared-row-pool (degree-adaptive;
+        #: append-only sides)
+        if left_storage not in ("dense", "pool") \
+                or right_storage not in ("dense", "pool"):
+            raise ValueError("storage must be 'dense' or 'pool'")
+        self.left_storage = left_storage
+        self.right_storage = right_storage
+        self.left_pool_size = left_pool_size or (
+            self.left_table_size * self.left_bucket_cap
+        )
+        self.right_pool_size = right_pool_size or (
+            self.right_table_size * self.right_bucket_cap
+        )
         #: preserved sides: rows survive unmatched (as NULL-padded rows
         #: for outer, as the output itself for semi, inverted for anti)
         self.preserve_left = join_type in (
@@ -316,16 +377,61 @@ class HashJoinExecutor:
             inconsistency=jnp.zeros((), jnp.int64),
         )
 
+    def _pool_side_state(self, schema: Schema, keys: Sequence[Expr],
+                         size: int, pool: int) -> PoolSideState:
+        def flat_store(f: Field):
+            if f.data_type.is_string:
+                col = StrCol(
+                    jnp.zeros((pool, f.str_width), jnp.uint8),
+                    jnp.zeros((pool,), jnp.int32),
+                )
+            else:
+                col = jnp.zeros((pool,), f.data_type.physical_dtype)
+            if f.nullable:
+                return NCol(col, jnp.zeros((pool,), jnp.bool_))
+            return col
+
+        return PoolSideState(
+            key_table=HashTable.create(
+                self._key_protos(schema, keys), size
+            ),
+            count=jnp.zeros((size,), jnp.int32),
+            index=HashTable.create(
+                [jnp.zeros((1,), jnp.uint64), jnp.zeros((1,), jnp.int32)],
+                pool,
+            ),
+            rows=tuple(flat_store(f) for f in schema),
+            clean_vals=jnp.zeros((pool,), jnp.int64),
+            overflow=jnp.zeros((), jnp.int64),
+            inconsistency=jnp.zeros((), jnp.int64),
+        )
+
+    def storage_of(self, side: str) -> str:
+        return self.left_storage if side == "left" else self.right_storage
+
     def init_state(self) -> JoinState:
-        return JoinState(
-            left=self._side_state(
+        if self.left_storage == "pool":
+            left = self._pool_side_state(
+                self.left_schema, self.left_keys,
+                self.left_table_size, self.left_pool_size,
+            )
+        else:
+            left = self._side_state(
                 self.left_schema, self.left_keys, self.left_bucket_cap,
                 self.left_table_size,
-            ),
-            right=self._side_state(
+            )
+        if self.right_storage == "pool":
+            right = self._pool_side_state(
+                self.right_schema, self.right_keys,
+                self.right_table_size, self.right_pool_size,
+            )
+        else:
+            right = self._side_state(
                 self.right_schema, self.right_keys, self.right_bucket_cap,
                 self.right_table_size,
-            ),
+            )
+        return JoinState(
+            left=left, right=right,
             emit_overflow=jnp.zeros((), jnp.int64),
         )
 
@@ -435,6 +541,65 @@ class HashJoinExecutor:
             inconsistency=side.inconsistency + n_missing,
         )
 
+    def _update_side_pool(self, side: PoolSideState, chunk: Chunk,
+                          keys: Sequence[Expr], clean_spec):
+        """Apply an append-only chunk to a pool side: claim key slots,
+        assign each inserted row rank ``count[slot] + in-chunk rank``,
+        and place it at the index position of ``(key-hash, rank)``.
+
+        Ranks stay contiguous per key (cleaning removes whole keys
+        only), so the emission's (key, j) addressing always lands."""
+        size = side.key_table.size
+        key_cols, null_keys = _null_stripped_keys(
+            [e.eval(chunk) for e in keys]
+        )
+        signs = chunk.signs()
+        joinable = chunk.valid if null_keys is None \
+            else chunk.valid & ~null_keys
+        is_ins = joinable & (signs > 0)
+        # append-only contract: retractions are a loud inconsistency
+        n_bad = jnp.sum((joinable & (signs < 0)).astype(jnp.int64))
+
+        h = hash64_columns(key_cols)
+        key_table, slots, _, overflow = side.key_table.lookup_or_insert(
+            key_cols, is_ins, hashes=h
+        )
+        is_ins = is_ins & ~overflow
+        safe = jnp.minimum(slots, size - 1)
+
+        # rank = pre-chunk degree + stable rank among this chunk's
+        # inserts of the same key
+        rank = side.count[safe] + _rank_by(slots.astype(jnp.uint64), is_ins)
+        index, pos, _, over_idx = side.index.lookup_or_insert(
+            [h, rank], is_ins
+        )
+        got = is_ins & ~over_idx
+        pool = side.index.size
+        tgt = jnp.where(got, jnp.minimum(pos, pool - 1), jnp.int32(pool))
+        rows = tuple(
+            _scatter_key(store, tgt, col, pool)
+            for store, col in zip(side.rows, chunk.columns)
+        )
+        if clean_spec is not None:
+            ckey = key_cols[clean_spec[0]].astype(jnp.int64)
+            clean_vals = side.clean_vals.at[tgt].set(ckey, mode="drop")
+        else:
+            clean_vals = side.clean_vals
+        count = side.count.at[
+            jnp.where(got, safe, jnp.int32(size))
+        ].add(1, mode="drop")
+        n_over = jnp.sum((is_ins & over_idx).astype(jnp.int64)) + \
+            jnp.sum(overflow.astype(jnp.int64))
+        return PoolSideState(
+            key_table=key_table,
+            count=count,
+            index=index,
+            rows=rows,
+            clean_vals=clean_vals,
+            overflow=side.overflow + n_over,
+            inconsistency=side.inconsistency + n_bad,
+        )
+
     def _bucket_row_hash(self, side: SideState, safe_slots) -> jnp.ndarray:
         """Row hashes of a side's buckets gathered at [cap] slots."""
 
@@ -469,7 +634,11 @@ class HashJoinExecutor:
         cap = chunk.capacity
 
         old_count = own.count  # own per-key row counts BEFORE the chunk
-        own2 = self._update_side(own, chunk, keys)
+        own_clean = self.left_clean if side == "left" else self.right_clean
+        if self.storage_of(side) == "pool":
+            own2 = self._update_side_pool(own, chunk, keys, own_clean)
+        else:
+            own2 = self._update_side(own, chunk, keys)
 
         key_cols, null_keys = _null_stripped_keys(
             [e.eval(chunk) for e in keys]
@@ -480,17 +649,24 @@ class HashJoinExecutor:
 
         # probe the build (other) side: per-row key slot + live rows
         bsize = other.key_table.size
-        B = other.occupied.shape[1]
+        probe_hash = hash64_columns(key_cols)
         slots, found, probe_over = other.key_table.lookup_counted(
-            key_cols, joinable
+            key_cols, joinable, hashes=probe_hash
         )
         safe = jnp.minimum(slots, bsize - 1)
-        occ = other.occupied[safe] & found[:, None]        # [cap, B]
-        m = jnp.sum(occ, axis=1).astype(jnp.int32)
-        # rank -> bucket index of the k-th live row (occupied first,
-        # stable: bool sort of the gathered occupancy bitmap only)
-        rank_to_idx = jnp.argsort(~occ, axis=1, stable=True) \
-            .astype(jnp.int32)
+        if self.storage_of("right" if side == "left" else "left") \
+                == "pool":
+            # pool build side: degree from the key table's count; rows
+            # are addressed at emission time by (key-hash, rank)
+            m = jnp.where(found, other.count[safe], 0).astype(jnp.int32)
+            rank_to_idx = jnp.zeros((cap, 1), jnp.int32)
+        else:
+            occ = other.occupied[safe] & found[:, None]        # [cap, B]
+            m = jnp.sum(occ, axis=1).astype(jnp.int32)
+            # rank -> bucket index of the k-th live row (occupied
+            # first, stable: bool sort of the occupancy bitmap only)
+            rank_to_idx = jnp.argsort(~occ, axis=1, stable=True) \
+                .astype(jnp.int32)
 
         # section 1: (probe × build) pairs
         pair_cnt = m if self.emit_pairs else jnp.zeros_like(m)
@@ -543,6 +719,7 @@ class HashJoinExecutor:
             signs=signs,
             slots=safe,
             rank_to_idx=rank_to_idx,
+            probe_hash=probe_hash,
             m=m,
             up_cnt=up_cnt,
             up_end=up_end,
@@ -603,20 +780,46 @@ class HashJoinExecutor:
         j = jnp.where(in_up, uj,
                       jnp.where(in_pairs, pj,
                                 jnp.where(in_down, dj, 0)))
-        bidx = p.rank_to_idx[
-            r, jnp.clip(j, 0, p.rank_to_idx.shape[1] - 1)
-        ]
         slot = p.slots[r]
 
         def probe_val(col):
             return gather_key(col, r)
 
-        def build_val(store):
-            if isinstance(store, NCol):
-                return NCol(build_val(store.data), store.null[slot, bidx])
-            if isinstance(store, StrCol):
-                return StrCol(store.data[slot, bidx], store.lens[slot, bidx])
-            return store[slot, bidx]
+        build_rows, build_index = build_rows
+        if build_index is not None:
+            # pool build side: ONE vectorized (key-hash, rank) index
+            # lookup resolves every build row this window needs
+            need = in_pairs | in_trans
+            pool = build_index.size
+            pos, bfound, _ = build_index.lookup_counted(
+                [p.probe_hash[r], j.astype(jnp.int32)], need
+            )
+            bpos = jnp.minimum(pos, pool - 1)
+            # a needed-but-missing build row (pool overflow hole) is
+            # dropped; the overflow counter already records the loss
+            valid_out = valid_out & (~need | bfound)
+
+            def build_val(store):
+                if isinstance(store, NCol):
+                    return NCol(build_val(store.data), store.null[bpos])
+                if isinstance(store, StrCol):
+                    return StrCol(store.data[bpos], store.lens[bpos])
+                return store[bpos]
+        else:
+            bidx = p.rank_to_idx[
+                r, jnp.clip(j, 0, p.rank_to_idx.shape[1] - 1)
+            ]
+
+            def build_val(store):
+                if isinstance(store, NCol):
+                    return NCol(
+                        build_val(store.data), store.null[slot, bidx]
+                    )
+                if isinstance(store, StrCol):
+                    return StrCol(
+                        store.data[slot, bidx], store.lens[slot, bidx]
+                    )
+                return store[slot, bidx]
 
         def pad_null(col, is_pad):
             """Wrap/extend a column with pad-row null flags."""
@@ -681,8 +884,12 @@ class HashJoinExecutor:
         return Chunk(out_cols, ops, valid_out, self._out_schema)
 
     def build_rows_of(self, state: JoinState, side: str) -> tuple:
-        """The build (non-arriving) side's row stores for emit_window."""
-        return (state.right if side == "left" else state.left).rows
+        """(row stores, index-or-None) of the build side for
+        emit_window — the index addresses pool-stored rows."""
+        build = state.right if side == "left" else state.left
+        if isinstance(build, PoolSideState):
+            return build.rows, build.index
+        return build.rows, None
 
     # ------------------------------------------------------------------
     def apply(self, state: JoinState, chunk: Chunk, side: str):
@@ -704,9 +911,14 @@ class HashJoinExecutor:
         ), out
 
     def max_windows(self, chunk_cap: int) -> int:
-        """Static bound on emission windows for one chunk."""
-        worst = chunk_cap * max(self.left_bucket_cap,
-                                self.right_bucket_cap) * 2 + chunk_cap
+        """Static bound on emission windows for one chunk (the dynamic
+        ``pending.total`` governs actual trips; pool sides' worst case
+        is the whole pool joining one probe row)."""
+        depth_l = self.left_pool_size if self.left_storage == "pool" \
+            else self.left_bucket_cap
+        depth_r = self.right_pool_size if self.right_storage == "pool" \
+            else self.right_bucket_cap
+        worst = chunk_cap * max(depth_l, depth_r) * 2 + chunk_cap
         return -(-worst // self.out_capacity)
 
     # ------------------------------------------------------------------
@@ -729,29 +941,82 @@ class HashJoinExecutor:
                 inconsistency=s.inconsistency,
             )
 
+        def rebuild_pool(s: PoolSideState) -> PoolSideState:
+            # the index is keyed by the JOIN KEY's hash, so a key-table
+            # rehash never invalidates it — rebuild only the key table
+            fresh, moved = s.key_table.rehashed()
+            return PoolSideState(
+                key_table=fresh,
+                count=permute_dense(s.count, moved),
+                index=s.index,
+                rows=s.rows,
+                clean_vals=s.clean_vals,
+                overflow=s.overflow,
+                inconsistency=s.inconsistency,
+            )
+
+        def rebuild_pool_index(s: PoolSideState) -> PoolSideState:
+            # cleaning tombstones the index too; relocate pool rows
+            # with their index entries once tombstones dominate
+            fresh, moved = s.index.rehashed()
+            return PoolSideState(
+                key_table=s.key_table,
+                count=s.count,
+                index=fresh,
+                rows=tuple(permute_dense(r, moved) for r in s.rows),
+                clean_vals=permute_dense(s.clean_vals, moved),
+                overflow=s.overflow,
+                inconsistency=s.inconsistency,
+            )
+
         sides = {}
         for name in ("left", "right"):
-            s: SideState = getattr(state, name)
-            sides[name] = jax.lax.cond(
-                s.key_table.tombstone_count() > s.key_table.size // 4,
-                rebuild, lambda x: x, s,
-            )
+            s = getattr(state, name)
+            if isinstance(s, PoolSideState):
+                s = jax.lax.cond(
+                    s.key_table.tombstone_count() > s.key_table.size // 4,
+                    rebuild_pool, lambda x: x, s,
+                )
+                s = jax.lax.cond(
+                    s.index.tombstone_count() > s.index.size // 4,
+                    rebuild_pool_index, lambda x: x, s,
+                )
+                sides[name] = s
+            else:
+                sides[name] = jax.lax.cond(
+                    s.key_table.tombstone_count() > s.key_table.size // 4,
+                    rebuild, lambda x: x, s,
+                )
         return JoinState(sides["left"], sides["right"], state.emit_overflow)
 
     def clean_below(self, state: JoinState, side: str, key_col_idx: int,
                     threshold) -> JoinState:
         """Watermark state cleaning on a window key column (q8 pattern)."""
-        s: SideState = getattr(state, side)
+        s = getattr(state, side)
         key = s.key_table.key_cols[key_col_idx]
         stale = s.key_table.occupied & (key < threshold)
-        cleaned = SideState(
-            key_table=s.key_table.clear_where(stale),
-            rows=s.rows,
-            occupied=s.occupied & ~stale[:, None],
-            count=jnp.where(stale, 0, s.count),
-            overflow=s.overflow,
-            inconsistency=s.inconsistency,
-        )
+        if isinstance(s, PoolSideState):
+            # whole keys evict together (ranks stay contiguous); pool
+            # rows clear by their stored clean-key value in ONE mask
+            stale_pool = s.index.occupied & (s.clean_vals < threshold)
+            cleaned = PoolSideState(
+                key_table=s.key_table.clear_where(stale),
+                count=jnp.where(stale, 0, s.count),
+                index=s.index.clear_where(stale_pool),
+                rows=s.rows,
+                clean_vals=s.clean_vals,
+                overflow=s.overflow,
+                inconsistency=s.inconsistency,
+            )
+        else:
+            cleaned = SideState(
+                key_table=s.key_table.clear_where(stale),
+                rows=s.rows,
+                occupied=s.occupied & ~stale[:, None],
+                count=jnp.where(stale, 0, s.count),
+                overflow=s.overflow,
+                inconsistency=s.inconsistency,
+            )
         if side == "left":
             return JoinState(cleaned, state.right, state.emit_overflow)
         return JoinState(state.left, cleaned, state.emit_overflow)

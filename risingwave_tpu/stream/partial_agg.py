@@ -22,7 +22,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from risingwave_tpu.common.chunk import Chunk, OP_INSERT, StrCol
+from risingwave_tpu.common.chunk import (
+    Chunk,
+    NCol,
+    OP_INSERT,
+    StrCol,
+    split_col,
+)
 from risingwave_tpu.common.hash import hash64_columns
 from risingwave_tpu.common.types import DataType, Field, Schema
 from risingwave_tpu.expr.agg import AggCall
@@ -64,19 +70,30 @@ class PartialAggExecutor(Executor):
         key_fields = tuple(
             Field(name, e.return_field(in_schema).data_type,
                   str_width=e.return_field(in_schema).str_width,
-                  decimal_scale=e.return_field(in_schema).decimal_scale)
+                  decimal_scale=e.return_field(in_schema).decimal_scale,
+                  nullable=e.return_field(in_schema).nullable)
             for name, e in self.group_by
         )
         partial_fields = []
         for a in self.aggs:
             if a.kind in ("count", "count_star"):
+                # counts are never NULL (a segment of all-NULL args
+                # contributes 0)
                 partial_fields.append(
                     Field(f"_p_{a.alias or a.kind}", DataType.INT64)
                 )
             else:
+                # sum/min/max over a nullable arg: the partial is NULL
+                # when the segment has no non-null rows, so the GLOBAL
+                # agg's native NULL-skip + all-NULL→NULL semantics
+                # compose across the exchange
                 f = a.out_field(in_schema)
-                partial_fields.append(Field(f"_p_{f.name}", f.data_type,
-                                            decimal_scale=f.decimal_scale))
+                partial_fields.append(Field(
+                    f"_p_{f.name}", f.data_type,
+                    decimal_scale=f.decimal_scale,
+                    nullable=a.arg is not None
+                    and a.arg.return_field(in_schema).nullable,
+                ))
         self._out_schema = Schema(key_fields + tuple(partial_fields))
 
     @property
@@ -84,10 +101,15 @@ class PartialAggExecutor(Executor):
         return self._out_schema
 
     def apply(self, state, chunk: Chunk):
+        from risingwave_tpu.common.chunk import conform_col
         from risingwave_tpu.state.hash_table import _keys_equal
 
         cap = chunk.capacity
-        key_cols = [e.eval(chunk) for _, e in self.group_by]
+        key_cols = [
+            conform_col(e.eval(chunk),
+                        e.return_field(self.in_schema).nullable, cap)
+            for _, e in self.group_by
+        ]
         signs = chunk.signs()  # 0 for invalid rows
         kh = hash64_columns(key_cols)
         kh = jnp.where(chunk.valid, kh, jnp.uint64(0xFFFFFFFFFFFFFFFF))
@@ -96,6 +118,8 @@ class PartialAggExecutor(Executor):
         signs_s = signs[order]
 
         def sort_col(c):
+            if isinstance(c, NCol):
+                return NCol(sort_col(c.data), c.null[order])
             if isinstance(c, StrCol):
                 return StrCol(c.data[order], c.lens[order])
             return c[order]
@@ -105,13 +129,11 @@ class PartialAggExecutor(Executor):
         # (the hash only orders; colliding distinct keys must still
         # split) and by validity flips (garbage keys of invalid rows
         # must never merge with real groups)
+        from risingwave_tpu.state.hash_table import _gather_key
         same_as_prev = jnp.ones((cap,), jnp.bool_)
         for c in sorted_keys:
-            if isinstance(c, StrCol):
-                prev = StrCol(c.data[:-1], c.lens[:-1])
-                cur = StrCol(c.data[1:], c.lens[1:])
-            else:
-                prev, cur = c[:-1], c[1:]
+            cur = _gather_key(c, jnp.arange(1, cap))
+            prev = _gather_key(c, jnp.arange(0, cap - 1))
             eq = _keys_equal(cur, prev)
             same_as_prev = same_as_prev.at[1:].min(eq)
         same_validity = jnp.ones((cap,), jnp.bool_).at[1:].set(
@@ -122,26 +144,59 @@ class PartialAggExecutor(Executor):
         seg_id = jnp.cumsum(is_new) - 1  # [cap]
 
         out_cols = list(sorted_keys)
-        for a in self.aggs:
+        for ai, a in enumerate(self.aggs):
             if a.arg is None:
-                col_s = jnp.ones((cap,), jnp.int64)
+                col_s, null_s = jnp.ones((cap,), jnp.int64), None
             else:
-                col_s = sort_col(a.arg.eval(chunk))
+                col_s, null_s = split_col(sort_col(a.arg.eval(chunk)))
+            # NULL args contribute nothing (SQL aggregates skip NULLs)
+            eff_signs = signs_s if null_s is None else jnp.where(
+                null_s, 0, signs_s
+            )
+            out_nullable = self._out_schema[
+                len(self.group_by) + ai].nullable
             if a.kind in ("count", "count_star"):
-                contrib = signs_s.astype(jnp.int64)
+                contrib = eff_signs.astype(jnp.int64)
                 part = jax.ops.segment_sum(contrib, seg_id,
                                            num_segments=cap)
             elif a.kind in ("sum", "sum0"):
                 dt = jnp.int64 if jnp.issubdtype(col_s.dtype, jnp.integer) \
                     else col_s.dtype
-                contrib = col_s.astype(dt) * signs_s.astype(dt)
+                # zero NULL payloads: a NULL row's payload is garbage
+                # and garbage * 0 can still poison float sums (inf/nan)
+                payload = col_s.astype(dt) if null_s is None else \
+                    jnp.where(null_s, jnp.zeros((), dt), col_s.astype(dt))
+                contrib = payload * eff_signs.astype(dt)
                 part = jax.ops.segment_sum(contrib, seg_id,
                                            num_segments=cap)
-            elif a.kind == "min":
-                part = jax.ops.segment_min(col_s, seg_id, num_segments=cap)
             else:
-                part = jax.ops.segment_max(col_s, seg_id, num_segments=cap)
-            out_cols.append(part[seg_id])  # broadcast back; leaders keep it
+                # min/max: mask NULL/inactive rows to the identity so
+                # they can't win the segment reduction
+                dt = col_s.dtype
+                if jnp.issubdtype(dt, jnp.floating):
+                    ident = jnp.asarray(
+                        jnp.inf if a.kind == "min" else -jnp.inf, dt)
+                else:
+                    info = jnp.iinfo(dt)
+                    ident = jnp.asarray(
+                        info.max if a.kind == "min" else info.min, dt)
+                masked = col_s if null_s is None else jnp.where(
+                    null_s, ident, col_s)
+                if a.kind == "min":
+                    part = jax.ops.segment_min(masked, seg_id,
+                                               num_segments=cap)
+                else:
+                    part = jax.ops.segment_max(masked, seg_id,
+                                               num_segments=cap)
+            part = part[seg_id]  # broadcast back; leaders keep it
+            if out_nullable:
+                # partial is NULL when the segment saw no non-null rows
+                nn = jax.ops.segment_sum(
+                    jnp.abs(eff_signs).astype(jnp.int64), seg_id,
+                    num_segments=cap,
+                )[seg_id]
+                part = NCol(part, nn == 0)
+            out_cols.append(part)
 
         valid_out = is_new & valid_s
         ops = jnp.full((cap,), OP_INSERT, jnp.int8)

@@ -28,7 +28,7 @@ except ImportError:  # pragma: no cover
 from risingwave_tpu.common.chunk import Chunk
 from risingwave_tpu.parallel.exchange import shuffle_chunk
 from risingwave_tpu.stream.executor import Executor
-from risingwave_tpu.stream.fragment import Fragment
+from risingwave_tpu.stream.fragment import WM_NONE, WM_SAFE_FLOOR, Fragment
 
 
 def make_mesh(n_devices: int | None = None, axis: str = "shard") -> Mesh:
@@ -175,9 +175,51 @@ class ShardedJob:
         outs.extend(keyed_outs)
         # keyed half is terminal — drain it on device too
         keyed_states = self.keyed_frag._drain_impl(keyed_states, epoch[0])
+        # watermark alignment + state cleaning (mirrors the linear
+        # barrier's flush → drain → wm → drain order)
+        local_states, keyed_states = self._wm_pass(
+            local_states, keyed_states
+        )
+        keyed_states = self.keyed_frag._drain_impl(keyed_states, epoch[0])
         out_tree = jax.tree.map(lambda x: x[None], tuple(outs))
         new_states = tuple(local_states) + tuple(keyed_states)
         return jax.tree.map(lambda x: x[None], new_states), out_tree
+
+    def _wm_pass(self, local_states, keyed_states):
+        """Cross-shard watermark alignment, entirely on device.
+
+        The reference aligns watermarks by flowing them through
+        exchange dispatchers and taking the min across upstream actors
+        (src/stream/src/executor/merge.rs watermark alignment).  Here
+        each shard's WatermarkFilter holds a local max_ts; the global
+        watermark is ``lax.pmin`` over the mesh axis — one ICI
+        collective per barrier — then every executor in both halves
+        applies its cleaning/EOWC hook.  A shard that has seen no data
+        pins the global watermark at the WM_NONE sentinel, so cleaning
+        never outruns a lagging shard (exactly the reference's
+        min-of-upstreams rule)."""
+        from risingwave_tpu.stream.message import Watermark
+        from risingwave_tpu.stream.watermark import WatermarkFilterExecutor
+
+        local_execs = list(self.local_frag.executors) \
+            if self.local_frag else []
+        keyed_execs = list(self.keyed_frag.executors)
+        locs, keys = list(local_states), list(keyed_states)
+        for i, ex in enumerate(local_execs):
+            if not isinstance(ex, WatermarkFilterExecutor):
+                continue
+            graw = jax.lax.pmin(locs[i].max_ts, self.AXIS)
+            val = jnp.where(
+                graw == WM_NONE,
+                jnp.int64(WM_SAFE_FLOOR),
+                graw - ex.delay_us,
+            )
+            wm = Watermark(ex.ts_col, val)
+            for j, ex2 in enumerate(local_execs):
+                locs[j] = ex2.on_watermark(locs[j], wm)
+            for j, ex2 in enumerate(keyed_execs):
+                keys[j] = ex2.on_watermark(keys[j], wm)
+        return tuple(locs), tuple(keys)
 
     # -- host API --------------------------------------------------------
     def step(self, states, k0_per_shard: jnp.ndarray):

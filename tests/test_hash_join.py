@@ -254,3 +254,158 @@ def test_binary_job_recover():
     assert job.sources["left"].offset == 1
     assert len(mv.to_host(job.states[1][0])) == n_rows
     assert job.committed_epoch == committed
+
+
+# -- degree-adaptive pool storage (round-3: shared row pool, no per-key
+# -- cap; ref JoinHashMap's unbounded rows, hash_join.rs:169) ----------
+
+def _pool_join(**kw):
+    return HashJoinExecutor(
+        L, R, [col("k")], [col("k")],
+        table_size=64, out_capacity=64,
+        left_storage="pool", right_storage="pool",
+        left_pool_size=1024, right_pool_size=1024, **kw,
+    )
+
+
+def _brute_inner(lrows, rrows):
+    return sorted(
+        (0, lk, a, rk, b)
+        for lk, a in lrows for rk, b in rrows if lk == rk
+    )
+
+
+def test_pool_join_hot_key_exceeds_any_bucket():
+    """One key holding 200 rows (far past any dense bucket_cap) joins
+    fully: the pool has no per-key depth limit."""
+    import jax
+
+    j = _pool_join()
+    st = j.init_state()
+    lrows = [(7, i) for i in range(200)] + [(1, 900), (2, 901)]
+    rows_txt = "I I\n" + "\n".join(f"+ {k} {v}" for k, v in lrows)
+    st, out = j.apply(st, Chunk.from_pretty(rows_txt, names=["k", "a"]),
+                      "left")
+    st, rows = _apply(j, st, _rc("""
+        I I
+        + 7 500
+        + 2 600
+    """), "right")
+    want = _brute_inner(lrows, [(7, 500), (2, 600)])
+    # out_capacity=64 < 201 matches: drain the remaining windows the
+    # way the DAG runtime does
+    assert int(st.left.overflow) == 0 and int(st.right.overflow) == 0
+    assert len(rows) == 64  # first window full
+    # full-match check via the windowed interface
+    st2 = j.init_state()
+    st2, _ = j.apply(st2, Chunk.from_pretty(rows_txt, names=["k", "a"]),
+                     "left")
+    chunk = _rc("""
+        I I
+        + 7 500
+        + 2 600
+    """)
+    st2, pending = j.apply_begin(st2, chunk, "right")
+    build = j.build_rows_of(st2, "right")
+    got = []
+    import jax.numpy as jnp
+    w = 0
+    while w * j.out_capacity < int(pending.total):
+        got.extend(
+            j.emit_window(build, pending, jnp.int32(w), "right").to_rows()
+        )
+        w += 1
+    assert sorted(got) == want
+
+
+def test_pool_join_10x_skew_matches_brute_force():
+    """10x hot-key skew across multiple chunks: exact results, zero
+    overflow, no per-key tuning (round-2 verdict item 4 done-criterion)."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(7)
+    j = _pool_join()
+    st = j.init_state()
+    lrows, rrows = [], []
+    got = []
+
+    def drain(pending, side):
+        build = j.build_rows_of(st, side)
+        w = 0
+        while w * j.out_capacity < int(pending.total):
+            got.extend(j.emit_window(
+                build, pending, jnp.int32(w), side).to_rows())
+            w += 1
+
+    for step in range(6):
+        # 90% of rows on key 7 (10x skew vs the other 9 keys)
+        lk = np.where(rng.random(32) < 0.9, 7,
+                      rng.integers(0, 9, 32)).astype(np.int64)
+        la = rng.integers(0, 1000, 32).astype(np.int64)
+        rk = np.where(rng.random(32) < 0.9, 7,
+                      rng.integers(0, 9, 32)).astype(np.int64)
+        rb = rng.integers(0, 1000, 32).astype(np.int64)
+        lchunk = "I I\n" + "\n".join(
+            f"+ {k} {v}" for k, v in zip(lk, la))
+        rchunk = "I I\n" + "\n".join(
+            f"+ {k} {v}" for k, v in zip(rk, rb))
+        st, pending = j.apply_begin(
+            st, Chunk.from_pretty(lchunk, names=["k", "a"]), "left")
+        drain(pending, "left")
+        lrows.extend(zip(lk.tolist(), la.tolist()))
+        st, pending = j.apply_begin(
+            st, Chunk.from_pretty(rchunk, names=["k", "b"]), "right")
+        drain(pending, "right")
+        rrows.extend(zip(rk.tolist(), rb.tolist()))
+
+    assert int(st.left.overflow) == 0 and int(st.right.overflow) == 0
+    assert sorted(got) == _brute_inner(lrows, rrows)
+
+
+def test_pool_join_watermark_cleaning_bounds_state():
+    """clean_below on a pool side evicts whole keys and their pool rows
+    in one mask; the index stays rank-consistent for survivors."""
+    import jax.numpy as jnp
+
+    j = _pool_join()
+    j.left_clean = (0, 0, 0)  # clean left keys below threshold
+    st = j.init_state()
+    lrows = [(k, 10 * k + i) for k in range(8) for i in range(5)]
+    txt = "I I\n" + "\n".join(f"+ {k} {v}" for k, v in lrows)
+    st, _ = j.apply(st, Chunk.from_pretty(txt, names=["k", "a"]), "left")
+    assert int(st.left.index.count()) == 40
+
+    st = j.clean_below(st, "left", 0, 5)  # drop keys 0..4
+    assert int(st.left.index.count()) == 15  # 3 keys x 5 rows remain
+
+    # survivors still join correctly (ranks intact)
+    st, pending = j.apply_begin(st, _rc("""
+        I I
+        + 6 600
+        + 2 200
+    """), "right")
+    build = j.build_rows_of(st, "right")
+    got = []
+    w = 0
+    while w * j.out_capacity < int(pending.total):
+        got.extend(j.emit_window(
+            build, pending, jnp.int32(w), "right").to_rows())
+        w += 1
+    want = _brute_inner([r for r in lrows if r[0] >= 5], [(6, 600)])
+    assert sorted(got) == want
+
+
+def test_pool_join_retraction_is_loud():
+    """A delete reaching an append-only pool side surfaces as
+    inconsistency, never silent corruption."""
+    j = _pool_join()
+    st = j.init_state()
+    st, _ = j.apply(st, _lc("""
+        I I
+        + 1 10
+    """), "left")
+    st, _ = j.apply(st, _lc("""
+        I I
+        - 1 10
+    """), "left")
+    assert int(st.left.inconsistency) == 1

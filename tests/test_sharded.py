@@ -255,3 +255,283 @@ def test_two_phase_partial_agg_unit():
     for op, *vals in outs[0].to_rows():
         mv[tuple(vals)] += 1 if op in (0, 3) else -1
     assert +mv == Counter({(1, 3, 16, 10): 1, (2, 2, 10, 7): 1})
+
+
+NEXMARK_WM_SOURCES = """
+CREATE SOURCE person (
+    id BIGINT, name VARCHAR, date_time TIMESTAMP,
+    WATERMARK FOR date_time AS date_time - INTERVAL '4' SECOND
+) WITH (connector = 'nexmark', nexmark.table = 'person',
+        nexmark.event.rate = '2000');
+CREATE SOURCE auction (
+    id BIGINT, seller BIGINT, reserve BIGINT, expires TIMESTAMP,
+    date_time TIMESTAMP,
+    WATERMARK FOR date_time AS date_time - INTERVAL '4' SECOND
+) WITH (connector = 'nexmark', nexmark.table = 'auction',
+        nexmark.event.rate = '2000');
+"""
+
+Q8_MV = """
+CREATE MATERIALIZED VIEW v AS
+SELECT p.id AS id, p.name AS name, a.reserve AS reserve
+FROM TUMBLE(person, date_time, INTERVAL '1' SECOND) p
+JOIN TUMBLE(auction, date_time, INTERVAL '1' SECOND) a
+ON p.id = a.seller AND p.window_start = a.window_start;
+"""
+
+
+def _windowed_engine(par, rate="1000"):
+    from risingwave_tpu.sql import Engine
+    from risingwave_tpu.sql.planner import PlannerConfig
+
+    eng = Engine(PlannerConfig(
+        chunk_capacity=128, agg_table_size=512, agg_emit_capacity=128,
+        mv_table_size=512, mv_ring_size=2048,
+    ))
+    eng.execute(
+        "CREATE SOURCE bid (auction BIGINT, price BIGINT, "
+        "date_time TIMESTAMP, "
+        "WATERMARK FOR date_time AS date_time - INTERVAL '4' SECOND) "
+        "WITH (connector='nexmark', nexmark.table='bid', "
+        f"nexmark.event.rate='{rate}')"
+    )
+    if par:
+        eng.execute(f"SET streaming_parallelism = {par}")
+    eng.execute(
+        "CREATE MATERIALIZED VIEW v AS SELECT window_start, "
+        "max(price) AS hi, count(*) AS n "
+        "FROM TUMBLE(bid, date_time, INTERVAL '2' SECOND) "
+        "GROUP BY window_start"
+    )
+    return eng
+
+
+def test_sharded_windowed_agg_matches_linear():
+    """q7-shaped: TUMBLE + GROUP BY window_start runs vnode-sharded
+    with watermark cleaning (round-2 verdict item 3a/3c)."""
+    from risingwave_tpu.stream.sharded import ShardedStreamingJob
+
+    b = _windowed_engine(8)
+    assert isinstance(b.jobs[0], ShardedStreamingJob)
+    for _ in range(6):
+        b.jobs[0].run_chunk()
+        b.jobs[0].inject_barrier()
+    a = _windowed_engine(0)
+    for _ in range(6 * 8):
+        a.jobs[0].run_chunk()
+        a.jobs[0].inject_barrier()
+    rows_a = a.execute("SELECT window_start, hi, n FROM v ORDER BY window_start")
+    rows_b = b.execute("SELECT window_start, hi, n FROM v ORDER BY window_start")
+    assert rows_a == rows_b and len(rows_a) > 2
+
+
+def test_sharded_windowed_agg_state_stays_bounded():
+    """50+ barriers: the sharded agg's occupied groups must not grow
+    (watermark cleaning evicts closed windows — sharded.py round-2 gap)."""
+    eng = _windowed_engine(8, rate="4000")
+    job = eng.jobs[0]
+    occupied_counts = []
+    for i in range(55):
+        job.run_chunk()
+        job.inject_barrier()
+        if i % 10 == 9:
+            for s in job.states:
+                if hasattr(s, "table"):
+                    occupied_counts.append(
+                        int(np.asarray(jax.device_get(
+                            s.table.occupied)).sum())
+                    )
+                    break
+    # live windows = window_size + wm lag worth, NOT all history
+    assert occupied_counts[-1] <= occupied_counts[0] + 4, occupied_counts
+    assert max(occupied_counts) < 64, occupied_counts
+
+
+def test_sharded_join_q8_matches_linear():
+    """q8-shaped sharded DAG: join inputs exchange by equi keys inside
+    shard_map; results must equal the linear run (verdict item 3d)."""
+    from risingwave_tpu.sql import Engine
+    from risingwave_tpu.sql.planner import PlannerConfig
+    from risingwave_tpu.stream.dag import DagJob
+
+    def build(par):
+        eng = Engine(PlannerConfig(
+            chunk_capacity=128,
+            join_left_table_size=1 << 12, join_left_bucket_cap=4,
+            join_right_table_size=1 << 10, join_right_bucket_cap=512,
+            join_out_capacity=1 << 12,
+            mv_table_size=4096, mv_ring_size=1 << 15,
+        ))
+        eng.execute(NEXMARK_WM_SOURCES)
+        if par:
+            eng.execute(f"SET streaming_parallelism = {par}")
+        eng.execute(Q8_MV)
+        return eng
+
+    b = build(8)
+    assert isinstance(b.jobs[0], DagJob) and b.jobs[0].mesh is not None
+    for _ in range(6):
+        b.jobs[0].chunk_round()
+        b.jobs[0].inject_barrier()
+    a = build(0)
+    for _ in range(6 * 8):
+        a.jobs[0].chunk_round()
+        a.jobs[0].inject_barrier()
+    rows_a = sorted(a.execute("SELECT id, name, reserve FROM v"))
+    rows_b = sorted(b.execute("SELECT id, name, reserve FROM v"))
+    assert rows_a == rows_b and len(rows_a) > 1000
+
+
+def test_sharded_join_recovers_from_checkpoint(tmp_path):
+    """Kill-and-recover a sharded join job from the durable store."""
+    from risingwave_tpu.sql import Engine
+    from risingwave_tpu.sql.planner import PlannerConfig
+
+    def build():
+        eng = Engine(PlannerConfig(
+            chunk_capacity=128,
+            join_left_table_size=1 << 12, join_left_bucket_cap=4,
+            join_right_table_size=1 << 10, join_right_bucket_cap=512,
+            join_out_capacity=1 << 12,
+            mv_table_size=4096, mv_ring_size=1 << 15,
+        ), data_dir=str(tmp_path))
+        eng.execute(NEXMARK_WM_SOURCES)
+        eng.execute("SET streaming_parallelism = 8")
+        eng.execute(Q8_MV)
+        return eng
+
+    eng = build()
+    job = eng.jobs[0]
+    for _ in range(4):
+        job.chunk_round()
+        job.inject_barrier()
+    want = sorted(eng.execute("SELECT id, name, reserve FROM v"))
+    committed = job.committed_epoch
+
+    # simulate mid-epoch crash: extra uncommitted work, then recover
+    job.chunk_round()
+    job.recover()
+    assert job.committed_epoch == committed
+    got = sorted(eng.execute("SELECT id, name, reserve FROM v"))
+    assert got == want
+
+    # continue after recovery: replay converges with an undisturbed run
+    job.chunk_round()
+    job.inject_barrier()
+    after = sorted(eng.execute("SELECT id, name, reserve FROM v"))
+    assert len(after) >= len(want)
+
+
+def test_partial_agg_nullable_cols():
+    """NCol group keys + args through the two-phase partial agg
+    (round-2 verdict item 3b): NULL keys form one group; NULL args are
+    skipped; an all-NULL segment yields a NULL partial."""
+    from collections import Counter
+    from risingwave_tpu.common.chunk import Chunk
+    from risingwave_tpu.common.types import Field
+    from risingwave_tpu.expr.agg import AggCall, count_star
+    from risingwave_tpu.expr.node import InputRef, col
+    from risingwave_tpu.stream.fragment import Fragment
+    from risingwave_tpu.stream.hash_agg import HashAggExecutor
+    from risingwave_tpu.stream.partial_agg import (
+        PartialAggExecutor,
+        translated_global_calls,
+    )
+
+    schema = Schema((
+        Field("g", DataType.INT64, nullable=True),
+        Field("v", DataType.INT64, nullable=True),
+    ))
+    group_by = [("g", col("g"))]
+    aggs = [count_star("rows"), AggCall("count", col("v"), "n"),
+            AggCall("sum", col("v"), "s"), AggCall("max", col("v"), "hi")]
+    partial = PartialAggExecutor(schema, group_by, aggs)
+    assert partial.out_schema[0].nullable          # key passthrough
+    assert not partial.out_schema[1].nullable      # count_star
+    assert partial.out_schema[3].nullable          # sum over nullable
+
+    chunk = Chunk.from_pretty("""
+        I I
+        + 1 10
+        + 1 .
+        + . 7
+        + . .
+        + 2 .
+    """, names=["g", "v"])
+    frag = Fragment([partial])
+    _, out = frag.step(frag.init_states(), chunk)
+
+    glob = HashAggExecutor(
+        partial.out_schema,
+        [("g", InputRef(0))],
+        translated_global_calls(aggs, 1),
+        table_size=64, emit_capacity=16,
+    )
+    gfrag = Fragment([glob])
+    gst = gfrag.init_states()
+    gst, _ = gfrag.step(gst, out)
+    gst, outs = gfrag.flush(gst, 1)
+    mv = Counter()
+    for op, *vals in outs[0].to_rows():
+        mv[tuple(vals)] += 1 if op in (0, 3) else -1
+    # group 1: 2 rows, count(v)=1, sum=10, max=10
+    # group NULL: 2 rows, count(v)=1, sum=7, max=7
+    # group 2: 1 row, count(v)=0, sum=NULL, max=NULL
+    assert +mv == Counter({
+        (1, 2, 1, 10, 10): 1,
+        (None, 2, 1, 7, 7): 1,
+        (2, 1, 0, None, None): 1,
+    })
+
+
+def test_sharded_exchange_carries_ncol():
+    """NCol columns survive the all_to_all; NULL keys route to ONE
+    shard (grouping-equality vnode routing)."""
+    from jax.sharding import PartitionSpec as P
+    from risingwave_tpu.common.chunk import NCol
+    from risingwave_tpu.common.types import Field
+    from risingwave_tpu.parallel.exchange import shuffle_chunk
+
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+    schema = Schema((
+        Field("g", DataType.INT64, nullable=True),
+        Field("v", DataType.INT64),
+    ))
+    mesh = make_mesh(8)
+    cap = 16
+
+    def body(_):
+        g = NCol(
+            jnp.arange(cap, dtype=jnp.int64) % 4,
+            jnp.arange(cap) % 4 == 3,  # every 4th row: NULL key
+        )
+        chunk = Chunk(
+            (g, jnp.arange(cap, dtype=jnp.int64)),
+            jnp.zeros((cap,), jnp.int8),
+            jnp.ones((cap,), jnp.bool_),
+            schema,
+        )
+        out = shuffle_chunk(chunk, [chunk.column(0)], "shard", 8)
+        return jax.tree.map(lambda x: x[None], out)
+
+    f = jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(P("shard"),), out_specs=P("shard"),
+        check_vma=False,
+    ))
+    out = f(jnp.zeros((8,), jnp.int32))
+    leaves = jax.tree.map(np.asarray, out)
+    null_shards = set()
+    total = 0
+    for shard in range(8):
+        c = jax.tree.map(lambda x: x[shard], leaves)
+        _, cols, valid = c.to_host()
+        for i in range(int(np.asarray(valid).sum())):
+            if cols[0][i] is None:
+                null_shards.add(shard)
+            total += 1
+    assert total == 8 * cap            # nothing lost
+    assert len(null_shards) == 1       # NULL keys on exactly one shard
