@@ -93,7 +93,7 @@ class DataType(enum.Enum):
 
     @classmethod
     def from_sql(cls, name: str) -> "DataType":
-        return _SQL_NAMES[name.strip().lower()]
+        return parse_sql_type(name)[0]
 
 
 _PHYSICAL: dict[DataType, jnp.dtype] = {
@@ -134,10 +134,35 @@ _SQL_NAMES.update(
         "varchar": DataType.VARCHAR,
         "string": DataType.VARCHAR,
         "text": DataType.VARCHAR,
+        "char": DataType.VARCHAR,
+        "character": DataType.VARCHAR,
         "timestamp without time zone": DataType.TIMESTAMP,
         "timestamp with time zone": DataType.TIMESTAMPTZ,
     }
 )
+
+def parse_sql_type(name: str):
+    """``(DataType, declared-width-or-None, declared-scale-or-None)``.
+
+    Accepts parameterized SQL spellings — ``VARCHAR(100)`` (device byte
+    width), ``NUMERIC(p, s)`` (scale) — alongside the bare names.  The
+    reference parses type parameters in its sqlparser
+    (src/sqlparser/src/ast/data_type.rs); here the declared VARCHAR
+    length doubles as the device column width."""
+    s = name.strip().lower()
+    width = scale = None
+    if "(" in s:
+        base, _, rest = s.partition("(")
+        args = rest.rstrip(") ").split(",")
+        base = base.strip()
+        t = _SQL_NAMES[base]
+        if t.is_string:
+            width = int(args[0])
+        elif t == DataType.DECIMAL and len(args) > 1:
+            scale = int(args[1])
+        return t, width, scale
+    return _SQL_NAMES[s], None, None
+
 
 # Default device width (bytes) for VARCHAR columns unless the schema
 # declares one.  Nexmark's longest generated strings (extra/url) fit well

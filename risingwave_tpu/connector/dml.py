@@ -30,11 +30,18 @@ class TableDmlManager:
     backfills new MVs from the table's state; a bounded log + real
     backfill executor land with the storage round)."""
 
-    def __init__(self, schema: Schema):
+    def __init__(self, schema: Schema, auto_width_cols=()):
         self.schema = schema
         self._readers: list["TableSourceReader"] = []
         self._history: list[tuple] = []
         self.rows_inserted = 0
+        #: columns whose VARCHAR device width was NOT declared: their
+        #: width follows the observed max (refresh_schema), never
+        #: truncating — the reference's VARCHAR is unbounded
+        #: (utf8_array.rs); a fixed-width device column must instead be
+        #: sized from the data before programs compile against it
+        self.auto_width_cols = set(auto_width_cols)
+        self._max_lens = {i: 0 for i in self.auto_width_cols}
 
     def new_reader(self, chunk_capacity: int) -> "TableSourceReader":
         r = TableSourceReader(self.schema, chunk_capacity)
@@ -44,11 +51,55 @@ class TableDmlManager:
 
     def insert(self, rows: Sequence[tuple]) -> int:
         rows = list(rows)
+        # one pass: per-string-column max encoded length of this batch
+        str_cols = [i for i, f in enumerate(self.schema)
+                    if f.data_type.is_string]
+        batch_max = {i: 0 for i in str_cols}
+        for row in rows:
+            for i in str_cols:
+                v = row[i]
+                if isinstance(v, str):
+                    n = len(v.encode("utf-8"))
+                    if n > batch_max[i]:
+                        batch_max[i] = n
+        for i in self._max_lens:
+            self._max_lens[i] = max(self._max_lens[i], batch_max[i])
+        # a string longer than a live reader's compiled width would be
+        # silently truncated in that dataflow — refuse loudly instead
+        # (batch max vs the narrowest reader: O(readers x columns))
+        for i in str_cols:
+            for r in self._readers:
+                f = r.schema[i]
+                if batch_max[i] > f.str_width:
+                    raise ValueError(
+                        f"value for {f.name!r} exceeds the width "
+                        f"({f.str_width}B) a running job compiled "
+                        "against; declare VARCHAR(n) wide enough "
+                        "before creating views on this table"
+                    )
         self._history.extend(rows)
         for r in self._readers:
             r.enqueue(rows)
         self.rows_inserted += len(rows)
         return len(rows)
+
+    def refresh_schema(self) -> Schema:
+        """Re-derive auto varchar widths from observed data.
+
+        Called by the engine before planning a new job on this table;
+        widths only grow (multiple-of-8, floor = the field's current
+        width) so already-compiled readers stay valid."""
+        from dataclasses import replace
+
+        fields = list(self.schema)
+        for i in self.auto_width_cols:
+            need = self._max_lens[i]
+            if need > fields[i].str_width:
+                fields[i] = replace(
+                    fields[i], str_width=-(-need // 8) * 8
+                )
+        self.schema = Schema(tuple(fields))
+        return self.schema
 
 
 class TableSourceReader:

@@ -176,6 +176,10 @@ class AggCall:
     arg: Expr | None = None
     alias: str | None = None
     distinct: bool = False
+    #: FILTER (WHERE <cond>): rows failing the predicate contribute
+    #: nothing to THIS call (ref: agg filter in agg_group.rs — per-call
+    #: visibility; here the call's contribution signs zero out)
+    filter: Expr | None = None
 
     def spec(self) -> AggSpec:
         return AGG_REGISTRY[self.kind]
@@ -190,10 +194,10 @@ class AggCall:
             f = self.arg.return_field(input_schema)
             in_t, scale = f.data_type, f.decimal_scale
             # sum/min/max/avg over a nullable argument are NULL when
-            # every argument row in the group is NULL; count never is
-            nullable = f.nullable and self.kind not in (
-                "count", "count_star"
-            )
+            # every argument row in the group is NULL (or when a FILTER
+            # excludes every row); count never is
+            nullable = (f.nullable or self.filter is not None) \
+                and self.kind not in ("count", "count_star")
         t = spec.return_type(in_t)
         return Field(self.alias or self.kind, t, decimal_scale=scale,
                      nullable=nullable)

@@ -22,6 +22,7 @@ from risingwave_tpu.common.types import (
     DataType,
     Field,
 )
+from risingwave_tpu.expr.node import Expr
 from risingwave_tpu.expr.registry import function, promote_numeric
 
 _SCALE = 10**DEFAULT_DECIMAL_SCALE
@@ -61,6 +62,14 @@ def coerce(col, field: Field, target: DataType):
         return jnp.round(col.astype(jnp.float64) * _SCALE).astype(jnp.int64)
     if target == DataType.BOOLEAN:
         return col != 0
+    _US_PER_DAY = 86_400_000_000
+    if t == DataType.DATE and target in (DataType.TIMESTAMP,
+                                         DataType.TIMESTAMPTZ):
+        # DATE is i32 days since epoch; timestamps are i64 microseconds
+        return col.astype(jnp.int64) * _US_PER_DAY
+    if t in (DataType.TIMESTAMP, DataType.TIMESTAMPTZ) \
+            and target == DataType.DATE:
+        return (col // _US_PER_DAY).astype(jnp.int32)
     return col.astype(target.physical_dtype)
 
 
@@ -657,3 +666,236 @@ for _part in ("year", "month", "day", "hour", "minute", "second",
 
     function(f"extract_{_part}(timestamp) -> bigint")(_mk_extract(_part))
     function(f"extract_{_part}(timestamptz) -> bigint")(_mk_extract(_part))
+
+
+@function("length(stringlike) -> int")
+def _length(a: StrCol):
+    # byte length (see char_length note)
+    return a.lens
+
+
+def _greedy_starts(a: StrCol, p: StrCol) -> jnp.ndarray:
+    """[cap, wa] bool: non-overlapping leftmost-first match starts of
+    ``p`` in ``a`` (the scan PG string functions use: after a match the
+    cursor jumps past it)."""
+    import jax
+
+    cap, wa = a.data.shape
+    offs = jnp.broadcast_to(jnp.arange(wa, dtype=jnp.int32)[None, :],
+                            (cap, wa))
+    hits = _match_at(a, p, offs)
+    hits = hits & (offs <= (a.lens - p.lens)[:, None]) & (p.lens > 0)[:, None]
+
+    def step(next_ok, hit_b):
+        b, hit = hit_b
+        sel = hit & (b >= next_ok)
+        return jnp.where(sel, b + p.lens, next_ok), sel
+
+    _, sels = jax.lax.scan(
+        step,
+        jnp.zeros((cap,), jnp.int32),
+        (jnp.arange(wa, dtype=jnp.int32), hits.T),
+    )
+    return sels.T
+
+
+def _cover_mask(sel: jnp.ndarray, span_lens: jnp.ndarray) -> jnp.ndarray:
+    """[cap, wa] bool: bytes covered by [start, start+len) spans."""
+    cap, wa = sel.shape
+    rows = jnp.broadcast_to(jnp.arange(cap)[:, None], (cap, wa))
+    cols = jnp.broadcast_to(jnp.arange(wa)[None, :], (cap, wa))
+    delta = jnp.zeros((cap, wa + 1), jnp.int32)
+    delta = delta.at[rows, cols].add(sel.astype(jnp.int32))
+    ends = jnp.clip(cols + span_lens[:, None], 0, wa)
+    delta = delta.at[rows, ends].add(jnp.where(sel, -1, 0))
+    return jnp.cumsum(delta[:, :wa], axis=1) > 0
+
+
+@function("split_part(stringlike, stringlike, int) -> same")
+@function("split_part(stringlike, stringlike, bigint) -> same")
+def _split_part(a: StrCol, delim: StrCol, n):
+    """Ref: src/expr/impl/src/scalar/split_part.rs (1-based; negative
+    counts from the end; out-of-range -> empty)."""
+    cap, wa = a.data.shape
+    sel = _greedy_starts(a, delim)
+    in_delim = _cover_mask(sel, delim.lens)
+    cols = jnp.broadcast_to(jnp.arange(wa, dtype=jnp.int32)[None, :],
+                            (cap, wa))
+    # part index of each byte = delimiters fully ended at or before it
+    part_id = jnp.cumsum(sel.astype(jnp.int32), axis=1) - sel
+    n_parts = jnp.sum(sel.astype(jnp.int32), axis=1) + 1
+    n = n.astype(jnp.int32)
+    target = jnp.where(n > 0, n - 1, n_parts + n)
+    keep = (part_id == target[:, None]) & ~in_delim \
+        & (cols < a.lens[:, None])
+    pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - keep
+    rows = jnp.broadcast_to(jnp.arange(cap)[:, None], (cap, wa))
+    out = jnp.zeros((cap, wa), jnp.uint8)
+    out = out.at[rows, jnp.where(keep, pos, wa)].set(a.data, mode="drop")
+    return StrCol(out, jnp.sum(keep, axis=1).astype(jnp.int32))
+
+
+@function("replace(stringlike, stringlike, stringlike) -> same")
+def _replace(a: StrCol, frm: StrCol, to: StrCol):
+    """Ref: src/expr/impl/src/scalar/replace.rs.  Output is clamped to
+    the input column's device width: growth past it (replacement longer
+    than the match at a near-full string) truncates — benchmark usage
+    (char removal / same-length swaps) is exact."""
+    cap, wa = a.data.shape
+    sel = _greedy_starts(a, frm)
+    in_from = _cover_mask(sel, frm.lens)
+    cols = jnp.broadcast_to(jnp.arange(wa, dtype=jnp.int32)[None, :],
+                            (cap, wa))
+    rows = jnp.broadcast_to(jnp.arange(cap)[:, None], (cap, wa))
+    in_str = cols < a.lens[:, None]
+    emit = jnp.where(sel, to.lens[:, None],
+                     jnp.where(in_from | ~in_str, 0, 1)).astype(jnp.int32)
+    start = jnp.cumsum(emit, axis=1) - emit      # exclusive prefix
+    out_len = jnp.minimum(start[:, -1] + emit[:, -1], wa)
+    out = jnp.zeros((cap, wa), jnp.uint8)
+    # pass-through bytes: one scatter
+    normal = in_str & ~in_from
+    out = out.at[rows, jnp.where(normal, start, wa)].set(
+        a.data, mode="drop"
+    )
+    # replacement spans: output-space cover + forward-filled span base
+    out_sel = jnp.zeros((cap, wa), jnp.bool_)
+    out_sel = out_sel.at[rows, jnp.where(sel, start, wa)].set(
+        True, mode="drop"
+    )
+    # base of the covering span / its to-length, forward-filled
+    import jax
+    base = jax.lax.cummax(jnp.where(out_sel, cols, -1), axis=1)
+    in_to = _cover_mask(
+        out_sel,
+        # per-row constant to-length applies at every span start
+        to.lens,
+    )
+    off = jnp.clip(cols - base, 0, to.data.shape[1] - 1)
+    to_bytes = jnp.take_along_axis(to.data, off, axis=1)
+    out = jnp.where(in_to & (base >= 0), to_bytes, out)
+    return StrCol(jnp.where(cols < out_len[:, None], out, 0), out_len)
+
+
+# ---------------------------------------------------------------------------
+# to_char (ref src/expr/impl/src/scalar/to_char.rs: PG patterns compiled
+# once per literal format — here at BIND time, so the kernel is a pure
+# fixed-width byte construction and the output StrCol width is static)
+
+_TO_CHAR_FIELDS = {
+    # pattern -> (component, digit width); longest-first matching
+    "HH24": ("hour24", 2), "hh24": ("hour24", 2),
+    "HH12": ("hour12", 2), "hh12": ("hour12", 2),
+    "YYYY": ("year", 4), "yyyy": ("year", 4),
+    "AM": ("meridiem_upper", 2), "PM": ("meridiem_upper", 2),
+    "am": ("meridiem_lower", 2), "pm": ("meridiem_lower", 2),
+    "HH": ("hour12", 2), "hh": ("hour12", 2),
+    "MI": ("minute", 2), "mi": ("minute", 2),
+    "SS": ("second", 2), "ss": ("second", 2),
+    "YY": ("year2", 2), "yy": ("year2", 2),
+    "MM": ("month", 2), "mm": ("month", 2),
+    "DD": ("day", 2), "dd": ("day", 2),
+    "MS": ("milli", 3), "ms": ("milli", 3),
+    "US": ("micro", 6), "us": ("micro", 6),
+}
+
+
+def compile_to_char_pattern(fmt: str) -> list:
+    """[(kind, payload)]: ("lit", bytes) | ("field", (component, width))."""
+    segs: list = []
+    i = 0
+    keys = sorted(_TO_CHAR_FIELDS, key=len, reverse=True)
+    lit: list[int] = []
+    while i < len(fmt):
+        hit = next((k for k in keys if fmt.startswith(k, i)), None)
+        if hit is None:
+            lit.extend(fmt[i].encode("utf-8"))
+            i += 1
+            continue
+        if lit:
+            segs.append(("lit", bytes(lit)))
+            lit = []
+        segs.append(("field", _TO_CHAR_FIELDS[hit]))
+        i += len(hit)
+    if lit:
+        segs.append(("lit", bytes(lit)))
+    return segs
+
+
+def eval_to_char(ts: jnp.ndarray, segs: list) -> StrCol:
+    """Format int64-us timestamps by a compiled pattern; fixed width."""
+    cap = ts.shape[0]
+    y, m, d = _civil_from_ts(ts)
+    us_in_day = ts % 86_400_000_000
+    comp = {
+        "year": y, "year2": y % 100, "month": m, "day": d,
+        "hour24": us_in_day // 3_600_000_000,
+        "minute": (us_in_day // 60_000_000) % 60,
+        "second": (us_in_day // 1_000_000) % 60,
+        "milli": (us_in_day // 1_000) % 1000,
+        "micro": us_in_day % 1_000_000,
+    }
+    comp["hour12"] = (comp["hour24"] + 11) % 12 + 1
+    parts = []
+    width = 0
+    for kind, payload in segs:
+        if kind == "lit":
+            arr = jnp.broadcast_to(
+                jnp.asarray(np.frombuffer(payload, np.uint8)),
+                (cap, len(payload)),
+            )
+            parts.append(arr)
+            width += len(payload)
+            continue
+        name, w = payload
+        if name.startswith("meridiem"):
+            is_pm = comp["hour24"] >= 12
+            a, p = (b"AM", b"PM") if name.endswith("upper") \
+                else (b"am", b"pm")
+            arr = jnp.where(
+                is_pm[:, None],
+                jnp.asarray(np.frombuffer(p, np.uint8))[None, :],
+                jnp.asarray(np.frombuffer(a, np.uint8))[None, :],
+            )
+            parts.append(jnp.broadcast_to(arr, (cap, 2)))
+            width += 2
+            continue
+        v = comp[name].astype(jnp.int64)
+        digits = [
+            (v // (10 ** (w - 1 - j))) % 10 + np.uint8(ord("0"))
+            for j in range(w)
+        ]
+        parts.append(jnp.stack(digits, axis=1).astype(jnp.uint8))
+        width += w
+    return StrCol(
+        jnp.concatenate(parts, axis=1),
+        jnp.full((cap,), width, jnp.int32),
+    )
+
+
+class ToChar(Expr):
+    """Bound to_char(ts, 'literal fmt') expression node."""
+
+    def __init__(self, arg: Expr, fmt: str):
+        self.arg = arg
+        self.fmt = fmt
+        self.segs = compile_to_char_pattern(fmt)
+        self.width = sum(
+            len(p) if k == "lit" else p[1] for k, p in self.segs
+        )
+
+    def return_field(self, schema) -> Field:
+        f = self.arg.return_field(schema)
+        return Field("to_char", DataType.VARCHAR,
+                     str_width=max(self.width, 1), nullable=f.nullable)
+
+    def return_type(self, schema):
+        return DataType.VARCHAR
+
+    def eval(self, chunk):
+        col, null = split_col(self.arg.eval(chunk))
+        out = eval_to_char(col, self.segs)
+        return make_col(out, null)
+
+    def __repr__(self):
+        return f"to_char({self.arg!r}, {self.fmt!r})"

@@ -48,16 +48,21 @@ class FuncCall:
     name: str
     args: tuple
     distinct: bool = False
+    #: aggregate FILTER (WHERE <cond>) clause (ref agg filter exprs)
+    filter_where: "object | None" = None
 
 
 @dataclass(frozen=True)
 class WindowCall:
-    """fn(args) OVER (PARTITION BY ... ORDER BY ...)."""
+    """fn(args) OVER (PARTITION BY ... ORDER BY ... [ROWS frame])."""
 
     name: str
     args: tuple
     partition_by: tuple
     order_by: tuple  # OrderItem
+    #: (preceding_rows, following_rows) for ROWS BETWEEN frames;
+    #: None = the default frame (unbounded preceding .. current row)
+    frame: "tuple | None" = None
 
 
 @dataclass(frozen=True)
@@ -74,7 +79,8 @@ class Case:
 
 @dataclass(frozen=True)
 class Star:
-    pass
+    #: qualified star (``A.*``): expand only that table's columns
+    table: "str | None" = None
 
 
 # -- query ------------------------------------------------------------------

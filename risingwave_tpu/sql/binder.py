@@ -104,8 +104,17 @@ class Binder:
         if isinstance(e, ast.FuncCall):
             if e.name in AGG_NAMES:
                 return self._bind_agg(e)
+            if e.filter_where is not None:
+                # postgres: "FILTER specified, but <fn> is not an
+                # aggregate function"
+                raise BindError(
+                    f"FILTER specified, but {e.name} is not an "
+                    "aggregate function"
+                )
             if e.name == "like":
                 return self._bind_like(e)
+            if e.name == "to_char":
+                return self._bind_to_char(e)
             args = tuple(self.bind(a) for a in e.args)
             # untyped NULL literals adopt the type of a typed sibling
             # (COALESCE(x, NULL), CASE branches, IS NULL over NULL...)
@@ -144,6 +153,26 @@ class Binder:
             return EFuncCall("ends_with", (lhs, lit_body))
         return EFuncCall("equal", (lhs, lit_body))
 
+    def _bind_to_char(self, e: ast.FuncCall) -> Expr:
+        """to_char(ts, 'fmt'): the PG pattern compiles at bind time into
+        a fixed-width device kernel (ref to_char.rs ChronoPattern —
+        there compiled per call via an LRU, here once per plan)."""
+        from risingwave_tpu.expr.scalar import ToChar
+
+        if len(e.args) != 2:
+            raise BindError("to_char takes (timestamp, format)")
+        fmt = e.args[1]
+        if not (isinstance(fmt, ast.Literal) and fmt.type_name == "string"):
+            raise BindError("to_char requires a literal format string")
+        arg = self.bind(e.args[0])
+        t = arg.return_field(self.scope.schema).data_type
+        if t == DataType.DATE:
+            # DATE is i32 days; the formatter consumes i64 microseconds
+            arg = EFuncCall("cast_timestamp", (arg,))
+        elif t not in (DataType.TIMESTAMP, DataType.TIMESTAMPTZ):
+            raise BindError(f"to_char over {t.name} not supported")
+        return ToChar(arg, fmt.value)
+
     def _bind_agg(self, e: ast.FuncCall) -> Expr:
         if not self.allow_aggs:
             raise BindError(f"aggregate {e.name} not allowed here")
@@ -151,14 +180,20 @@ class Binder:
             raise BindError(
                 f"DISTINCT {e.name} not yet supported (count/sum only)"
             )
+        filt = None
+        if e.filter_where is not None:
+            # the filter predicate binds against the agg INPUT scope
+            # (no aggregates inside it)
+            filt = Binder(self.scope).bind(e.filter_where)
         if e.name == "count" and (not e.args or
                                   isinstance(e.args[0], ast.Star)):
             if e.distinct:
                 raise BindError("COUNT(DISTINCT *) is not valid")
-            call = agg_mod.AggCall("count_star", None)
+            call = agg_mod.AggCall("count_star", None, filter=filt)
         else:
             arg = self.bind(e.args[0])
-            call = agg_mod.AggCall(e.name, arg, distinct=e.distinct)
+            call = agg_mod.AggCall(e.name, arg, distinct=e.distinct,
+                                   filter=filt)
         self.agg_calls.append(call)
         # placeholder referencing the agg output (resolved by the planner:
         # agg outputs are appended after the group keys)

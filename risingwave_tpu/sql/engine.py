@@ -47,6 +47,25 @@ from risingwave_tpu.stream.dag import DagJob, FragNode, JoinNode
 from risingwave_tpu.stream.runtime import StreamingJob
 
 
+def _join_exchange_keys(key_exprs, chunk):
+    """Evaluate join keys for vnode routing, nullability-normalized.
+
+    compute_vnodes hashes an NCol as [zeroed-payload, null-flag] but a
+    plain column as [payload] — so a key nullable on one join side and
+    NOT NULL on the other would route equal non-NULL values to
+    different shards.  Join equality discards NULL keys anyway (they
+    match nothing), so routing hashes the zeroed payload alone: equal
+    non-NULL values collide regardless of declared nullability, and
+    NULL-keyed rows land (consistently) with payload-zero rows, where
+    they emit as unmatched like anywhere else."""
+    from risingwave_tpu.common.hash import normalize_null_col
+
+    keys = []
+    for e in key_exprs:
+        keys.append(normalize_null_col(e.eval(chunk))[0])
+    return keys
+
+
 class Engine:
     def __init__(self, config: "PlannerConfig | RwConfig | None" = None,
                  data_dir: str | None = None):
@@ -299,24 +318,39 @@ class Engine:
 
     @staticmethod
     def _declared_schema(stmt: ast.CreateSource):
-        """(schema, watermark) from a CREATE SOURCE/TABLE statement."""
-        schema = Schema(tuple(
-            Field(c.name, DataType.from_sql(c.type_name),
-                  nullable=c.nullable)
-            for c in stmt.columns
-        ))
+        """(schema, watermark, auto-width cols) from CREATE SOURCE/TABLE.
+
+        ``auto`` lists VARCHAR columns declared without a length: their
+        device width starts at the default and is re-derived from
+        observed data before each new plan (DML tables only — external
+        sources size from their declared schema)."""
+        from risingwave_tpu.common.types import parse_sql_type
+
+        fields = []
+        auto = []
+        for i, c in enumerate(stmt.columns):
+            t, width, scale = parse_sql_type(c.type_name)
+            kw = {}
+            if width is not None:
+                kw["str_width"] = width
+            elif t.is_string:
+                auto.append(i)
+            if scale is not None:
+                kw["decimal_scale"] = scale
+            fields.append(Field(c.name, t, nullable=c.nullable, **kw))
+        schema = Schema(tuple(fields))
         wm = None
         if stmt.watermark is not None:
             wm = (schema.index_of(stmt.watermark.column),
                   stmt.watermark.delay.micros)
-        return schema, wm
+        return schema, wm, auto
 
     def _dml_table(self, stmt: ast.CreateSource) -> CatalogEntry:
         """CREATE TABLE without a connector: INSERT-fed (ref src/dml)."""
         from risingwave_tpu.connector.dml import TableDmlManager
 
-        schema, wm = self._declared_schema(stmt)
-        dml = TableDmlManager(schema)
+        schema, wm, auto = self._declared_schema(stmt)
+        dml = TableDmlManager(schema, auto_width_cols=auto)
         cap = self.config.chunk_capacity
 
         def factory(split_id: int = 0, num_splits: int = 1):
@@ -331,7 +365,7 @@ class Engine:
         )
 
     def _datagen_source(self, stmt: ast.CreateSource) -> CatalogEntry:
-        schema, wm = self._declared_schema(stmt)
+        schema, wm, _ = self._declared_schema(stmt)
         cap = self.config.chunk_capacity
 
         def factory(split_id: int = 0, num_splits: int = 1):
@@ -341,6 +375,18 @@ class Engine:
             stmt.name, "source", schema, reader_factory=factory,
             watermark=wm, append_only=True, definition=str(stmt),
         )
+
+    def _refresh_dml_widths(self) -> None:
+        """Re-derive auto varchar widths for DML tables before planning.
+
+        The reference's VARCHAR is unbounded (utf8_array.rs); a device
+        column needs a static width before the job's programs compile,
+        so width follows the observed max at plan time.  Running jobs
+        keep their compiled widths; TableDmlManager.insert refuses data
+        that would silently truncate in one of them."""
+        for entry in self.catalog.list("source"):
+            if entry.dml is not None and entry.dml.auto_width_cols:
+                entry.schema = entry.dml.refresh_schema()
 
     def _build_job(self, plan, name: str):
         """Instantiate the runtime job for a plan (shared MV/sink path).
@@ -674,7 +720,8 @@ class Engine:
         # two-phase is retraction-unsafe (partial min/max ignore signs;
         # global row_count counts partial rows) — append-only plans only
         if plan.append_only and all(
-            a.kind in TWO_PHASE_KINDS for a in agg.aggs
+            a.kind in TWO_PHASE_KINDS and a.filter is None
+            for a in agg.aggs
         ):
             partial = PartialAggExecutor(
                 agg.in_schema, agg.group_by, agg.aggs
@@ -782,10 +829,10 @@ class Engine:
         for i in joins:
             join = plan.nodes[i].join
             exchanges[(i, "left")] = (
-                lambda c, ks=join.left_keys: [e.eval(c) for e in ks]
+                lambda c, ks=join.left_keys: _join_exchange_keys(ks, c)
             )
             exchanges[(i, "right")] = (
-                lambda c, ks=join.right_keys: [e.eval(c) for e in ks]
+                lambda c, ks=join.right_keys: _join_exchange_keys(ks, c)
             )
         job = DagJob(
             plan.sources, plan.nodes, name,
@@ -810,6 +857,7 @@ class Engine:
             if stmt.if_not_exists:
                 return None
             raise ValueError(f"{stmt.name!r} already exists")
+        self._refresh_dml_widths()
         plan = self.planner.plan(stmt.query,
                                  eowc=stmt.emit_on_window_close)
         job, mv_exec, state_index, dag_meta, is_new = self._build_job(
@@ -844,6 +892,7 @@ class Engine:
                 ast.TableRef(stmt.from_rel),
             )
         sink = create_sink(stmt.with_options)
+        self._refresh_dml_widths()
         plan = self.planner.plan(query, sink=sink)
         job, sink_exec, _, dag_meta, is_new = self._build_job(
             plan, stmt.name

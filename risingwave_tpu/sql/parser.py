@@ -332,7 +332,23 @@ class Parser:
                 parts.append(self.next().value)
             else:
                 break
-        return " ".join(parts)
+        name = " ".join(parts)
+        # parameterized types: VARCHAR(100), NUMERIC(10, 2)
+        if name in ("varchar", "char", "character", "character varying",
+                    "decimal", "numeric") and self.accept_op("("):
+            args = [self._type_param()]
+            while self.accept_op(","):
+                args.append(self._type_param())
+            self.expect_op(")")
+            name += "(" + ",".join(args) + ")"
+        return name
+
+    def _type_param(self) -> str:
+        t = self.next()
+        if t.kind != "number" or not t.value.lstrip("-").isdigit():
+            raise ParseError(f"expected integer type parameter, got "
+                             f"{t.value!r}")
+        return t.value
 
     def _drop(self):
         kind = self.ident()  # source | table | sink | materialized view
@@ -663,13 +679,50 @@ class Parser:
                         ob.append(ast.OrderItem(e, desc))
                         if not self.accept_op(","):
                             break
+                frame = self._window_frame()
                 self.expect_op(")")
                 return ast.WindowCall(w, tuple(args), tuple(part),
-                                      tuple(ob))
-            return ast.FuncCall(w, tuple(args), distinct)
+                                      tuple(ob), frame=frame)
+            fc = ast.FuncCall(w, tuple(args), distinct)
+            if self.accept_word("filter"):
+                self.expect_op("(")
+                self.expect_word("where")
+                cond = self._expr()
+                self.expect_op(")")
+                fc = ast.FuncCall(w, tuple(args), distinct,
+                                  filter_where=cond)
+            return fc
         if self.accept_op("."):
+            if self.accept_op("*"):
+                return ast.Star(table=w)
             return ast.ColumnRef(self.ident(), table=w)
         return ast.ColumnRef(w)
+
+    def _window_frame(self):
+        """ROWS BETWEEN <n> PRECEDING AND CURRENT ROW (the benchmark
+        frame shape); returns (preceding, following) or None."""
+        if not self.accept_word("rows"):
+            return None
+
+        def bound(start: bool) -> int:
+            if self.accept_word("current"):
+                self.expect_word("row")
+                return 0
+            if self.accept_word("unbounded"):
+                self.expect_word("preceding" if start else "following")
+                return -1  # unbounded sentinel
+            t = self.next()
+            if t.kind != "number":
+                raise ParseError(f"expected frame bound, got {t.value!r}")
+            n = int(t.value)
+            self.expect_word("preceding" if start else "following")
+            return n
+
+        self.expect_word("between")
+        pre = bound(True)
+        self.expect_word("and")
+        fol = bound(False)
+        return (pre, fol)
 
     def _interval(self, text: str) -> ast.IntervalLit:
         m = re.match(r"^\s*(\d+)\s*([a-zA-Z]+)?\s*$", text)

@@ -99,6 +99,25 @@ class DagPlan:
 
 
 @dataclass
+class GroupTopNSpec:
+    """A row_number-in-subquery TopN rewrite in flight.
+
+    Ref: the reference plans ``SELECT .. FROM (SELECT *, ROW_NUMBER()
+    OVER (PARTITION BY p ORDER BY o) rn FROM t) WHERE rn <= k`` as a
+    StreamGroupTopN (optimizer/rule/over_window_to_topn_rule.rs); this
+    carries the pieces through the inner plan's construction."""
+
+    partition: tuple        # ast exprs, inner FROM scope
+    order: tuple            # ast OrderItems, inner FROM scope
+    limit: int
+    offset: int
+    outer_items: tuple      # outer SELECT items (inner-output scope)
+    outer_where: tuple      # residual outer conjuncts
+    alias: "str | None"     # subquery alias
+    rank_alias: "str | None" = None  # emit the in-band row_number as this
+
+
+@dataclass
 class PlannerConfig:
     agg_table_size: int = 1 << 16
     agg_emit_capacity: int = 4096
@@ -129,8 +148,9 @@ class Planner:
         self.config = config or PlannerConfig()
 
     # ------------------------------------------------------------------
-    def plan(self, select: ast.Select, sink=None,
-             eowc: bool = False) -> "UnaryPlan | DagPlan":
+    def plan(self, select: ast.Select, sink=None, eowc: bool = False,
+             group_topn: "GroupTopNSpec | None" = None
+             ) -> "UnaryPlan | DagPlan":
         """``sink`` replaces the MV terminal; ``eowc`` = EMIT ON WINDOW
         CLOSE (final append-only rows when windows close)."""
         def has_subquery(f) -> bool:
@@ -140,13 +160,20 @@ class Planner:
                 return has_subquery(f.left) or has_subquery(f.right)
             return False
 
+        if group_topn is None:
+            rewritten = self._match_group_topn(select)
+            if rewritten is not None:
+                inner, spec = rewritten
+                return self.plan(inner, sink=sink, eowc=eowc,
+                                 group_topn=spec)
+
         if isinstance(select.from_, ast.Join) or has_subquery(select.from_):
             if eowc:
                 raise PlanError(
                     "EMIT ON WINDOW CLOSE on joins/subqueries: next round"
                 )
-            return self._plan_join(select, sink)
-        plan = self._plan_unary(select, sink, eowc)
+            return self._plan_join(select, sink, group_topn=group_topn)
+        plan = self._plan_unary(select, sink, eowc, group_topn=group_topn)
         if isinstance(plan.reader, MvTap):
             # cascade: a single fragment node tapping the upstream MV
             from risingwave_tpu.stream.dag import FragNode
@@ -157,6 +184,130 @@ class Planner:
                 mv_node=0, mv_index=plan.mv_index,
             )
         return plan
+
+    # -- GroupTopN (row_number-in-subquery) rewrite ---------------------
+    def _match_group_topn(self, select: ast.Select):
+        """Detect SELECT .. FROM (SELECT *, ROW_NUMBER() OVER (..) rn
+        FROM ..) WHERE rn <= k and return (inner-sans-window, spec)."""
+        f = select.from_
+        if not isinstance(f, ast.SubqueryRef):
+            return None
+        inner = f.select
+        if (inner.order_by or inner.limit is not None or inner.offset
+                or inner.group_by or inner.having is not None):
+            return None
+        wins = [(i, it) for i, it in enumerate(inner.items)
+                if isinstance(it.expr, ast.WindowCall)]
+        if len(wins) != 1:
+            return None
+        wi, witem = wins[0]
+        w = witem.expr
+        if w.name != "row_number" or w.frame is not None or not w.order_by:
+            return None
+        rank_name = witem.alias or "row_number"
+        if select.where is None:
+            return None
+        limit = offset = None
+        rest: list = []
+        for c in self._conjuncts(select.where):
+            lo = self._rank_bound(c, rank_name)
+            if lo is not None and limit is None:
+                limit, offset = lo
+            else:
+                rest.append(c)
+        if limit is None:
+            return None
+        if select.order_by or select.limit is not None or select.offset:
+            return None  # outer ORDER/LIMIT over group topn: next round
+
+        # does the outer query use the rank column (selected by name or
+        # via *)?  If so the TopN must emit its in-band row_number.
+        def refs_rank(e) -> bool:
+            if isinstance(e, ast.ColumnRef):
+                return e.name == rank_name
+            if isinstance(e, ast.Case):
+                return any(refs_rank(c) or refs_rank(r)
+                           for c, r in e.conditions) or (
+                    e.else_result is not None
+                    and refs_rank(e.else_result)
+                )
+            return any(
+                refs_rank(x) for x in getattr(e, "args", ())
+                if not isinstance(x, ast.Star)
+            ) or any(
+                refs_rank(getattr(e, a)) for a in ("left", "right",
+                                                   "operand")
+                if getattr(e, a, None) is not None
+            )
+        has_star = any(isinstance(it.expr, ast.Star)
+                       for it in select.items)
+        with_rank = has_star or any(
+            not isinstance(it.expr, ast.Star) and refs_rank(it.expr)
+            for it in select.items
+        ) or any(refs_rank(c) for c in rest)
+        if has_star and wi != len(inner.items) - 1:
+            # the rank column is appended LAST by the rewrite; a * over
+            # a mid-list window item would reorder columns
+            return None
+        import dataclasses
+        inner2 = dataclasses.replace(
+            inner, items=tuple(it for i, it in enumerate(inner.items)
+                               if i != wi),
+        )
+        spec = GroupTopNSpec(
+            partition=tuple(w.partition_by), order=tuple(w.order_by),
+            limit=limit, offset=offset,
+            outer_items=tuple(select.items), outer_where=tuple(rest),
+            alias=f.alias,
+            rank_alias=rank_name if with_rank else None,
+        )
+        return inner2, spec
+
+    @staticmethod
+    def _rank_bound(c, rank_name: str):
+        """rn <= k / rn < k / rn = k / k >= rn → (limit, offset)."""
+        if not isinstance(c, ast.BinaryOp):
+            return None
+        op, left, right = c.op, c.left, c.right
+        if isinstance(right, ast.ColumnRef) and right.name == rank_name:
+            flip = {"greater_than_or_equal": "less_than_or_equal",
+                    "greater_than": "less_than",
+                    "equal": "equal"}.get(op)
+            if flip is None:
+                return None
+            op, left, right = flip, right, left
+        if not (isinstance(left, ast.ColumnRef) and left.name == rank_name
+                and left.table is None
+                and isinstance(right, ast.Literal)
+                and right.type_name == "int"):
+            return None
+        k = right.value
+        if op == "less_than_or_equal" and k >= 1:
+            return (k, 0)
+        if op == "less_than" and k >= 2:
+            return (k - 1, 0)
+        if op == "equal" and k >= 1:
+            return (1, k - 1)
+        return None
+
+    def _resolve_group_topn(self, spec: GroupTopNSpec, scope: Scope,
+                            proj: list):
+        """Bind the partition/order keys in the INNER scope and locate
+        them in the projection (appending hidden columns as needed);
+        returns (group_positions, [(position, desc)], spec)."""
+        b = Binder(scope)
+
+        def locate(bexpr) -> int:
+            for pi, (_, pe) in enumerate(proj):
+                if self._expr_eq(pe, bexpr):
+                    return pi
+            proj.append((f"_hidden_gtn{len(proj)}", bexpr))
+            return len(proj) - 1
+
+        group_pos = [locate(b.bind(e)) for e in spec.partition]
+        order_pos = [(locate(b.bind(oi.expr)), oi.descending)
+                     for oi in spec.order]
+        return (group_pos, order_pos, spec)
 
     # -- FROM resolution ------------------------------------------------
     def _resolve_input(self, from_) -> PlannedInput:
@@ -239,7 +390,9 @@ class Planner:
         return pk_positions
 
     def _plan_unary(self, select: ast.Select, sink=None,
-                    eowc: bool = False) -> UnaryPlan:
+                    eowc: bool = False,
+                    group_topn: "GroupTopNSpec | None" = None
+                    ) -> UnaryPlan:
         if select.from_ is None:
             raise PlanError("SELECT without FROM is not a streaming job")
         pin = self._resolve_input(select.from_)
@@ -261,12 +414,17 @@ class Planner:
             return self._plan_over_window(select, pin, execs, scope)
 
         has_agg = bool(select.group_by) or self._has_agg(select)
+        if has_agg and group_topn is not None:
+            raise PlanError(
+                "row_number subquery over an aggregation: next round"
+            )
         if eowc and not has_agg:
             raise PlanError(
                 "EMIT ON WINDOW CLOSE needs GROUP BY window_start over a "
                 "watermarked windowed source"
             )
         pk_positions: list[int] = []
+        gtn = None
         if has_agg:
             execs2, out_schema, pk_positions = self._plan_agg(
                 select, scope, pin, eowc
@@ -289,6 +447,8 @@ class Planner:
                 pk_positions = self._stream_key_projection(
                     proj, scope.schema, pin.stream_key
                 )
+            if group_topn is not None:
+                gtn = self._resolve_group_topn(group_topn, scope, proj)
             execs.append(ProjectExecutor(scope.schema, proj))
             out_schema = execs[-1].out_schema
 
@@ -296,6 +456,7 @@ class Planner:
             execs, out_schema, select,
             input_append_only=pin.append_only, has_agg=has_agg,
             pk_positions=pk_positions, sink=sink, eowc=eowc,
+            group_topn=gtn,
         )
         return UnaryPlan(pin.reader, Fragment(execs), len(execs) - 1,
                          append_only=pin.append_only)
@@ -320,6 +481,12 @@ class Planner:
             )
         witems = [(item, item.expr) for item in select.items
                   if isinstance(item.expr, ast.WindowCall)]
+        if any(w.frame is not None for _, w in witems):
+            # parsed but not yet executed: reject loudly rather than
+            # silently computing the default frame
+            raise PlanError(
+                "ROWS BETWEEN window frames: next round"
+            )
         spec = (witems[0][1].partition_by, witems[0][1].order_by)
         for _, w in witems[1:]:
             if (w.partition_by, w.order_by) != spec:
@@ -402,9 +569,44 @@ class Planner:
 
     def _append_terminal(self, execs, out_schema, select, *,
                          input_append_only: bool, has_agg: bool,
-                         pk_positions, sink, eowc: bool) -> None:
-        """Shared plan tail: optional TopN, then sink or materialize."""
+                         pk_positions, sink, eowc: bool,
+                         group_topn=None) -> None:
+        """Shared plan tail: optional (group) TopN, then sink or
+        materialize."""
         has_topn = bool(select.order_by and select.limit is not None)
+        if group_topn is not None:
+            group_pos, order_pos, spec = group_topn
+            for pos, _ in order_pos:
+                if out_schema[pos].nullable:
+                    raise PlanError(
+                        "row_number ORDER BY on a nullable column: "
+                        "next round"
+                    )
+            pool = max(self.config.topn_pool_size,
+                       2 * self.config.chunk_capacity)
+            execs.append(GroupTopNExecutor(
+                out_schema,
+                group_by=[InputRef(i) for i in group_pos],
+                order_by=[(InputRef(i), d) for i, d in order_pos],
+                limit=spec.limit, offset=spec.offset,
+                pool_size=pool,
+                emit_capacity=self.config.topn_emit_capacity,
+                append_only=input_append_only,
+                rank_alias=spec.rank_alias,
+            ))
+            out_schema = execs[-1].out_schema
+            scope2 = Scope.of(out_schema, spec.alias)
+            for c in spec.outer_where:
+                execs.append(FilterExecutor(
+                    out_schema, Binder(scope2).bind(c)
+                ))
+            items = self._expand_items(spec.outer_items, scope2)
+            proj2 = [(nm, Binder(scope2).bind(e)) for nm, e in items]
+            execs.append(ProjectExecutor(out_schema, proj2))
+            out_schema = execs[-1].out_schema
+            # group-topn output is retractable, keyed by the whole row
+            input_append_only = False
+            pk_positions = list(range(len(out_schema)))
         if has_topn:
             if eowc:
                 raise PlanError(
@@ -562,6 +764,13 @@ class Planner:
                     "mixing DISTINCT and plain aggregates (or multiple "
                     "distinct args) needs the expand rewrite: next round"
                 )
+            if any(a.filter is not None for a in distinct_calls):
+                # dedup-before-agg collapses rows ACROSS filter
+                # predicates — a per-filter distinct needs counted dedup
+                # state (ref distinct.rs)
+                raise PlanError(
+                    "DISTINCT aggregates with FILTER: next round"
+                )
             import dataclasses
 
             from risingwave_tpu.stream.top_n import AppendOnlyDedupExecutor
@@ -642,6 +851,11 @@ class Planner:
                 tuple(self._rewrite_post_agg(a, group_by, n_keys)
                       for a in e.args),
             )
+        from risingwave_tpu.expr.scalar import ToChar
+        if isinstance(e, ToChar):
+            return ToChar(
+                self._rewrite_post_agg(e.arg, group_by, n_keys), e.fmt
+            )
         return e  # literals
 
     @staticmethod
@@ -655,12 +869,16 @@ class Planner:
                 Planner._expr_eq(x, y) for x, y in zip(a.args, b.args)
             )
         from risingwave_tpu.expr.node import Literal as ELit
+        from risingwave_tpu.expr.scalar import ToChar
         if isinstance(a, ELit):
             return a.value == b.value and a.data_type == b.data_type
+        if isinstance(a, ToChar):
+            return a.fmt == b.fmt and Planner._expr_eq(a.arg, b.arg)
         return False
 
     # -- join pipelines ---------------------------------------------------
-    def _plan_join(self, select: ast.Select, sink=None) -> DagPlan:
+    def _plan_join(self, select: ast.Select, sink=None,
+                   group_topn: "GroupTopNSpec | None" = None) -> DagPlan:
         """Joins — including nested (multi-way) trees — as a DagPlan.
 
         Each base input becomes a source (+ optional prep fragment
@@ -895,6 +1113,10 @@ class Planner:
 
         has_agg = bool(select.group_by) or self._has_agg(select)
         if has_agg:
+            if group_topn is not None:
+                raise PlanError(
+                    "row_number subquery over an aggregation: next round"
+                )
             # aggregation over the joined stream (TPC-H/q4 shape): the
             # join's retractions flow into the agg, which handles them
             execs2, out_schema, pk_pos = self._plan_agg(
@@ -919,28 +1141,17 @@ class Planner:
                 pk_positions = self._stream_key_projection(
                     proj, both.schema, root.stream_key
                 )
+            gtn = None
+            if group_topn is not None:
+                gtn = self._resolve_group_topn(group_topn, both, proj)
             post_execs.append(ProjectExecutor(both.schema, proj))
             out_schema = post_execs[-1].out_schema
-            if sink is not None:
-                from risingwave_tpu.stream.sink import SinkExecutor
-                post_execs.append(SinkExecutor(
-                    out_schema, sink, ring_size=cfg.mv_ring_size
-                ))
-            elif root.append_only:
-                post_execs.append(AppendOnlyMaterialize(
-                    out_schema, ring_size=cfg.mv_ring_size
-                ))
-            else:
-                # retractable join output: keyed materialization on the
-                # stream key when derivable, else the whole row.
-                # KNOWN GAP in the fallback (mirrors the TopN pk note):
-                # identical duplicate rows collapse into one MV slot.
-                post_execs.append(MaterializeExecutor(
-                    out_schema,
-                    pk_indices=pk_positions
-                    or list(range(len(out_schema))),
-                    table_size=cfg.mv_table_size,
-                ))
+            self._append_terminal(
+                post_execs, out_schema, select,
+                input_append_only=root.append_only, has_agg=False,
+                pk_positions=pk_positions, sink=sink, eowc=False,
+                group_topn=gtn,
+            )
         nodes.append(FragNode(Fragment(post_execs), root_ref))
         return DagPlan(
             sources, nodes, len(nodes) - 1, len(post_execs) - 1
@@ -978,10 +1189,13 @@ class Planner:
         out = []
         for idx, item in enumerate(items):
             if isinstance(item.expr, ast.Star):
+                want = item.expr.table
                 for ci, f in enumerate(scope.schema):
                     # pk bookkeeping columns of an upstream MV are not
                     # user-visible (each plan re-derives its own)
                     if f.name.startswith("_hidden_"):
+                        continue
+                    if want is not None and scope.qualifiers[ci] != want:
                         continue
                     out.append((f.name, ast.ColumnRef(f.name,
                                                       scope.qualifiers[ci])))

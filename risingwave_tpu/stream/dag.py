@@ -365,7 +365,12 @@ class DagJob:
         # window 0 propagates directly (NOT via the inbox) so windows
         # stay in emission order downstream — a +pair in window 0 must
         # land before its -pair in window 1
-        first = join.emit_window(build_rows, pending, jnp.int32(0), side)
+        first, probe_bound = join.emit_window(
+            build_rows, pending, jnp.int32(0), side
+        )
+        new_states[idx] = new_states[idx]._replace(
+            emit_overflow=new_states[idx].emit_overflow + probe_bound
+        )
         self._propagate(new_states, [(("node", idx), first)])
         max_w = join.max_windows(chunk.capacity)
         if max_w <= 1:
@@ -385,8 +390,13 @@ class DagJob:
 
         def body(carry):
             sts, w = carry
-            window = join.emit_window(build_rows, pending, w, side)
+            window, probe_bound = join.emit_window(
+                build_rows, pending, w, side
+            )
             lst = list(sts)
+            lst[idx] = lst[idx]._replace(
+                emit_overflow=lst[idx].emit_overflow + probe_bound
+            )
             self._propagate(lst, [(("node", idx), window)])
             return tuple(lst), w + 1
 

@@ -185,7 +185,11 @@ class HashAggExecutor(Executor):
         for ai, a in enumerate(self.aggs):
             if a.arg is None or a.kind in ("count", "count_star"):
                 continue
-            if a.arg.return_field(in_schema).nullable:
+            # a FILTER clause makes any aggregate's input set possibly
+            # empty even over a NOT NULL argument → same NULL-output
+            # tracking as a nullable argument
+            if a.arg.return_field(in_schema).nullable \
+                    or a.filter is not None:
                 self._nn_prim[ai] = len(self._prim_specs)
                 self._prim_specs.append((ai, _ADD_COUNT))
 
@@ -331,6 +335,17 @@ class HashAggExecutor(Executor):
 
         prims = list(state.prims)
         arg_cache: dict[int, jnp.ndarray] = {}
+        filt_cache: dict[int, jnp.ndarray] = {}
+
+        def filter_mask(a, agg_idx):
+            """bool [cap] FILTER (WHERE ...) mask; NULL = excluded."""
+            if a.filter is None:
+                return None
+            if agg_idx not in filt_cache:
+                fcol, fnull = split_col(a.filter.eval(chunk))
+                filt_cache[agg_idx] = fcol if fnull is None \
+                    else fcol & ~fnull
+            return filt_cache[agg_idx]
         for pi, (agg_idx, ps) in enumerate(self._prim_specs):
             a = self.aggs[agg_idx]
             if pi in self._cache_prims:
@@ -353,10 +368,13 @@ class HashAggExecutor(Executor):
             col, col_null = split_col(col)
             if col_null is not None and not isinstance(col, StrCol):
                 col = jnp.where(col_null, jnp.zeros((), col.dtype), col)
+            fm = filter_mask(a, agg_idx)
             if perm is None:
                 prim_signs = signs if col_null is None else jnp.where(
                     col_null, 0, signs
                 )
+                if fm is not None:
+                    prim_signs = jnp.where(fm, prim_signs, 0)
                 # per-row update scattered directly (invalid rows carry
                 # sign 0 ⇒ identity, and sentinel slots drop)
                 seg = ps.lift(col, prim_signs)
@@ -364,6 +382,8 @@ class HashAggExecutor(Executor):
                 prim_signs = s_signs if col_null is None else jnp.where(
                     col_null[perm], 0, s_signs
                 )
+                if fm is not None:
+                    prim_signs = jnp.where(fm[perm], prim_signs, 0)
                 # per-row lift in sorted order, then segment-reduce:
                 # the value at each segment END is the segment's update
                 contrib = ps.lift(gather_key(col, perm), prim_signs)
@@ -422,6 +442,9 @@ class HashAggExecutor(Executor):
                     active = active & ~(
                         vnull if perm is None else vnull[perm]
                     )
+                fm = filter_mask(a, agg_idx)
+                if fm is not None:
+                    active = active & (fm if perm is None else fm[perm])
                 vals, occ, over, miss = self._minput_update(
                     minput_vals[mi], minput_occ[mi], row_slots,
                     v_sorted, s_signs, active, ins_pos,

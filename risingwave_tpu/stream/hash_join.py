@@ -570,10 +570,15 @@ class HashJoinExecutor:
         # rank = pre-chunk degree + stable rank among this chunk's
         # inserts of the same key
         rank = side.count[safe] + _rank_by(slots.astype(jnp.uint64), is_ins)
-        index, pos, _, over_idx = side.index.lookup_or_insert(
+        index, pos, idx_new, over_idx = side.index.lookup_or_insert(
             [h, rank], is_ins
         )
         got = is_ins & ~over_idx
+        # an (h, rank) entry that already existed means a prior index
+        # overflow stranded a higher-rank entry while count stalled:
+        # this insert overwrites that live pool row.  Count it so
+        # maintenance fails loudly instead of silently losing the row.
+        n_overwrite = jnp.sum((got & ~idx_new).astype(jnp.int64))
         pool = side.index.size
         tgt = jnp.where(got, jnp.minimum(pos, pool - 1), jnp.int32(pool))
         rows = tuple(
@@ -589,7 +594,7 @@ class HashJoinExecutor:
             jnp.where(got, safe, jnp.int32(size))
         ].add(1, mode="drop")
         n_over = jnp.sum((is_ins & over_idx).astype(jnp.int64)) + \
-            jnp.sum(overflow.astype(jnp.int64))
+            jnp.sum(overflow.astype(jnp.int64)) + n_overwrite
         return PoolSideState(
             key_table=key_table,
             count=count,
@@ -741,12 +746,18 @@ class HashJoinExecutor:
         return new_state, pending
 
     def emit_window(self, build_rows: tuple, p: JoinEmit, w,
-                    side: str) -> Chunk:
+                    side: str):
         """Materialize window ``w`` of the pending emission space.
 
         ``build_rows`` is the build (non-arriving) side's row stores —
         taken from the CURRENT state so the while_loop carry holds the
-        stores once, not per-window copies."""
+        stores once, not per-window copies.
+
+        Returns ``(chunk, probe_bound int64)``: the second value counts
+        build-index probes that exhausted the probe-iteration bound —
+        rows whose presence is then UNKNOWN and which are dropped from
+        the output; callers must fold it into ``emit_overflow`` so
+        maintenance fails loudly (hash_table.lookup_counted contract)."""
         out_cap = self.out_capacity
         cap = p.signs.shape[0]
         gpos = w * out_cap + jnp.arange(out_cap, dtype=jnp.int32)
@@ -786,12 +797,13 @@ class HashJoinExecutor:
             return gather_key(col, r)
 
         build_rows, build_index = build_rows
+        probe_bound = jnp.int64(0)
         if build_index is not None:
             # pool build side: ONE vectorized (key-hash, rank) index
             # lookup resolves every build row this window needs
             need = in_pairs | in_trans
             pool = build_index.size
-            pos, bfound, _ = build_index.lookup_counted(
+            pos, bfound, probe_bound = build_index.lookup_counted(
                 [p.probe_hash[r], j.astype(jnp.int32)], need
             )
             bpos = jnp.minimum(pos, pool - 1)
@@ -881,7 +893,8 @@ class HashJoinExecutor:
             in_up, jnp.int8(up_op),
             jnp.where(in_down, jnp.int8(down_op), base_op),
         )
-        return Chunk(out_cols, ops, valid_out, self._out_schema)
+        return Chunk(out_cols, ops, valid_out, self._out_schema), \
+            probe_bound
 
     def build_rows_of(self, state: JoinState, side: str) -> tuple:
         """(row stores, index-or-None) of the build side for
@@ -902,12 +915,13 @@ class HashJoinExecutor:
         self-consistency): update own side, then probe the other side.
         """
         state, pending = self.apply_begin(state, chunk, side)
-        out = self.emit_window(
+        out, probe_bound = self.emit_window(
             self.build_rows_of(state, side), pending, jnp.int32(0), side
         )
         dropped = jnp.maximum(pending.total - self.out_capacity, 0)
         return state._replace(
             emit_overflow=state.emit_overflow + dropped.astype(jnp.int64)
+            + probe_bound
         ), out
 
     def max_windows(self, chunk_cap: int) -> int:

@@ -41,7 +41,7 @@ from risingwave_tpu.common.chunk import (
 )
 from risingwave_tpu.common.compact import mask_indices
 from risingwave_tpu.common.hash import hash64_columns
-from risingwave_tpu.common.types import Schema
+from risingwave_tpu.common.types import DataType, Field, Schema
 from risingwave_tpu.expr.node import Expr
 from risingwave_tpu.stream.executor import Executor
 
@@ -195,9 +195,10 @@ class GroupTopNExecutor(Executor):
     """TOP N (+offset) per group over a changelog (plain TopN: no group).
 
     ``order_by``: (expr, descending) pairs evaluated on the input schema.
-    Output = input columns (the reference appends rank only with
-    WITH TIES / row_number plans; parity for those lands with the
-    over-window executor).
+    Output = input columns; with ``rank_alias`` set, a 1-based in-band
+    row_number column is appended (the row_number-in-subquery rewrite's
+    rank output — a row whose rank shifts retracts its old (row, rank)
+    pair and emits the new one, ref group_top_n with output row_number).
     """
 
     emits_on_apply = False
@@ -216,6 +217,7 @@ class GroupTopNExecutor(Executor):
         watermark_lag: int = 0,
         watermark_src_col: int | None = None,
         append_only: bool = False,
+        rank_alias: str | None = None,
     ):
         super().__init__(in_schema)
         self.group_by = tuple(group_by)
@@ -234,6 +236,17 @@ class GroupTopNExecutor(Executor):
         #: needs to absorb one epoch of inserts plus the band (the
         #: reference's append_only TopN cache makes the same move)
         self.append_only = append_only
+        self.rank_alias = rank_alias
+        if rank_alias is not None:
+            self._out_schema = Schema(tuple(in_schema) + (
+                Field(rank_alias, DataType.INT64),
+            ))
+        else:
+            self._out_schema = in_schema
+
+    @property
+    def out_schema(self) -> Schema:
+        return self._out_schema
 
     def init_state(self) -> TopNState:
         protos = []
@@ -245,12 +258,17 @@ class GroupTopNExecutor(Executor):
                 ))
             else:
                 protos.append(jnp.zeros((1,), f.data_type.physical_dtype))
+        if self.rank_alias is not None:
+            # the emitted-snapshot buffers carry the rank column too
+            protos_prev = protos + [jnp.zeros((1,), jnp.int64)]
+        else:
+            protos_prev = protos
         S, E = self.pool_size, self.emit_capacity
         return TopNState(
             rows=tuple(_empty_like_col(p, S) for p in protos),
             valid=jnp.zeros((S,), jnp.bool_),
             row_hash=jnp.zeros((S,), jnp.uint64),
-            prev_rows=tuple(_empty_like_col(p, E) for p in protos),
+            prev_rows=tuple(_empty_like_col(p, E) for p in protos_prev),
             prev_valid=jnp.zeros((E,), jnp.bool_),
             prev_hash=jnp.zeros((E,), jnp.uint64),
             overflow=jnp.zeros((), jnp.int64),
@@ -274,8 +292,8 @@ class GroupTopNExecutor(Executor):
         ), None
 
     # ------------------------------------------------------------------
-    def _band_mask(self, state: TopNState) -> jnp.ndarray:
-        """Current TopN band membership per pool slot."""
+    def _band_mask(self, state: TopNState):
+        """(band membership, 1-based in-band rank) per pool slot."""
         S = self.pool_size
         pool_chunk = Chunk(
             state.rows, jnp.zeros((S,), jnp.int8), state.valid,
@@ -308,17 +326,34 @@ class GroupTopNExecutor(Executor):
         in_band_sorted = state.valid[order] & (rank >= self.offset) & (
             rank < self.offset + self.limit
         )
-        return jnp.zeros((S,), jnp.bool_).at[order].set(in_band_sorted)
+        band = jnp.zeros((S,), jnp.bool_).at[order].set(in_band_sorted)
+        # absolute 1-based row_number (NOT band-relative): an rn = k
+        # rewrite (limit 1, offset k-1) must still emit rank k
+        ranks = jnp.zeros((S,), jnp.int64).at[order].set(
+            (rank + 1).astype(jnp.int64)
+        )
+        return band, ranks
 
     def flush(self, state: TopNState, epoch):
         S, E = self.pool_size, self.emit_capacity
-        band = self._band_mask(state)
+        band, ranks = self._band_mask(state)
         # compact current band to [E]
         cur_idx = mask_indices(band, E, S)
         cur_live = cur_idx < S
         safe = jnp.minimum(cur_idx, S - 1)
         cur_rows = tuple(_gather(c, safe) for c in state.rows)
         cur_hash = jnp.where(cur_live, state.row_hash[safe], 0)
+        if self.rank_alias is not None:
+            # the rank is part of the OUTPUT row: fold it into the diff
+            # hash so a rank shift retracts the old (row, rank) pair
+            cur_rank = jnp.where(cur_live, ranks[safe], 0)
+            cur_rows = cur_rows + (cur_rank,)
+            cur_hash = jnp.where(
+                cur_live,
+                cur_hash ^ (cur_rank.astype(jnp.uint64)
+                            * jnp.uint64(0x9E3779B97F4A7C15)),
+                0,
+            )
 
         # membership diffs by hash multiset (duplicates handled by rank)
         from risingwave_tpu.stream.hash_join import _rank_by as rank_by
@@ -351,7 +386,7 @@ class GroupTopNExecutor(Executor):
             jnp.full((E,), OP_INSERT, jnp.int8),
         )
         valid = cat(del_side, ins_side)
-        out = Chunk(out_cols, ops, valid, self.in_schema)
+        out = Chunk(out_cols, ops, valid, self.out_schema)
 
         # append-only inputs: rows outside the band can never re-enter
         # (no retractions), so evict them — the pool then only needs to

@@ -63,6 +63,9 @@ def run() -> dict:
         key=lambda s: [int(x) if x.isdigit() else x
                        for x in re.split(r"(\d+)", s)],
     )
+    only = os.environ.get("RWT_ONLY")
+    if only:
+        names = [n for n in names if n in only.split(",")]
     for name in names:
         view_file = os.path.join(QUERY_DIR, "views", f"{name}.slt.part")
         query_file = os.path.join(QUERY_DIR, f"{name}.slt.part")

@@ -312,7 +312,7 @@ def test_pool_join_hot_key_exceeds_any_bucket():
     w = 0
     while w * j.out_capacity < int(pending.total):
         got.extend(
-            j.emit_window(build, pending, jnp.int32(w), "right").to_rows()
+            j.emit_window(build, pending, jnp.int32(w), "right")[0].to_rows()
         )
         w += 1
     assert sorted(got) == want
@@ -334,7 +334,7 @@ def test_pool_join_10x_skew_matches_brute_force():
         w = 0
         while w * j.out_capacity < int(pending.total):
             got.extend(j.emit_window(
-                build, pending, jnp.int32(w), side).to_rows())
+                build, pending, jnp.int32(w), side)[0].to_rows())
             w += 1
 
     for step in range(6):
@@ -389,7 +389,7 @@ def test_pool_join_watermark_cleaning_bounds_state():
     w = 0
     while w * j.out_capacity < int(pending.total):
         got.extend(j.emit_window(
-            build, pending, jnp.int32(w), "right").to_rows())
+            build, pending, jnp.int32(w), "right")[0].to_rows())
         w += 1
     want = _brute_inner([r for r in lrows if r[0] >= 5], [(6, 600)])
     assert sorted(got) == want

@@ -154,7 +154,7 @@ def test_windowed_emission_losslessness():
                 for w in range(j.max_windows(chunk.capacity)):
                     fold(acc, j.emit_window(
                         build, pend, jnp.int32(w), side
-                    ))
+                    )[0])
             else:
                 st, out = j.apply(st, chunk, side)
                 fold(acc, out)
