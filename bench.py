@@ -48,7 +48,8 @@ CHUNKS_PER_BARRIER = 8
 SOURCES = """
 CREATE SOURCE bid (
     auction BIGINT, bidder BIGINT, price BIGINT,
-    channel VARCHAR, url VARCHAR, date_time TIMESTAMP
+    channel VARCHAR, url VARCHAR, date_time TIMESTAMP,
+    WATERMARK FOR date_time AS date_time - INTERVAL '4' SECOND
 ) WITH (connector = 'nexmark', nexmark.table = 'bid',
         nexmark.event.rate = '{rate}');
 CREATE SOURCE person (
@@ -110,7 +111,7 @@ def measure(query: str) -> float:
         join_pool_size=1 << 22,
         # out_capacity sizes every emission window chunk; oversizing
         # it taxes every chunk with dead rows (measured 3.6x on q8)
-        join_out_capacity=1 << 15,
+        join_out_capacity=1 << 12,
         mv_table_size=1 << 18,
         # q1/q8 materialize every output row; the ring must hold the
         # whole warmup+measured window (the lap counter voids lossy runs)

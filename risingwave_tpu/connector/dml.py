@@ -62,11 +62,11 @@ class TableDmlManager:
                     n = len(v.encode("utf-8"))
                     if n > batch_max[i]:
                         batch_max[i] = n
-        for i in self._max_lens:
-            self._max_lens[i] = max(self._max_lens[i], batch_max[i])
         # a string longer than a live reader's compiled width would be
         # silently truncated in that dataflow — refuse loudly instead
-        # (batch max vs the narrowest reader: O(readers x columns))
+        # (batch max vs the narrowest reader: O(readers x columns)).
+        # Validated BEFORE _max_lens folds the batch in: a rejected
+        # batch must not inflate future auto widths.
         for i in str_cols:
             for r in self._readers:
                 f = r.schema[i]
@@ -77,6 +77,8 @@ class TableDmlManager:
                         "against; declare VARCHAR(n) wide enough "
                         "before creating views on this table"
                     )
+        for i in self._max_lens:
+            self._max_lens[i] = max(self._max_lens[i], batch_max[i])
         self._history.extend(rows)
         for r in self._readers:
             r.enqueue(rows)

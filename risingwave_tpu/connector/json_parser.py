@@ -1,0 +1,112 @@
+"""JSON-lines parser + rate-limited chunk builder.
+
+Reference counterparts: ``src/connector/src/parser/`` (JsonParser and
+the shared ``chunk_builder.rs`` with rate limiting) — the parser turns
+raw connector payloads into typed ``StreamChunk``s, tolerating
+malformed rows (counted, not fatal: the reference's parser error
+policy).
+
+TPU-first shape: parsing is HOST work at the ingest boundary (strings,
+ragged bytes); the output is a fixed-capacity device ``Chunk`` whose
+columns are dense numpy arrays — one host→device transfer per chunk,
+nothing row-at-a-time on device.
+"""
+
+from __future__ import annotations
+
+import json
+from datetime import datetime, timezone
+
+import numpy as np
+
+from risingwave_tpu.common.chunk import Chunk
+from risingwave_tpu.common.types import DataType, Schema
+
+
+def _parse_ts_us(v) -> int:
+    """Timestamp to int64 microseconds (ISO string, epoch s/ms/us)."""
+    if isinstance(v, (int, float)):
+        # heuristic magnitudes: s < 1e11, ms < 1e14, else us
+        x = float(v)
+        if abs(x) < 1e11:
+            return int(x * 1_000_000)
+        if abs(x) < 1e14:
+            return int(x * 1_000)
+        return int(x)
+    s = str(v).replace("T", " ").replace("Z", "")
+    dt = datetime.fromisoformat(s)
+    if dt.tzinfo is not None:
+        dt = dt.astimezone(timezone.utc).replace(tzinfo=None)
+    epoch = datetime(1970, 1, 1)
+    return int((dt - epoch).total_seconds() * 1_000_000)
+
+
+class JsonChunkBuilder:
+    """Accumulate parsed JSON objects into fixed-capacity chunks.
+
+    ``max_rows_per_chunk`` is the rate limit (ref chunk_builder.rs —
+    the reference throttles source chunks to ``chunk_size``); rows
+    beyond it stay queued for the next chunk.
+    """
+
+    def __init__(self, schema: Schema, max_rows_per_chunk: int = 4096):
+        self.schema = schema
+        self.max_rows = max_rows_per_chunk
+        self._rows: list[tuple] = []
+        #: malformed payloads skipped (ref parser error tolerance)
+        self.parse_errors = 0
+
+    def push_line(self, line: "str | bytes") -> bool:
+        """Parse one JSON line into the pending row queue."""
+        if isinstance(line, bytes):
+            line = line.decode("utf-8", errors="replace")
+        line = line.strip()
+        if not line:
+            return False
+        try:
+            obj = json.loads(line)
+            row = []
+            for f in self.schema:
+                v = obj.get(f.name)
+                if v is None:
+                    if not f.nullable:
+                        raise ValueError(f"missing NOT NULL {f.name}")
+                    row.append(None)
+                    continue
+                t = f.data_type
+                if t.is_string:
+                    row.append(str(v))
+                elif t in (DataType.TIMESTAMP, DataType.TIMESTAMPTZ):
+                    row.append(_parse_ts_us(v))
+                elif t in (DataType.FLOAT32, DataType.FLOAT64,
+                           DataType.DECIMAL):
+                    row.append(float(v))
+                elif t == DataType.BOOLEAN:
+                    row.append(bool(v))
+                else:
+                    row.append(int(v))
+            self._rows.append(tuple(row))
+            return True
+        except (ValueError, TypeError, json.JSONDecodeError):
+            self.parse_errors += 1
+            return False
+
+    def pending(self) -> int:
+        return len(self._rows)
+
+    def next_chunk(self, capacity: int) -> Chunk:
+        """Emit up to min(capacity, rate limit) rows as a device Chunk
+        (possibly zero valid rows — shape-static by construction)."""
+        n = min(len(self._rows), capacity, self.max_rows)
+        batch, self._rows = self._rows[:n], self._rows[n:]
+        if n == 0:
+            arrays = [np.zeros((0,), np.int64) for _ in self.schema]
+            return Chunk.from_numpy(self.schema, arrays,
+                                    capacity=capacity)
+        arrays = [
+            np.asarray([r[i] for r in batch], dtype=object)
+            if any(r[i] is None for r in batch)
+            else np.asarray([r[i] for r in batch])
+            for i in range(len(self.schema))
+        ]
+        return Chunk.from_numpy(self.schema, arrays, capacity=capacity)

@@ -100,6 +100,66 @@ _MIN = PrimState("min", _same, _minmax_init("min"), _minmax_lift("min"))
 _MAX = PrimState("max", _same, _minmax_init("max"), _minmax_lift("max"))
 
 
+# -- min/max over short strings: order-preserving uint64 packing ------------
+#
+# A fixed-width string of <= 8 bytes packs big-endian into a uint64 whose
+# unsigned order IS the byte-lexicographic order (zero-padding sorts
+# shorter prefixes first, matching SQL collation on ASCII).  Biasing the
+# sign bit maps that to SIGNED int64 order, so the scatter-min/max
+# machinery works unchanged.  (Ref: memcomparable key encoding,
+# src/common/src/util/memcmp_encoding — same trick, one word wide.)
+
+_STR8_BIAS = np.uint64(1 << 63)
+
+
+def _pack_str8(col) -> jnp.ndarray:
+    data, lens = col.data, col.lens  # [cap, w<=8], [cap]
+    cap, w = data.shape
+    j = np.arange(w)
+    shifts = jnp.asarray(((7 - j) * 8), jnp.uint64)
+    in_str = j[None, :] < lens[:, None]
+    b = jnp.where(in_str, data, 0).astype(jnp.uint64)
+    packed = jnp.sum(b << shifts[None, :], axis=1)
+    import jax
+    return jax.lax.bitcast_convert_type(
+        packed ^ _STR8_BIAS, jnp.int64
+    )
+
+
+def _minmax_str_lift(mode):
+    def lift(col, signs):
+        packed = _pack_str8(col)
+        neutral = _minmax_init(mode)(jnp.int64)
+        return jnp.where(signs > 0, packed, neutral)
+
+    return lift
+
+
+def _out_minmax_str(states, count, out_field):
+    import jax
+    v = jax.lax.bitcast_convert_type(states[0], jnp.uint64) ^ _STR8_BIAS
+    w = 8
+    j = np.arange(w)
+    shifts = jnp.asarray(((7 - j) * 8), jnp.uint64)
+    bytes_ = ((v[:, None] >> shifts[None, :])
+              & jnp.uint64(0xFF)).astype(jnp.uint8)
+    nz = bytes_ != 0
+    lens = jnp.where(
+        jnp.any(nz, axis=1),
+        w - jnp.argmax(nz[:, ::-1], axis=1), 0
+    ).astype(jnp.int32)
+    from risingwave_tpu.common.chunk import StrCol
+    return StrCol(bytes_, lens)
+
+
+_MIN_STR = PrimState(
+    "min", lambda d: jnp.int64, _minmax_init("min"), _minmax_str_lift("min")
+)
+_MAX_STR = PrimState(
+    "max", lambda d: jnp.int64, _minmax_init("max"), _minmax_str_lift("max")
+)
+
+
 @dataclass(frozen=True)
 class AggSpec:
     """A SQL aggregate = primitive states + an output combiner."""
@@ -159,6 +219,11 @@ AGG_REGISTRY: dict[str, AggSpec] = {
     "avg": AggSpec("avg", (_ADD_SUM, _ADD_COUNT), _out_avg, True, _avg_type),
     "min": AggSpec("min", (_MIN,), _out_first, False, lambda t: t),
     "max": AggSpec("max", (_MAX,), _out_first, False, lambda t: t),
+    # min/max over strings (<= 8 device bytes; planner rewrite)
+    "min_str": AggSpec("min_str", (_MIN_STR,), _out_minmax_str, False,
+                       lambda t: DataType.VARCHAR),
+    "max_str": AggSpec("max_str", (_MAX_STR,), _out_minmax_str, False,
+                       lambda t: DataType.VARCHAR),
 }
 
 
@@ -199,8 +264,12 @@ class AggCall:
             nullable = (f.nullable or self.filter is not None) \
                 and self.kind not in ("count", "count_star")
         t = spec.return_type(in_t)
+        kw = {}
+        if t.is_string:
+            # packed-string min/max emits a fixed 8-byte column
+            kw["str_width"] = 8
         return Field(self.alias or self.kind, t, decimal_scale=scale,
-                     nullable=nullable)
+                     nullable=nullable, **kw)
 
 
 def count_star(alias: str = "count") -> AggCall:

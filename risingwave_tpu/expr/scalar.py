@@ -11,6 +11,7 @@ that need logical-type context declare a trailing ``fields`` kwarg).
 
 from __future__ import annotations
 
+import re
 from typing import Sequence
 
 import jax.numpy as jnp
@@ -899,3 +900,92 @@ class ToChar(Expr):
 
     def __repr__(self):
         return f"to_char({self.arg!r}, {self.fmt!r})"
+
+
+# ---------------------------------------------------------------------------
+# regexp_match (restricted pattern family, compiled at bind time)
+
+_RX_FAMILY = re.compile(
+    # (&|^) prefix-guard, a literal, then a ([^X]*) capture
+    r"^(?:\((?P<guard>[^)|])\|\^\)|\(\^\|(?P<guard2>[^)|])\))?"
+    r"(?P<lit>[A-Za-z0-9_=:/.\-]+)"
+    r"\(\[\^(?P<stop>.)\]\*\)$"
+)
+
+
+class RegexpGroup(Expr):
+    """``(regexp_match(s, 'pat'))[n]`` for the benchmark pattern family
+    ``(&|^)literal([^X]*)``: the n-th capture (n=2 → the [^X]* run
+    after the literal, anchored at start or after the guard char).
+
+    Ref: src/expr/impl/src/scalar/regexp.rs — full regexes run a
+    backtracking engine; this subset compiles to fixed-width byte
+    kernels (match scan + bounded take), NULL when unmatched."""
+
+    def __init__(self, arg: Expr, pattern: str, group: int):
+        m = _RX_FAMILY.match(pattern)
+        if m is None:
+            raise ValueError(
+                f"regexp_match pattern {pattern!r} outside the "
+                "supported (&|^)literal([^X]*) family"
+            )
+        if group != 2:
+            raise ValueError("only capture group [2] is supported")
+        self.arg = arg
+        self.pattern = pattern
+        self.guard = m.group("guard") or m.group("guard2")
+        self.lit = m.group("lit")
+        self.stop = m.group("stop")
+
+    def return_field(self, schema) -> Field:
+        f = self.arg.return_field(schema)
+        return Field("regexp_match", DataType.VARCHAR,
+                     str_width=f.str_width, nullable=True)
+
+    def return_type(self, schema):
+        return DataType.VARCHAR
+
+    def eval(self, chunk):
+        from risingwave_tpu.common.chunk import NCol, encode_strings
+
+        s, s_null = split_col(self.arg.eval(chunk))
+        cap, w = s.data.shape
+        ld, ll = encode_strings([self.lit], max(len(self.lit), 1))
+        lit = StrCol(
+            jnp.broadcast_to(jnp.asarray(ld[0]), (cap, ld.shape[1])),
+            jnp.broadcast_to(jnp.asarray(ll[0]), (cap,)),
+        )
+        offs = jnp.broadcast_to(
+            jnp.arange(w, dtype=jnp.int32)[None, :], (cap, w)
+        )
+        hits = _match_at(s, lit, offs) & (
+            offs <= (s.lens - len(self.lit))[:, None]
+        )
+        if self.guard is not None:
+            prev_idx = jnp.clip(offs - 1, 0, w - 1)
+            prev = jnp.take_along_axis(s.data, prev_idx, axis=1)
+            guarded = (offs == 0) | (prev == ord(self.guard))
+            hits = hits & guarded
+        found = jnp.any(hits, axis=1)
+        first = jnp.argmax(hits, axis=1).astype(jnp.int32)
+        start = first + len(self.lit)
+        # capture runs until the stop char (or end of string)
+        idx = jnp.arange(w, dtype=jnp.int32)[None, :]
+        src = jnp.clip(idx + start[:, None], 0, w - 1)
+        shifted = jnp.take_along_axis(s.data, src, axis=1)
+        in_str = (idx + start[:, None]) < s.lens[:, None]
+        is_stop = (shifted == ord(self.stop)) & in_str
+        # length = first stop position (or remaining length)
+        any_stop = jnp.any(is_stop, axis=1)
+        stop_at = jnp.argmax(is_stop, axis=1).astype(jnp.int32)
+        lens = jnp.where(
+            any_stop, stop_at,
+            jnp.maximum(s.lens - start, 0),
+        )
+        lens = jnp.where(found, jnp.maximum(lens, 0), 0)
+        data = jnp.where(idx < lens[:, None], shifted, 0).astype(jnp.uint8)
+        null = ~found if s_null is None else (~found | s_null)
+        return NCol(StrCol(data, lens), null)
+
+    def __repr__(self):
+        return f"regexp_match({self.arg!r}, {self.pattern!r})[2]"

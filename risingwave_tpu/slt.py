@@ -81,10 +81,17 @@ def _render(engine, rows) -> list[tuple]:
     def fmt_ts(us):
         if us is None:
             return None
-        dt = datetime(1970, 1, 1) + timedelta(microseconds=int(us))
-        s = dt.isoformat(sep=" ")
-        if "." in s:
-            s = s.rstrip("0").rstrip(".")
+        us = int(us)
+        dt = datetime(1970, 1, 1) + timedelta(microseconds=us)
+        s = dt.replace(microsecond=0).isoformat(sep=" ")
+        # fractional seconds render in millisecond groups like the
+        # reference ('00:00:20.210', not pg's trimmed '.21'); micro
+        # precision extends to 6 digits
+        frac = us % 1_000_000
+        if frac:
+            if frac % 1000 == 0:
+                return f"{s}.{frac // 1000:03d}"
+            return f"{s}.{frac:06d}"
         return s
 
     def fmt_date(days):

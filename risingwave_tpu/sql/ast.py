@@ -107,6 +107,34 @@ class SubqueryRef:
 
 
 @dataclass(frozen=True)
+class CreateFunction:
+    """CREATE FUNCTION ... LANGUAGE SQL — inlined at plan time (the
+    reference compiles SQL UDFs by inlining too: expr/impl udf)."""
+
+    name: str
+    params: tuple           # parameter names, positional
+    body_sql: str           # "SELECT <expr>"
+    if_not_exists: bool = False
+
+
+@dataclass(frozen=True)
+class InSubquery:
+    """``expr [NOT] IN (SELECT ...)`` — planned as a semi/anti join."""
+
+    expr: object
+    select: "Select"
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class ScalarSubquery:
+    """``(SELECT <single aggregate row>)`` in a comparison — planned as
+    a dynamic filter against the subquery's 1-row changelog."""
+
+    select: "Select"
+
+
+@dataclass(frozen=True)
 class Tumble:
     """TUMBLE(table, time_col, interval) table function in FROM."""
 

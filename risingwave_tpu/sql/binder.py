@@ -115,6 +115,13 @@ class Binder:
                 return self._bind_like(e)
             if e.name == "to_char":
                 return self._bind_to_char(e)
+            if e.name == "array_index":
+                return self._bind_array_index(e)
+            if e.name == "regexp_match":
+                raise BindError(
+                    "regexp_match is supported only as "
+                    "(regexp_match(s, 'pat'))[n]"
+                )
             args = tuple(self.bind(a) for a in e.args)
             # untyped NULL literals adopt the type of a typed sibling
             # (COALESCE(x, NULL), CASE branches, IS NULL over NULL...)
@@ -173,13 +180,35 @@ class Binder:
             raise BindError(f"to_char over {t.name} not supported")
         return ToChar(arg, fmt.value)
 
+    def _bind_array_index(self, e: ast.FuncCall) -> Expr:
+        """Array subscripts exist only for regexp_match captures this
+        round: ``(regexp_match(s, 'pat'))[n]`` compiles to a bounded
+        byte kernel (scalar.RegexpGroup)."""
+        from risingwave_tpu.expr.scalar import RegexpGroup
+
+        target, idx = e.args
+        if not (isinstance(target, ast.FuncCall)
+                and target.name == "regexp_match"):
+            raise BindError(
+                "array subscripts are supported on regexp_match only"
+            )
+        if len(target.args) != 2:
+            raise BindError("regexp_match takes (string, pattern)")
+        pat = target.args[1]
+        if not (isinstance(pat, ast.Literal)
+                and pat.type_name == "string"):
+            raise BindError("regexp_match requires a literal pattern")
+        arg = self.bind(target.args[0])
+        try:
+            return RegexpGroup(arg, pat.value, idx.value)
+        except ValueError as err:
+            raise BindError(str(err))
+
     def _bind_agg(self, e: ast.FuncCall) -> Expr:
         if not self.allow_aggs:
             raise BindError(f"aggregate {e.name} not allowed here")
-        if e.distinct and e.name not in ("count", "sum"):
-            raise BindError(
-                f"DISTINCT {e.name} not yet supported (count/sum only)"
-            )
+        # DISTINCT composes for every kind: count/sum/avg states update
+        # on dedup transitions; min/max are distinct-insensitive
         filt = None
         if e.filter_where is not None:
             # the filter predicate binds against the agg INPUT scope

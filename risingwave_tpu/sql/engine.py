@@ -19,7 +19,7 @@ from typing import Any, Sequence
 
 import numpy as np
 
-from risingwave_tpu.common.chunk import Chunk
+from risingwave_tpu.common.chunk import Chunk, split_col
 from risingwave_tpu.common.config import RwConfig, SessionConfig, SystemParams
 from risingwave_tpu.common.metrics import MetricsRegistry
 from risingwave_tpu.common.types import DataType, Field, Schema
@@ -45,6 +45,63 @@ from risingwave_tpu.sql.planner import (
 )
 from risingwave_tpu.stream.dag import DagJob, FragNode, JoinNode
 from risingwave_tpu.stream.runtime import StreamingJob
+
+
+def _ast_map(node, fn):
+    """Bottom-up structural map over the (frozen-dataclass) SQL AST."""
+    import dataclasses
+
+    if dataclasses.is_dataclass(node) and not isinstance(node, type):
+        changed = {}
+        for f in dataclasses.fields(node):
+            v = getattr(node, f.name)
+            nv = _ast_map(v, fn)
+            if nv is not v:
+                changed[f.name] = nv
+        if changed:
+            node = dataclasses.replace(node, **changed)
+        return fn(node)
+    if isinstance(node, tuple):
+        mapped = tuple(_ast_map(x, fn) for x in node)
+        return mapped if any(m is not x for m, x in zip(mapped, node)) \
+            else node
+    if isinstance(node, list):
+        mapped = [_ast_map(x, fn) for x in node]
+        return mapped if any(m is not x for m, x in zip(mapped, node)) \
+            else node
+    return node
+
+
+def inline_udfs(stmt, udfs: dict, depth: int = 0):
+    """Expand SQL-UDF calls by AST substitution (the reference inlines
+    SQL UDFs in the frontend binder the same way)."""
+    if not udfs:
+        return stmt
+    if depth > 8:
+        raise ValueError("SQL UDF recursion exceeds depth 8")
+
+    def expand(node):
+        if not isinstance(node, ast.FuncCall) or node.name not in udfs:
+            return node
+        params, body = udfs[node.name]
+        if len(node.args) != len(params):
+            raise ValueError(
+                f"{node.name} takes {len(params)} arguments, "
+                f"got {len(node.args)}"
+            )
+        sub = dict(zip(params, node.args))
+
+        def substitute(n):
+            if isinstance(n, ast.ColumnRef) and n.table is None \
+                    and n.name in sub:
+                return sub[n.name]
+            return n
+
+        expanded = _ast_map(body, substitute)
+        # the body may itself call UDFs
+        return inline_udfs(expanded, udfs, depth + 1)
+
+    return _ast_map(stmt, expand)
 
 
 def _join_exchange_keys(key_exprs, chunk):
@@ -97,6 +154,9 @@ class Engine:
         # dead engine's counters for same-named jobs
         self.metrics = MetricsRegistry()
         self.checkpoint_store = None
+        #: SQL UDFs: name -> (param names, body expr AST), inlined at
+        #: parse time (ref: frontend SQL-UDF inlining)
+        self.functions: dict[str, tuple] = {}
         if data_dir is not None:
             from risingwave_tpu.storage import CheckpointStore
             self.checkpoint_store = CheckpointStore(
@@ -109,8 +169,28 @@ class Engine:
         """Run one or more statements; returns the last result."""
         result = None
         for stmt in parse(sql):
-            result = self._execute_one(stmt)
+            if isinstance(stmt, ast.CreateFunction):
+                result = self._create_function(stmt)
+                continue
+            result = self._execute_one(inline_udfs(stmt, self.functions))
         return result
+
+    def _create_function(self, stmt: ast.CreateFunction):
+        """Register a SQL UDF (ref: frontend SQL UDF inlining)."""
+        if stmt.name in self.functions:
+            if stmt.if_not_exists:
+                return None
+            raise ValueError(f"function {stmt.name!r} already exists")
+        body = parse(stmt.body_sql)
+        if len(body) != 1 or not isinstance(body[0], ast.Select) \
+                or body[0].from_ is not None or len(body[0].items) != 1:
+            raise ValueError(
+                "SQL UDF body must be a single SELECT <expr>"
+            )
+        self.functions[stmt.name] = (
+            tuple(stmt.params), body[0].items[0].expr
+        )
+        return None
 
     def query(self, sql: str):
         """Run statements; returns (column_names, rows) for wire clients."""
@@ -270,9 +350,13 @@ class Engine:
             entry = self._nexmark_source(stmt)
         elif connector == "datagen":
             entry = self._datagen_source(stmt)
+        elif connector == "filetail":
+            entry = self._filetail_source(stmt)
         else:
-            raise ValueError(f"unsupported connector {connector!r} "
-                             "(nexmark, datagen available this round)")
+            raise ValueError(
+                f"unsupported connector {connector!r} "
+                "(nexmark, datagen, filetail available this round)"
+            )
         self.catalog.create(entry, stmt.if_not_exists)
         return None
 
@@ -362,6 +446,34 @@ class Engine:
             stmt.name, "source", schema, reader_factory=factory,
             watermark=wm, append_only=True, definition=str(stmt),
             dml=dml, stream_key=pk,
+        )
+
+    def _filetail_source(self, stmt: ast.CreateSource) -> CatalogEntry:
+        """External JSONL source tailed from disk (ref SplitReader +
+        JSON parser, src/connector/src/source/base.rs:596)."""
+        from risingwave_tpu.connector.file_source import FileTailSplitReader
+
+        schema, wm, _ = self._declared_schema(stmt)
+        opts = stmt.with_options
+        path = opts.get("path")
+        if not path:
+            raise ValueError("filetail needs WITH (path = '...')")
+        fmt = opts.get("format", "json")
+        if fmt != "json":
+            raise ValueError(f"filetail format {fmt!r} (json only)")
+        cap = self.config.chunk_capacity
+        rate = int(opts.get("rate.limit", cap))
+
+        def factory(split_id: int = 0, num_splits: int = 1):
+            return FileTailSplitReader(
+                path, schema, chunk_capacity=cap,
+                split_id=split_id, num_splits=num_splits,
+                max_rows_per_chunk=rate,
+            )
+
+        return CatalogEntry(
+            stmt.name, "source", schema, reader_factory=factory,
+            watermark=wm, append_only=True, definition=str(stmt),
         )
 
     def _datagen_source(self, stmt: ast.CreateSource) -> CatalogEntry:
@@ -693,9 +805,20 @@ class Engine:
         prefix = execs[:agg_idx]
         if any(not isinstance(ex, (_F, _H, _P, _W)) for ex in prefix):
             return None
-        # suffix after the agg: only per-key-safe operators (a TopN or
-        # sink here would compute per-SHARD results — stays linear)
+        # suffix after the agg: per-key-safe operators, plus a GLOBAL
+        # TopN (group_by == []) — each shard keeps its own top-k band,
+        # a guaranteed superset of the global top-k, and the serving
+        # read applies the final order+limit over the merged shards
+        # (ref: per-actor TopN + singleton merge, executor/top_n/; the
+        # merge here rides the serving boundary instead of a singleton
+        # fragment).  Sinks stay linear (host delivery ordering).
+        from risingwave_tpu.stream.top_n import GroupTopNExecutor as _T
+        topn_spec = None
         for ex in execs[agg_idx + 1:]:
+            if isinstance(ex, _T) and not ex.group_by \
+                    and ex.rank_alias is None:
+                topn_spec = (ex.order_by, ex.limit, ex.offset)
+                continue
             if not isinstance(ex, (_F, _P, _M, _AOM)):
                 return None
         agg = execs[agg_idx]
@@ -721,6 +844,7 @@ class Engine:
         # global row_count counts partial rows) — append-only plans only
         if plan.append_only and all(
             a.kind in TWO_PHASE_KINDS and a.filter is None
+            and not a.distinct
             for a in agg.aggs
         ):
             partial = PartialAggExecutor(
@@ -746,6 +870,25 @@ class Engine:
             exchange_key_fn = (
                 lambda c, k=n_keys: [c.column(i) for i in range(k)]
             )
+        # spill-to-host draining isn't wired for the sharded runtime
+        # yet: overflow stays a loud error there (next round: per-shard
+        # rings drained via a gathered readback)
+        for ex in keyed_execs:
+            if getattr(ex, "spill_ring", 0):
+                ex.spill_ring = 0
+        if topn_spec is not None:
+            # per-shard band must cover GLOBAL rank offset+limit (a
+            # globally rank-o row may rank 0 on its shard)
+            order_by, limit, offset = topn_spec
+            keyed_execs = [
+                _T(ex.in_schema, group_by=[], order_by=ex.order_by,
+                   limit=limit + offset, offset=0,
+                   pool_size=ex.pool_size,
+                   emit_capacity=ex.emit_capacity,
+                   append_only=ex.append_only)
+                if isinstance(ex, _T) and not ex.group_by else ex
+                for ex in keyed_execs
+            ]
         sharded = ShardedJob(
             mesh,
             source_fn=reader.impl,
@@ -762,6 +905,10 @@ class Engine:
         # index into the SHARDED executor list (the two-phase rewrite
         # inserts a partial agg, shifting positions vs the linear plan)
         terminal = keyed_execs[-1]
+        if topn_spec is not None:
+            # the serving read applies the GLOBAL order+limit over the
+            # merged per-shard bands
+            terminal.serving_topn = topn_spec
         return job, terminal, (len(local_execs) + len(keyed_execs) - 1,)
 
     def _try_sharded_dag_plan(self, plan: DagPlan, name: str, par: int,
@@ -858,6 +1005,9 @@ class Engine:
                 return None
             raise ValueError(f"{stmt.name!r} already exists")
         self._refresh_dml_widths()
+        self.planner.parallel_hint = int(
+            self.session_config.get("streaming_parallelism")
+        )
         plan = self.planner.plan(stmt.query,
                                  eowc=stmt.emit_on_window_close)
         job, mv_exec, state_index, dag_meta, is_new = self._build_job(
@@ -893,6 +1043,9 @@ class Engine:
             )
         sink = create_sink(stmt.with_options)
         self._refresh_dml_widths()
+        self.planner.parallel_hint = int(
+            self.session_config.get("streaming_parallelism")
+        )
         plan = self.planner.plan(query, sink=sink)
         job, sink_exec, _, dag_meta, is_new = self._build_job(
             plan, stmt.name
@@ -1194,6 +1347,52 @@ class Engine:
             state = state[i]
         return entry.mv_executor.to_host(state)
 
+    @staticmethod
+    def _order_permutation(chunk, order_by, n_rows: int) -> list[int]:
+        """Stable multi-key sort permutation over a host-built chunk.
+
+        Keys evaluate in ORIGINAL row order (the permutation indexes
+        original rows, so every pass stays aligned); NULLs sort last
+        for ASC (pg default), first for DESC."""
+        from risingwave_tpu.common.chunk import StrCol, decode_strings
+
+        perm = list(range(n_rows))
+        vis = np.asarray(chunk.valid)
+        for e, desc in reversed(list(order_by)):
+            vals, vals_null = split_col(e.eval(chunk))
+            if isinstance(vals, StrCol):
+                host = decode_strings(
+                    np.asarray(vals.data)[vis], np.asarray(vals.lens)[vis]
+                ).tolist()
+            else:
+                host = np.asarray(vals)[vis].tolist()
+            if vals_null is not None:
+                nulls = np.asarray(vals_null)[vis].tolist()
+                z = type(host[0])() if host else 0
+                host = [(True, z) if nul else (False, v)
+                        for v, nul in zip(host, nulls)]
+            perm.sort(key=lambda i: host[i], reverse=desc)
+        return perm
+
+    def _apply_serving_topn(self, entry: CatalogEntry, rows: list):
+        """Global order+limit over a sharded TopN MV's merged bands.
+
+        Each shard's band is a superset slice of the global top-k; the
+        serving boundary is the singleton merge (ref top_n singleton
+        fragments)."""
+        spec = getattr(entry.mv_executor, "serving_topn", None)
+        if spec is None or not rows:
+            return rows
+        order_by, limit, offset = spec
+        schema = entry.mv_executor.in_schema
+        arrays = [np.asarray([r[i] for r in rows])
+                  for i in range(len(schema))]
+        chunk = Chunk.from_numpy(schema, arrays, capacity=len(rows))
+        perm = self._order_permutation(chunk, order_by, len(rows))
+        rows = [rows[i] for i in perm]
+        end = None if limit is None else offset + limit
+        return rows[offset:end]
+
     def _serve(self, select: ast.Select):
         """Batch read over a materialized view (local execution mode)."""
         if not isinstance(select.from_, ast.TableRef):
@@ -1203,6 +1402,7 @@ class Engine:
             raise PlanError("serving reads are over materialized views; "
                             "streaming queries use CREATE MATERIALIZED VIEW")
         rows = self._mv_rows(entry)
+        rows = self._apply_serving_topn(entry, rows)
         schema = entry.schema
         # rebuild a host chunk and evaluate the residual query eagerly
         if rows:
@@ -1240,28 +1440,16 @@ class Engine:
         if select.order_by:
             out_scope = Scope.of(out_chunk.schema)
             ob = Binder(out_scope)
-            for oi in reversed(select.order_by):
-                key = self.planner._bind_order_key(
+            order_by = [
+                (self.planner._bind_order_key(
                     oi.expr, ob, out_chunk.schema
-                )
-                kchunk = out_chunk  # keys evaluate over the output rows
-                vals = key.eval(kchunk)
-                from risingwave_tpu.common.chunk import StrCol, decode_strings
-                vis = np.asarray(kchunk.valid)
-                if isinstance(vals, StrCol):
-                    host = decode_strings(
-                        np.asarray(vals.data)[vis], np.asarray(vals.lens)[vis]
-                    ).tolist()
-                else:
-                    host = np.asarray(vals)[vis].tolist()
-                order = sorted(
-                    range(len(result)), key=lambda i: host[i],
-                    reverse=oi.descending,
-                )
-                result = [result[i] for i in order]
-                # keep key/rows aligned for the next (outer) key pass
-                host_sorted = [host[i] for i in order]
-                host = host_sorted
+                ), oi.descending)
+                for oi in select.order_by
+            ]
+            perm = self._order_permutation(
+                out_chunk, order_by, len(result)
+            )
+            result = [result[i] for i in perm]
         if select.offset:
             result = result[select.offset:]
         if select.limit is not None:

@@ -19,11 +19,12 @@ _TOKEN_RE = re.compile(
   | (?P<comment>--[^\n]*)
   | (?P<number>\d+\.\d+|\.\d+|\d+)
   | (?P<string>'(?:[^']|'')*')
+  | (?P<dollar>\$(?P<dtag>[A-Za-z_]*)\$.*?\$(?P=dtag)\$)
   | (?P<cast>::)
-  | (?P<op><=|>=|<>|!=|\|\||[-+*/%<>=(),.;])
+  | (?P<op><=|>=|<>|!=|\|\||[-+*/%<>=(),.;\[\]])
   | (?P<ident>[A-Za-z_][A-Za-z0-9_]*|"[^"]+")
     """,
-    re.VERBOSE,
+    re.VERBOSE | re.DOTALL,
 )
 
 _INTERVAL_UNITS = {
@@ -54,6 +55,12 @@ def tokenize(sql: str) -> list[Token]:
         if not m:
             raise ParseError(f"unexpected character {sql[pos]!r} at {pos}")
         pos = m.end()
+        if m.group("dollar") is not None:
+            # dollar-quoted body: strip the $tag$ ... $tag$ delimiters
+            raw = m.group("dollar")
+            ntag = len(m.group("dtag")) + 2
+            out.append(Token("dollar_string", raw[ntag:-ntag]))
+            continue
         kind = m.lastgroup
         if kind in ("ws", "comment"):
             continue
@@ -289,6 +296,37 @@ class Parser:
                 self.expect_word("close")
                 eowc = True
             return ast.CreateMaterializedView(name, query, ine, eowc)
+        if self.accept_word("function"):
+            # CREATE FUNCTION f(a type, b type) RETURNS type
+            #   LANGUAGE SQL AS $$SELECT <expr>$$
+            ine = self._if_not_exists()
+            name = self.ident()
+            params: list[str] = []
+            self.expect_op("(")
+            if not (self.peek() and self.peek().value == ")"):
+                while True:
+                    params.append(self.ident())
+                    self._type_name()  # param types are documentation
+                    if not self.accept_op(","):
+                        break
+            self.expect_op(")")
+            self.expect_word("returns")
+            self._type_name()
+            self.expect_word("language")
+            lang = self.ident()
+            if lang != "sql":
+                raise ParseError(
+                    f"LANGUAGE {lang} not supported (SQL UDFs only)"
+                )
+            self.expect_word("as")
+            t = self.next()
+            if t.kind == "dollar_string":
+                body_sql = t.value
+            elif t.kind == "string":
+                body_sql = t.value[1:-1].replace("''", "'")
+            else:
+                raise ParseError("expected a quoted function body")
+            return ast.CreateFunction(name, tuple(params), body_sql, ine)
         raise ParseError("expected SOURCE, TABLE or MATERIALIZED VIEW")
 
     def _with_options(self) -> dict:
@@ -561,6 +599,11 @@ class Parser:
             )
         elif w == "in":
             self.expect_op("(")
+            t = self.peek()
+            if t and t.kind == "word" and t.value == "select":
+                sub = self._select()
+                self.expect_op(")")
+                return ast.InSubquery(left, sub, negated=negate)
             items = [self._expr()]
             while self.accept_op(","):
                 items.append(self._expr())
@@ -590,9 +633,23 @@ class Parser:
         return self._postfix(self._primary())
 
     def _postfix(self, e):
-        while self.accept_op("::"):
-            e = ast.Cast(e, self._type_name())
-        return e
+        while True:
+            if self.accept_op("::"):
+                e = ast.Cast(e, self._type_name())
+                continue
+            if self.accept_op("["):
+                t = self.next()
+                if t.kind != "number" or not t.value.isdigit():
+                    raise ParseError(
+                        "only literal integer array subscripts are "
+                        "supported"
+                    )
+                self.expect_op("]")
+                e = ast.FuncCall(
+                    "array_index", (e, ast.Literal(int(t.value), "int"))
+                )
+                continue
+            return e
 
     def _primary(self):
         t = self.next()
@@ -603,6 +660,11 @@ class Parser:
         if t.kind == "string":
             return ast.Literal(t.value[1:-1].replace("''", "'"), "string")
         if t.kind == "op" and t.value == "(":
+            nxt = self.peek()
+            if nxt and nxt.kind == "word" and nxt.value == "select":
+                sub = self._select()
+                self.expect_op(")")
+                return ast.ScalarSubquery(sub)
             e = self._expr()
             self.expect_op(")")
             return e

@@ -57,6 +57,8 @@ class PlannedInput:
     watermark_col: int | None    # col idx in `schema` carrying event time
     window_size: int | None      # tumble/hop size (for cleaning lag)
     append_only: bool
+    #: hop slide (== window_size for tumble; None when unwindowed)
+    window_slide: "int | None" = None
     #: column positions uniquely identifying a row of this input's
     #: changelog (the reference's *stream key*) — required to key the
     #: materialization of retractable non-agg plans
@@ -139,6 +141,15 @@ class PlannerConfig:
     chunk_capacity: int = 4096
     #: per-group value capacity for retractable min/max (ref minput.rs)
     minput_bucket_cap: int = 64
+    #: dedup-table size per DISTINCT agg call (None = agg_table_size);
+    #: sized for groups x distinct values, not groups
+    distinct_table_size: "int | None" = None
+    #: overflow-row ring capacity for non-windowed aggs (None = 4x
+    #: chunk_capacity; 0 disables spill-to-host — overflow is then a
+    #: loud error)
+    agg_spill_ring: "int | None" = None
+    #: host-tier table size (None = 8x agg_table_size)
+    agg_spill_table_size: "int | None" = None
 
 
 class Planner:
@@ -146,6 +157,10 @@ class Planner:
                  config: PlannerConfig | None = None):
         self.catalog = catalog
         self.config = config or PlannerConfig()
+        #: session streaming_parallelism at plan time (engine-set):
+        #: >1 keeps plans in shapes the sharded runtime can take over
+        #: (the pane rewrite produces a 2-agg chain it can't, yet)
+        self.parallel_hint = 1
 
     # ------------------------------------------------------------------
     def plan(self, select: ast.Select, sink=None, eowc: bool = False,
@@ -166,6 +181,7 @@ class Planner:
                 inner, spec = rewritten
                 return self.plan(inner, sink=sink, eowc=eowc,
                                  group_topn=spec)
+        select = self._rewrite_in_subqueries(select)
 
         if isinstance(select.from_, ast.Join) or has_subquery(select.from_):
             if eowc:
@@ -184,6 +200,49 @@ class Planner:
                 mv_node=0, mv_index=plan.mv_index,
             )
         return plan
+
+    # -- IN (SELECT ...) rewrite ----------------------------------------
+    def _rewrite_in_subqueries(self, select: ast.Select) -> ast.Select:
+        """``x [NOT] IN (SELECT c FROM ...)`` conjuncts become semi/anti
+        joins against the subquery (ref: the reference's apply-to-join
+        subquery unnesting, optimizer/rule/ — RisingWave plans the same
+        shape as StreamHashJoin LeftSemi/LeftAnti).
+
+        NOTE NULL semantics: ``NOT IN`` with NULLs in the subquery is
+        three-valued in SQL (never true); the anti join here treats
+        NULL keys as non-matching.  The benchmark columns are NOT NULL.
+        """
+        if select.where is None:
+            return select
+        conjs = self._conjuncts(select.where)
+        ins = [c for c in conjs if isinstance(c, ast.InSubquery)]
+        if not ins:
+            return select
+        rest = [c for c in conjs if not isinstance(c, ast.InSubquery)]
+        from_ = select.from_
+        for k, c in enumerate(ins):
+            sub = c.select
+            if len(sub.items) != 1 or isinstance(sub.items[0].expr,
+                                                 ast.Star):
+                raise PlanError(
+                    "IN subquery must select exactly one column"
+                )
+            alias = f"_in_sq{k}"
+            col_name = sub.items[0].alias or self._default_name(
+                sub.items[0].expr, 0
+            )
+            from_ = ast.Join(
+                left=from_,
+                right=ast.SubqueryRef(sub, alias),
+                on=ast.BinaryOp("equal", c.expr,
+                                ast.ColumnRef(col_name, alias)),
+                kind="anti" if c.negated else "semi",
+            )
+        where = None
+        for r in rest:
+            where = r if where is None else ast.BinaryOp("and", where, r)
+        import dataclasses
+        return dataclasses.replace(select, from_=from_, where=where)
 
     # -- GroupTopN (row_number-in-subquery) rewrite ---------------------
     def _match_group_topn(self, select: ast.Select):
@@ -210,7 +269,7 @@ class Planner:
         limit = offset = None
         rest: list = []
         for c in self._conjuncts(select.where):
-            lo = self._rank_bound(c, rank_name)
+            lo = self._rank_bound(c, rank_name, f.alias)
             if lo is not None and limit is None:
                 limit, offset = lo
             else:
@@ -264,20 +323,23 @@ class Planner:
         return inner2, spec
 
     @staticmethod
-    def _rank_bound(c, rank_name: str):
+    def _rank_bound(c, rank_name: str, alias: "str | None" = None):
         """rn <= k / rn < k / rn = k / k >= rn → (limit, offset)."""
+        def is_rank(e) -> bool:
+            return (isinstance(e, ast.ColumnRef) and e.name == rank_name
+                    and e.table in (None, alias))
+
         if not isinstance(c, ast.BinaryOp):
             return None
         op, left, right = c.op, c.left, c.right
-        if isinstance(right, ast.ColumnRef) and right.name == rank_name:
+        if is_rank(right):
             flip = {"greater_than_or_equal": "less_than_or_equal",
                     "greater_than": "less_than",
                     "equal": "equal"}.get(op)
             if flip is None:
                 return None
             op, left, right = flip, right, left
-        if not (isinstance(left, ast.ColumnRef) and left.name == rank_name
-                and left.table is None
+        if not (is_rank(left)
                 and isinstance(right, ast.Literal)
                 and right.type_name == "int"):
             return None
@@ -365,7 +427,7 @@ class Planner:
             return PlannedInput(
                 inner.reader, inner.executors + [hop], scope,
                 hop.out_schema, inner.watermark_col, size,
-                inner.append_only,
+                inner.append_only, window_slide=slide,
             )
         raise PlanError(f"unsupported FROM clause {from_!r}")
 
@@ -426,9 +488,13 @@ class Planner:
         pk_positions: list[int] = []
         gtn = None
         if has_agg:
-            execs2, out_schema, pk_positions = self._plan_agg(
-                select, scope, pin, eowc
-            )
+            pane = self._try_pane_agg(select, scope, pin, execs, eowc)
+            if pane is not None:
+                execs2, out_schema, pk_positions = pane
+            else:
+                execs2, out_schema, pk_positions = self._plan_agg(
+                    select, scope, pin, eowc
+                )
             execs.extend(execs2)
         else:
             items = self._expand_items(select.items, scope)
@@ -461,35 +527,21 @@ class Planner:
         return UnaryPlan(pin.reader, Fragment(execs), len(execs) - 1,
                          append_only=pin.append_only)
 
-    def _plan_over_window(self, select: ast.Select, pin, execs,
-                          scope) -> UnaryPlan:
-        """SELECT items with fn() OVER (...): one OverWindowExecutor.
-
-        All window calls must share one OVER clause this round (the
-        reference groups calls per window spec the same way)."""
+    def _build_over_window(self, items, scope: Scope, execs: list):
+        """Append an OverWindowExecutor + post-projection for SELECT
+        items containing fn() OVER (...) calls (one shared OVER clause).
+        Returns the projected out_schema."""
         from risingwave_tpu.stream.over_window import (
             OverWindowExecutor,
             WindowFuncCall,
         )
 
-        if (select.group_by or select.having is not None
-                or select.order_by or select.limit is not None
-                or select.offset):
-            raise PlanError(
-                "window functions with GROUP BY/HAVING/ORDER BY/LIMIT "
-                "in one SELECT: next round"
-            )
-        witems = [(item, item.expr) for item in select.items
+        witems = [(item, item.expr) for item in items
                   if isinstance(item.expr, ast.WindowCall)]
-        if any(w.frame is not None for _, w in witems):
-            # parsed but not yet executed: reject loudly rather than
-            # silently computing the default frame
-            raise PlanError(
-                "ROWS BETWEEN window frames: next round"
-            )
-        spec = (witems[0][1].partition_by, witems[0][1].order_by)
+        spec = (witems[0][1].partition_by, witems[0][1].order_by,
+                witems[0][1].frame)
         for _, w in witems[1:]:
-            if (w.partition_by, w.order_by) != spec:
+            if (w.partition_by, w.order_by, w.frame) != spec:
                 raise PlanError(
                     "all window calls must share one OVER clause "
                     "(multi-spec plans: next round)"
@@ -505,11 +557,22 @@ class Planner:
                 )
         calls = []
         supported = {"row_number", "rank", "dense_rank", "lag", "lead",
-                     "sum", "count", "min", "max"}
-        needs_arg = {"lag", "lead", "sum", "min", "max"}
+                     "sum", "count", "avg", "min", "max"}
+        needs_arg = {"lag", "lead", "sum", "avg", "min", "max"}
+        framable = {"sum", "count", "avg"}
         for idx, (item, w) in enumerate(witems):
             if w.name not in supported:
                 raise PlanError(f"window function {w.name} not supported")
+            if w.frame is not None:
+                if w.name not in framable:
+                    raise PlanError(
+                        f"ROWS frames on {w.name}() OVER: next round"
+                    )
+                if w.frame[1] != 0 or w.frame[0] < 0:
+                    raise PlanError(
+                        "only ROWS BETWEEN n PRECEDING AND CURRENT ROW "
+                        "frames are supported"
+                    )
             if w.name in needs_arg and (
                 not w.args or isinstance(w.args[0], ast.Star)
             ):
@@ -531,6 +594,7 @@ class Planner:
             calls.append(WindowFuncCall(
                 w.name, arg, offset,
                 item.alias or f"{w.name}{idx}",
+                frame=w.frame,
             ))
         ow = OverWindowExecutor(
             scope.schema, partition, order, calls,
@@ -547,19 +611,36 @@ class Planner:
         post_b = Binder(Scope(out_schema,
                               tuple(scope.qualifiers)
                               + tuple(None for _ in calls)))
-        for idx, item in enumerate(select.items):
+        for idx, item in enumerate(items):
             if isinstance(item.expr, ast.WindowCall):
                 name = item.alias or calls[wi].alias
                 proj.append((name, InputRef(n_in + wi)))
                 wi += 1
             elif isinstance(item.expr, ast.Star):
                 for ci, f in enumerate(scope.schema):
+                    if f.name.startswith("_hidden_"):
+                        continue
                     proj.append((f.name, InputRef(ci)))
             else:
                 name = item.alias or self._default_name(item.expr, idx)
                 proj.append((name, post_b.bind(item.expr)))
         execs.append(ProjectExecutor(out_schema, proj))
-        out_schema = execs[-1].out_schema
+        return execs[-1].out_schema
+
+    def _plan_over_window(self, select: ast.Select, pin, execs,
+                          scope) -> UnaryPlan:
+        """SELECT items with fn() OVER (...): one OverWindowExecutor.
+
+        All window calls must share one OVER clause this round (the
+        reference groups calls per window spec the same way)."""
+        if (select.group_by or select.having is not None
+                or select.order_by or select.limit is not None
+                or select.offset):
+            raise PlanError(
+                "window functions with GROUP BY/HAVING/ORDER BY/LIMIT "
+                "in one SELECT: next round"
+            )
+        out_schema = self._build_over_window(select.items, scope, execs)
         execs.append(MaterializeExecutor(
             out_schema, pk_indices=list(range(len(out_schema))),
             table_size=self.config.mv_table_size,
@@ -600,10 +681,18 @@ class Planner:
                 execs.append(FilterExecutor(
                     out_schema, Binder(scope2).bind(c)
                 ))
-            items = self._expand_items(spec.outer_items, scope2)
-            proj2 = [(nm, Binder(scope2).bind(e)) for nm, e in items]
-            execs.append(ProjectExecutor(out_schema, proj2))
-            out_schema = execs[-1].out_schema
+            if any(isinstance(it.expr, ast.WindowCall)
+                   for it in spec.outer_items):
+                # q6 shape: fn() OVER (...) over the group-topn output
+                out_schema = self._build_over_window(
+                    spec.outer_items, scope2, execs
+                )
+            else:
+                items = self._expand_items(spec.outer_items, scope2)
+                proj2 = [(nm, Binder(scope2).bind(e))
+                         for nm, e in items]
+                execs.append(ProjectExecutor(out_schema, proj2))
+                out_schema = execs[-1].out_schema
             # group-topn output is retractable, keyed by the whole row
             input_append_only = False
             pk_positions = list(range(len(out_schema)))
@@ -698,7 +787,12 @@ class Planner:
                    if not isinstance(i.expr, ast.Star))
 
     def _plan_agg(self, select: ast.Select, scope: Scope,
-                  pin: PlannedInput, eowc: bool = False):
+                  pin: PlannedInput, eowc: bool = False,
+                  extra_out: "list | None" = None):
+        """Plan the aggregation chain; with ``extra_out`` (AST exprs in
+        the input scope, aggregates allowed) their values are appended
+        to the output as hidden columns and their positions returned
+        as a 4th element (the dynamic-filter LHS hook)."""
         cfg = self.config
         group_asts = list(select.group_by)
         in_binder = Binder(scope)
@@ -726,6 +820,10 @@ class Planner:
         if select.having is not None:
             having_expr = item_binder.bind(select.having)
             agg_calls = item_binder.agg_calls
+        extra_bound: list[Expr] = []
+        for e_ast in (extra_out or []):
+            extra_bound.append(item_binder.bind(e_ast))
+            agg_calls = item_binder.agg_calls
 
         # watermark-driven cleaning when a group key is the window start
         wm_idx = None
@@ -744,48 +842,40 @@ class Planner:
                 "watermarked windowed source"
             )
         execs: list[Executor] = []
-        distinct_calls = [a for a in agg_calls if a.distinct]
-        if distinct_calls:
-            # DISTINCT via dedup-before-agg (ref distinct dedup tables):
-            # drop duplicate (group keys..., arg) rows, then aggregate.
-            # Exact for append-only inputs; retractable distinct needs
-            # per-key counted dedup state (next round).
-            if not pin.append_only:
-                raise PlanError(
-                    "DISTINCT aggregates over retractable inputs: "
-                    "next round"
-                )
-            first_arg = distinct_calls[0].arg
-            if len(distinct_calls) != len(agg_calls) or any(
-                not self._expr_eq(a.arg, first_arg)
-                for a in distinct_calls[1:]
-            ):
-                raise PlanError(
-                    "mixing DISTINCT and plain aggregates (or multiple "
-                    "distinct args) needs the expand rewrite: next round"
-                )
-            if any(a.filter is not None for a in distinct_calls):
-                # dedup-before-agg collapses rows ACROSS filter
-                # predicates — a per-filter distinct needs counted dedup
-                # state (ref distinct.rs)
-                raise PlanError(
-                    "DISTINCT aggregates with FILTER: next round"
-                )
+        if any(a.distinct for a in agg_calls):
+            # DISTINCT is native in the agg executor (per-call counted
+            # dedup tables, ref distinct.rs) — mixing with plain calls,
+            # per-call FILTERs, multiple distinct args, and retractable
+            # inputs all compose.  min/max are distinct-insensitive.
             import dataclasses
-
-            from risingwave_tpu.stream.top_n import AppendOnlyDedupExecutor
-            dedup_keys = [e for _, e in group_by] + [first_arg]
-            execs.append(AppendOnlyDedupExecutor(
-                scope.schema, dedup_keys,
-                table_size=cfg.agg_table_size,
-                # window-keyed DISTINCT state is evicted with the window
-                watermark_key_idx=wm_idx,
-                watermark_lag=lag,
-                watermark_src_col=pin.watermark_col,
-            ))
             agg_calls = [
-                dataclasses.replace(a, distinct=False) for a in agg_calls
+                dataclasses.replace(a, distinct=False)
+                if a.distinct and a.kind in ("min", "max") else a
+                for a in agg_calls
             ]
+        # min/max over short strings: packed-uint64 monoid (agg.py
+        # _pack_str8); wider strings need a materialized-input string
+        # state — not yet built
+        for ci, a in enumerate(agg_calls):
+            if a.kind in ("min", "max") and a.arg is not None:
+                f = a.arg.return_field(scope.schema)
+                if f.data_type.is_string:
+                    if f.str_width > 8:
+                        raise PlanError(
+                            f"{a.kind} over strings wider than 8 device "
+                            "bytes: next round"
+                        )
+                    if not pin.append_only:
+                        # packed monoid can't retract; a string minput
+                        # state hasn't been built
+                        raise PlanError(
+                            f"{a.kind} over strings on a retractable "
+                            "input: next round"
+                        )
+                    import dataclasses
+                    agg_calls[ci] = dataclasses.replace(
+                        a, kind=f"{a.kind}_str"
+                    )
         agg = HashAggExecutor(
             scope.schema, group_by, agg_calls,
             table_size=cfg.agg_table_size,
@@ -799,7 +889,19 @@ class Planner:
             # state (ref minput.rs) instead of crash-on-delete
             retractable_input=not pin.append_only,
             minput_bucket_cap=cfg.minput_bucket_cap,
+            distinct_table_size=cfg.distinct_table_size,
+            # spill-to-host for UNBOUNDED key spaces (no watermark
+            # cleaning): overflow rows divert to the host tier instead
+            # of erroring.  Windowed aggs keep overflow-as-error — their
+            # state is bounded by cleaning, and freed slots would break
+            # the tier's structural group ownership (stream/spill.py).
+            spill_ring=((cfg.agg_spill_ring
+                         if cfg.agg_spill_ring is not None
+                         else 4 * cfg.chunk_capacity)
+                        if wm_idx is None and not eowc else 0),
         )
+        agg.spill_table_size = (cfg.agg_spill_table_size
+                                or cfg.agg_table_size * 8)
         execs.append(agg)
 
         # post-projection over agg output: group keys + agg results
@@ -819,6 +921,13 @@ class Planner:
             for ki in range(len(group_by)) if ki not in selected_keys
         ]
         proj_items = rewritten + hidden
+        extra_pos: list[int] = []
+        for xi, xb in enumerate(extra_bound):
+            proj_items.append((
+                f"_hidden_dynf{xi}",
+                self._rewrite_post_agg(xb, group_by, len(group_by)),
+            ))
+            extra_pos.append(len(proj_items) - 1)
         if having_expr is not None:
             hv = self._rewrite_post_agg(having_expr, group_by, len(group_by))
             execs.append(FilterExecutor(agg.out_schema, hv))
@@ -831,7 +940,165 @@ class Planner:
                 if isinstance(e, InputRef) and e.index == ki:
                     pk_pos.append(pi)
                     break
+        if extra_out is not None:
+            return execs, post.out_schema, pk_pos, extra_pos
         return execs, post.out_schema, pk_pos
+
+    def _try_pane_agg(self, select: ast.Select, scope: Scope,
+                      pin: PlannedInput, execs: list, eowc: bool):
+        """Sliding-window (HOP) aggregation via PANES — stream slicing.
+
+        The naive hop plan expands every event into size/slide window
+        rows BEFORE aggregating (ref hop_window.rs row expansion) — a
+        k-fold tax on the agg's scatter path.  Panes aggregate ONCE per
+        event into tumbling slide-width panes, then expand only the
+        aggregated PANE DELTAS (tiny) into their k covering windows and
+        combine with translated partial-agg calls — the classic
+        pane/stream-slicing optimization, done with the two-phase
+        machinery (partial_agg.translated_global_calls).
+
+        Eligible: append-only hop input, GROUP BY window_start + keys,
+        two-phase-decomposable calls without DISTINCT/FILTER, linear
+        (unsharded) plans.  Returns None when ineligible."""
+        from risingwave_tpu.stream.partial_agg import (
+            TWO_PHASE_KINDS,
+            translated_global_calls,
+        )
+
+        if eowc or not pin.append_only or self.parallel_hint > 1:
+            return None
+        size, slide = pin.window_size, pin.window_slide
+        if size is None or slide is None or slide >= size \
+                or size % slide != 0 or pin.watermark_col is None:
+            return None
+        hop_pos = next(
+            (i for i, ex in enumerate(execs)
+             if isinstance(ex, HopWindowExecutor)), None,
+        )
+        if hop_pos is None:
+            return None
+        hop = execs[hop_pos]
+        ws_idx = len(hop.in_schema)  # window_start position (appended)
+
+        def touches_window(e: Expr) -> bool:
+            if isinstance(e, InputRef):
+                return e.index >= ws_idx
+            if isinstance(e, AggRef):
+                return e.call.arg is not None \
+                    and touches_window(e.call.arg)
+            if isinstance(e, EFuncCall):
+                return any(touches_window(a) for a in e.args)
+            return False
+
+        # bind group keys + items exactly as _plan_agg would
+        group_asts = list(select.group_by)
+        in_binder = Binder(scope)
+        group_by: list = []
+        ws_key_pos = None
+        for gi, ga in enumerate(group_asts):
+            name = ga.name if isinstance(ga, ast.ColumnRef) else f"_key{gi}"
+            ge = in_binder.bind(ga)
+            if isinstance(ge, InputRef) and ge.index == ws_idx:
+                ws_key_pos = gi
+            elif touches_window(ge):
+                return None  # window_end/ts-derived keys: no pane form
+            group_by.append((name, ge))
+        if ws_key_pos is None:
+            return None
+        item_binder = Binder(scope, allow_aggs=True)
+        bound_items = []
+        for idx, item in enumerate(select.items):
+            if isinstance(item.expr, ast.Star):
+                raise PlanError("SELECT * with GROUP BY is not valid")
+            name = item.alias or self._default_name(item.expr, idx)
+            bound_items.append((name, item_binder.bind(item.expr)))
+        agg_calls = item_binder.agg_calls
+        having_expr = None
+        if select.having is not None:
+            having_expr = item_binder.bind(select.having)
+            agg_calls = item_binder.agg_calls
+        if any(a.kind not in TWO_PHASE_KINDS or a.distinct
+               or a.filter is not None for a in agg_calls):
+            return None
+        if any(a.arg is not None and touches_window(a.arg)
+               for a in agg_calls):
+            return None
+        # the WHERE filter (already in execs) must not read window cols
+        for ex in execs:
+            if isinstance(ex, FilterExecutor) \
+                    and touches_window(ex.predicate):
+                return None
+
+        cfg = self.config
+        n_keys = len(group_by)
+        k = size // slide
+        # 1. panes: tumble by slide (same schema/positions as the hop)
+        execs[hop_pos] = HopWindowExecutor(
+            hop.in_schema, hop.ts_col, slide, slide
+        )
+        # 2. per-pane partial agg (append-only, cleans when the pane's
+        # LAST covering window closes: wm >= pane_start + size)
+        pane_agg = HashAggExecutor(
+            execs[hop_pos].out_schema, group_by, agg_calls,
+            table_size=cfg.agg_table_size,
+            emit_capacity=cfg.agg_emit_capacity,
+            watermark_group_idx=ws_key_pos,
+            watermark_lag=size,
+            watermark_src_col=pin.watermark_col,
+        )
+        # 3. expand PANE DELTAS to their k covering windows
+        expand = HopWindowExecutor(
+            pane_agg.out_schema, ws_key_pos, slide, size
+        )
+        n_pane_out = len(pane_agg.out_schema)
+        # 4. combine partials per (keys..., window_start) — pane updates
+        # retract, so the global phase runs retractable (minput holds up
+        # to k live pane-partials per window for min/max)
+        final_group = [
+            (nm, InputRef(n_pane_out) if gi == ws_key_pos
+             else InputRef(gi))
+            for gi, (nm, _) in enumerate(group_by)
+        ]
+        final_agg = HashAggExecutor(
+            expand.out_schema, final_group,
+            translated_global_calls(agg_calls, n_keys),
+            table_size=cfg.agg_table_size,
+            emit_capacity=cfg.agg_emit_capacity,
+            watermark_group_idx=ws_key_pos,
+            watermark_lag=size,
+            watermark_src_col=pin.watermark_col,
+            retractable_input=True,
+            minput_bucket_cap=max(cfg.minput_bucket_cap, 2 * k),
+        )
+        execs2: list = [pane_agg, expand, final_agg]
+
+        # post projection / having / pk — identical to _plan_agg's tail
+        # (final agg output = [keys..., agg outs...] in original order)
+        rewritten = [
+            (name, self._rewrite_post_agg(e, group_by, n_keys))
+            for name, e in bound_items
+        ]
+        selected_keys = {
+            e.index for _, e in rewritten
+            if isinstance(e, InputRef) and e.index < n_keys
+        }
+        hidden = [
+            (f"_hidden_{final_agg.out_schema[ki].name}", InputRef(ki))
+            for ki in range(n_keys) if ki not in selected_keys
+        ]
+        proj_items = rewritten + hidden
+        if having_expr is not None:
+            hv = self._rewrite_post_agg(having_expr, group_by, n_keys)
+            execs2.append(FilterExecutor(final_agg.out_schema, hv))
+        post = ProjectExecutor(final_agg.out_schema, proj_items)
+        execs2.append(post)
+        pk_pos = []
+        for ki in range(n_keys):
+            for pi, (nm, e) in enumerate(proj_items):
+                if isinstance(e, InputRef) and e.index == ki:
+                    pk_pos.append(pi)
+                    break
+        return execs2, post.out_schema, pk_pos
 
     def _rewrite_post_agg(self, e: Expr, group_by, n_keys: int) -> Expr:
         """Rewrite a bound select expr to read the agg output schema."""
@@ -851,10 +1118,15 @@ class Planner:
                 tuple(self._rewrite_post_agg(a, group_by, n_keys)
                       for a in e.args),
             )
-        from risingwave_tpu.expr.scalar import ToChar
+        from risingwave_tpu.expr.scalar import RegexpGroup, ToChar
         if isinstance(e, ToChar):
             return ToChar(
                 self._rewrite_post_agg(e.arg, group_by, n_keys), e.fmt
+            )
+        if isinstance(e, RegexpGroup):
+            return RegexpGroup(
+                self._rewrite_post_agg(e.arg, group_by, n_keys),
+                e.pattern, 2,
             )
         return e  # literals
 
@@ -869,11 +1141,14 @@ class Planner:
                 Planner._expr_eq(x, y) for x, y in zip(a.args, b.args)
             )
         from risingwave_tpu.expr.node import Literal as ELit
-        from risingwave_tpu.expr.scalar import ToChar
+        from risingwave_tpu.expr.scalar import RegexpGroup, ToChar
         if isinstance(a, ELit):
             return a.value == b.value and a.data_type == b.data_type
         if isinstance(a, ToChar):
             return a.fmt == b.fmt and Planner._expr_eq(a.arg, b.arg)
+        if isinstance(a, RegexpGroup):
+            return a.pattern == b.pattern \
+                and Planner._expr_eq(a.arg, b.arg)
         return False
 
     # -- join pipelines ---------------------------------------------------
@@ -982,7 +1257,8 @@ class Planner:
 
         KIND_MAP = {"inner": "inner", "left": "left_outer",
                     "right": "right_outer", "full": "full_outer",
-                    "cross": "inner"}
+                    "cross": "inner",
+                    "semi": "left_semi", "anti": "left_anti"}
         #: WHERE conjuncts; comma-joins mine their equi-conditions from
         #: here (the classic implicit-join rewrite), the rest become
         #: post-join filters
@@ -1053,11 +1329,18 @@ class Planner:
                 left_pool_size=cfg.join_pool_size,
                 right_pool_size=cfg.join_pool_size,
             )
-            # the join's OUTPUT schema carries the pad nullability
-            both = Scope(
-                join.out_schema,
-                tuple(left.scope.qualifiers) + tuple(right.scope.qualifiers),
-            )
+            # the join's OUTPUT schema carries the pad nullability;
+            # semi/anti joins emit only the preserved side's columns
+            if join.is_semi or join.is_anti:
+                pres = left if join.preserve_left else right
+                both = Scope(join.out_schema,
+                             tuple(pres.scope.qualifiers))
+            else:
+                both = Scope(
+                    join.out_schema,
+                    tuple(left.scope.qualifiers)
+                    + tuple(right.scope.qualifiers),
+                )
             # window-keyed joins over watermarked sources clean closed
             # windows at barriers (bounded state, ref q8 pattern)
             for side_name, pin, keys in (("left", left, left_keys),
@@ -1112,6 +1395,61 @@ class Planner:
             )
 
         has_agg = bool(select.group_by) or self._has_agg(select)
+        # HAVING conjuncts comparing an aggregate against a scalar
+        # subquery peel off into dynamic-filter nodes (ref
+        # dynamic_filter.rs — `HAVING agg >= (SELECT ...)`)
+        having_subs: list = []
+        if has_agg and select.having is not None:
+            plain_hv: list = []
+            for c in self._conjuncts(select.having):
+                m = self._match_scalar_sub_cmp(c)
+                if m is not None:
+                    having_subs.append(m)
+                else:
+                    plain_hv.append(c)
+            if having_subs:
+                import dataclasses
+                new_hv = None
+                for r in plain_hv:
+                    new_hv = r if new_hv is None \
+                        else ast.BinaryOp("and", new_hv, r)
+                select = dataclasses.replace(select, having=new_hv)
+        if has_agg and having_subs:
+            from risingwave_tpu.stream.dynamic_filter import (
+                DynamicFilterExecutor,
+            )
+            execs2, out_schema, pk_pos, extra_pos = self._plan_agg(
+                select, both, root,
+                extra_out=[lhs for lhs, _, _ in having_subs],
+            )
+            post_execs.extend(execs2)
+            nodes.append(FragNode(Fragment(post_execs), root_ref))
+            ref = ("node", len(nodes) - 1)
+            for (lhs, cmp, sub), pos in zip(having_subs, extra_pos):
+                if len(sub.items) != 1 or isinstance(sub.items[0].expr,
+                                                     ast.Star):
+                    raise PlanError(
+                        "scalar subquery must select exactly one column"
+                    )
+                sref, _sinfo = resolve_subquery(
+                    ast.SubqueryRef(sub, f"_sc_sq{len(nodes)}")
+                )
+                nodes.append(JoinNode(DynamicFilterExecutor(
+                    out_schema, filter_col=pos, cmp=cmp,
+                    pool_size=max(cfg.topn_pool_size,
+                                  2 * cfg.chunk_capacity),
+                ), ref, sref))
+                ref = ("node", len(nodes) - 1)
+            tail: list[Executor] = []
+            self._append_terminal(
+                tail, out_schema, select,
+                input_append_only=False, has_agg=True,
+                pk_positions=pk_pos, sink=sink, eowc=False,
+            )
+            nodes.append(FragNode(Fragment(tail), ref))
+            return DagPlan(
+                sources, nodes, len(nodes) - 1, len(tail) - 1
+            )
         if has_agg:
             if group_topn is not None:
                 raise PlanError(
@@ -1162,6 +1500,24 @@ class Planner:
             return self._conjuncts(e.left) + self._conjuncts(e.right)
         return [e]
 
+    _SUB_CMPS = {"greater_than": "gt", "greater_than_or_equal": "ge",
+                 "less_than": "lt", "less_than_or_equal": "le"}
+    _SUB_FLIP = {"gt": "lt", "ge": "le", "lt": "gt", "le": "ge"}
+
+    def _match_scalar_sub_cmp(self, c):
+        """``lhs CMP (SELECT ...)`` → (lhs_ast, cmp, sub_select)."""
+        if not (isinstance(c, ast.BinaryOp)
+                and c.op in self._SUB_CMPS):
+            return None
+        cmp = self._SUB_CMPS[c.op]
+        if isinstance(c.right, ast.ScalarSubquery) \
+                and not isinstance(c.left, ast.ScalarSubquery):
+            return (c.left, cmp, c.right.select)
+        if isinstance(c.left, ast.ScalarSubquery) \
+                and not isinstance(c.right, ast.ScalarSubquery):
+            return (c.right, self._SUB_FLIP[cmp], c.left.select)
+        return None
+
     def _equi_pair(self, e, lscope: Scope, rscope: Scope, n_left: int):
         if not (isinstance(e, ast.BinaryOp) and e.op == "equal"):
             return None
@@ -1190,6 +1546,10 @@ class Planner:
         for idx, item in enumerate(items):
             if isinstance(item.expr, ast.Star):
                 want = item.expr.table
+                if want is not None and want not in scope.qualifiers:
+                    raise PlanError(
+                        f"table {want!r} in {want}.* not found in FROM"
+                    )
                 for ci, f in enumerate(scope.schema):
                     # pk bookkeeping columns of an upstream MV are not
                     # user-visible (each plan re-derives its own)

@@ -265,9 +265,18 @@ class DagJob:
         )
         self.checkpoints = [snap]
         if self.checkpoint_store is not None:
+            # device pytree handed over as-is: the store's block-digest
+            # pass fetches only the epoch's dirty blocks
             self.checkpoint_store.save(
-                self.name, epoch, jax.device_get(snap.states), src_state
+                self.name, epoch, snap.states, src_state
             )
+            for (idx, j), tier in getattr(self, "_spill_tiers",
+                                          {}).items():
+                if tier.rows_absorbed:
+                    self.checkpoint_store.save(
+                        f"{self.name}@spill{idx}_{j}", epoch,
+                        tier.state_host(), {},
+                    )
 
     def downstream_closure(self, ref: Ref,
                            through_joins: bool = True) -> list[int]:
@@ -674,6 +683,16 @@ class DagJob:
                     vals.append(sub)
                 continue
             jstate = new_states[idx]
+            if not hasattr(jstate, "left"):
+                # two-input non-join node (dynamic filter): counters
+                # live flat on the state itself
+                for attr in COUNTER_ATTRS:
+                    if hasattr(jstate, attr):
+                        labels.append(f"n{idx}.dynfilter.{attr}")
+                        vals.append(
+                            getattr(jstate, attr).astype(jnp.int64)[None]
+                        )
+                continue
             for side_name in ("left", "right"):
                 s = getattr(jstate, side_name)
                 for attr in COUNTER_ATTRS:
@@ -794,6 +813,7 @@ class DagJob:
     # -- checkpoint / recovery ------------------------------------------
     def _commit_checkpoint(self, sealed) -> None:
         if self.mesh is None:  # sink delivery is a host-side read;
+            self._drain_spill_tiers(sealed)
             new_states = list(self.states)  # sharded plans exclude sinks
             for idx, node in enumerate(self.nodes):
                 if isinstance(node, FragNode):
@@ -803,6 +823,92 @@ class DagJob:
             self.states = tuple(new_states)
         self.committed_epoch = sealed
         self._snapshot_and_save(sealed)
+
+    # -- spill-to-host (stream/spill.py) --------------------------------
+    def _restore_spill_tiers(self, epoch: int) -> None:
+        """Recovery companion: reload host-tier states saved alongside
+        the job checkpoint (runtime.py's StreamingJob does the same)."""
+        if self.checkpoint_store is None:
+            return
+        for idx, j, ex in self._spill_sites():
+            self._ensure_spill_tier(idx, j, ex)
+            key = f"{self.name}@spill{idx}_{j}"
+            if epoch in self.checkpoint_store.epochs(key):
+                loaded = self.checkpoint_store.load(key, epoch)
+                if loaded is not None:
+                    tier = self._spill_tiers[(idx, j)]
+                    tier.restore(loaded[1])
+                    tier.rows_absorbed = 1
+
+    def _spill_sites(self):
+        """[(node_idx, exec_idx, executor)] of spill-enabled aggs."""
+        out = []
+        for idx, node in enumerate(self.nodes):
+            if not isinstance(node, FragNode):
+                continue
+            for j, ex in enumerate(node.fragment.executors):
+                if getattr(ex, "spill_ring", 0):
+                    out.append((idx, j, ex))
+        return out
+
+    def _ensure_spill_tier(self, idx: int, j: int, ex) -> None:
+        if not hasattr(self, "_spill_tiers"):
+            self._spill_tiers = {}
+            self._spill_progs = {}
+        key = (idx, j)
+        if key in self._spill_tiers:
+            return
+        from risingwave_tpu.stream.spill import AggSpillTier
+        self._spill_tiers[key] = AggSpillTier(
+            ex, getattr(ex, "spill_table_size", ex.table_size * 8)
+        )
+
+        def drain(states, idx=idx, j=j, ex=ex):
+            new_states = list(states)
+            node_states = list(new_states[idx])
+            node_states[j], chunk = ex.drain_spill(node_states[j])
+            new_states[idx] = tuple(node_states)
+            return tuple(new_states), chunk
+
+        def inject(states, chunk, idx=idx, j=j):
+            new_states = list(states)
+            node = self.nodes[idx]
+            node_states = list(new_states[idx])
+            cur = chunk
+            for k in range(j + 1, len(node.fragment.executors)):
+                if cur is None:
+                    break
+                node_states[k], cur = \
+                    node.fragment.executors[k].apply(
+                        node_states[k], cur
+                    )
+            new_states[idx] = tuple(node_states)
+            if cur is not None:
+                self._propagate(new_states, [(("node", idx), cur)])
+            return tuple(new_states)
+
+        self._spill_progs[key] = (
+            jax.jit(drain, donate_argnums=(0,)),
+            jax.jit(inject, donate_argnums=(0,)),
+        )
+
+    def _drain_spill_tiers(self, sealed) -> None:
+        """Snapshot-barrier hook: divert ring rows to host tiers and
+        inject their changelog downstream of each agg node."""
+        import numpy as _np
+        for idx, j, ex in self._spill_sites():
+            self._ensure_spill_tier(idx, j, ex)
+            key = (idx, j)
+            cnt = int(_np.asarray(self.states[idx][j].spill_count))
+            if cnt == 0:
+                continue
+            drain_p, inject_p = self._spill_progs[key]
+            self.states, chunk = drain_p(self.states)
+            out = self._spill_tiers[key].process(
+                jax.device_get(chunk), sealed
+            )
+            if out is not None:
+                self.states = inject_p(self.states, out)
 
     def recover(self) -> None:
         """Reset to the last committed checkpoint (ref §3.5)."""
@@ -823,6 +929,7 @@ class DagJob:
                 self.committed_epoch = epoch
                 for name, src in self.sources.items():
                     restore_source(src, src_state.get(name, {}))
+                self._restore_spill_tiers(epoch)
                 return
         if not self.checkpoints:
             self.states = self._init_states()

@@ -93,6 +93,54 @@ class AggState(NamedTuple):
     #: slot-aligned with ``table``
     minput_vals: tuple = ()
     minput_occ: tuple = ()
+    #: per-DISTINCT-call dedup state (ref distinct.rs dedup tables):
+    #: a hash table keyed (group keys..., arg) and an int64 [size]
+    #: row-count per key — 0↔nonzero transitions drive the agg update
+    distinct_tables: tuple = ()
+    distinct_counts: tuple = ()
+    #: spill ring: INPUT rows whose group could not claim a device slot
+    #: divert here instead of being dropped; the runtime drains the
+    #: ring at snapshot barriers into the host-resident overflow tier
+    #: (stream/spill.py — the state_table.rs "state beyond memory is
+    #: the norm" analog)
+    spill_rows: tuple = ()
+    spill_ops: jnp.ndarray = ()
+    spill_count: jnp.ndarray = ()
+
+
+def _empty_input_col(f: Field, n: int):
+    """Zeroed [n] storage for one input-schema column (NCol-aware)."""
+    if f.data_type.is_string:
+        base = StrCol(
+            jnp.zeros((n, f.str_width), jnp.uint8),
+            jnp.zeros((n,), jnp.int32),
+        )
+    else:
+        base = jnp.zeros((n,), f.data_type.physical_dtype)
+    if f.nullable:
+        return NCol(base, jnp.zeros((n,), jnp.bool_))
+    return base
+
+
+def _scatter_input_col(store, pos, col):
+    """Scatter a chunk column into [R] storage (NCol/StrCol-aware)."""
+    if isinstance(store, NCol):
+        return NCol(
+            _scatter_input_col(store.data, pos,
+                               col.data if isinstance(col, NCol)
+                               else col),
+            store.null.at[pos].set(
+                col.null if isinstance(col, NCol)
+                else jnp.zeros(pos.shape, jnp.bool_),
+                mode="drop",
+            ),
+        )
+    if isinstance(store, StrCol):
+        return StrCol(
+            store.data.at[pos].set(col.data, mode="drop"),
+            store.lens.at[pos].set(col.lens, mode="drop"),
+        )
+    return store.at[pos].set(col, mode="drop")
 
 
 def _interleave(old, new):
@@ -129,8 +177,24 @@ class HashAggExecutor(Executor):
         emit_on_window_close: bool = False,
         retractable_input: bool = False,
         minput_bucket_cap: int = 64,
+        distinct_table_size: int | None = None,
+        spill_ring: int = 0,
     ):
         super().__init__(in_schema)
+        #: overflow-row ring capacity (0 = overflow is a hard error);
+        #: the planner enables this for non-windowed aggregations whose
+        #: key cardinality is unbounded
+        self.spill_ring = spill_ring
+        self._ctor_kwargs = dict(
+            in_schema=in_schema, group_by=tuple(group_by),
+            aggs=tuple(aggs), emit_capacity=emit_capacity,
+            watermark_group_idx=watermark_group_idx,
+            watermark_lag=watermark_lag,
+            watermark_src_col=watermark_src_col,
+            emit_on_window_close=emit_on_window_close,
+            retractable_input=retractable_input,
+            minput_bucket_cap=minput_bucket_cap,
+        )
         #: EOWC (ref emit_on_window_close plan property): flush emits
         #: only CLOSED windows as final append-only rows and evicts them
         self.emit_on_window_close = emit_on_window_close
@@ -176,6 +240,14 @@ class HashAggExecutor(Executor):
             pi for pi, (ai, _) in enumerate(self._prim_specs)
             if ai in self._minput_aggs
         }
+        #: DISTINCT calls with their own counted dedup tables (ref
+        #: distinct.rs); min/max are distinct-insensitive and handled
+        #: as plain calls
+        self.distinct_table_size = distinct_table_size or table_size
+        self._distinct_aggs: list[int] = [
+            ai for ai, a in enumerate(self.aggs)
+            if a.distinct and a.kind not in ("min", "max")
+        ]
         # hidden non-null-count prims: an aggregate over a NULLABLE
         # argument yields SQL NULL when every argument row in the group
         # is NULL (ref AggregateFunction semantics); count() needs no
@@ -225,6 +297,21 @@ class HashAggExecutor(Executor):
             return jnp.int64
         return a.arg.return_field(self.in_schema).data_type.physical_dtype
 
+    def _distinct_protos(self, agg_idx: int) -> list:
+        """Key prototypes of a distinct call's dedup table:
+        (group keys..., arg)."""
+        f = self.aggs[agg_idx].arg.return_field(self.in_schema)
+        if f.data_type.is_string:
+            p = StrCol(
+                jnp.zeros((1, f.str_width), jnp.uint8),
+                jnp.zeros((1,), jnp.int32),
+            )
+        else:
+            p = jnp.zeros((1,), f.data_type.physical_dtype)
+        if f.nullable:
+            p = NCol(p, jnp.zeros((1,), jnp.bool_))
+        return self._key_protos() + [p]
+
     def init_state(self) -> AggState:
         size = self.table_size
         table = HashTable.create(self._key_protos(), size)
@@ -258,6 +345,23 @@ class HashAggExecutor(Executor):
                 jnp.zeros((size, B), jnp.bool_)
                 for ai in self._minput_aggs
             ),
+            distinct_tables=tuple(
+                HashTable.create(self._distinct_protos(ai),
+                                 self.distinct_table_size)
+                for ai in self._distinct_aggs
+            ),
+            distinct_counts=tuple(
+                jnp.zeros((self.distinct_table_size,), jnp.int64)
+                for _ in self._distinct_aggs
+            ),
+            spill_rows=tuple(
+                _empty_input_col(f, self.spill_ring)
+                for f in self.in_schema
+            ) if self.spill_ring else (),
+            spill_ops=jnp.zeros((self.spill_ring,), jnp.int8)
+            if self.spill_ring else (),
+            spill_count=jnp.zeros((), jnp.int32)
+            if self.spill_ring else (),
         )
 
     # ------------------------------------------------------------------
@@ -320,8 +424,16 @@ class HashAggExecutor(Executor):
                 s_keys, rep, hashes=s_h
             )
             # overflowed representatives drop their whole segment —
-            # count rows
+            # count rows (or divert them to the spill ring)
             n_over = jnp.sum(jnp.where(rep & overflow, seg_rows, 0))
+            if self.spill_ring:
+                seg_over = jnp.zeros((cap + 1,), jnp.bool_).at[
+                    jnp.where(rep, seg_id, 0)
+                ].set(rep & overflow, mode="drop")
+                sorted_spill = s_valid & seg_over[seg_id]
+                spill_mask = jnp.zeros((cap,), jnp.bool_).at[perm].set(
+                    sorted_spill
+                )
         else:
             perm = None
             s_signs = signs
@@ -329,6 +441,45 @@ class HashAggExecutor(Executor):
                 key_cols, valid, hashes=h
             )
             n_over = jnp.sum((overflow & valid).astype(jnp.int64))
+            if self.spill_ring:
+                spill_mask = valid & overflow
+        spill_rows = state.spill_rows
+        spill_ops = state.spill_ops
+        spill_count = state.spill_count
+        if self.spill_ring:
+            # divert overflow rows into the ring (original chunk order);
+            # only rows the ring itself cannot hold stay in n_over.
+            # The capture runs under lax.cond so the CLEAN path (no
+            # overflow — the steady state) skips the ring scatters.
+            R = self.spill_ring
+
+            def capture(args):
+                spill_rows, spill_ops, spill_count = args
+                rank = jnp.cumsum(spill_mask.astype(jnp.int32)) - \
+                    spill_mask.astype(jnp.int32)
+                pos = spill_count + rank
+                ok = spill_mask & (pos < R)
+                tgt = jnp.where(ok, pos, jnp.int32(R))
+                rows = tuple(
+                    _scatter_input_col(store, tgt, col)
+                    for store, col in zip(spill_rows, chunk.columns)
+                )
+                ops2 = spill_ops.at[tgt].set(chunk.ops, mode="drop")
+                cnt = jnp.minimum(
+                    spill_count + jnp.sum(spill_mask.astype(jnp.int32)),
+                    jnp.int32(R),
+                ).astype(jnp.int32)
+                dropped = jnp.sum((spill_mask & ~ok).astype(jnp.int64))
+                return rows, ops2, cnt, dropped
+
+            def skip(args):
+                rows, ops2, cnt = args
+                return rows, ops2, cnt, jnp.zeros((), jnp.int64)
+
+            spill_rows, spill_ops, spill_count, n_over = jax.lax.cond(
+                jnp.any(spill_mask), capture, skip,
+                (spill_rows, spill_ops, spill_count),
+            )
         # freshly claimed slots may be reclaimed after state cleaning —
         # reset their (stale) primitive state before applying updates
         ins_pos = jnp.where(inserted, slots, jnp.int32(self.table_size))
@@ -346,6 +497,83 @@ class HashAggExecutor(Executor):
                 filt_cache[agg_idx] = fcol if fnull is None \
                     else fcol & ~fnull
             return filt_cache[agg_idx]
+
+        # DISTINCT dedup (ref distinct.rs): per call, count rows per
+        # (group, value) key; only 0↔nonzero transitions reach the
+        # aggregate — emitted as a ±1 "transition sign" at one
+        # representative row per key, zero elsewhere.  The transition
+        # depends only on the key's net delta, so in-chunk ordering is
+        # irrelevant.
+        d_tables = list(state.distinct_tables)
+        d_counts = list(state.distinct_counts)
+        d_signs: dict[int, jnp.ndarray] = {}
+        n_over_d = jnp.zeros((), jnp.int64)
+        n_bad_d = jnp.zeros((), jnp.int64)
+        if self._distinct_aggs:
+            from risingwave_tpu.stream.hash_join import _rank_by
+            for di, agg_idx in enumerate(self._distinct_aggs):
+                a = self.aggs[agg_idx]
+                if agg_idx not in arg_cache:
+                    arg_cache[agg_idx] = a.arg.eval(chunk)
+                acol = arg_cache[agg_idx]
+                _, anull = split_col(acol)
+                eligible = valid & (signs != 0)
+                if self.spill_ring:
+                    # diverted rows replay in the tier's own dedup state
+                    eligible = eligible & ~spill_mask
+                if anull is not None:
+                    eligible = eligible & ~anull
+                fm = filter_mask(a, agg_idx)
+                if fm is not None:
+                    eligible = eligible & fm
+                dt, dslots, dins, dover = d_tables[di].lookup_or_insert(
+                    key_cols + [acol], eligible
+                )
+                d_tables[di] = dt
+                size_d = dt.size
+                n_over_d = n_over_d + jnp.sum(
+                    (dover & eligible).astype(jnp.int64)
+                )
+                eligible = eligible & ~dover
+                safe_d = jnp.minimum(dslots, size_d - 1)
+                cnt = d_counts[di]
+                # reclaimed (tombstoned→reused) slots carry stale counts
+                cnt = cnt.at[
+                    jnp.where(dins, dslots, jnp.int32(size_d))
+                ].set(0, mode="drop")
+                contrib = jnp.where(eligible,
+                                    signs.astype(jnp.int64), 0)
+                delta = jnp.zeros((size_d,), jnp.int64).at[safe_d].add(
+                    jnp.where(eligible, contrib, 0)
+                )
+                n0 = cnt[safe_d]
+                n1 = n0 + delta[safe_d]
+                # deletes of never-inserted values drive a count
+                # negative — the consistency_error! analog
+                n_bad_d = n_bad_d + jnp.sum(
+                    (eligible & (n1 < 0)).astype(jnp.int64)
+                )
+                rep = eligible & (
+                    _rank_by(dslots.astype(jnp.uint64), eligible) == 0
+                )
+                d_signs[agg_idx] = jnp.where(
+                    rep,
+                    (n1 > 0).astype(jnp.int64)
+                    - (n0 > 0).astype(jnp.int64),
+                    0,
+                )
+                d_counts[di] = cnt.at[
+                    jnp.where(eligible, safe_d, jnp.int32(size_d))
+                ].add(contrib, mode="drop")
+                # a (group, value) whose count retracted to 0 frees its
+                # slot (tombstone) — churning retractable inputs must
+                # not accumulate dead keys (ref distinct.rs deletes
+                # count-0 dedup rows)
+                died = jnp.zeros((size_d,), jnp.bool_).at[
+                    jnp.where(rep & (n1 <= 0) & (n0 > 0), safe_d,
+                              jnp.int32(size_d))
+                ].set(True, mode="drop")
+                d_tables[di] = d_tables[di].clear_where(died)
         for pi, (agg_idx, ps) in enumerate(self._prim_specs):
             a = self.aggs[agg_idx]
             if pi in self._cache_prims:
@@ -370,20 +598,27 @@ class HashAggExecutor(Executor):
                 col = jnp.where(col_null, jnp.zeros((), col.dtype), col)
             fm = filter_mask(a, agg_idx)
             if perm is None:
-                prim_signs = signs if col_null is None else jnp.where(
-                    col_null, 0, signs
-                )
-                if fm is not None:
-                    prim_signs = jnp.where(fm, prim_signs, 0)
+                if agg_idx in d_signs:
+                    # DISTINCT: the dedup pass already folded filter/
+                    # NULL/duplicate semantics into ±1 transition signs
+                    prim_signs = d_signs[agg_idx]
+                else:
+                    prim_signs = signs if col_null is None else jnp.where(
+                        col_null, 0, signs
+                    )
+                    if fm is not None:
+                        prim_signs = jnp.where(fm, prim_signs, 0)
                 # per-row update scattered directly (invalid rows carry
                 # sign 0 ⇒ identity, and sentinel slots drop)
                 seg = ps.lift(col, prim_signs)
             else:
-                prim_signs = s_signs if col_null is None else jnp.where(
-                    col_null[perm], 0, s_signs
-                )
-                if fm is not None:
-                    prim_signs = jnp.where(fm[perm], prim_signs, 0)
+                if agg_idx in d_signs:
+                    prim_signs = d_signs[agg_idx][perm]
+                else:
+                    prim_signs = s_signs if col_null is None \
+                        else jnp.where(col_null[perm], 0, s_signs)
+                    if fm is not None:
+                        prim_signs = jnp.where(fm[perm], prim_signs, 0)
                 # per-row lift in sorted order, then segment-reduce:
                 # the value at each segment END is the segment's update
                 contrib = ps.lift(gather_key(col, perm), prim_signs)
@@ -466,12 +701,40 @@ class HashAggExecutor(Executor):
             prev_prims=state.prev_prims,
             prev_row_count=state.prev_row_count,
             emitted=state.emitted,
-            overflow=state.overflow + n_over + n_over_mi,
-            inconsistency=state.inconsistency + n_bad + n_miss_mi,
+            overflow=state.overflow + n_over + n_over_mi + n_over_d,
+            inconsistency=state.inconsistency + n_bad + n_miss_mi
+            + n_bad_d,
             wm=state.wm,
             minput_vals=tuple(minput_vals),
             minput_occ=tuple(minput_occ),
+            distinct_tables=tuple(d_tables),
+            distinct_counts=tuple(d_counts),
+            spill_rows=spill_rows,
+            spill_ops=spill_ops,
+            spill_count=spill_count,
         ), None
+
+    def drain_spill(self, state: AggState):
+        """(state with an empty ring, Chunk of the diverted rows).
+
+        Jitted by the runtime at snapshot barriers; the chunk feeds the
+        host overflow tier (stream/spill.py)."""
+        R = self.spill_ring
+        valid = jnp.arange(R, dtype=jnp.int32) < state.spill_count
+        chunk = Chunk(state.spill_rows, state.spill_ops, valid,
+                      self.in_schema)
+        return state._replace(
+            spill_count=jnp.zeros((), jnp.int32)
+        ), chunk
+
+    def make_spill_tier(self, table_size: int) -> "HashAggExecutor":
+        """A same-shaped aggregation for the host (CPU) overflow tier."""
+        return HashAggExecutor(
+            table_size=table_size,
+            distinct_table_size=max(table_size,
+                                    self.distinct_table_size),
+            **self._ctor_kwargs,
+        )
 
     def _minput_update(self, vals, occ, row_slots, v_sorted, s_signs,
                        active, ins_pos):
@@ -747,9 +1010,34 @@ class HashAggExecutor(Executor):
                 ),
             )
 
-        return jax.lax.cond(
+        state = jax.lax.cond(
             state.table.tombstone_count() > self.table_size // 4,
             do_rehash, lambda s: s, state,
+        )
+        if not self._distinct_aggs:
+            return state
+
+        # distinct dedup tables compact independently (their own keys)
+        def rehash_d(state: AggState) -> AggState:
+            from risingwave_tpu.state.hash_table import permute_dense
+            d_tables = []
+            d_counts = []
+            for dt, cnt in zip(state.distinct_tables,
+                               state.distinct_counts):
+                fresh, moved = dt.rehashed()
+                d_tables.append(fresh)
+                d_counts.append(permute_dense(cnt, moved))
+            return state._replace(
+                distinct_tables=tuple(d_tables),
+                distinct_counts=tuple(d_counts),
+            )
+
+        any_tomb = state.distinct_tables[0].tombstone_count()
+        for dt in state.distinct_tables[1:]:
+            any_tomb = jnp.maximum(any_tomb, dt.tombstone_count())
+        return jax.lax.cond(
+            any_tomb > self.distinct_table_size // 4,
+            rehash_d, lambda s: s, state,
         )
 
     # ------------------------------------------------------------------
@@ -764,6 +1052,17 @@ class HashAggExecutor(Executor):
         if key_null is not None:
             stale = stale & ~key_null  # NULL keys are never below a wm
         table = state.table.clear_where(stale)
+        # distinct dedup keys carry the same group-key prefix: evict
+        # their (group, value) rows with the window too
+        d_tables = []
+        d_counts = []
+        for dt, cnt in zip(state.distinct_tables, state.distinct_counts):
+            k, kn = split_col(dt.key_cols[key_col_idx])
+            stale_d = dt.occupied & (k < threshold)
+            if kn is not None:
+                stale_d = stale_d & ~kn
+            d_tables.append(dt.clear_where(stale_d))
+            d_counts.append(jnp.where(stale_d, 0, cnt))
         return state._replace(
             table=table,
             row_count=jnp.where(stale, 0, state.row_count),
@@ -773,4 +1072,6 @@ class HashAggExecutor(Executor):
             minput_occ=tuple(
                 o & ~stale[:, None] for o in state.minput_occ
             ),
+            distinct_tables=tuple(d_tables),
+            distinct_counts=tuple(d_counts),
         )

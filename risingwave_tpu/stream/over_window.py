@@ -57,10 +57,14 @@ class WindowFuncCall:
     """One window function in the OVER clause plan."""
 
     kind: str            # row_number | rank | dense_rank | lag | lead |
-    #                      sum | count | min | max  (frame: unbounded..current)
+    #                      sum | count | avg | min | max
     arg: Expr | None = None
     offset: int = 1      # lag/lead distance
     alias: str | None = None
+    #: ROWS BETWEEN <pre> PRECEDING AND CURRENT ROW (sum/count/avg);
+    #: None = the default frame (unbounded preceding .. current row).
+    #: Ref: over_window frame_finder.rs ROWS frames.
+    frame: "tuple[int, int] | None" = None
 
     def out_field(self, in_schema: Schema) -> Field:
         name = self.alias or self.kind
@@ -70,6 +74,11 @@ class WindowFuncCall:
         if self.kind == "sum" and f.data_type in (DataType.INT16,
                                                   DataType.INT32):
             return Field(name, DataType.INT64)
+        if self.kind == "avg":
+            if f.data_type == DataType.DECIMAL:
+                return Field(name, DataType.DECIMAL,
+                             decimal_scale=f.decimal_scale)
+            return Field(name, DataType.FLOAT64)
         return Field(name, f.data_type, str_width=f.str_width,
                      decimal_scale=f.decimal_scale)
 
@@ -228,22 +237,49 @@ class OverWindowExecutor(Executor):
                     got = jnp.where(same_part, col_s[src_c],
                                     jnp.zeros((), col_s.dtype))
                 outs.append(got)
-            elif call.kind in ("sum", "count", "min", "max"):
+            elif call.kind in ("sum", "count", "avg", "min", "max"):
                 if call.kind == "count":
                     v = valid_s.astype(jnp.int64)
                 else:
                     v = _gather(call.arg.eval(pool_chunk), order)
-                    if call.kind == "sum" and jnp.issubdtype(
+                    if call.kind in ("sum", "avg") and jnp.issubdtype(
                             v.dtype, jnp.integer):
                         v = v.astype(jnp.int64)
                 # segment prefix scan re-anchored at partition starts:
                 # subtract the prefix total BEFORE this partition (a
                 # direct gather at seg_start — correct for negative
                 # values too, unlike a running-max anchor)
-                if call.kind in ("sum", "count"):
+                if call.kind in ("sum", "count", "avg"):
+                    is_dec_avg = (
+                        call.kind == "avg"
+                        and call.arg.return_field(
+                            self.in_schema
+                        ).data_type == DataType.DECIMAL
+                    )
+                    if call.kind == "avg" and not is_dec_avg:
+                        v = v.astype(jnp.float64)
                     cum = jnp.cumsum(v, axis=0)
                     before = cum - v
-                    outs.append(cum - before[seg_start])
+                    if call.frame is not None:
+                        # ROWS BETWEEN pre PRECEDING AND CURRENT ROW:
+                        # frame start = max(i - pre, partition start)
+                        pre = call.frame[0]
+                        lo = jnp.maximum(idx - pre, seg_start) \
+                            if pre >= 0 else seg_start
+                        frame_n = (idx - lo + 1).astype(jnp.int64)
+                        agg = cum - before[lo]
+                    else:
+                        frame_n = (idx - seg_start + 1).astype(jnp.int64)
+                        agg = cum - before[seg_start]
+                    if call.kind == "avg":
+                        if is_dec_avg:
+                            # truncate toward zero at the input scale
+                            agg = jnp.sign(agg) * (
+                                jnp.abs(agg) // frame_n
+                            )
+                        else:
+                            agg = agg / frame_n.astype(jnp.float64)
+                    outs.append(agg)
                 else:
                     opfn = jnp.minimum if call.kind == "min" \
                         else jnp.maximum

@@ -170,6 +170,27 @@ class StreamingJob:
         #: counters vector from the last barrier program (device array;
         #: read back once per maintenance interval)
         self._counters = None
+        #: spill-to-host tiers (stream/spill.py) per spill-enabled agg:
+        #: [(exec_idx, drain_jit, inject_jit, tier)]
+        self._spill: list = []
+        for i, ex in enumerate(fragment.executors):
+            if not getattr(ex, "spill_ring", 0):
+                continue
+            from risingwave_tpu.stream.spill import AggSpillTier
+            drain = jax.jit(
+                lambda states, i=i, ex=ex: self._drain_impl(states, i, ex),
+                donate_argnums=(0,),
+            )
+            inject = jax.jit(
+                lambda states, chunk, i=i: self._inject_impl(
+                    states, chunk, i
+                ),
+                donate_argnums=(0,),
+            )
+            tier = AggSpillTier(
+                ex, getattr(ex, "spill_table_size", ex.table_size * 8)
+            )
+            self._spill.append((i, drain, inject, tier))
         # fuse generation into the step when the source is traceable:
         # the source chunk never materializes standalone — XLA fuses
         # generator arithmetic straight into the executor kernels
@@ -260,6 +281,38 @@ class StreamingJob:
                 np.asarray(self._counters),
             )
 
+    def _drain_impl(self, states, i, ex):
+        new_states = list(states)
+        new_states[i], chunk = ex.drain_spill(states[i])
+        return tuple(new_states), chunk
+
+    def _inject_impl(self, states, chunk, i):
+        """Feed a tier changelog through the executors AFTER the agg."""
+        new_states = list(states)
+        cur = chunk
+        for j in range(i + 1, len(self.fragment.executors)):
+            if cur is None:
+                break
+            new_states[j], cur = self.fragment.executors[j].apply(
+                new_states[j], cur
+            )
+        return tuple(new_states)
+
+    def _drain_spill_tiers(self, epoch_val) -> None:
+        """Snapshot-barrier hook: divert ring rows to the host tier and
+        inject its changelog downstream (ref: state beyond memory via
+        the state-store tier, state_table.rs:187)."""
+        import numpy as _np
+        for i, drain, inject, tier in self._spill:
+            cnt = int(_np.asarray(self.states[i].spill_count))
+            if cnt == 0:
+                continue
+            self.states, chunk = drain(self.states)
+            host_chunk = jax.device_get(chunk)
+            out = tier.process(host_chunk, epoch_val)
+            if out is not None:
+                self.states = inject(self.states, out)
+
     def _commit_checkpoint(self, barrier: Barrier) -> None:
         """Commit = snapshot + sink delivery + committed_epoch, all on
         the SAME cadence: recovery rewinds to the last snapshot, so a
@@ -270,6 +323,7 @@ class StreamingJob:
         if self._ckpts_since_snapshot < self.snapshot_interval:
             return
         self._ckpts_since_snapshot = 0
+        self._drain_spill_tiers(epoch_val)
         self.states = deliver_sinks(self.fragment, self.states, epoch_val)
         self.committed_epoch = epoch_val
         src_state = self.source.state() if hasattr(self.source, "state") \
@@ -287,9 +341,17 @@ class StreamingJob:
         # durable store keeps epoch history (ref: Hummock versions)
         self.checkpoints = [snap]
         if self.checkpoint_store is not None:
+            # device pytree handed over as-is: the store's block-digest
+            # pass fetches only the epoch's dirty blocks
             self.checkpoint_store.save(
-                self.name, epoch_val, jax.device_get(snap.states), src_state
+                self.name, epoch_val, snap.states, src_state
             )
+            for i, _, _, tier in self._spill:
+                if tier.rows_absorbed:
+                    self.checkpoint_store.save(
+                        f"{self.name}@spill{i}", epoch_val,
+                        tier.state_host(), {},
+                    )
 
     def _apply_mutation(self, mutation) -> None:
         if mutation.kind == "pause":
@@ -313,6 +375,15 @@ class StreamingJob:
                 self.states = jax.device_put(states)
                 self.committed_epoch = epoch
                 restore_source(self.source, src_state)
+                for i, _, _, tier in self._spill:
+                    t = self.checkpoint_store.load(
+                        f"{self.name}@spill{i}", epoch
+                    ) if epoch in self.checkpoint_store.epochs(
+                        f"{self.name}@spill{i}"
+                    ) else None
+                    if t is not None:
+                        tier.restore(t[1])
+                        tier.rows_absorbed = 1
                 return
         if not self.checkpoints:
             self.states = self.fragment.init_states()

@@ -1,0 +1,64 @@
+"""Host-resident overflow tier for aggregation state (spill-to-host).
+
+Reference counterpart: state beyond memory is the NORM in the
+reference — every stateful operator is backed by an unbounded disk
+store behind an in-memory cache (``state_table.rs:187``,
+``managed_lru.rs``).  A fixed device hash table cannot grow, so rows
+whose group cannot claim a slot divert to a device-side ring
+(hash_agg spill_ring) and drain — at snapshot barriers — into this
+tier: the SAME HashAggExecutor compiled for the host CPU device with a
+much larger table.  Its emissions inject into the dataflow right after
+the device aggregation, so downstream (projection, MV) sees one merged
+changelog.
+
+Ownership is structural, not tracked: a group lives in the tier iff
+its first row overflowed, and the device table only frees slots via
+watermark cleaning — which the planner excludes from spill-enabled
+plans (windowed aggs keep overflow-as-error; their state is bounded by
+cleaning).  A device-resident group never overflows (probes find it),
+so no group is ever split across tiers.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+
+
+class AggSpillTier:
+    """CPU twin of a device HashAggExecutor, fed by its spill ring."""
+
+    def __init__(self, agg, table_size: int):
+        self.cpu = jax.devices("cpu")[0]
+        with jax.default_device(self.cpu):
+            self.agg = agg.make_spill_tier(table_size)
+            self.state = self.agg.init_state()
+        self.rows_absorbed = 0
+
+    def process(self, drained_chunk_host, epoch) -> "Any | None":
+        """Apply one drained ring chunk + flush; returns the tier's
+        changelog chunk (host arrays) or None when nothing changed."""
+        with jax.default_device(self.cpu):
+            chunk = jax.device_put(drained_chunk_host, self.cpu)
+            st, _ = self.agg.apply(self.state, chunk)
+            st, out = self.agg.flush(st, epoch)
+            self.state = st
+        self.rows_absorbed += int(np.asarray(drained_chunk_host.valid).sum())
+        return out
+
+    def flush_only(self, epoch):
+        """Barrier flush with no new rows (emits nothing when clean)."""
+        with jax.default_device(self.cpu):
+            st, out = self.agg.flush(self.state, epoch)
+            self.state = st
+        return out
+
+    # -- checkpoint -----------------------------------------------------
+    def state_host(self):
+        return jax.device_get(self.state)
+
+    def restore(self, host_state) -> None:
+        with jax.default_device(self.cpu):
+            self.state = jax.device_put(host_state, self.cpu)

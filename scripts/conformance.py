@@ -90,7 +90,7 @@ def run() -> dict:
             run_slt(eng, query_file, tick_between=0)
             results[name] = ("pass", "")
         except SltError as e:
-            results[name] = ("fail", str(e.message)[:200])
+            results[name] = ("fail", str(e.message)[:6000])
         except Exception as e:
             results[name] = ("error", str(e)[:200])
         _drop_new(eng, before)
@@ -108,6 +108,7 @@ def _drop_new(eng: Engine, before: set) -> None:
 
 def main() -> None:
     results = run()
+    only = os.environ.get("RWT_ONLY")
     counts = {"pass": 0, "skip": 0, "fail": 0, "error": 0}
     for status, _ in results.values():
         counts[status] += 1
@@ -129,12 +130,15 @@ def main() -> None:
         detail = detail.replace("|", "\\|").replace("\n", " ")
         lines.append(f"| {name} | {status} | {detail} |")
     lines.append("")
-    with open(OUT, "w") as f:
-        f.write("\n".join(lines))
+    if not only:
+        with open(OUT, "w") as f:
+            f.write("\n".join(lines))
     print("\n".join(lines[:8]))
     print(f"... report written to {OUT}")
     for name, (status, detail) in results.items():
         print(f"{name:18s} {status:5s} {detail[:110]}")
+        if status in ("fail", "error") and len(detail) > 110:
+            print(detail)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,141 @@
+"""Attribute q8's cost: stage-by-stage timings of the windowed join.
+
+Round-3 verdict ask #4: q8 runs 16x below q7 on CPU with no in-repo
+attribution.  This times each pipeline stage as its own jitted program
+over identical inputs:
+
+  1. source generation + tumble windowing (both sides)
+  2. join apply_begin (state update + emission staging)
+  3. emission window 0 materialization (emit_window)
+  4. the full per-chunk step (everything incl. extra windows + MV)
+
+Usage: JAX_PLATFORMS=cpu python scripts/profile_q8.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import risingwave_tpu  # noqa: F401,E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from risingwave_tpu.sql import Engine  # noqa: E402
+from risingwave_tpu.sql.planner import PlannerConfig  # noqa: E402
+
+CAP = 8192
+
+
+def timeit(name, fn, n=20):
+    jax.block_until_ready(fn())  # compile
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn()
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / n
+    print(f"{name:42s} {dt * 1e3:9.2f} ms  "
+          f"({CAP / dt / 1e6:7.2f}M rows/s/side)", flush=True)
+    return dt
+
+
+def main():
+    eng = Engine(PlannerConfig(
+        chunk_capacity=CAP,
+        agg_table_size=1 << 18, agg_emit_capacity=4096,
+        join_left_table_size=1 << 22, join_right_table_size=1 << 18,
+        join_pool_size=1 << 22, join_out_capacity=1 << 15,
+        mv_table_size=1 << 18, mv_ring_size=1 << 23,
+    ))
+    eng.execute("""
+    CREATE SOURCE person (
+        id BIGINT, name VARCHAR, date_time TIMESTAMP,
+        WATERMARK FOR date_time AS date_time - INTERVAL '4' SECOND
+    ) WITH (connector = 'nexmark', nexmark.table = 'person',
+            nexmark.event.rate = '1000000');
+    CREATE SOURCE auction (
+        id BIGINT, seller BIGINT, reserve BIGINT, expires TIMESTAMP,
+        date_time TIMESTAMP,
+        WATERMARK FOR date_time AS date_time - INTERVAL '4' SECOND
+    ) WITH (connector = 'nexmark', nexmark.table = 'auction',
+            nexmark.event.rate = '1000000');
+    CREATE MATERIALIZED VIEW bench_mv AS
+    SELECT p.id AS id, p.name AS name, a.reserve AS reserve
+    FROM TUMBLE(person, date_time, INTERVAL '1' SECOND) p
+    JOIN TUMBLE(auction, date_time, INTERVAL '1' SECOND) a
+    ON p.id = a.seller AND p.window_start = a.window_start;
+    """)
+    eng.tick(barriers=2, chunks_per_barrier=2)  # warm state + compile
+    job = eng.jobs[0]
+    from risingwave_tpu.stream.dag import JoinNode
+
+    jidx = next(i for i, n in enumerate(job.nodes)
+                if isinstance(n, JoinNode))
+    join = job.nodes[jidx].join
+    # prep fragments feeding the join (wm filter + tumble per side)
+    src = "p"
+    reader = job.sources[src]
+
+    prep_idx = next(
+        i for i, n in enumerate(job.nodes)
+        if not isinstance(n, JoinNode) and n.input == ("source", src)
+    )
+    prep = job.nodes[prep_idx].fragment
+
+    @jax.jit
+    def gen_only(k0):
+        return reader.impl(k0, reader.cap)
+
+    @jax.jit
+    def gen_prep(states, k0):
+        chunk = reader.impl(k0, reader.cap)
+        return prep._step_impl(states, chunk)
+
+    @jax.jit
+    def join_begin(jstate, chunk):
+        return join.apply_begin(jstate, chunk, "left")
+
+    @jax.jit
+    def emit0(jstate, pending):
+        build = join.build_rows_of(jstate, "left")
+        return join.emit_window(build, pending, jnp.int32(0), "left")
+
+    k0 = jnp.int64(10_000_000)
+    timeit("source gen only", lambda: gen_only(k0))
+    st_prep = job.states[prep_idx]
+    _, chunk = gen_prep(st_prep, k0)
+    timeit("gen + wm + tumble", lambda: gen_prep(st_prep, k0)[1])
+    jstate = job.states[jidx]
+    st2, pending = join_begin(jstate, chunk)
+    timeit("join apply_begin", lambda: join_begin(jstate, chunk)[1])
+    timeit("emit window 0", lambda: emit0(st2, pending)[0])
+    print("max_windows:", join.max_windows(CAP),
+          "out_capacity:", join.out_capacity)
+    print("pending total (this chunk):", int(pending.total))
+
+    # whole-step reference (the real per-chunk cost)
+    prog, fused = job._step_programs.get(src, (None, None))
+    if prog is None:
+        job._step_programs[src] = job._make_step(src)
+        prog, fused = job._step_programs[src]
+    job.states = prog(job.states, k0)
+    jax.block_until_ready(job.states)
+
+    def full():
+        return prog(job.states, jnp.int64(reader.next_base()))
+
+    t0 = time.perf_counter()
+    N = 20
+    for _ in range(N):
+        job.states = full()
+    jax.block_until_ready(job.states)
+    dt = (time.perf_counter() - t0) / N
+    print(f"{'FULL step (person side)':42s} {dt * 1e3:9.2f} ms  "
+          f"({CAP / dt / 1e6:7.2f}M rows/s/side)")
+
+
+if __name__ == "__main__":
+    main()

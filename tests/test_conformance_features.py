@@ -185,3 +185,140 @@ def test_parse_rows_between_frame():
               "ROWS BETWEEN 10 PRECEDING AND CURRENT ROW) FROM t")[0]
     w = s.items[0].expr
     assert w.frame == (10, 0)
+
+
+def test_distinct_mixed_with_filters_q15_shape():
+    eng = small_engine()
+    eng.execute("CREATE TABLE bid (auction BIGINT, bidder BIGINT, "
+                "price BIGINT)")
+    rows = [(1, b, p) for b, p in
+            [(0, 500), (0, 20000), (1, 500), (1, 500), (2, 2000000),
+             (3, 20000), (3, 500), (4, 20000)]]
+    for a, b, p in rows:
+        eng.execute(f"INSERT INTO bid VALUES ({a},{b},{p})")
+    eng.execute(
+        "CREATE MATERIALIZED VIEW v AS SELECT auction, "
+        "count(*) AS total, "
+        "count(distinct bidder) AS bidders, "
+        "count(distinct bidder) filter (where price < 10000) AS b1, "
+        "count(distinct bidder) filter (where price >= 10000) AS b2, "
+        "sum(distinct price) AS sp "
+        "FROM bid GROUP BY auction"
+    )
+    eng.tick(barriers=2)
+    (r,) = eng.execute("SELECT * FROM v")
+    # b1: bidders {0,1,3} with price<10000; b2: {0,2,3,4} >= 10000
+    assert r == (1, 8, 5, 3, 4, 500 + 20000 + 2000000)
+
+
+def test_distinct_retracts_on_deletes():
+    """Retractable input: distinct counts fall when the last copy of a
+    value retracts (counted dedup state, ref distinct.rs)."""
+    eng = small_engine()
+    eng.execute("CREATE TABLE auction (id BIGINT, cat BIGINT, "
+                "PRIMARY KEY (id))")
+    eng.execute("CREATE TABLE bid (auction BIGINT, bidder BIGINT)")
+    eng.execute("INSERT INTO auction VALUES (1, 10)")
+    for b in (7, 7, 8):
+        eng.execute(f"INSERT INTO bid VALUES (1, {b})")
+    # the join output retracts when auction rows change; distinct
+    # bidder count rides the transitions
+    eng.execute(
+        "CREATE MATERIALIZED VIEW v AS SELECT COUNT(DISTINCT b.bidder) "
+        "AS db FROM auction a JOIN bid b ON a.id = b.auction"
+    )
+    eng.tick(barriers=2)
+    assert eng.execute("SELECT * FROM v") == [(2,)]
+    eng.execute("INSERT INTO bid VALUES (1, 9)")
+    eng.tick(barriers=2)
+    assert eng.execute("SELECT * FROM v") == [(3,)]
+
+
+def test_in_and_not_in_subquery_q103_q104():
+    eng = small_engine()
+    eng.execute("CREATE TABLE auction (id BIGINT, item_name VARCHAR, "
+                "PRIMARY KEY (id))")
+    eng.execute("CREATE TABLE bid (auction BIGINT, bidder BIGINT)")
+    for aid in range(5):
+        eng.execute(f"INSERT INTO auction VALUES ({aid},'i{aid}')")
+    for a, n in ((0, 3), (1, 1), (2, 2)):
+        for i in range(n):
+            eng.execute(f"INSERT INTO bid VALUES ({a},{i})")
+    eng.execute(
+        "CREATE MATERIALIZED VIEW v103 AS SELECT a.id AS aid FROM "
+        "auction a WHERE a.id IN (SELECT b.auction FROM bid b "
+        "GROUP BY b.auction HAVING COUNT(*) >= 2)"
+    )
+    eng.execute(
+        "CREATE MATERIALIZED VIEW v104 AS SELECT a.id AS aid FROM "
+        "auction a WHERE a.id NOT IN (SELECT b.auction FROM bid b "
+        "GROUP BY b.auction HAVING COUNT(*) < 2)"
+    )
+    eng.tick(barriers=2)
+    assert sorted(eng.execute("SELECT aid FROM v103")) == [(0,), (2,)]
+    assert sorted(eng.execute("SELECT aid FROM v104")) == \
+        [(0,), (2,), (3,), (4,)]
+    eng.execute("INSERT INTO bid VALUES (1, 9)")  # auction 1 now has 2
+    eng.tick(barriers=2)
+    assert sorted(eng.execute("SELECT aid FROM v103")) == \
+        [(0,), (1,), (2,)]
+    assert sorted(eng.execute("SELECT aid FROM v104")) == \
+        [(0,), (1,), (2,), (3,), (4,)]
+
+
+def test_scalar_subquery_having_dynamic_filter_q102():
+    eng = small_engine()
+    eng.execute("CREATE TABLE auction (id BIGINT, item_name VARCHAR, "
+                "PRIMARY KEY (id))")
+    eng.execute("CREATE TABLE bid (auction BIGINT, bidder BIGINT)")
+    for aid in range(4):
+        eng.execute(f"INSERT INTO auction VALUES ({aid},'i{aid}')")
+    for a, n in ((0, 5), (1, 1), (2, 3), (3, 2)):
+        for i in range(n):
+            eng.execute(f"INSERT INTO bid VALUES ({a},{i})")
+    eng.execute(
+        "CREATE MATERIALIZED VIEW v AS SELECT a.id AS aid, "
+        "COUNT(b.auction) AS bc FROM auction a JOIN bid b "
+        "ON a.id = b.auction GROUP BY a.id, a.item_name "
+        "HAVING COUNT(b.auction) >= "
+        "(SELECT COUNT(*) / COUNT(DISTINCT auction) FROM bid)"
+    )
+    eng.tick(barriers=2)
+    # 11 bids / 4 auctions = 2 -> {0:5, 2:3, 3:2}
+    assert sorted(eng.execute("SELECT aid, bc FROM v")) == \
+        [(0, 5), (2, 3), (3, 2)]
+    # threshold moves up; previously-passing groups must retract
+    for i in range(9):
+        eng.execute(f"INSERT INTO bid VALUES (1, {100 + i})")
+    eng.tick(barriers=2)
+    # 20 bids / 4 = 5 -> {0:5, 1:10}
+    assert sorted(eng.execute("SELECT aid, bc FROM v")) == \
+        [(0, 5), (1, 10)]
+
+
+def test_sql_udf_inline_q14():
+    eng = small_engine()
+    eng.execute("CREATE TABLE t (s VARCHAR, c VARCHAR)")
+    eng.execute("INSERT INTO t VALUES ('accbcac', 'c')")
+    eng.execute(
+        "CREATE FUNCTION count_char(s varchar, c varchar) RETURNS int "
+        "LANGUAGE SQL AS $$SELECT LENGTH(s) - LENGTH(REPLACE(s, c, ''))$$"
+    )
+    eng.execute("CREATE MATERIALIZED VIEW v AS "
+                "SELECT count_char(s, c) AS n FROM t")
+    eng.tick(barriers=2)
+    assert eng.execute("SELECT * FROM v") == [(4,)]
+
+
+def test_sql_udf_duplicate_and_arity_errors():
+    import pytest
+    eng = small_engine()
+    eng.execute("CREATE FUNCTION one(x int) RETURNS int "
+                "LANGUAGE SQL AS 'SELECT x + 1'")
+    with pytest.raises(ValueError, match="already exists"):
+        eng.execute("CREATE FUNCTION one(x int) RETURNS int "
+                    "LANGUAGE SQL AS 'SELECT x'")
+    eng.execute("CREATE TABLE t (a BIGINT)")
+    with pytest.raises(ValueError, match="takes 1 arguments"):
+        eng.execute("CREATE MATERIALIZED VIEW v AS "
+                    "SELECT one(a, a) AS n FROM t")

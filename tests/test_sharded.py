@@ -535,3 +535,58 @@ def test_sharded_exchange_carries_ncol():
             total += 1
     assert total == 8 * cap            # nothing lost
     assert len(null_shards) == 1       # NULL keys on exactly one shard
+
+
+def test_sql_sharded_global_topn_matches_linear():
+    """GROUP BY + ORDER BY/LIMIT plans sharded: per-shard bands hold a
+    superset of the global top-k and the serving read applies the
+    global order+limit (r3 verdict ask #8 — q4/q6-shaped plans stop
+    falling back to linear)."""
+    from risingwave_tpu.sql import Engine
+    from risingwave_tpu.sql.planner import PlannerConfig
+
+    SQL = ("CREATE MATERIALIZED VIEW v AS SELECT auction, count(*) AS n "
+           "FROM bid GROUP BY auction ORDER BY n DESC, auction LIMIT 5")
+
+    def build(par):
+        eng = Engine(PlannerConfig(
+            chunk_capacity=128, agg_table_size=512, agg_emit_capacity=128,
+            mv_table_size=512, mv_ring_size=1024,
+            topn_pool_size=512, topn_emit_capacity=128,
+        ))
+        eng.execute(
+            "CREATE SOURCE bid (auction BIGINT, price BIGINT, "
+            "date_time TIMESTAMP) WITH (connector='nexmark', "
+            "nexmark.table='bid')"
+        )
+        if par:
+            eng.execute(f"SET streaming_parallelism = {par}")
+        eng.execute(SQL)
+        return eng
+
+    from risingwave_tpu.stream.sharded import ShardedStreamingJob
+    a = build(0)
+    b = build(8)
+    assert isinstance(b.jobs[0], ShardedStreamingJob), \
+        "global TopN should shard now"
+
+    # equal row counts: linear 8 chunks of 128 = sharded 1 step of 8x128
+    a.tick(barriers=1, chunks_per_barrier=8)
+    b.jobs[0].run_chunk()
+    b.jobs[0].inject_barrier()
+
+    got_a = a.execute("SELECT auction, n FROM v")
+    got_b = b.execute("SELECT auction, n FROM v")
+    # band CONTENT matches (linear serving returns band rows unordered;
+    # the sharded read merges + orders via serving_topn)
+    assert sorted(tuple(map(int, r)) for r in got_a) == \
+        sorted(tuple(map(int, r)) for r in got_b)
+    assert len(got_b) == 5
+    # and the band is the true top-5 (ground truth)
+    from risingwave_tpu.connector.nexmark import NexmarkGenerator
+    g = NexmarkGenerator()
+    _, cols, _ = g.gen_bids(0, 1024).to_host()
+    import collections
+    cnt = collections.Counter(int(x) for x in cols[0])
+    want = sorted(cnt.items(), key=lambda kv: (-kv[1], kv[0]))[:5]
+    assert [tuple(map(int, r)) for r in got_b] == want

