@@ -107,6 +107,15 @@ class SubqueryRef:
 
 
 @dataclass(frozen=True)
+class AlterParallelism:
+    """ALTER MATERIALIZED VIEW <name> SET PARALLELISM <n> — online
+    rescale at a barrier (ref scale.rs reschedule)."""
+
+    name: str
+    parallelism: int
+
+
+@dataclass(frozen=True)
 class CreateFunction:
     """CREATE FUNCTION ... LANGUAGE SQL — inlined at plan time (the
     reference compiles SQL UDFs by inlining too: expr/impl udf)."""

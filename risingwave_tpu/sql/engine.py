@@ -273,11 +273,45 @@ class Engine:
             ]
         if isinstance(stmt, ast.Explain):
             return self._explain(stmt.statement)
+        if isinstance(stmt, ast.AlterParallelism):
+            return self._alter_parallelism(stmt)
         if isinstance(stmt, ast.Insert):
             return self._insert(stmt)
         if isinstance(stmt, ast.Select):
             return self._serve(stmt)
         raise ValueError(f"unhandled statement {stmt!r}")
+
+    def _alter_parallelism(self, stmt: ast.AlterParallelism):
+        """Online rescale of a running sharded MV at a barrier (ref
+        ScaleController reschedule, scale.rs:224)."""
+        from risingwave_tpu.stream.sharded import ShardedStreamingJob
+
+        entry = self.catalog.get(stmt.name)
+        if entry.kind != "mview" or not isinstance(
+            entry.job, ShardedStreamingJob
+        ):
+            raise ValueError(
+                f"{stmt.name} is not a sharded materialized view "
+                "(linear jobs re-plan via DROP + CREATE with "
+                "streaming_parallelism set)"
+            )
+        import jax as _jax
+        n = stmt.parallelism
+        if n < 2 or n > len(_jax.devices()):
+            raise ValueError(
+                f"parallelism {n} outside [2, {len(_jax.devices())}]"
+            )
+        entry.job.rescale(n)
+        # retained checkpoints hold the OLD state-tree shape; re-seed
+        # so recovery restores the new topology (recover() rebuilds the
+        # mesh to the checkpoint's shard dim)
+        if self.checkpoint_store is not None:
+            self.checkpoint_store.save(
+                entry.job.name, entry.job.committed_epoch,
+                entry.job.states,
+                {"offset": entry.job.reader.offset},
+            )
+        return None
 
     def _insert(self, stmt: ast.Insert):
         entry = self.catalog.get(stmt.table)
@@ -812,15 +846,25 @@ class Engine:
         # (ref: per-actor TopN + singleton merge, executor/top_n/; the
         # merge here rides the serving boundary instead of a singleton
         # fragment).  Sinks stay linear (host delivery ordering).
+        from risingwave_tpu.stream.sink import SinkExecutor as _SK
         from risingwave_tpu.stream.top_n import GroupTopNExecutor as _T
         topn_spec = None
+        has_sink = False
         for ex in execs[agg_idx + 1:]:
             if isinstance(ex, _T) and not ex.group_by \
                     and ex.rank_alias is None:
                 topn_spec = (ex.order_by, ex.limit, ex.offset)
                 continue
+            if isinstance(ex, _SK):
+                # per-shard ring cursors; host merge delivery at the
+                # snapshot barrier (ShardedStreamingJob._deliver_sinks)
+                has_sink = True
+                continue
             if not isinstance(ex, (_F, _P, _M, _AOM)):
                 return None
+        if topn_spec is not None and has_sink:
+            # a sink must see the GLOBAL band, not per-shard bands
+            return None
         agg = execs[agg_idx]
         n = min(par, len(jax.devices()))
         if n < 2:

@@ -164,9 +164,19 @@ class Parser:
                 kind = "materialized views"
             return ast.ShowStatement(kind)
         if self.accept_word("alter"):
-            self.expect_word("system")
+            if self.accept_word("system"):
+                self.expect_word("set")
+                return self._set(system=True)
+            self.expect_word("materialized")
+            self.expect_word("view")
+            name = self.ident()
             self.expect_word("set")
-            return self._set(system=True)
+            self.expect_word("parallelism")
+            self.accept_op("=") or self.accept_word("to")
+            t = self.next()
+            if t.kind != "number" or not t.value.isdigit():
+                raise ParseError("SET PARALLELISM needs an integer")
+            return ast.AlterParallelism(name, int(t.value))
         if self.accept_word("set"):
             return self._set(system=False)
         if self.accept_word("insert"):

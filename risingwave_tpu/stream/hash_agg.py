@@ -66,7 +66,7 @@ from risingwave_tpu.common.compact import (
 )
 from risingwave_tpu.common.hash import hash64_columns
 from risingwave_tpu.common.types import Field, Schema
-from risingwave_tpu.expr.node import Expr
+from risingwave_tpu.expr.node import Expr, InputRef
 from risingwave_tpu.expr.agg import AggCall
 from risingwave_tpu.state.hash_table import HashTable, gather_key, keys_equal
 from risingwave_tpu.stream.executor import Executor
@@ -713,6 +713,57 @@ class HashAggExecutor(Executor):
             spill_ops=spill_ops,
             spill_count=spill_count,
         ), None
+
+    def reconstructible_from_rows(self) -> bool:
+        """True when the agg's full state round-trips through its own
+        input rows: plain InputRef keys in order and one sum/sum0/min/
+        max call per trailing input column — exactly the GLOBAL half of
+        a two-phase pair (translated_global_calls).  Such an agg can be
+        rebuilt on a different mesh by re-applying extracted rows (the
+        online-rescale path, ref scale.rs: state follows vnodes)."""
+        n_keys = len(self.group_by)
+        for ki, (_, e) in enumerate(self.group_by):
+            if not (isinstance(e, InputRef) and e.index == ki):
+                return False
+        if self._minput_aggs or self._distinct_aggs:
+            return False
+        for ai, a in enumerate(self.aggs):
+            if a.kind not in ("sum", "sum0", "min", "max") \
+                    or a.distinct or a.filter is not None:
+                return False
+            if not (isinstance(a.arg, InputRef)
+                    and a.arg.index == n_keys + ai):
+                return False
+            if self.in_schema[n_keys + ai].data_type.is_string:
+                # string min/max state is a PACKED int64 (_pack_str8);
+                # extract_chunk cannot emit it as the string input col
+                return False
+        return len(self.in_schema) == n_keys + len(self.aggs)
+
+    def extract_chunk(self, state_host) -> Chunk:
+        """One INPUT-schema chunk holding every live group's state
+        (host arrays; capacity = table_size).  Re-applying it to a
+        fresh state reconstructs the aggregation exactly — valid only
+        when ``reconstructible_from_rows()``."""
+        n_keys = len(self.group_by)
+        cols = list(state_host.table.key_cols)
+        pi = 0
+        for ai, a in enumerate(self.aggs):
+            spec = a.spec()
+            val = state_host.prims[pi]
+            pi += len(spec.states)
+            f = self.in_schema[n_keys + ai]
+            if f.nullable and ai in self._nn_prim:
+                nn = state_host.prims[self._nn_prim[ai]]
+                val = NCol(jnp.asarray(val), jnp.asarray(nn == 0))
+            cols.append(val)
+        occ = jnp.asarray(state_host.table.occupied)
+        return Chunk(
+            tuple(jnp.asarray(c) if not isinstance(c, (NCol, StrCol))
+                  else c for c in cols),
+            jnp.zeros((self.table_size,), jnp.int8),
+            occ, self.in_schema,
+        )
 
     def drain_spill(self, state: AggState):
         """(state with an empty ring, Chunk of the diverted rows).

@@ -359,6 +359,7 @@ class ShardedStreamingJob:
             self._ckpts_since_snapshot += 1
             if self._ckpts_since_snapshot >= self.snapshot_interval:
                 self._ckpts_since_snapshot = 0
+                self._deliver_sinks(sealed)
                 snap_states = _snapshot_copy(self.states)
                 self._mem_snapshot = (
                     sealed, snap_states, {"offset": self.reader.offset}
@@ -376,6 +377,29 @@ class ShardedStreamingJob:
             loaded = self.checkpoint_store.load(self.name)
             if loaded is not None:
                 epoch, states, src = loaded
+                # an online rescale may have committed a DIFFERENT
+                # parallelism than the DDL replanned: rebuild the mesh
+                # to the checkpoint's shard dim (state is authoritative
+                # — silently truncating shards would drop groups)
+                n_ckpt = jax.tree.leaves(states)[0].shape[0]
+                if n_ckpt != self.sharded.n_shards:
+                    if n_ckpt > len(jax.devices()):
+                        raise RuntimeError(
+                            f"checkpoint has {n_ckpt} shards but only "
+                            f"{len(jax.devices())} devices are visible"
+                        )
+                    old = self.sharded
+                    self.sharded = ShardedJob(
+                        make_mesh(n_ckpt),
+                        source_fn=old.source_fn,
+                        chunk_capacity=old.cap,
+                        local_executors=list(
+                            old.local_frag.executors
+                            if old.local_frag else []
+                        ),
+                        exchange_key_fn=old.exchange_key_fn,
+                        keyed_executors=list(old.keyed_frag.executors),
+                    )
                 sharding = jax.NamedSharding(
                     self.sharded.mesh, P(self.sharded.AXIS)
                 )
@@ -397,6 +421,151 @@ class ShardedStreamingJob:
         self.states = self.sharded.init_states()
         if hasattr(self.reader, "offset"):
             self.reader.offset = 0
+
+    def _deliver_sinks(self, sealed: int) -> None:
+        """Per-shard sink cursors, merged host-side at the snapshot
+        barrier (ref sink.rs delivery; cross-shard row order is
+        unspecified, matching the reference's per-parallelism sinks).
+        The cursors live in the sharded state tree, so delivery and
+        the checkpoint commit share one cadence — exactly-once across
+        recovery."""
+        states = list(self.states)
+        for i, ex in enumerate(self.sharded.executors):
+            if not hasattr(ex, "deliver"):
+                continue
+            host_shards = []
+            for s in range(self.sharded.n_shards):
+                st = jax.tree.map(lambda x: x[s], states[i])
+                # every shard's rows first; ONE commit marker per epoch
+                # (the closed-epoch reader protocol, sinks.py)
+                host_shards.append(ex.deliver(st, sealed, commit=False))
+            ex.sink.commit(sealed)
+            states[i] = jax.device_put(
+                jax.tree.map(lambda *xs: jnp.stack(xs), *host_shards),
+                jax.NamedSharding(self.sharded.mesh,
+                                  P(self.sharded.AXIS)),
+            )
+        self.states = tuple(states)
+
+    # -- online rescale --------------------------------------------------
+    def rescale(self, new_n: int) -> None:
+        """Re-parallelize at a barrier: N → new_n shards.
+
+        Ref: ``ScaleController`` reschedules by reassigning vnode
+        ownership at a barrier and letting state follow vnodes through
+        shared storage (src/meta/src/stream/scale.rs:224,336).  Here
+        state is device-resident, so it MOVES: the keyed aggregation's
+        live groups are extracted as input-schema rows, re-routed by
+        the same vnode map onto the new mesh, and re-applied; every
+        downstream state (TopN bands, the MV) rebuilds from the agg's
+        first post-rescale flush, which re-emits all groups against
+        fresh prev-state.  Watermarks carry over conservatively (the
+        old global min seeds every new shard)."""
+        from risingwave_tpu.parallel.exchange import (
+            compute_vnodes, shard_of_vnode,
+        )
+        from risingwave_tpu.stream.hash_agg import (
+            HashAggExecutor as _A,
+        )
+        from risingwave_tpu.stream.watermark import (
+            WatermarkFilterExecutor as _W,
+        )
+
+        old = self.sharded
+        if new_n == old.n_shards:
+            return
+        keyed = old.keyed_frag.executors
+        if not (keyed and isinstance(keyed[0], _A)
+                and keyed[0].reconstructible_from_rows()):
+            raise ValueError(
+                "online rescale needs a two-phase keyed aggregation "
+                "(partial -> exchange -> global); this job's keyed "
+                "stage cannot be re-keyed (minput/distinct state or a "
+                "non-agg head): next round"
+            )
+        if any(hasattr(ex, "deliver") for ex in keyed):
+            # downstream rebuild re-emits every group — a sink would
+            # re-deliver them as duplicates
+            raise ValueError("online rescale of sink jobs: next round")
+        agg = keyed[0]
+        # 1. seal in-flight state at a barrier
+        sealed = self.epoch.curr.value
+        self.states, _ = old.flush(self.states, sealed)
+        host = jax.device_get(self.states)
+        n_local = len(old.local_frag.executors) if old.local_frag else 0
+
+        # 2. extract live groups per OLD shard + the global watermark
+        chunks = []
+        for s in range(old.n_shards):
+            st = jax.tree.map(lambda x: x[s], host)
+            chunks.append(agg.extract_chunk(st[n_local]))
+        wm_mins: dict[int, int] = {}
+        for i, ex in enumerate(
+            old.local_frag.executors if old.local_frag else []
+        ):
+            if isinstance(ex, _W):
+                wm_mins[i] = min(
+                    int(host[i].max_ts[s]) for s in range(old.n_shards)
+                )
+
+        # 3. fresh job on the new mesh (same executor descriptors)
+        new = ShardedJob(
+            make_mesh(new_n),
+            source_fn=old.source_fn,
+            chunk_capacity=old.cap,
+            local_executors=list(
+                old.local_frag.executors if old.local_frag else []
+            ),
+            exchange_key_fn=old.exchange_key_fn,
+            keyed_executors=list(keyed),
+        )
+        states = jax.device_get(new.init_states())
+
+        # 4. route extracted rows by the SAME vnode map onto new shards
+        import numpy as np
+
+        @jax.jit
+        def dest_of(chunk):
+            keys = old.exchange_key_fn(chunk)
+            return shard_of_vnode(compute_vnodes(keys), new_n)
+
+        @jax.jit
+        def apply_keyed(keyed_states, chunk):
+            out, _ = new.keyed_frag._step_impl(keyed_states, chunk)
+            return out
+
+        per_shard = [jax.tree.map(lambda x: x[t], states)
+                     for t in range(new_n)]
+        for chunk in chunks:
+            chunk = jax.tree.map(jnp.asarray, chunk)
+            dests = np.asarray(dest_of(chunk))
+            for t in range(new_n):
+                keep = jnp.asarray((dests == t)) & chunk.valid
+                if not bool(np.asarray(keep).any()):
+                    continue
+                sub = chunk.mask(keep)
+                ks = tuple(per_shard[t][n_local:])
+                ks = apply_keyed(ks, sub)
+                per_shard[t] = tuple(per_shard[t][:n_local]) + tuple(ks)
+        # watermark seeds
+        for i, wm in wm_mins.items():
+            for t in range(new_n):
+                lst = list(per_shard[t])
+                lst[i] = lst[i]._replace(
+                    max_ts=jnp.asarray(wm, jnp.int64)
+                )
+                per_shard[t] = tuple(lst)
+
+        restacked = jax.tree.map(
+            lambda *xs: jnp.stack(xs), *per_shard
+        )
+        sharding = jax.NamedSharding(new.mesh, P(new.AXIS))
+        self.sharded = new
+        self.states = jax.device_put(restacked, sharding)
+        # 5. first flush re-emits every group into the fresh downstream
+        # states (TopN bands, MV) before anything is served
+        self.states, _ = self.sharded.flush(self.states, sealed)
+        self._mem_snapshot = None  # old-shape snapshots are invalid
 
     # serving: per-shard MV partitions merged host-side
     def mv_rows(self, mv_executor, state_index: int):

@@ -80,8 +80,13 @@ class SinkExecutor(Executor):
         ), None
 
     # -- host barrier hook ----------------------------------------------
-    def deliver(self, state: SinkState, epoch: int) -> SinkState:
-        """Drain new rows to the connector; commit the epoch."""
+    def deliver(self, state: SinkState, epoch: int,
+                commit: bool = True) -> SinkState:
+        """Drain new rows to the connector; commit the epoch.
+
+        ``commit=False`` defers the epoch commit marker — the sharded
+        runtime drains every shard's ring first and commits ONCE, so
+        readers of the closed-epoch protocol see one marker per epoch."""
         from risingwave_tpu.common.chunk import apply_null_mask, split_col
 
         total = int(state.cursor)
@@ -116,5 +121,6 @@ class SinkExecutor(Executor):
             rows = [tuple(c[i] for c in cols) for i in range(n)]
             self.sink.write_batch(self.in_schema.names(), ops, rows)
             state = state._replace(read_cursor=jnp.int64(total))
-        self.sink.commit(epoch)
+        if commit:
+            self.sink.commit(epoch)
         return state

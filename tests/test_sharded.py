@@ -590,3 +590,168 @@ def test_sql_sharded_global_topn_matches_linear():
     cnt = collections.Counter(int(x) for x in cols[0])
     want = sorted(cnt.items(), key=lambda kv: (-kv[1], kv[0]))[:5]
     assert [tuple(map(int, r)) for r in got_b] == want
+
+
+def test_online_rescale_2_to_4_converges():
+    """ALTER MATERIALIZED VIEW ... SET PARALLELISM mid-stream: state
+    moves to the new mesh at a barrier and results converge with an
+    undisturbed run (r3 verdict ask #7; ref scale.rs reschedule)."""
+    from risingwave_tpu.sql import Engine
+    from risingwave_tpu.sql.planner import PlannerConfig
+
+    def build(par):
+        eng = Engine(PlannerConfig(
+            chunk_capacity=128, agg_table_size=512, agg_emit_capacity=128,
+            mv_table_size=512, mv_ring_size=1024,
+        ))
+        eng.execute(
+            "CREATE SOURCE bid (auction BIGINT, price BIGINT, "
+            "date_time TIMESTAMP) WITH (connector='nexmark', "
+            "nexmark.table='bid')"
+        )
+        eng.execute(f"SET streaming_parallelism = {par}")
+        eng.execute(
+            "CREATE MATERIALIZED VIEW v AS SELECT auction, "
+            "count(*) AS n, max(price) AS hi FROM bid GROUP BY auction"
+        )
+        return eng
+
+    eng = build(2)
+    from risingwave_tpu.stream.sharded import ShardedStreamingJob
+    job = eng.jobs[0]
+    assert isinstance(job, ShardedStreamingJob)
+    assert job.sharded.n_shards == 2
+
+    # phase 1 on 2 shards: 2 chunk-units = 2*2*128 = 512 rows
+    job.run_chunk(); job.run_chunk(); job.inject_barrier()
+    eng.execute("ALTER MATERIALIZED VIEW v SET PARALLELISM 4")
+    assert job.sharded.n_shards == 4
+    mid = {int(r[0]): (int(r[1]), int(r[2]))
+           for r in eng.execute("SELECT auction, n, hi FROM v")}
+
+    # phase 2 on 4 shards: 1 chunk-unit = 4*128 = 512 rows
+    job.run_chunk(); job.inject_barrier()
+    got = {int(r[0]): (int(r[1]), int(r[2]))
+           for r in eng.execute("SELECT auction, n, hi FROM v")}
+
+    from risingwave_tpu.connector.nexmark import NexmarkGenerator
+
+    def want(total):
+        g = NexmarkGenerator()
+        _, cols, _ = g.gen_bids(0, total).to_host()
+        out = {}
+        for auc, pr in zip(cols[0], cols[2]):
+            n, hi = out.get(int(auc), (0, 0))
+            out[int(auc)] = (n + 1, max(hi, int(pr)))
+        return out
+
+    assert mid == want(512), "state lost/duplicated across rescale"
+    assert got == want(1024), "post-rescale stream diverged"
+
+
+def test_sharded_sink_delivers_exactly_once_across_recovery():
+    """A sharded agg job with a file sink: per-shard ring cursors merge
+    at the snapshot barrier; recovery neither duplicates nor drops
+    (r3 verdict ask #8, sink half)."""
+    import json as _json
+
+    from risingwave_tpu.sql import Engine
+    from risingwave_tpu.sql.planner import PlannerConfig
+
+    import tempfile, os
+    tmp = tempfile.mkdtemp()
+    path = os.path.join(tmp, "out.jsonl")
+    data_dir = os.path.join(tmp, "ckpt")
+
+    def build():
+        eng = Engine(PlannerConfig(
+            chunk_capacity=128, agg_table_size=512, agg_emit_capacity=128,
+            mv_table_size=512, mv_ring_size=2048,
+        ), data_dir=data_dir)
+        eng.execute(
+            "CREATE SOURCE bid (auction BIGINT, price BIGINT, "
+            "date_time TIMESTAMP) WITH (connector='nexmark', "
+            "nexmark.table='bid')"
+        )
+        eng.execute("SET streaming_parallelism = 4")
+        eng.execute(
+            "CREATE SINK s AS SELECT auction, count(*) AS n FROM bid "
+            f"GROUP BY auction WITH (connector='file', path='{path}')"
+        )
+        return eng
+
+    eng = build()
+    from risingwave_tpu.stream.sharded import ShardedStreamingJob
+    job = eng.jobs[0]
+    assert isinstance(job, ShardedStreamingJob), "sink job should shard"
+    job.run_chunk()
+    job.inject_barrier()
+
+    # fold the delivered changelog: per-key latest insert wins
+    def fold():
+        state = {}
+        for line in open(path):
+            r = _json.loads(line)
+            if r["op"] in ("insert", "update_insert"):
+                state[r["auction"]] = r["n"]
+            elif r["op"] in ("delete", "update_delete"):
+                state.pop(r["auction"], None)
+        return state
+
+    from risingwave_tpu.connector.nexmark import NexmarkGenerator
+    import collections
+    g = NexmarkGenerator()
+    _, cols, _ = g.gen_bids(0, 512).to_host()
+    want1 = dict(collections.Counter(int(x) for x in cols[0]))
+    assert fold() == want1
+
+    # crash + recover: delivery resumes from the committed cursor
+    eng2 = build()
+    eng2.recover()
+    job2 = eng2.jobs[0]
+    job2.run_chunk()
+    job2.inject_barrier()
+    _, cols, _ = g.gen_bids(0, 1024).to_host()
+    want2 = dict(collections.Counter(int(x) for x in cols[0]))
+    assert fold() == want2, "duplicated or lost sink rows after recovery"
+
+
+def test_rescale_survives_recovery_with_stale_ddl_parallelism():
+    """A rescaled job's checkpoint is authoritative: recovery rebuilds
+    the mesh to the checkpoint's shard dim even when the replanned DDL
+    asked for the old parallelism."""
+    import tempfile
+    from risingwave_tpu.sql import Engine
+    from risingwave_tpu.sql.planner import PlannerConfig
+
+    data_dir = tempfile.mkdtemp()
+
+    def build():
+        eng = Engine(PlannerConfig(
+            chunk_capacity=128, agg_table_size=512, agg_emit_capacity=128,
+            mv_table_size=512, mv_ring_size=1024,
+        ), data_dir=data_dir)
+        eng.execute(
+            "CREATE SOURCE bid (auction BIGINT, price BIGINT, "
+            "date_time TIMESTAMP) WITH (connector='nexmark', "
+            "nexmark.table='bid')"
+        )
+        eng.execute("SET streaming_parallelism = 2")
+        eng.execute(
+            "CREATE MATERIALIZED VIEW v AS SELECT auction, "
+            "count(*) AS n FROM bid GROUP BY auction"
+        )
+        return eng
+
+    eng = build()
+    job = eng.jobs[0]
+    job.run_chunk()
+    job.inject_barrier()
+    eng.execute("ALTER MATERIALIZED VIEW v SET PARALLELISM 4")
+    want = sorted(map(tuple, eng.execute("SELECT * FROM v")))
+
+    eng2 = build()          # DDL replans at parallelism 2
+    eng2.recover()
+    job2 = eng2.jobs[0]
+    assert job2.sharded.n_shards == 4, "checkpoint topology not restored"
+    assert sorted(map(tuple, eng2.execute("SELECT * FROM v"))) == want

@@ -102,3 +102,38 @@ def test_agg_spill_recovery(tmp_path):
     eng2.recover()
     got = sorted(map(tuple, eng2.execute("SELECT * FROM mv")))
     assert got == want
+
+
+def test_dag_agg_spill_over_join():
+    """Spill drains for aggregations inside DAG jobs too (join → agg):
+    the tier's changelog injects through the node's remaining
+    executors and propagates downstream."""
+    eng = spill_engine()
+    eng.execute("CREATE TABLE item (id BIGINT, grp BIGINT, "
+                "PRIMARY KEY (id))")
+    eng.execute("CREATE TABLE hit (item BIGINT, w BIGINT)")
+    n_groups = 200  # >> agg_table_size(64)
+    for i in range(n_groups):
+        eng.execute(f"INSERT INTO item VALUES ({i},{i % 7})")
+    rows = []
+    for i in range(n_groups):
+        for r in range(2):
+            rows.append((i, 10 * i + r))
+    for i in range(0, len(rows), 64):
+        vals = ",".join(f"({a},{b})" for a, b in rows[i:i + 64])
+        eng.execute(f"INSERT INTO hit VALUES {vals}")
+    eng.execute(
+        "CREATE MATERIALIZED VIEW mv AS SELECT h.item AS k, "
+        "count(*) AS n, sum(h.w) AS s FROM hit h "
+        "JOIN item i ON h.item = i.id GROUP BY h.item"
+    )
+    eng.tick(barriers=6)
+    got = {int(r[0]): (int(r[1]), int(r[2]))
+           for r in eng.execute("SELECT * FROM mv")}
+    want = {i: (2, 10 * i + 10 * i + 1) for i in range(n_groups)}
+    assert len(got) == n_groups, len(got)
+    assert got == want
+    # the tier really absorbed rows
+    job = eng.jobs[0]
+    tiers = getattr(job, "_spill_tiers", {})
+    assert tiers and any(t.rows_absorbed for t in tiers.values())
