@@ -13,7 +13,6 @@ construction).
 
 from __future__ import annotations
 
-from collections import deque
 from typing import Sequence
 
 import numpy as np
@@ -44,8 +43,9 @@ class TableDmlManager:
         self._max_lens = {i: 0 for i in self.auto_width_cols}
 
     def new_reader(self, chunk_capacity: int) -> "TableSourceReader":
-        r = TableSourceReader(self.schema, chunk_capacity)
-        r.enqueue(self._history)  # replay everything inserted so far
+        # the reader shares the history list: it starts at offset 0, so
+        # everything inserted so far replays (poor-man's backfill)
+        r = TableSourceReader(self.schema, chunk_capacity, self._history)
         self._readers.append(r)
         return r
 
@@ -79,9 +79,7 @@ class TableDmlManager:
                     )
         for i in self._max_lens:
             self._max_lens[i] = max(self._max_lens[i], batch_max[i])
-        self._history.extend(rows)
-        for r in self._readers:
-            r.enqueue(rows)
+        self._history.extend(rows)  # readers see this shared list
         self.rows_inserted += len(rows)
         return len(rows)
 
@@ -105,26 +103,33 @@ class TableDmlManager:
 
 
 class TableSourceReader:
-    """Queue-fed source reader; empty chunks when idle."""
+    """Cursor over the table's shared history log; empty chunks when
+    idle.
 
-    def __init__(self, schema: Schema, chunk_capacity: int):
+    NON-destructive: rows are never popped, only the ``offset`` cursor
+    advances — so recovery can REWIND the cursor and replay rows that
+    were consumed but not yet committed (a destructive queue silently
+    lost them; the reference's DML replays from the upstream table's
+    durable state, here the history list is that log)."""
+
+    def __init__(self, schema: Schema, chunk_capacity: int,
+                 history: list):
         self.schema = schema
         self.cap = chunk_capacity
-        self._pending: deque[tuple] = deque()
-        #: consumed-row offset (checkpointable like any source cursor;
-        #: replay of unread DML after recovery is the caller's concern
-        #: until the log-store lands)
+        #: shared with TableDmlManager._history (no copy)
+        self._rows = history
+        #: consumed-row cursor into the table history (checkpointable)
         self.offset = 0
 
-    def enqueue(self, rows: Sequence[tuple]) -> None:
-        self._pending.extend(rows)
-
     def pending(self) -> int:
-        return len(self._pending)
+        # a restored offset may exceed the in-process history (fresh
+        # process, history not yet replayed): never negative — the
+        # cursor simply has nothing to read until history catches up
+        return max(0, len(self._rows) - self.offset)
 
     def next_chunk(self) -> Chunk:
-        n = min(len(self._pending), self.cap)
-        batch = [self._pending.popleft() for _ in range(n)]
+        n = min(self.pending(), self.cap)
+        batch = self._rows[self.offset:self.offset + n]
         self.offset += n
         if n == 0:
             # shape-static empty chunk
@@ -138,3 +143,6 @@ class TableSourceReader:
 
     def state(self) -> dict:
         return {"offset": self.offset}
+
+    def restore(self, state: dict) -> None:
+        self.offset = int(state.get("offset", 0))

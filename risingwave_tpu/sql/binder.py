@@ -122,6 +122,14 @@ class Binder:
                     "regexp_match is supported only as "
                     "(regexp_match(s, 'pat'))[n]"
                 )
+            if e.name == "split_part" and len(e.args) == 3 \
+                    and isinstance(e.args[2], ast.Literal) \
+                    and e.args[2].type_name == "int" \
+                    and e.args[2].value == 0:
+                # ref split_part.rs: position 0 is an error, not empty;
+                # the argument is almost always a literal so reject at
+                # bind time (the device kernel cannot raise per-row)
+                raise BindError("field position must not be zero")
             args = tuple(self.bind(a) for a in e.args)
             # untyped NULL literals adopt the type of a typed sibling
             # (COALESCE(x, NULL), CASE branches, IS NULL over NULL...)

@@ -1023,6 +1023,15 @@ class Planner:
         if any(a.arg is not None and touches_window(a.arg)
                for a in agg_calls):
             return None
+        # min/max over strings: the pane combine phase is retractable,
+        # which the packed-string monoid can't support — fall back to
+        # _plan_agg (which plans min_str/max_str or raises a clear
+        # PlanError) instead of crashing at executor build
+        for a in agg_calls:
+            if a.kind in ("min", "max") and a.arg is not None \
+                    and a.arg.return_field(scope.schema) \
+                           .data_type.is_string:
+                return None
         # the WHERE filter (already in execs) must not read window cols
         for ex in execs:
             if isinstance(ex, FilterExecutor) \

@@ -159,6 +159,12 @@ class CheckpointStore:
         if (dirty is None or since_full >= self.full_interval - 1
                 or int(dirty.sum()) * 2 > digests.shape[0]):
             kind = "full"
+        # a re-save of an epoch already in the manifest (post-rescale
+        # re-base, re-seal after a crashed commit) must be FULL: a
+        # delta would overwrite a chain entry with a wrong-base delta
+        if epoch in self._load_manifest()["jobs"].get(
+                job_name, {}).get("epochs", []):
+            kind = "full"
 
         path = os.path.join(job_dir, f"epoch_{epoch}")
         if kind == "full":
@@ -205,11 +211,15 @@ class CheckpointStore:
                 "epoch": epoch, "kind": kind,
             }, f)
         os.replace(path + ".meta.tmp", path + ".meta")
-        self._last_digests[job_name] = (epoch, digests)
 
         m = self._load_manifest()
         job = m["jobs"].setdefault(job_name, {"epochs": []})
-        job["epochs"].append(epoch)
+        # idempotent per epoch: a re-save of an already-committed epoch
+        # (e.g. ALTER PARALLELISM re-basing state at the current epoch)
+        # REPLACES the entry — appending would leave duplicate epochs
+        # in GC/load bookkeeping (advisor r4)
+        if epoch not in job["epochs"]:
+            job["epochs"].append(epoch)
         job.setdefault("kind", {})[str(epoch)] = kind
         job["committed"] = epoch
         # GC beyond keep_epochs — but never break a delta chain: keep
@@ -231,6 +241,16 @@ class CheckpointStore:
                         os.remove(p)
             job["epochs"] = epochs_l[idx:]
         self._store_manifest(m)
+        # only after the manifest commit: a save that dies earlier must
+        # not leave the digest cache pointing at an orphan file
+        self._last_digests[job_name] = (epoch, digests)
+
+    def invalidate(self, job_name: str) -> None:
+        """Drop the in-memory digest cache for a job (called on any
+        recovery rewind): the next save re-bases with a full snapshot
+        instead of a delta computed against post-rewind live state."""
+        self._last_digests.pop(job_name, None)
+        self._since_full.pop(job_name, None)
 
     def committed_epoch(self, job_name: str) -> int | None:
         m = self._load_manifest()

@@ -48,6 +48,7 @@ from risingwave_tpu.stream.runtime import (
     check_counter_values,
     deliver_sinks,
     restore_source,
+    rewind_spill_tier,
 )
 
 try:  # jax >= 0.8
@@ -258,25 +259,32 @@ class DagJob:
             name: (src.state() if hasattr(src, "state") else {})
             for name, src in self.sources.items()
         }
+        # ONE host materialization per tier, shared by the in-memory
+        # snapshot and the durable save
+        spill_host = {key: tier.snapshot() for key, tier in
+                      getattr(self, "_spill_tiers", {}).items()
+                      if tier.rows_absorbed}
         snap = CheckpointSnapshot(
             epoch=epoch,
             states=_snapshot_copy(self.states),
             source_state=src_state,
+            spill=spill_host,
         )
         self.checkpoints = [snap]
         if self.checkpoint_store is not None:
+            # tier saves FIRST (see StreamingJob._commit_checkpoint): a
+            # crash between the saves leaves the tier ahead, which
+            # recovery rewinds; the reverse order loses absorbed groups
+            for (idx, j), host_state in spill_host.items():
+                self.checkpoint_store.save(
+                    f"{self.name}@spill{idx}_{j}", epoch,
+                    host_state, {},
+                )
             # device pytree handed over as-is: the store's block-digest
             # pass fetches only the epoch's dirty blocks
             self.checkpoint_store.save(
                 self.name, epoch, snap.states, src_state
             )
-            for (idx, j), tier in getattr(self, "_spill_tiers",
-                                          {}).items():
-                if tier.rows_absorbed:
-                    self.checkpoint_store.save(
-                        f"{self.name}@spill{idx}_{j}", epoch,
-                        tier.state_host(), {},
-                    )
 
     def downstream_closure(self, ref: Ref,
                            through_joins: bool = True) -> list[int]:
@@ -826,19 +834,16 @@ class DagJob:
 
     # -- spill-to-host (stream/spill.py) --------------------------------
     def _restore_spill_tiers(self, epoch: int) -> None:
-        """Recovery companion: reload host-tier states saved alongside
-        the job checkpoint (runtime.py's StreamingJob does the same)."""
-        if self.checkpoint_store is None:
-            return
+        """Recovery companion: rewind host tiers via the shared policy
+        (see runtime.rewind_spill_tier)."""
         for idx, j, ex in self._spill_sites():
             self._ensure_spill_tier(idx, j, ex)
             key = f"{self.name}@spill{idx}_{j}"
-            if epoch in self.checkpoint_store.epochs(key):
-                loaded = self.checkpoint_store.load(key, epoch)
-                if loaded is not None:
-                    tier = self._spill_tiers[(idx, j)]
-                    tier.restore(loaded[1])
-                    tier.rows_absorbed = 1
+            self.checkpoint_store.invalidate(key)
+            rewind_spill_tier(
+                self.checkpoint_store, key, epoch,
+                self._spill_tiers[(idx, j)],
+            )
 
     def _spill_sites(self):
         """[(node_idx, exec_idx, executor)] of spill-enabled aggs."""
@@ -914,6 +919,9 @@ class DagJob:
         """Reset to the last committed checkpoint (ref §3.5)."""
         self._counters = None
         if self.checkpoint_store is not None:
+            # see StreamingJob.recover: rewinds invalidate the digest
+            # cache so the next save re-bases with a full snapshot
+            self.checkpoint_store.invalidate(self.name)
             loaded = self.checkpoint_store.load(self.name)
             if loaded is not None:
                 epoch, states, src_state = loaded
@@ -936,11 +944,18 @@ class DagJob:
             for src in self.sources.values():
                 if hasattr(src, "offset"):
                     src.offset = 0
+            for tier in getattr(self, "_spill_tiers", {}).values():
+                tier.reset()
             return
         snap = self.checkpoints[-1]
         self.states = _snapshot_copy(snap.states)
         for name, src in self.sources.items():
             restore_source(src, snap.source_state.get(name, {}))
+        for key, tier in getattr(self, "_spill_tiers", {}).items():
+            if snap.spill and key in snap.spill:
+                tier.restore(snap.spill[key])
+            else:
+                tier.reset()
 
     # -- serving (sharded) ----------------------------------------------
     def mv_rows(self, mv_executor, state_index):

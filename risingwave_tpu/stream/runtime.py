@@ -58,6 +58,9 @@ class CheckpointSnapshot:
     epoch: int
     states: Any
     source_state: dict
+    #: host copies of spill-tier states at this epoch (key → pytree);
+    #: None/missing key = the tier had absorbed nothing yet
+    spill: dict | None = None
 
 
 #: jitted device→device snapshot copy (one dispatch for the whole tree)
@@ -118,6 +121,22 @@ def restore_source(source, state: dict) -> None:
         source.restore(state)
     elif hasattr(source, "offset") and "offset" in state:
         source.offset = state["offset"]
+
+
+def rewind_spill_tier(store, key: str, epoch: int, tier) -> None:
+    """Rewind a host spill tier after job recovery: restore the nearest
+    tier epoch <= the job's recovered epoch (a crash between the tier
+    save and the job save leaves the tier one epoch ahead); when no
+    eligible checkpoint exists the tier postdates every commit and must
+    RESET — keeping its live state would double-count the replayed
+    rows.  Shared by StreamingJob and DagJob."""
+    cands = [e for e in store.epochs(key) if e <= epoch] \
+        if store is not None else []
+    loaded = store.load(key, cands[-1]) if cands else None
+    if loaded is not None:
+        tier.restore(loaded[1])
+    else:
+        tier.reset()
 
 
 def deliver_sinks(fragment: Fragment, states, epoch_val):
@@ -332,26 +351,35 @@ class StreamingJob:
         # dispatch: the donated step/flush buffers would otherwise be
         # invalidated under the snapshot (use-after-donation); durable
         # persistence additionally pays the device→host transfer
+        # ONE host materialization per tier, shared by the in-memory
+        # snapshot and the durable save
+        spill_host = {i: tier.snapshot() for i, _, _, tier in self._spill
+                      if tier.rows_absorbed}
         snap = CheckpointSnapshot(
             epoch=epoch_val,
             states=_snapshot_copy(self.states),
             source_state=src_state,
+            spill=spill_host,
         )
         # retain only the latest committed snapshot in memory; the
         # durable store keeps epoch history (ref: Hummock versions)
         self.checkpoints = [snap]
         if self.checkpoint_store is not None:
+            # tier saves FIRST: a crash between the two saves leaves the
+            # tier AHEAD of the job checkpoint, and recovery rewinds it
+            # to the nearest tier epoch <= the job's — absorbed groups
+            # are never silently lost and replayed rows never
+            # double-count (the reverse order had both failure modes)
+            for i in spill_host:
+                self.checkpoint_store.save(
+                    f"{self.name}@spill{i}", epoch_val,
+                    spill_host[i], {},
+                )
             # device pytree handed over as-is: the store's block-digest
             # pass fetches only the epoch's dirty blocks
             self.checkpoint_store.save(
                 self.name, epoch_val, snap.states, src_state
             )
-            for i, _, _, tier in self._spill:
-                if tier.rows_absorbed:
-                    self.checkpoint_store.save(
-                        f"{self.name}@spill{i}", epoch_val,
-                        tier.state_host(), {},
-                    )
 
     def _apply_mutation(self, mutation) -> None:
         if mutation.kind == "pause":
@@ -369,6 +397,11 @@ class StreamingJob:
         snapshot."""
         self._counters = None
         if self.checkpoint_store is not None:
+            # any rewind invalidates the store's in-memory digest
+            # cache: the next save must re-base with a full snapshot,
+            # or a delta computed against post-rewind live state could
+            # overwrite a valid chain entry with a wrong-base delta
+            self.checkpoint_store.invalidate(self.name)
             loaded = self.checkpoint_store.load(self.name)
             if loaded is not None:
                 epoch, states, src_state = loaded
@@ -376,25 +409,29 @@ class StreamingJob:
                 self.committed_epoch = epoch
                 restore_source(self.source, src_state)
                 for i, _, _, tier in self._spill:
-                    t = self.checkpoint_store.load(
-                        f"{self.name}@spill{i}", epoch
-                    ) if epoch in self.checkpoint_store.epochs(
-                        f"{self.name}@spill{i}"
-                    ) else None
-                    if t is not None:
-                        tier.restore(t[1])
-                        tier.rows_absorbed = 1
+                    key = f"{self.name}@spill{i}"
+                    self.checkpoint_store.invalidate(key)
+                    rewind_spill_tier(
+                        self.checkpoint_store, key, epoch, tier
+                    )
                 return
         if not self.checkpoints:
             self.states = self.fragment.init_states()
             if hasattr(self.source, "offset"):
                 self.source.offset = 0
+            for _, _, _, tier in self._spill:
+                tier.reset()
             return
         snap = self.checkpoints[-1]
         # copy: the next step donates its input buffers, which must not
         # invalidate the retained snapshot
         self.states = _snapshot_copy(snap.states)
         restore_source(self.source, snap.source_state)
+        for i, _, _, tier in self._spill:
+            if snap.spill and i in snap.spill:
+                tier.restore(snap.spill[i])
+            else:
+                tier.reset()
 
     # ------------------------------------------------------------------
     def chunk_round(self) -> int:

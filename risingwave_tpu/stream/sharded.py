@@ -426,9 +426,14 @@ class ShardedStreamingJob:
         """Per-shard sink cursors, merged host-side at the snapshot
         barrier (ref sink.rs delivery; cross-shard row order is
         unspecified, matching the reference's per-parallelism sinks).
-        The cursors live in the sharded state tree, so delivery and
-        the checkpoint commit share one cadence — exactly-once across
-        recovery."""
+        The cursors live in the sharded state tree and share the
+        checkpoint cadence, but delivery runs BEFORE the durable save:
+        a crash between the two rewinds the cursors and re-delivers the
+        epoch's rows — at-least-once, like the linear runtime.
+        Downstream readers get exactly-once by honoring the per-epoch
+        commit marker (the closed-epoch reader protocol, sinks.py):
+        rows of an epoch delivered twice carry the same epoch tag, and
+        only one commit marker is ever emitted per epoch."""
         states = list(self.states)
         for i, ex in enumerate(self.sharded.executors):
             if not hasattr(ex, "deliver"):

@@ -59,6 +59,21 @@ class AggSpillTier:
     def state_host(self):
         return jax.device_get(self.state)
 
+    def snapshot(self):
+        """Owned host copy (np.array forces a copy — device_get of a
+        CPU-backed array may alias the live buffer)."""
+        return jax.tree.map(np.array, jax.device_get(self.state))
+
     def restore(self, host_state) -> None:
         with jax.default_device(self.cpu):
             self.state = jax.device_put(host_state, self.cpu)
+        self.rows_absorbed = 1
+
+    def reset(self) -> None:
+        """Forget every absorbed group: recovery rewound to an epoch
+        at/before which this tier had no checkpoint, so its live state
+        is from the FUTURE of the recovered epoch — keeping it would
+        double-count the replayed rows."""
+        with jax.default_device(self.cpu):
+            self.state = self.agg.init_state()
+        self.rows_absorbed = 0

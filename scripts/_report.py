@@ -1,0 +1,33 @@
+"""Marker-delimited section replacement for shared report files.
+
+CONFORMANCE.md holds one section per conformance suite (nexmark,
+tpch, ...) plus hand-maintained sections (known deviations); each
+runner rewrites ONLY its own section so suites can run independently.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def replace_section(path: str, tag: str, content: str) -> None:
+    begin = f"<!-- {tag}:begin -->"
+    end = f"<!-- {tag}:end -->"
+    block = f"{begin}\n{content.rstrip()}\n{end}"
+    if os.path.exists(path):
+        with open(path) as f:
+            text = f.read()
+    else:
+        text = ""
+    if begin in text and end in text:
+        head, rest = text.split(begin, 1)
+        _, tail = rest.split(end, 1)
+        text = head + block + tail
+    else:
+        if text and not text.endswith("\n"):
+            text += "\n"
+        text += ("\n" if text else "") + block + "\n"
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)

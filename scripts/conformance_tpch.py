@@ -1,14 +1,15 @@
-"""Nexmark .slt conformance: run the reference corpus, emit a report.
+"""TPC-H-as-MV .slt conformance: run the reference corpus, emit a report.
 
 Consumes the REFERENCE's engine-agnostic sqllogictest corpus
-(/root/reference/e2e_test/nexmark/ tables+inserts,
-/root/reference/e2e_test/streaming/nexmark/ views+expected results)
-against this engine, one query at a time, and writes CONFORMANCE.md:
-N passed / M skipped-with-reason / K failed.  Queries the planner or
-parser rejects are SKIPPED (feature gaps, listed); result mismatches
+(/root/reference/e2e_test/tpch/ table setup + inserts,
+/root/reference/e2e_test/streaming/tpch/ view definitions + expected
+results) against this engine, one query at a time, and rewrites the
+TPCH section of CONFORMANCE.md.  Queries the planner or parser rejects
+are SKIPPED (feature gaps, each with its reason); result mismatches
 are FAILURES (correctness bugs).
 
-Usage: JAX_PLATFORMS=cpu python scripts/conformance.py [ref_root]
+Usage: JAX_PLATFORMS=cpu python scripts/conformance_tpch.py [ref_root]
+       RWT_ONLY=q1,q6 filters (and then does NOT rewrite the report).
 """
 
 from __future__ import annotations
@@ -24,25 +25,30 @@ from risingwave_tpu.slt import SltError, run_slt  # noqa: E402
 from risingwave_tpu.sql import Engine  # noqa: E402
 from risingwave_tpu.sql.planner import PlannerConfig  # noqa: E402
 
+from _report import replace_section  # noqa: E402
+
 REF = sys.argv[1] if len(sys.argv) > 1 else "/root/reference"
-SETUP_DIR = os.path.join(REF, "e2e_test/nexmark")
-QUERY_DIR = os.path.join(REF, "e2e_test/streaming/nexmark")
+SETUP_DIR = os.path.join(REF, "e2e_test/tpch")
+QUERY_DIR = os.path.join(REF, "e2e_test/streaming/tpch")
 OUT = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "CONFORMANCE.md")
+
+TABLES = ("supplier", "part", "partsupp", "customer", "orders",
+          "lineitem", "nation", "region")
 
 
 def make_engine() -> Engine:
     return Engine(PlannerConfig(
         chunk_capacity=512,
-        agg_table_size=1 << 12,
-        agg_emit_capacity=1 << 11,
-        join_table_size=1 << 11,
-        join_bucket_cap=64,
-        join_out_capacity=1 << 14,
+        agg_table_size=1 << 13,
+        agg_emit_capacity=1 << 12,
+        join_table_size=1 << 13,
+        join_bucket_cap=128,
+        join_out_capacity=1 << 15,
         mv_table_size=1 << 13,
         mv_ring_size=1 << 15,
-        topn_pool_size=1 << 11,
-        topn_emit_capacity=1 << 10,
+        topn_pool_size=1 << 12,
+        topn_emit_capacity=1 << 11,
         minput_bucket_cap=128,
     ))
 
@@ -51,7 +57,7 @@ def run() -> dict:
     eng = make_engine()
     run_slt(eng, os.path.join(SETUP_DIR, "create_tables.slt.part"),
             tick_between=0)
-    for t in ("person", "auction", "bid"):
+    for t in TABLES:
         run_slt(eng, os.path.join(SETUP_DIR, f"insert_{t}.slt.part"),
                 tick_between=0)
     eng.tick(barriers=2)
@@ -59,9 +65,8 @@ def run() -> dict:
     results: dict[str, tuple[str, str]] = {}
     names = sorted(
         (f[:-len(".slt.part")] for f in os.listdir(QUERY_DIR)
-         if re.match(r"q\d", f)),
-        key=lambda s: [int(x) if x.isdigit() else x
-                       for x in re.split(r"(\d+)", s)],
+         if re.match(r"q\d+\.slt\.part$", f)),
+        key=lambda s: int(s[1:]),
     )
     only = os.environ.get("RWT_ONLY")
     if only:
@@ -69,19 +74,15 @@ def run() -> dict:
     for name in names:
         view_file = os.path.join(QUERY_DIR, "views", f"{name}.slt.part")
         query_file = os.path.join(QUERY_DIR, f"{name}.slt.part")
-        if not os.path.exists(view_file):
-            results[name] = ("skip", "no view definition in corpus")
-            continue
         before = {e.name for e in eng.catalog.list()}
         try:
             run_slt(eng, view_file, tick_between=0)
         except SltError as e:
-            reason = str(e.message)[:160]
-            results[name] = ("skip", f"plan: {reason}")
+            results[name] = ("skip", f"plan: {str(e.message)[:200]}")
             _drop_new(eng, before)
             continue
         except Exception as e:  # engine bug during CREATE
-            results[name] = ("error", f"create: {e}"[:160])
+            results[name] = ("error", f"create: {e}"[:200])
             _drop_new(eng, before)
             continue
         try:
@@ -92,7 +93,7 @@ def run() -> dict:
         except SltError as e:
             results[name] = ("fail", str(e.message)[:6000])
         except Exception as e:
-            results[name] = ("error", str(e)[:200])
+            results[name] = ("error", str(e)[:300])
         _drop_new(eng, before)
     return results
 
@@ -113,10 +114,10 @@ def main() -> None:
     for status, _ in results.values():
         counts[status] += 1
     lines = [
-        "## Nexmark conformance (reference .slt corpus)",
+        "## TPC-H-as-MV conformance (reference .slt corpus)",
         "",
-        "Source: `/root/reference/e2e_test/{nexmark,streaming/nexmark}`"
-        " — the reference's own sqllogictest files run unmodified.",
+        "Source: `/root/reference/e2e_test/{tpch,streaming/tpch}` — the"
+        " reference's own sqllogictest files run unmodified.",
         "",
         f"**{counts['pass']} passed, {counts['skip']} skipped "
         f"(unsupported feature), {counts['fail']} failed, "
@@ -127,18 +128,14 @@ def main() -> None:
         "|---|---|---|",
     ]
     for name, (status, detail) in results.items():
-        detail = detail.replace("|", "\\|").replace("\n", " ")
+        detail = detail.replace("|", "\\|").replace("\n", " ")[:300]
         lines.append(f"| {name} | {status} | {detail} |")
     lines.append("")
     if not only:
-        from _report import replace_section
-        replace_section(OUT, "nexmark", "\n".join(lines))
-    print("\n".join(lines[:8]))
-    print(f"... report written to {OUT}")
+        replace_section(OUT, "tpch", "\n".join(lines))
+        print(f"report written to {OUT}")
     for name, (status, detail) in results.items():
-        print(f"{name:18s} {status:5s} {detail[:110]}")
-        if status in ("fail", "error") and len(detail) > 110:
-            print(detail)
+        print(f"{name:6s} {status:5s} {detail[:150]}")
 
 
 if __name__ == "__main__":
