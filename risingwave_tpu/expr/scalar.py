@@ -190,6 +190,26 @@ def _round_dec(a, fields: Sequence[Field]):
     return jnp.round(a)
 
 
+@function("round(numeric, int) -> same")
+@function("round(numeric, bigint) -> same")
+def _round_dec_n(a, n, fields: Sequence[Field]):
+    """round(x, n): n decimal places (ref round_digits.rs).  DECIMAL
+    keeps its storage scale with the value rounded to n places; floats
+    round via scaling."""
+    if fields[0].data_type == DataType.DECIMAL:
+        scale = fields[0].decimal_scale
+        # n is almost always a literal; device-side we support the
+        # whole column form with a per-row power
+        shift = jnp.maximum(scale - n.astype(jnp.int64), 0)
+        p = 10 ** shift
+        mag = (jnp.abs(a) + p // 2) // p * p
+        return jnp.sign(a) * mag
+    if jnp.issubdtype(a.dtype, jnp.integer):
+        return a  # rounding an integer to >=0 places is the identity
+    p = 10.0 ** n.astype(jnp.float64)
+    return jnp.round(a * p) / p
+
+
 # ---------------------------------------------------------------------------
 # comparison
 
@@ -668,6 +688,17 @@ for _part in ("year", "month", "day", "hour", "minute", "second",
     function(f"extract_{_part}(timestamp) -> bigint")(_mk_extract(_part))
     function(f"extract_{_part}(timestamptz) -> bigint")(_mk_extract(_part))
 
+    def _mk_extract_date(part):
+        inner = _mk_extract(part)
+
+        def impl(d):
+            # DATE is i32 days since epoch; reuse the civil mapping
+            return inner(d.astype(jnp.int64) * 86_400_000_000)
+
+        return impl
+
+    function(f"extract_{_part}(date) -> bigint")(_mk_extract_date(_part))
+
 
 @function("length(stringlike) -> int")
 def _length(a: StrCol):
@@ -872,6 +903,84 @@ def eval_to_char(ts: jnp.ndarray, segs: list) -> StrCol:
         jnp.concatenate(parts, axis=1),
         jnp.full((cap,), width, jnp.int32),
     )
+
+
+class LikePattern(Expr):
+    """General %-wildcard LIKE, compiled at bind time.
+
+    Ref: src/expr/impl/src/scalar/like.rs — the reference walks a
+    byte-DP; for '%'-only patterns leftmost-greedy sequential segment
+    search is equivalent and vectorizes: each literal segment takes one
+    ``_match_at`` scan over all offsets, with the running cursor
+    enforcing order.  '_' wildcards remain unsupported (parser/binder
+    reject them)."""
+
+    def __init__(self, arg: Expr, pattern: str):
+        if "_" in pattern:
+            raise ValueError("LIKE '_' wildcards not supported")
+        self.arg = arg
+        self.pattern = pattern
+        self.segs = [s for s in pattern.split("%") if s != ""]
+        self.anchor_start = not pattern.startswith("%")
+        self.anchor_end = not pattern.endswith("%")
+
+    def return_field(self, schema) -> Field:
+        f = self.arg.return_field(schema)
+        return Field("like", DataType.BOOLEAN, nullable=f.nullable)
+
+    def return_type(self, schema):
+        return DataType.BOOLEAN
+
+    def _const(self, seg: str, cap: int) -> StrCol:
+        from risingwave_tpu.common.chunk import encode_strings
+        b = seg.encode("utf-8")
+        data, lens = encode_strings([seg], max(len(b), 1))
+        return StrCol(
+            jnp.broadcast_to(jnp.asarray(data[0]), (cap, data.shape[1])),
+            jnp.broadcast_to(jnp.asarray(lens[0]), (cap,)),
+        )
+
+    def eval(self, chunk):
+        a, null = split_col(self.arg.eval(chunk))
+        cap, wa = a.data.shape
+        segs = self.segs
+        if not segs:  # '%', '%%', ... — everything matches
+            return make_col(jnp.ones((cap,), jnp.bool_), null)
+        if len(segs) == 1 and self.anchor_start and self.anchor_end:
+            pat = self._const(segs[0], cap)
+            ok = _match_at(
+                a, pat, jnp.zeros((cap, 1), jnp.int32)
+            )[:, 0] & (a.lens == pat.lens)
+            return make_col(ok, null)
+        ok = jnp.ones((cap,), jnp.bool_)
+        pos = jnp.zeros((cap,), jnp.int32)
+        offs_all = jnp.broadcast_to(
+            jnp.arange(wa, dtype=jnp.int32)[None, :], (cap, wa)
+        )
+        for k, seg in enumerate(segs):
+            pat = self._const(seg, cap)
+            if k == 0 and self.anchor_start:
+                ok &= _match_at(
+                    a, pat, jnp.zeros((cap, 1), jnp.int32)
+                )[:, 0] & (pat.lens <= a.lens)
+                pos = pat.lens.astype(jnp.int32)
+                continue
+            if k == len(segs) - 1 and self.anchor_end:
+                off = a.lens - pat.lens
+                ok &= _match_at(
+                    a, pat, jnp.maximum(off, 0)[:, None]
+                )[:, 0] & (off >= pos)
+                continue
+            hits = _match_at(a, pat, offs_all) \
+                & (offs_all >= pos[:, None]) \
+                & (offs_all <= (a.lens - pat.lens)[:, None])
+            ok &= jnp.any(hits, axis=1)
+            first = jnp.argmax(hits, axis=1).astype(jnp.int32)
+            pos = first + pat.lens
+        return make_col(ok, null)
+
+    def __repr__(self):
+        return f"like({self.arg!r}, {self.pattern!r})"
 
 
 class ToChar(Expr):

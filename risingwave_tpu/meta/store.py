@@ -1,0 +1,92 @@
+"""Durable metadata store: DDL log + DML write-ahead log.
+
+Reference counterpart: the meta node's SQL metastore (sea-orm entities
+over SQLite/PG, src/meta/model/) + ``DdlController`` recovery
+(src/meta/src/rpc/ddl_controller.rs:1096): a fresh process reloads the
+catalog and rebuilds every streaming job from persisted metadata, then
+resumes from the last committed epoch.
+
+TPU-first simplification: metadata volume is tiny and totally ordered
+by the single control loop, so the store is two append-only JSONL logs
+under ``data_dir``:
+
+- ``catalog.jsonl`` — every applied DDL statement's raw SQL, in
+  order (CREATE/DROP/ALTER/SET).  Replaying the log against a fresh
+  Engine reconstructs the catalog AND the streaming jobs, because DDL
+  is the single source of plan shape.
+- ``dml/<table>.jsonl`` — committed INSERT batches per DML table (the
+  reference's DML goes through the upstream table's durable state;
+  here the table history IS that state, so it must survive restarts
+  for source cursors to replay against).
+
+Atomicity: lines are appended with a trailing newline and fsync'd;
+a torn final line (crash mid-append) is detected and dropped at read
+time.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+class MetaStore:
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self._ddl_path = os.path.join(root, "catalog.jsonl")
+        self._dml_dir = os.path.join(root, "dml")
+        os.makedirs(self._dml_dir, exist_ok=True)
+
+    # -- append ---------------------------------------------------------
+    def _append(self, path: str, obj: dict) -> None:
+        line = json.dumps(obj, separators=(",", ":")) + "\n"
+        with open(path, "a") as f:
+            f.write(line)
+            f.flush()
+            os.fsync(f.fileno())
+
+    def append_ddl(self, sql: str) -> None:
+        self._append(self._ddl_path, {"sql": sql})
+
+    def append_dml(self, table: str, rows: list) -> None:
+        self._append(
+            os.path.join(self._dml_dir, f"{table}.jsonl"),
+            {"rows": [list(r) for r in rows]},
+        )
+
+    # -- read -----------------------------------------------------------
+    @staticmethod
+    def _lines(path: str) -> list[dict]:
+        if not os.path.exists(path):
+            return []
+        out = []
+        with open(path) as f:
+            for line in f:
+                if not line.endswith("\n"):
+                    break  # torn tail from a crash mid-append
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    break
+        return out
+
+    def ddl_log(self) -> list[str]:
+        return [e["sql"] for e in self._lines(self._ddl_path)]
+
+    def dml_rows(self, table: str) -> list[tuple]:
+        rows: list[tuple] = []
+        for e in self._lines(os.path.join(self._dml_dir,
+                                          f"{table}.jsonl")):
+            rows.extend(tuple(r) for r in e["rows"])
+        return rows
+
+    def truncate_dml(self, table: str) -> None:
+        """DROP TABLE discards the table's history; a later same-named
+        CREATE TABLE must not resurrect pre-drop rows at replay."""
+        p = os.path.join(self._dml_dir, f"{table}.jsonl")
+        if os.path.exists(p):
+            os.remove(p)
+
+    def has_catalog(self) -> bool:
+        return os.path.exists(self._ddl_path)

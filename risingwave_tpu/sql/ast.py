@@ -22,12 +22,17 @@ class ColumnRef:
 @dataclass(frozen=True)
 class Literal:
     value: Any
-    type_name: str  # "int" | "float" | "string" | "bool" | "interval"
+    #: "int" | "float" | "string" | "bool" | "null" | "date" (days
+    #: since epoch) | "timestamp" (microseconds since epoch)
+    type_name: str
 
 
 @dataclass(frozen=True)
 class IntervalLit:
     micros: int
+    #: calendar months (INTERVAL 'n' MONTH/YEAR); not convertible to
+    #: micros — consumed by bind-time date-arithmetic folding
+    months: int = 0
 
 
 @dataclass(frozen=True)
@@ -96,6 +101,9 @@ class SelectItem:
 class TableRef:
     name: str
     alias: str | None = None
+    #: ``FOR SYSTEM_TIME AS OF PROCTIME()`` — the build side of a
+    #: temporal join (ref temporal_join.rs)
+    temporal: bool = False
 
 
 @dataclass(frozen=True)
@@ -133,6 +141,15 @@ class InSubquery:
     expr: object
     select: "Select"
     negated: bool = False
+
+
+@dataclass(frozen=True)
+class ExistsSubquery:
+    """``EXISTS (SELECT ...)`` — planned as a semi join on the
+    correlated equi predicates mined from the subquery's WHERE
+    (NOT EXISTS → anti join)."""
+
+    select: "Select"
 
 
 @dataclass(frozen=True)

@@ -73,18 +73,40 @@ class Binder:
             if e.type_name == "bool":
                 return ELiteral(e.value, DataType.BOOLEAN)
             if e.type_name == "float":
-                return ELiteral(e.value, DataType.FLOAT64)
+                # PG: a decimal-point literal is NUMERIC, not float —
+                # exact arithmetic/comparisons against DECIMAL columns
+                # (0.08 - 0.01 must equal 0.07 exactly); float contexts
+                # promote it back to float via the numeric lattice.
+                # Literals the scaled-int64 representation cannot hold
+                # exactly (needs >6 dp, or overflows) stay FLOAT64.
+                v = e.value
+                if abs(v) < 9e12 and round(v * 10**6) / 10**6 == v:
+                    return ELiteral(v, DataType.DECIMAL)
+                return ELiteral(v, DataType.FLOAT64)
             if e.type_name == "int":
                 return as_expr(e.value)
+            if e.type_name == "date":
+                return ELiteral(e.value, DataType.DATE)
+            if e.type_name == "timestamp":
+                return ELiteral(e.value, DataType.TIMESTAMP)
             if e.type_name == "null":
                 # untyped NULL defaults to int64; casts/CASE re-type it
                 return ELiteral(None, DataType.INT64)
             raise BindError(f"unsupported literal {e}")
         if isinstance(e, ast.IntervalLit):
+            if e.months:
+                raise BindError(
+                    "month/year intervals are supported only in "
+                    "date/timestamp literal arithmetic (folded at bind "
+                    "time)"
+                )
             return ELiteral(e.micros, DataType.INTERVAL)
         if isinstance(e, ast.UnaryOp):
             return EFuncCall(e.op, (self.bind(e.operand),))
         if isinstance(e, ast.BinaryOp):
+            folded = self._fold_datetime_arith(e)
+            if folded is not None:
+                return folded
             return EFuncCall(e.op, (self.bind(e.left), self.bind(e.right)))
         if isinstance(e, ast.Cast):
             t = DataType.from_sql(e.type_name)
@@ -145,20 +167,62 @@ class Binder:
             return EFuncCall(e.name, args)
         raise BindError(f"cannot bind {e!r}")
 
+    def _fold_datetime_arith(self, e: ast.BinaryOp):
+        """Constant-fold ``DATE/TIMESTAMP literal ± INTERVAL`` at bind
+        time (the only supported home of month/year intervals: calendar
+        months have no fixed micros — ref Interval {months,days,usecs}
+        arithmetic, src/common/src/types/interval.rs)."""
+        import datetime as _dt
+
+        if e.op not in ("add", "subtract"):
+            return None
+        lit, iv = e.left, e.right
+        if not (isinstance(lit, ast.Literal)
+                and lit.type_name in ("date", "timestamp")
+                and isinstance(iv, ast.IntervalLit)):
+            return None
+        sign = 1 if e.op == "add" else -1
+        if lit.type_name == "date":
+            base = _dt.datetime(1970, 1, 1) + _dt.timedelta(days=lit.value)
+        else:
+            base = _dt.datetime(1970, 1, 1) \
+                + _dt.timedelta(microseconds=lit.value)
+        if iv.months:
+            total = base.year * 12 + (base.month - 1) + sign * iv.months
+            y, m = divmod(total, 12)
+            # clamp the day into the target month (PG: Jan 31 + 1 mon
+            # = Feb 28)
+            for day in (base.day, 30, 29, 28):
+                try:
+                    base = base.replace(year=y, month=m + 1, day=day)
+                    break
+                except ValueError:
+                    continue
+        base = base + _dt.timedelta(microseconds=sign * iv.micros)
+        if lit.type_name == "date" and base.time() == _dt.time(0, 0):
+            days = (base.date() - _dt.date(1970, 1, 1)).days
+            return ELiteral(days, DataType.DATE)
+        # exact integer microseconds (float total_seconds() rounds)
+        us = (base - _dt.datetime(1970, 1, 1)) \
+            // _dt.timedelta(microseconds=1)
+        return ELiteral(us, DataType.TIMESTAMP)
+
     def _bind_like(self, e: ast.FuncCall) -> Expr:
-        """LIKE with literal %-only patterns compiles to prefix/suffix/
-        substring kernels (full regex LIKE needs per-char wildcards —
-        later round)."""
+        """LIKE with literal %-only patterns: single-segment forms
+        compile to prefix/suffix/substring kernels; multi-segment
+        interior-% patterns compile to the sequential-scan LikePattern
+        kernel ('_' wildcards remain unsupported)."""
         target, pat = e.args
         if not (isinstance(pat, ast.Literal) and pat.type_name == "string"):
             raise BindError("LIKE requires a string literal pattern")
         p = pat.value
         if "_" in p:
             raise BindError("LIKE '_' wildcards not yet supported")
+        lhs = self.bind(target)
         body = p.strip("%")
         if "%" in body:
-            raise BindError("LIKE with interior % not yet supported")
-        lhs = self.bind(target)
+            from risingwave_tpu.expr.scalar import LikePattern
+            return LikePattern(lhs, p)
         lit_body = ELiteral(body, DataType.VARCHAR)
         if p.startswith("%") and p.endswith("%"):
             return EFuncCall("contains", (lhs, lit_body))

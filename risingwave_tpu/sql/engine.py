@@ -157,22 +157,64 @@ class Engine:
         #: SQL UDFs: name -> (param names, body expr AST), inlined at
         #: parse time (ref: frontend SQL-UDF inlining)
         self.functions: dict[str, tuple] = {}
+        self.meta_store = None
+        #: True while replaying the durable DDL/DML logs (suppresses
+        #: re-logging)
+        self._replaying = False
         if data_dir is not None:
+            from risingwave_tpu.meta.store import MetaStore
             from risingwave_tpu.storage import CheckpointStore
             self.checkpoint_store = CheckpointStore(
                 data_dir,
                 keep_epochs=self.rw_config.storage.checkpoint_keep_epochs,
             )
+            self.meta_store = MetaStore(data_dir)
+            if self.meta_store.has_catalog():
+                self._bootstrap()
+
+    def _bootstrap(self) -> None:
+        """Cold-start recovery (ref DdlController + recovery,
+        ddl_controller.rs:1096, SURVEY.md §3.5): replay the durable DDL
+        log to rebuild catalog + jobs, reload each DML table's history,
+        then restore every job's state and source cursors from the last
+        committed checkpoint."""
+        self._replaying = True
+        try:
+            for sql in self.meta_store.ddl_log():
+                self.execute(sql)
+            self.recover()
+        finally:
+            self._replaying = False
 
     # ------------------------------------------------------------------
+    #: DDL statement kinds recorded in the durable catalog log — the
+    #: full set whose replay reconstructs catalog + job topology +
+    #: plan-relevant parameters (session SETs included: they steer
+    #: planning, e.g. streaming_parallelism)
+    _LOGGED_DDL = (
+        ast.CreateSource, ast.CreateMaterializedView, ast.CreateSink,
+        ast.CreateFunction, ast.DropStatement, ast.AlterParallelism,
+        ast.SetStatement,
+    )
+
     def execute(self, sql: str):
         """Run one or more statements; returns the last result."""
+        from risingwave_tpu.sql.parser import parse_with_text
+
         result = None
-        for stmt in parse(sql):
+        for text, stmt in parse_with_text(sql):
             if isinstance(stmt, ast.CreateFunction):
                 result = self._create_function(stmt)
-                continue
-            result = self._execute_one(inline_udfs(stmt, self.functions))
+            else:
+                result = self._execute_one(
+                    inline_udfs(stmt, self.functions)
+                )
+            if isinstance(stmt, self._LOGGED_DDL):
+                # DDL (or a planner-relevant SET) invalidates cached
+                # serving pipelines
+                self._serving_cache = {}
+                if self.meta_store is not None and not self._replaying:
+                    self.meta_store.append_ddl(text)
         return result
 
     def _create_function(self, stmt: ast.CreateFunction):
@@ -241,11 +283,18 @@ class Engine:
                         # this MV's private readers must stop being
                         # pulled once nothing consumes them
                         job.remove_sources(entry.dag_sources or [])
-                        job.reseed_checkpoint()
+                        if not self._replaying:
+                            job.reseed_checkpoint()
                     else:
                         self.jobs.remove(job)
                 if entry.kind == "sink" and entry.mv_executor is not None:
                     entry.mv_executor.sink.close()
+                if entry.dml is not None and self.meta_store is not None \
+                        and not self._replaying:
+                    # the durable history dies with the table; NOT at
+                    # replay — there the log already holds only the
+                    # final generation's rows
+                    self.meta_store.truncate_dml(stmt.name)
             self.catalog.drop(stmt.name, stmt.if_exists)
             return None
         if isinstance(stmt, ast.ShowStatement):
@@ -254,6 +303,33 @@ class Engine:
                     "sinks": "sink"}.get(stmt.kind)
             return [(e.name,) for e in self.catalog.list(kind)]
         if isinstance(stmt, ast.FlushStatement):
+            # ref FLUSH semantics (handler/flush.rs): block until all
+            # DML issued so far is materialized and checkpointed — here:
+            # drain every bounded source's pending rows, then commit one
+            # barrier.  Unbounded sources (nexmark/datagen) have no
+            # pending() and are excluded (they never drain).
+            cpb = max(
+                1, int(self.system_params.get("chunks_per_barrier"))
+            )
+            for _ in range(4096):
+                pending = 0
+                for job in self.jobs:
+                    srcs = list(getattr(job, "sources", {}).values())
+                    if not srcs:
+                        s = getattr(job, "source", None) \
+                            or getattr(job, "reader", None)
+                        srcs = [s] if s is not None else []
+                    for s in srcs:
+                        if hasattr(s, "pending"):
+                            pending += s.pending()
+                if pending == 0:
+                    break
+                self.tick(barriers=1, chunks_per_barrier=cpb)
+            else:
+                raise RuntimeError(
+                    "FLUSH did not drain in 4096 barriers "
+                    f"({pending} rows still pending)"
+                )
             self.tick(barriers=1, chunks_per_barrier=0)
             return None
         if isinstance(stmt, ast.SetStatement):
@@ -304,8 +380,10 @@ class Engine:
         entry.job.rescale(n)
         # retained checkpoints hold the OLD state-tree shape; re-seed
         # so recovery restores the new topology (recover() rebuilds the
-        # mesh to the checkpoint's shard dim)
-        if self.checkpoint_store is not None:
+        # mesh to the checkpoint's shard dim).  During bootstrap replay
+        # the states are fresh — the real checkpoint must NOT be
+        # overwritten; the trailing recover() will rescale-restore.
+        if self.checkpoint_store is not None and not self._replaying:
             self.checkpoint_store.save(
                 entry.job.name, entry.job.committed_epoch,
                 entry.job.states,
@@ -340,6 +418,8 @@ class Engine:
                 )
             rows.append(tuple(vals))
         entry.dml.insert(rows)
+        if self.meta_store is not None and not self._replaying:
+            self.meta_store.append_dml(stmt.table, rows)
         return None
 
     def _explain(self, stmt) -> list[tuple[str]]:
@@ -469,6 +549,13 @@ class Engine:
 
         schema, wm, auto = self._declared_schema(stmt)
         dml = TableDmlManager(schema, auto_width_cols=auto)
+        if self._replaying and self.meta_store is not None:
+            # cold start: reload the table's durable history BEFORE any
+            # MV replay plans against it — auto varchar widths and
+            # recovered source cursors both index into this history
+            hist = self.meta_store.dml_rows(stmt.name)
+            if hist:
+                dml.insert(hist)
         cap = self.config.chunk_capacity
 
         def factory(split_id: int = 0, num_splits: int = 1):
@@ -607,9 +694,118 @@ class Engine:
         entry.dag_nodes = [0]
         entry.dag_sources = [src_name]
         # retained checkpoints hold the StreamingJob-shaped state tree;
-        # re-snapshot so recover() sees the DagJob shape
-        dag.reseed_checkpoint()
+        # re-snapshot so recover() sees the DagJob shape (not during
+        # bootstrap replay: states are fresh, the durable checkpoint
+        # already holds the final-topology state)
+        if not self._replaying:
+            dag.reseed_checkpoint()
         return dag, 0
+
+    # -- batch serving over snapshots -----------------------------------
+    def _serve_batch(self, select: ast.Select):
+        """Serving reads through the SAME compiled executor pipeline as
+        streaming — scan → filter → project → agg → join over one-shot
+        bounded snapshot sources, jit-cached per query shape.
+
+        Ref: the reference's batch engine (src/batch/src/executor/
+        mod.rs:46) + local execution mode (scheduler/local.rs:60).  The
+        TPU-first twist: batch IS streaming over bounded input — the
+        planner's dataflow runs to completion on a snapshot, so serving
+        semantics can never drift from the device kernels (the old
+        interpreted `_serve_agg` path re-implemented SQL in host
+        Python; it is gone)."""
+        import dataclasses
+
+        key = repr(select)
+        if not hasattr(self, "_serving_cache"):
+            self._serving_cache: dict = {}
+        hit = self._serving_cache.get(key)
+        if hit is None:
+            stripped = dataclasses.replace(
+                select, order_by=(), limit=None, offset=None
+            )
+            plan = self.planner.plan(stripped)
+            if isinstance(plan, UnaryPlan):
+                plan = DagPlan(
+                    sources={"_in": plan.reader},
+                    nodes=[FragNode(plan.fragment, ("source", "_in"))],
+                    mv_node=0, mv_index=plan.mv_index,
+                )
+            readers: dict[str, Any] = {}
+            for name, r in plan.sources.items():
+                if isinstance(r, MvTap):
+                    readers[name] = _SnapshotReader(
+                        self, self.catalog.get(r.name)
+                    )
+                elif hasattr(r, "pending"):
+                    readers[name] = r  # bounded (table-history cursor)
+                else:
+                    raise PlanError(
+                        "serving reads over unbounded sources: create "
+                        "a materialized view instead"
+                    )
+            job = DagJob(readers, plan.nodes, "_serve",
+                         checkpoint_frequency=1, checkpoint_store=None)
+            job.snapshot_interval = 1 << 30  # no commits: one-shot
+            terminal = plan.nodes[plan.mv_node].fragment.executors[
+                plan.mv_index
+            ]
+            hit = (job, plan, terminal, readers)
+            self._serving_cache[key] = hit
+        job, plan, terminal, readers = hit
+        # fresh state + fresh snapshot every execution; the COMPILED
+        # programs persist in the job (static shapes)
+        job.states = job._init_states()
+        for r in readers.values():
+            if hasattr(r, "reset"):
+                r.reset()
+            else:
+                r.offset = 0  # table cursor rewinds over shared history
+        for _ in range(1 << 20):
+            if not any(r.pending() for r in readers.values()):
+                break
+            job.chunk_round()
+        job.inject_barrier()  # flush + drain emissions
+        job.inject_barrier()  # residual drains (maintenance pass)
+        st = job.states[plan.mv_node][plan.mv_index]
+        rows = terminal.to_host(st)
+        schema = terminal.in_schema
+        keep = [i for i, f in enumerate(schema)
+                if not f.name.startswith("_hidden_")]
+        self._last_columns = [schema[i].name for i in keep]
+        self._last_fields = [schema[i] for i in keep]
+        rows = [tuple(r[i] for i in keep) for r in rows]
+        out_schema = Schema(tuple(schema[i] for i in keep))
+        return self._host_order_limit(rows, select, out_schema)
+
+    def _host_order_limit(self, rows: list, select: ast.Select,
+                          schema: Schema) -> list:
+        """ORDER BY (output columns) / LIMIT / OFFSET on host rows."""
+        if select.order_by:
+            for oi in reversed(select.order_by):
+                e = oi.expr
+                if isinstance(e, ast.ColumnRef):
+                    i = schema.index_of(e.name)
+                elif isinstance(e, ast.Literal) and e.type_name == "int":
+                    if not 1 <= e.value <= len(schema):
+                        raise PlanError(
+                            f"ORDER BY position {e.value} is not in "
+                            f"the select list (1..{len(schema)})"
+                        )
+                    i = e.value - 1  # ORDER BY <position>
+                else:
+                    raise PlanError(
+                        "serving ORDER BY supports output columns"
+                    )
+                rows.sort(
+                    key=lambda r: (r[i] is None, r[i]),
+                    reverse=oi.descending,
+                )
+        if select.offset:
+            rows = rows[select.offset:]
+        if select.limit is not None:
+            rows = rows[:select.limit]
+        return rows
 
     def _mv_snapshot_chunk(self, entry: CatalogEntry):
         """The upstream MV's current rows as ONE insert chunk (device-
@@ -652,6 +848,7 @@ class Engine:
                 checkpoint_frequency=ckpt_freq,
                 checkpoint_store=self.checkpoint_store,
             )
+            self._prime_temporal_builds(job, range(len(job.nodes)))
             terminal = plan.nodes[plan.mv_node].fragment.executors[
                 plan.mv_index
             ]
@@ -745,10 +942,42 @@ class Engine:
                         nid, [snap_for(ref[1])], side=side
                     )
 
-        target.reseed_checkpoint()
+        self._prime_temporal_builds(target, ids)
+        if not self._replaying:
+            target.reseed_checkpoint()
         terminal = rewritten[plan.mv_node].fragment.executors[plan.mv_index]
         return target, terminal, (ids[plan.mv_node], plan.mv_index), \
             (ids, list(src_rename.values())), False
+
+    def _prime_temporal_builds(self, job: DagJob, node_ids) -> None:
+        """Drain each temporal join's build-side source BEFORE any
+        probe chunk flows: the build table must reflect the table's
+        full current state at MV creation (ref temporal_join.rs reads
+        the upstream table's storage directly; this local copy
+        backfills instead)."""
+        from risingwave_tpu.stream.temporal_join import (
+            TemporalJoinExecutor,
+        )
+
+        for nid in node_ids:
+            node = job.nodes[nid]
+            if not (isinstance(node, JoinNode)
+                    and isinstance(node.join, TemporalJoinExecutor)):
+                continue
+            ref = node.right
+            while ref[0] == "node":
+                n2 = job.nodes[ref[1]]
+                if isinstance(n2, FragNode):
+                    ref = n2.input
+                else:
+                    break  # joins feeding a temporal build: leave as-is
+            if ref[0] != "source":
+                continue
+            r = job.sources.get(ref[1])
+            for _ in range(1 << 16):
+                if not (hasattr(r, "pending") and r.pending() > 0):
+                    break
+                job.run_chunk(ref[1])
 
     def _merge_dag_jobs(self, a: DagJob, b: DagJob) -> DagJob:
         """Fuse job ``b`` into ``a`` (a join of MVs living in different
@@ -1164,191 +1393,6 @@ class Engine:
             vals = vals.astype(np.float64) / 10**f.decimal_scale
         return vals.tolist(), False
 
-    def _serve_agg(self, select: ast.Select, scope, chunk):
-        """Host-side aggregates over an MV snapshot (the batch
-        hash/sort-agg executors of SURVEY §2.8 for the local mode)."""
-        if select.group_by:
-            return self._serve_group_agg(select, scope, chunk)
-        if select.having is not None:
-            raise PlanError("HAVING on serving aggregates: next round")
-        vis = np.asarray(chunk.valid)
-        out = []
-        names = []
-        for item in select.items:
-            e = item.expr
-            if not (isinstance(e, ast.FuncCall)
-                    and e.name in ("count", "sum", "min", "max", "avg")):
-                raise PlanError(
-                    "serving aggregates support plain count/sum/min/max/"
-                    "avg items"
-                )
-            names.append(item.alias or e.name)
-            if e.name == "count" and (
-                not e.args or isinstance(e.args[0], ast.Star)
-            ):
-                out.append(int(vis.sum()))
-                continue
-            vals, is_str = self._host_col(
-                Binder(scope).bind(e.args[0]), chunk, vis
-            )
-            if is_str and e.name in ("sum", "avg"):
-                raise PlanError(f"{e.name} over strings is not valid")
-            if not is_str:
-                vals = np.asarray(vals)
-            if e.distinct:
-                if e.name != "count":
-                    raise PlanError(
-                        "DISTINCT supported for count only (serving)"
-                    )
-                out.append(len(set(
-                    vals if isinstance(vals, list) else vals.tolist()
-                )))
-                continue
-            if e.name == "count":
-                out.append(len(vals))  # COUNT over empty = 0, not NULL
-            elif len(vals) == 0:
-                out.append(None)
-            elif e.name == "sum":
-                out.append(sum(vals) if isinstance(vals, list)
-                           else vals.sum().item())
-            elif e.name == "min":
-                out.append(min(vals) if isinstance(vals, list)
-                           else vals.min().item())
-            elif e.name == "max":
-                out.append(max(vals) if isinstance(vals, list)
-                           else vals.max().item())
-            else:
-                out.append(float(np.mean(vals)))
-        self._last_columns = names
-        result = [tuple(out)]
-        if select.offset:
-            result = result[select.offset:]
-        if select.limit is not None:
-            result = result[:select.limit]
-        return result
-
-    def _serve_group_agg(self, select: ast.Select, scope, chunk):
-        """Batch GROUP BY over an MV snapshot (hash-agg local mode)."""
-        from collections import defaultdict
-
-        from risingwave_tpu.common.chunk import StrCol, decode_strings
-
-        if select.having is not None:
-            raise PlanError("HAVING on serving aggregates: next round")
-        vis = np.asarray(chunk.valid)
-        b = Binder(scope)
-        group_cols = [
-            self._host_col(b.bind(g), chunk, vis)[0]
-            for g in select.group_by
-        ]
-        n = int(vis.sum())
-        keys = [tuple(c[i] for c in group_cols) for i in range(n)]
-
-        names = []
-        # per item: either a group expr (echo) or an aggregate
-        plans = []  # ("key", gi) | ("agg", name, values, distinct)
-        for idx, item in enumerate(select.items):
-            e = item.expr
-            matched = None
-            for gi, g in enumerate(select.group_by):
-                if e == g:
-                    matched = gi
-                    break
-            if matched is not None:
-                names.append(item.alias or self.planner._default_name(
-                    e, idx
-                ))
-                plans.append(("key", matched))
-                continue
-            if not (isinstance(e, ast.FuncCall)
-                    and e.name in ("count", "sum", "min", "max", "avg")):
-                raise PlanError(
-                    "serving GROUP BY items must be group keys or "
-                    "count/sum/min/max/avg"
-                )
-            names.append(item.alias or e.name)
-            if e.name == "count" and (
-                not e.args or isinstance(e.args[0], ast.Star)
-            ):
-                plans.append(("agg", "count_star", None, False))
-            else:
-                vals, is_str = self._host_col(
-                    b.bind(e.args[0]), chunk, vis
-                )
-                if is_str and e.name in ("sum", "avg"):
-                    raise PlanError(
-                        f"{e.name} over strings is not valid"
-                    )
-                plans.append(("agg", e.name, vals, e.distinct))
-
-        groups: dict = defaultdict(list)
-        for i in range(n):
-            groups[keys[i]].append(i)
-        out = []
-        for key, idxs in groups.items():
-            row = []
-            for p in plans:
-                if p[0] == "key":
-                    row.append(key[p[1]])
-                    continue
-                _, kind, vals, distinct = p
-                if kind == "count_star":
-                    row.append(len(idxs))
-                    continue
-                sel = [vals[i] for i in idxs]
-                if distinct:
-                    if kind != "count":
-                        raise PlanError(
-                            "DISTINCT supported for count only (serving)"
-                        )
-                    row.append(len(set(sel)))
-                elif kind == "count":
-                    row.append(len(sel))
-                elif kind == "sum":
-                    row.append(sum(sel))
-                elif kind == "min":
-                    row.append(min(sel))
-                elif kind == "max":
-                    row.append(max(sel))
-                else:
-                    row.append(float(np.mean(sel)))
-            out.append(tuple(row))
-        self._last_columns = names
-        # ORDER BY/LIMIT/OFFSET over the grouped result
-        if select.order_by:
-            for oi in reversed(select.order_by):
-                pos = None
-                if isinstance(oi.expr, ast.Literal) \
-                        and oi.expr.type_name == "int":
-                    if not (1 <= oi.expr.value <= len(names)):
-                        raise PlanError(
-                            f"ORDER BY position {oi.expr.value} out of "
-                            "range"
-                        )
-                    pos = oi.expr.value - 1
-                else:
-                    ref_name = oi.expr.name if isinstance(
-                        oi.expr, ast.ColumnRef
-                    ) else None
-                    for ni, item in enumerate(select.items):
-                        if item.expr == oi.expr or (
-                            ref_name is not None
-                            and item.alias == ref_name
-                        ):
-                            pos = ni
-                            break
-                if pos is None:
-                    raise PlanError(
-                        "serving GROUP BY ORDER BY must reference a "
-                        "select item"
-                    )
-                out.sort(key=lambda r: r[pos], reverse=oi.descending)
-        if select.offset:
-            out = out[select.offset:]
-        if select.limit is not None:
-            out = out[:select.limit]
-        return out
-
     def _mv_rows(self, entry: CatalogEntry):
         from risingwave_tpu.stream.sharded import ShardedStreamingJob
 
@@ -1437,8 +1481,37 @@ class Engine:
         end = None if limit is None else offset + limit
         return rows[offset:end]
 
+    def _needs_batch_exec(self, select: ast.Select) -> bool:
+        """Fast path = plain projection/filter over one MV; everything
+        else (aggs, GROUP BY, joins, derived tables, subqueries in
+        WHERE, base-table scans) runs the batch executor pipeline."""
+        if not isinstance(select.from_, ast.TableRef):
+            return True
+        if select.from_.name not in self.catalog:
+            return False  # fast path raises the proper error
+        if self.catalog.get(select.from_.name).kind != "mview":
+            return True
+        if select.group_by or select.having is not None \
+                or self.planner._has_agg(select):
+            return True
+
+        def has_sub(e) -> bool:
+            if isinstance(e, (ast.ScalarSubquery, ast.InSubquery,
+                              ast.ExistsSubquery)):
+                return True
+            for a in ("left", "right", "operand"):
+                v = getattr(e, a, None)
+                if v is not None and has_sub(v):
+                    return True
+            return any(has_sub(x) for x in getattr(e, "args", ())
+                       if not isinstance(x, ast.Star))
+
+        return select.where is not None and has_sub(select.where)
+
     def _serve(self, select: ast.Select):
         """Batch read over a materialized view (local execution mode)."""
+        if self._needs_batch_exec(select):
+            return self._serve_batch(select)
         if not isinstance(select.from_, ast.TableRef):
             raise PlanError("serving reads support SELECT ... FROM <mv>")
         entry = self.catalog.get(select.from_.name)
@@ -1459,8 +1532,10 @@ class Engine:
         if select.where is not None:
             keep = Binder(scope).bind(select.where).eval(chunk)
             chunk = chunk.mask(keep)
-        if self.planner._has_agg(select) or select.group_by:
-            return self._serve_agg(select, scope, chunk)
+        # aggregates/GROUP BY route to _serve_batch before reaching
+        # here (_needs_batch_exec); the interpreted host-agg path that
+        # used to live at this dispatch is deleted — one SQL semantics,
+        # one (compiled) implementation
         items = self.planner._expand_items(select.items, scope)
         b = Binder(scope)
         out_cols = []
@@ -1499,6 +1574,58 @@ class Engine:
         if select.limit is not None:
             result = result[:select.limit]
         return result
+
+
+class _SnapshotReader:
+    """Bounded serving source: an MV's rows at read time, as one
+    static-capacity all-inserts chunk (ref RowSeqScanExecutor reading a
+    BatchTable at a pinned epoch, row_seq_scan.rs:44 — here the
+    'table' is the MV's device state, snapshotted zero-copy)."""
+
+    def __init__(self, engine, entry):
+        self.engine = engine
+        self.entry = entry
+        self._chunks: list = []
+        self._empty = None
+
+    def reset(self) -> None:
+        from risingwave_tpu.stream.sharded import ShardedStreamingJob
+        import jax.numpy as jnp
+
+        entry = self.entry
+        if isinstance(entry.job, ShardedStreamingJob) \
+                or getattr(entry.job, "mesh", None) is not None:
+            # sharded upstream: host-gathered rows re-encoded at the
+            # executor's static capacity
+            rows = self.engine._mv_rows(entry)
+            ex = entry.mv_executor
+            cap = getattr(ex, "table_size", None) \
+                or getattr(ex, "ring_size")
+            schema = ex.in_schema
+            if rows:
+                arrays = [np.asarray([r[i] for r in rows])
+                          for i in range(len(schema))]
+            else:
+                arrays = [np.zeros((0,), np.int64) for _ in schema]
+            chunk = Chunk.from_numpy(schema, arrays, capacity=cap)
+        else:
+            chunk = self.engine._mv_snapshot_chunk(entry)
+        self._chunks = [chunk]
+        if self._empty is None:
+            self._empty = Chunk(
+                chunk.columns,
+                jnp.zeros((chunk.capacity,), jnp.int8),
+                jnp.zeros((chunk.capacity,), jnp.bool_),
+                chunk.schema,
+            )
+
+    def pending(self) -> int:
+        return len(self._chunks)
+
+    def next_chunk(self):
+        if self._chunks:
+            return self._chunks.pop()
+        return self._empty
 
 
 def _const_value(e):

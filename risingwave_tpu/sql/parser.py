@@ -35,6 +35,12 @@ _INTERVAL_UNITS = {
     "millisecond": 1_000, "milliseconds": 1_000,
 }
 
+#: calendar units carried as a months count (ref Interval {months,
+#: days, usecs}); consumed by bind-time date-arithmetic folding
+_INTERVAL_MONTH_UNITS = {
+    "month": 1, "months": 1, "year": 12, "years": 12,
+}
+
 
 class Token:
     __slots__ = ("kind", "value")
@@ -411,6 +417,32 @@ class Parser:
 
     # -- SELECT ---------------------------------------------------------
     def _select(self) -> ast.Select:
+        if self.accept_word("with"):
+            # WITH name [(col,...)] AS (select) [, ...] select — CTEs
+            # inline as derived tables (the reference's share/DAG dedup
+            # merges repeated uses back into one plan; here the DAG
+            # builder's shared-source merge plays that role)
+            ctes: dict[str, ast.Select] = {}
+            while True:
+                name = self.ident()
+                cols: list[str] = []
+                if self.accept_op("("):
+                    while True:
+                        cols.append(self.ident())
+                        if not self.accept_op(","):
+                            break
+                    self.expect_op(")")
+                self.expect_word("as")
+                self.expect_op("(")
+                sub = self._select()
+                self.expect_op(")")
+                if cols:
+                    sub = _realias(sub, cols)
+                ctes[name] = sub
+                if not self.accept_op(","):
+                    break
+            body = self._select()
+            return _substitute_ctes(body, ctes)
         self.expect_word("select")
         items = []
         while True:
@@ -496,13 +528,19 @@ class Parser:
             right = self._table_factor()
             self.expect_word("on")
             on = self._expr()
+            if getattr(right, "temporal", False):
+                if kind not in ("inner", "left"):
+                    raise ParseError(
+                        "FOR SYSTEM_TIME joins support INNER/LEFT"
+                    )
+                kind = "temporal" if kind == "inner" else "temporal_left"
             left = ast.Join(left, right, on, kind)
         return left
 
     def _table_factor(self):
         t = self.peek()
         if t and t.kind == "op" and t.value == "(":
-            # derived table: ( SELECT ... ) [AS] alias
+            # derived table: ( SELECT ... ) [AS] alias [(col, ...)]
             self.expect_op("(")
             select = self._select()
             self.expect_op(")")
@@ -516,6 +554,13 @@ class Parser:
                       "offset", "emit",
                   )):
                 alias = self.ident()
+            if alias is not None and self.accept_op("("):
+                # column alias list renames the derived table's output
+                cols = [self.ident()]
+                while self.accept_op(","):
+                    cols.append(self.ident())
+                self.expect_op(")")
+                select = _realias(select, cols)
             return ast.SubqueryRef(select, alias)
         if t and t.value in ("tumble", "hop"):
             fn = self.next().value
@@ -544,6 +589,18 @@ class Parser:
                 return ast.Tumble(table, col, iv1, alias)
             return ast.Hop(table, col, iv1, iv2, alias)
         name = self.ident()
+        temporal = False
+        if (self.peek() and self.peek().value == "for"
+                and self.peek(1) and self.peek(1).value == "system_time"):
+            # t FOR SYSTEM_TIME AS OF PROCTIME(): temporal-join build
+            self.next()
+            self.next()
+            self.expect_word("as")
+            self.expect_word("of")
+            self.expect_word("proctime")
+            self.expect_op("(")
+            self.expect_op(")")
+            temporal = True
         alias = None
         if self.accept_word("as"):
             alias = self.ident()
@@ -551,9 +608,10 @@ class Parser:
               and self.peek().value not in (
                   "join", "inner", "left", "right", "full", "on", "where",
                   "group", "having", "order", "limit", "offset", "emit",
+                  "for",
               )):
             alias = self.ident()
-        return ast.TableRef(name, alias)
+        return ast.TableRef(name, alias, temporal)
 
     # -- expressions ----------------------------------------------------
     def _expr(self, min_prec: int = 0):
@@ -686,6 +744,35 @@ class Parser:
             if s.kind != "string":
                 raise ParseError("expected INTERVAL 'value'")
             return self._interval(s.value[1:-1])
+        if w in ("date", "timestamp") and self.peek() \
+                and self.peek().kind == "string":
+            # typed literal: DATE '1994-01-01' / TIMESTAMP '… …'
+            raw = self.next().value[1:-1]
+            return self._datetime_literal(w, raw)
+        if w == "exists" and self.peek() \
+                and self.peek().value == "(":
+            self.expect_op("(")
+            sub = self._select()
+            self.expect_op(")")
+            return ast.ExistsSubquery(sub)
+        if w == "substring" and self.accept_op("("):
+            # substring(s FROM a [FOR n]) — also accept the plain
+            # comma form through the generic call path below is NOT
+            # possible once '(' is consumed, so handle both here
+            e = self._expr()
+            if self.accept_word("from"):
+                start = self._expr()
+                count = None
+                if self.accept_word("for"):
+                    count = self._expr()
+                self.expect_op(")")
+                args = (e, start) if count is None else (e, start, count)
+                return ast.FuncCall("substr", args)
+            args = [e]
+            while self.accept_op(","):
+                args.append(self._expr())
+            self.expect_op(")")
+            return ast.FuncCall("substr", tuple(args))
         if w in ("true", "false"):
             return ast.Literal(w == "true", "bool")
         if w == "null":
@@ -796,6 +883,25 @@ class Parser:
         fol = bound(False)
         return (pre, fol)
 
+    def _datetime_literal(self, kind: str, raw: str):
+        """DATE 'Y-m-d' → days since epoch; TIMESTAMP → microseconds."""
+        import datetime as _dt
+        try:
+            if kind == "date":
+                d = _dt.date.fromisoformat(raw.strip())
+                return ast.Literal(
+                    (d - _dt.date(1970, 1, 1)).days, "date"
+                )
+            ts = _dt.datetime.fromisoformat(raw.strip())
+            epoch = _dt.datetime(1970, 1, 1)
+            # exact integer microseconds (float total_seconds() rounds)
+            return ast.Literal(
+                (ts - epoch) // _dt.timedelta(microseconds=1),
+                "timestamp",
+            )
+        except ValueError as e:
+            raise ParseError(f"bad {kind} literal {raw!r}: {e}")
+
     def _interval(self, text: str) -> ast.IntervalLit:
         m = re.match(r"^\s*(\d+)\s*([a-zA-Z]+)?\s*$", text)
         if not m:
@@ -804,22 +910,112 @@ class Parser:
         unit = (m.group(2) or "second").lower()
         # also accept the unit as the next word: INTERVAL '10' SECOND
         if m.group(2) is None and self.peek() and self.peek().kind == "word" \
-                and self.peek().value in _INTERVAL_UNITS:
+                and self.peek().value in (_INTERVAL_UNITS.keys()
+                                          | _INTERVAL_MONTH_UNITS.keys()):
             unit = self.next().value
+        if unit in _INTERVAL_MONTH_UNITS:
+            return ast.IntervalLit(0, months=n * _INTERVAL_MONTH_UNITS[unit])
         if unit not in _INTERVAL_UNITS:
             raise ParseError(f"unsupported interval unit {unit!r}")
         return ast.IntervalLit(n * _INTERVAL_UNITS[unit])
 
 
+def _realias(select: ast.Select, cols: list[str]) -> ast.Select:
+    """Apply a column alias list to a SELECT's output items."""
+    import dataclasses
+    items = select.items
+    if len(cols) != len(items) or any(
+            isinstance(i.expr, ast.Star) for i in items):
+        raise ParseError(
+            f"column alias list has {len(cols)} names for "
+            f"{len(items)} output columns"
+        )
+    return dataclasses.replace(select, items=tuple(
+        ast.SelectItem(i.expr, c) for i, c in zip(items, cols)
+    ))
+
+
+def _substitute_ctes(node, ctes: dict):
+    """Deep-rewrite TableRefs naming a CTE into derived tables.
+
+    Covers FROM trees and subqueries inside expressions (IN / EXISTS /
+    scalar subqueries) — e.g. TPC-H q15 uses its CTE both in FROM and
+    in a scalar subquery."""
+    import dataclasses
+
+    def walk(x):
+        if isinstance(x, ast.TableRef) and x.name in ctes:
+            return ast.SubqueryRef(ctes[x.name], x.alias or x.name)
+        if isinstance(x, (ast.Tumble, ast.Hop)):
+            return dataclasses.replace(x, table=walk(x.table))
+        if isinstance(x, ast.Join):
+            return dataclasses.replace(
+                x, left=walk(x.left), right=walk(x.right),
+                on=walk(x.on) if x.on is not None else None,
+            )
+        if isinstance(x, ast.Select):
+            return dataclasses.replace(
+                x,
+                items=tuple(
+                    ast.SelectItem(walk(i.expr), i.alias)
+                    if not isinstance(i.expr, ast.Star) else i
+                    for i in x.items
+                ),
+                from_=walk(x.from_) if x.from_ is not None else None,
+                where=walk(x.where) if x.where is not None else None,
+                group_by=tuple(walk(g) for g in x.group_by),
+                having=walk(x.having) if x.having is not None else None,
+                order_by=tuple(
+                    ast.OrderItem(walk(o.expr), o.descending)
+                    for o in x.order_by
+                ),
+            )
+        if isinstance(x, ast.ScalarSubquery):
+            return ast.ScalarSubquery(walk(x.select))
+        if isinstance(x, ast.ExistsSubquery):
+            return ast.ExistsSubquery(walk(x.select))
+        if isinstance(x, ast.InSubquery):
+            return ast.InSubquery(walk(x.expr), walk(x.select),
+                                  x.negated)
+        if isinstance(x, ast.BinaryOp):
+            return ast.BinaryOp(x.op, walk(x.left), walk(x.right))
+        if isinstance(x, ast.UnaryOp):
+            return ast.UnaryOp(x.op, walk(x.operand))
+        if isinstance(x, ast.Case):
+            return ast.Case(
+                tuple((walk(c), walk(r)) for c, r in x.conditions),
+                walk(x.else_result) if x.else_result is not None
+                else None,
+            )
+        if isinstance(x, ast.FuncCall):
+            return dataclasses.replace(x, args=tuple(
+                a if isinstance(a, ast.Star) else walk(a)
+                for a in x.args
+            ), filter_where=(walk(x.filter_where)
+                             if x.filter_where is not None else None))
+        if isinstance(x, ast.Cast):
+            return dataclasses.replace(x, operand=walk(x.operand))
+        return x
+
+    return walk(node)
+
+
 def parse(sql: str):
     """Parse one or more ;-separated statements."""
-    stmts = []
+    return [stmt for _, stmt in parse_with_text(sql)]
+
+
+def parse_with_text(sql: str):
+    """Parse statements keeping each one's raw SQL text (the durable
+    DDL log records the text, not the AST)."""
+    out = []
     for part in _split_statements(sql):
         p = Parser(part)
-        stmts.append(p.parse_statement())
+        stmt = p.parse_statement()
         if p.peek() is not None:
             raise ParseError(f"trailing tokens at {p.peek()}")
-    return stmts
+        out.append((part, stmt))
+    return out
 
 
 def _split_statements(sql: str) -> list[str]:

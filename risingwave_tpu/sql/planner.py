@@ -181,7 +181,10 @@ class Planner:
                 inner, spec = rewritten
                 return self.plan(inner, sink=sink, eowc=eowc,
                                  group_topn=spec)
+        select = self._factor_where(select)
         select = self._rewrite_in_subqueries(select)
+        select = self._rewrite_exists_subqueries(select)
+        select = self._rewrite_correlated_scalar(select)
 
         if isinstance(select.from_, ast.Join) or has_subquery(select.from_):
             if eowc:
@@ -243,6 +246,391 @@ class Planner:
             where = r if where is None else ast.BinaryOp("and", where, r)
         import dataclasses
         return dataclasses.replace(select, from_=from_, where=where)
+
+    # -- OR common-conjunct factoring -----------------------------------
+    def _factor_where(self, select: ast.Select) -> ast.Select:
+        if select.where is None:
+            return select
+        new = self._factor_or(select.where)
+        if new is select.where:
+            return select
+        import dataclasses
+        return dataclasses.replace(select, where=new)
+
+    def _factor_or(self, e):
+        """``(A AND e) OR (B AND e) → e AND (A OR B)``: lifts
+        predicates duplicated across every OR branch — notably the
+        equi-join conditions TPC-H q19 repeats per branch — up to the
+        conjunct level where comma-join mining can consume them (ref:
+        the reference optimizer's common-factor extraction in
+        condition rewriting)."""
+        if isinstance(e, ast.BinaryOp) and e.op == "and":
+            lf = self._factor_or(e.left)
+            rf = self._factor_or(e.right)
+            if lf is e.left and rf is e.right:
+                return e
+            return ast.BinaryOp("and", lf, rf)
+        if not (isinstance(e, ast.BinaryOp) and e.op == "or"):
+            return e
+        branches: list = []
+
+        def collect(x) -> None:
+            if isinstance(x, ast.BinaryOp) and x.op == "or":
+                collect(x.left)
+                collect(x.right)
+            else:
+                branches.append(self._factor_or(x))
+
+        collect(e)
+        conj_lists = [self._conjuncts(b) for b in branches]
+        common: list = []
+        for c in conj_lists[0]:
+            if any(c == x for x in common):
+                continue
+            if all(any(c == d for d in cl) for cl in conj_lists[1:]):
+                common.append(c)
+        if not common:
+            return e
+
+        def and_fold(parts):
+            out = None
+            for p in parts:
+                out = p if out is None else ast.BinaryOp("and", out, p)
+            return out
+
+        residues: list = []
+        some_branch_empty = False
+        for cl in conj_lists:
+            rem = list(cl)
+            for c in common:
+                for j, d in enumerate(rem):
+                    if d == c:
+                        rem.pop(j)
+                        break
+            if not rem:
+                # this branch is exactly the common part: the OR of
+                # residues is vacuously true
+                some_branch_empty = True
+                break
+            residues.append(and_fold(rem))
+        parts = list(common)
+        if not some_branch_empty:
+            out = None
+            for r in residues:
+                out = r if out is None else ast.BinaryOp("or", out, r)
+            parts.append(out)
+        return and_fold(parts)
+
+    # -- EXISTS rewrite -------------------------------------------------
+    def _from_name_sets(self, from_):
+        """(names, (qual, name) pairs) visible from a FROM tree — used
+        to split an EXISTS subquery's predicates into local vs
+        correlated (outer) references."""
+        names: set = set()
+        quals: set = set()
+        if isinstance(from_, ast.Join):
+            for side in (from_.left, from_.right):
+                n, q = self._from_name_sets(side)
+                names |= n
+                quals |= q
+            return names, quals
+        if isinstance(from_, ast.SubqueryRef):
+            for i, it in enumerate(from_.select.items):
+                if isinstance(it.expr, ast.Star):
+                    n, q = self._from_name_sets(from_.select.from_)
+                    names |= n
+                    continue
+                nm = it.alias or self._default_name(it.expr, i)
+                names.add(nm)
+                if from_.alias:
+                    quals.add((from_.alias, nm))
+            return names, quals
+        if isinstance(from_, (ast.Tumble, ast.Hop)):
+            n, q = self._from_name_sets(from_.table)
+            names |= n | {"window_start", "window_end"}
+            return names, quals
+        # TableRef
+        try:
+            entry = self.catalog.get(from_.name)
+        except Exception:
+            return names, quals
+        qual = from_.alias or from_.name
+        for f in entry.schema:
+            names.add(f.name)
+            quals.add((qual, f.name))
+        return names, quals
+
+    def _rewrite_exists_subqueries(self, select: ast.Select) -> ast.Select:
+        """``[NOT] EXISTS (SELECT .. FROM u WHERE u.k = outer.k AND
+        <local>)`` conjuncts become semi/anti joins on the correlated
+        equi keys, with local predicates pushed into the subquery
+        (ref: the reference's correlated-subquery unnesting to
+        StreamHashJoin LeftSemi/LeftAnti, optimizer/rule/
+        apply_join_transpose_rule.rs and kin)."""
+        if select.where is None:
+            return select
+        conjs = self._conjuncts(select.where)
+        hits = []
+        for c in conjs:
+            if isinstance(c, ast.ExistsSubquery):
+                hits.append((c, c.select, False))
+            elif (isinstance(c, ast.UnaryOp) and c.op == "not"
+                    and isinstance(c.operand, ast.ExistsSubquery)):
+                hits.append((c, c.operand.select, True))
+        if not hits:
+            return select
+        rest = [c for c in conjs
+                if not any(c is h[0] for h in hits)]
+        from_ = select.from_
+        for k, (_, sub, negated) in enumerate(hits):
+            sub_names, sub_quals = self._from_name_sets(sub.from_)
+
+            def is_local(e) -> bool:
+                if not isinstance(e, ast.ColumnRef):
+                    return False
+                if e.table is not None:
+                    return (e.table, e.name) in sub_quals
+                return e.name in sub_names
+
+            local: list = []
+            join_keys: list = []  # (sub_col: ColumnRef, outer_expr)
+            sub_conjs = self._conjuncts(sub.where) \
+                if sub.where is not None else []
+            for sc in sub_conjs:
+                refs = self._column_refs(sc)
+                if refs and all(is_local(r) for r in refs):
+                    local.append(sc)
+                    continue
+                if (isinstance(sc, ast.BinaryOp) and sc.op == "equal"):
+                    a, b = sc.left, sc.right
+                    if isinstance(a, ast.ColumnRef) \
+                            and isinstance(b, ast.ColumnRef):
+                        if is_local(a) and not is_local(b):
+                            join_keys.append((a, b))
+                            continue
+                        if is_local(b) and not is_local(a):
+                            join_keys.append((b, a))
+                            continue
+                raise PlanError(
+                    "EXISTS supports correlated equality predicates "
+                    f"only (got {sc!r})"
+                )
+            if not join_keys:
+                raise PlanError(
+                    "EXISTS subquery must correlate on at least one "
+                    "equality with the outer query"
+                )
+            alias = f"_ex_sq{k}"
+            import dataclasses
+            lwhere = None
+            for c2 in local:
+                lwhere = c2 if lwhere is None \
+                    else ast.BinaryOp("and", lwhere, c2)
+            items = tuple(
+                ast.SelectItem(sc_col, f"_exk{j}")
+                for j, (sc_col, _) in enumerate(join_keys)
+            )
+            sub2 = dataclasses.replace(
+                sub, items=items, where=lwhere, group_by=(),
+                having=None, order_by=(), limit=None, offset=None,
+            )
+            on = None
+            for j, (_, outer_e) in enumerate(join_keys):
+                eq = ast.BinaryOp(
+                    "equal", outer_e, ast.ColumnRef(f"_exk{j}", alias)
+                )
+                on = eq if on is None else ast.BinaryOp("and", on, eq)
+            from_ = ast.Join(
+                left=from_, right=ast.SubqueryRef(sub2, alias),
+                on=on, kind="anti" if negated else "semi",
+            )
+        where = None
+        for r in rest:
+            where = r if where is None else ast.BinaryOp("and", where, r)
+        import dataclasses
+        return dataclasses.replace(select, from_=from_, where=where)
+
+    def _column_refs(self, e) -> list:
+        """All ColumnRefs in an AST expression."""
+        out: list = []
+        stack = [e]
+        while stack:
+            x = stack.pop()
+            if isinstance(x, ast.ColumnRef):
+                out.append(x)
+            elif isinstance(x, ast.Case):
+                for c, r in x.conditions:
+                    stack += [c, r]
+                if x.else_result is not None:
+                    stack.append(x.else_result)
+            else:
+                for a in ("left", "right", "operand", "expr",
+                          "filter_where"):
+                    v = getattr(x, a, None)
+                    if v is not None and not isinstance(v, str):
+                        stack.append(v)
+                stack.extend(
+                    a for a in getattr(x, "args", ())
+                    if not isinstance(a, ast.Star)
+                )
+        return out
+
+    def _rewrite_correlated_scalar(self, select: ast.Select) -> ast.Select:
+        """``lhs CMP (SELECT agg(..) FROM .. WHERE sub_col = outer_col
+        AND <local>)`` decorrelates into a join against the subquery
+        grouped by its correlation keys, with ``lhs CMP agg_out`` as a
+        residual predicate (the reference's Apply→Join unnesting,
+        optimizer/rule/apply_agg_transpose_rule.rs and kin).
+
+        Empty-group semantics: the scalar subquery yields NULL over an
+        empty set, making the comparison never-true — the inner join
+        dropping missing keys is equivalent (count/count_star would
+        yield 0, NOT NULL, so those stay unsupported here)."""
+        if select.where is None:
+            return select
+        conjs = self._conjuncts(select.where)
+        hits = []
+        for c in conjs:
+            m = self._match_scalar_sub_cmp(c)
+            if m is None or self._is_uncorrelated(m[2]):
+                continue
+            hits.append((c, m))
+        if not hits:
+            return select
+        new_conjs = list(conjs)
+        from_ = select.from_
+        for k, (c, (lhs, cmp, sub)) in enumerate(hits):
+            if (sub.group_by or sub.having is not None
+                    or len(sub.items) != 1
+                    or isinstance(sub.items[0].expr, ast.Star)):
+                raise PlanError(
+                    "correlated scalar subquery must be a single "
+                    "ungrouped aggregate"
+                )
+            item = sub.items[0].expr
+            if any(f.name == "count"
+                   for f in self._column_refs_funcs(item)):
+                raise PlanError(
+                    "correlated scalar COUNT subquery (0 vs NULL over "
+                    "empty groups) is not supported"
+                )
+            sub_names, sub_quals = self._from_name_sets(sub.from_)
+
+            def is_local(e) -> bool:
+                if not isinstance(e, ast.ColumnRef):
+                    return False
+                if e.table is not None:
+                    return (e.table, e.name) in sub_quals
+                return e.name in sub_names
+
+            local: list = []
+            corr: list = []  # (sub_col, outer_col)
+            for sc in (self._conjuncts(sub.where)
+                       if sub.where is not None else []):
+                refs = self._column_refs(sc)
+                if refs and all(is_local(r) for r in refs):
+                    local.append(sc)
+                    continue
+                if isinstance(sc, ast.BinaryOp) and sc.op == "equal":
+                    a, b = sc.left, sc.right
+                    if isinstance(a, ast.ColumnRef) \
+                            and isinstance(b, ast.ColumnRef):
+                        if is_local(a) and not is_local(b):
+                            corr.append((a, b))
+                            continue
+                        if is_local(b) and not is_local(a):
+                            corr.append((b, a))
+                            continue
+                raise PlanError(
+                    "correlated scalar subquery supports equality "
+                    f"correlation only (got {sc!r})"
+                )
+            if not corr:
+                raise PlanError(
+                    "correlated scalar subquery lost its correlation"
+                )
+            alias = f"_cs_sq{k}"
+            import dataclasses
+            lwhere = None
+            for c2 in local:
+                lwhere = c2 if lwhere is None \
+                    else ast.BinaryOp("and", lwhere, c2)
+            items = tuple(
+                ast.SelectItem(sc_col, f"_ck{j}")
+                for j, (sc_col, _) in enumerate(corr)
+            ) + (ast.SelectItem(item, "_cv"),)
+            sub2 = dataclasses.replace(
+                sub, items=items, where=lwhere,
+                group_by=tuple(sc_col for sc_col, _ in corr),
+                having=None, order_by=(), limit=None, offset=None,
+            )
+            on = None
+            for j, (_, outer_c) in enumerate(corr):
+                eq = ast.BinaryOp(
+                    "equal", outer_c, ast.ColumnRef(f"_ck{j}", alias)
+                )
+                on = eq if on is None else ast.BinaryOp("and", on, eq)
+            from_ = ast.Join(
+                left=from_, right=ast.SubqueryRef(sub2, alias),
+                on=on, kind="inner",
+            )
+            # replace the conjunct with lhs CMP <agg out>
+            inv = {"gt": "greater_than", "ge": "greater_than_or_equal",
+                   "lt": "less_than", "le": "less_than_or_equal",
+                   "eq": "equal"}
+            new_conjs[new_conjs.index(c)] = ast.BinaryOp(
+                inv[cmp], lhs, ast.ColumnRef("_cv", alias)
+            )
+        where = None
+        for r in new_conjs:
+            where = r if where is None else ast.BinaryOp("and", where, r)
+        import dataclasses
+        return dataclasses.replace(select, from_=from_, where=where)
+
+    def _column_refs_funcs(self, e) -> list:
+        """All FuncCalls in an AST expression."""
+        out: list = []
+        stack = [e]
+        while stack:
+            x = stack.pop()
+            if isinstance(x, ast.FuncCall):
+                out.append(x)
+                stack.extend(a for a in x.args
+                             if not isinstance(a, ast.Star))
+            elif isinstance(x, ast.Case):
+                for c, r in x.conditions:
+                    stack += [c, r]
+                if x.else_result is not None:
+                    stack.append(x.else_result)
+            else:
+                for a in ("left", "right", "operand", "expr"):
+                    v = getattr(x, a, None)
+                    if v is not None and not isinstance(v, str):
+                        stack.append(v)
+                stack.extend(
+                    a for a in getattr(x, "args", ())
+                    if not isinstance(a, ast.Star)
+                )
+        return out
+
+    def _is_uncorrelated(self, sub: ast.Select) -> bool:
+        """Every column the subquery references resolves in its own
+        FROM — safe to plan as an independent 1-row changelog."""
+        names, quals = self._from_name_sets(sub.from_)
+
+        def local(r) -> bool:
+            if r.table is not None:
+                return (r.table, r.name) in quals
+            return r.name in names
+
+        exprs = [it.expr for it in sub.items
+                 if not isinstance(it.expr, ast.Star)]
+        if sub.where is not None:
+            exprs.append(sub.where)
+        exprs.extend(sub.group_by)
+        if sub.having is not None:
+            exprs.append(sub.having)
+        return all(local(r) for e in exprs for r in self._column_refs(e))
 
     # -- GroupTopN (row_number-in-subquery) rewrite ---------------------
     def _match_group_topn(self, select: ast.Select):
@@ -1175,8 +1563,70 @@ class Planner:
         sources: dict[str, Any] = {}
         nodes: list = []
 
+        def reorder_cross(jn: ast.Join) -> ast.Join:
+            """Greedy connectivity ordering of a comma-join chain: each
+            next factor must share a WHERE equi-conjunct with the
+            already-joined set (the reference optimizer's join
+            reordering; TPC-H q2 lists part, supplier, partsupp —
+            part×supplier has no direct edge, part×partsupp does)."""
+            factors: list = []
+
+            def flatten(x) -> None:
+                if isinstance(x, ast.Join) and x.kind == "cross":
+                    flatten(x.left)
+                    flatten(x.right)
+                else:
+                    factors.append(x)
+
+            flatten(jn)
+            if len(factors) <= 2:
+                return jn
+            fsets = [self._from_name_sets(f) for f in factors]
+
+            def owners(ref) -> list[int]:
+                out = []
+                for fi, (names, quals) in enumerate(fsets):
+                    ok = (ref.table, ref.name) in quals if ref.table \
+                        else ref.name in names
+                    if ok:
+                        out.append(fi)
+                return out
+
+            edges: list[tuple[int, int]] = []
+            for conj in where_conjs:
+                if not (isinstance(conj, ast.BinaryOp)
+                        and conj.op == "equal"):
+                    continue
+                lo = {o for r in self._column_refs(conj.left)
+                      for o in owners(r)}
+                ro = {o for r in self._column_refs(conj.right)
+                      for o in owners(r)}
+                if len(lo) == 1 and len(ro) == 1 and lo != ro:
+                    edges.append((lo.pop(), ro.pop()))
+            order = [0]
+            remaining = set(range(1, len(factors)))
+            while remaining:
+                pick = next(
+                    (j for j in sorted(remaining)
+                     if any((a in order and b == j)
+                            or (b in order and a == j)
+                            for a, b in edges)),
+                    None,
+                )
+                if pick is None:
+                    pick = min(remaining)  # disconnected: keep order,
+                    # resolve_join raises its usual clear error
+                order.append(pick)
+                remaining.discard(pick)
+            out = factors[order[0]]
+            for j in order[1:]:
+                out = ast.Join(out, factors[j], None, "cross")
+            return out
+
         def resolve(from_):
             if isinstance(from_, ast.Join):
+                if from_.kind == "cross":
+                    from_ = reorder_cross(from_)
                 return resolve_join(from_)
             if isinstance(from_, ast.SubqueryRef):
                 return resolve_subquery(from_)
@@ -1205,6 +1655,13 @@ class Planner:
             plans subqueries as shared sub-plans)."""
             nonlocal where_conjs
             inner = sq.select
+            # subquery bodies get the same unnesting rewrites as the
+            # top level (IN / EXISTS → semi/anti joins, correlated
+            # scalar aggs → grouped joins)
+            inner = self._factor_where(inner)
+            inner = self._rewrite_in_subqueries(inner)
+            inner = self._rewrite_exists_subqueries(inner)
+            inner = self._rewrite_correlated_scalar(inner)
             if inner.order_by or inner.limit is not None or inner.offset:
                 raise PlanError(
                     "ORDER BY/LIMIT in FROM subqueries: next round"
@@ -1222,13 +1679,53 @@ class Planner:
                 if inner.where is not None else []
             )
             iref, iinfo = resolve(inner.from_)
-            execs: list[Executor] = []
             scope = iinfo.scope
+            # scalar-subquery comparisons peel into dynamic filters
+            # (same rewrite as the top level; q22's derived table)
+            inner_dyn: list = []
+            for conj in list(where_conjs):
+                m = self._match_scalar_sub_cmp(conj)
+                if m is not None and isinstance(m[0], ast.ColumnRef) \
+                        and self._is_uncorrelated(m[2]):
+                    inner_dyn.append(m)
+                    where_conjs.remove(conj)
+            execs: list[Executor] = []
             for conj in where_conjs:  # filters not consumed by joins
                 execs.append(FilterExecutor(
                     scope.schema, Binder(scope).bind(conj)
                 ))
             where_conjs = saved_conjs
+            ref = iref
+            append_only_in = iinfo.append_only
+            if inner_dyn:
+                from risingwave_tpu.stream.dynamic_filter import (
+                    DynamicFilterExecutor,
+                )
+                if execs:
+                    nodes.append(FragNode(Fragment(execs), ref))
+                    ref = ("node", len(nodes) - 1)
+                    execs = []
+                for lhs, cmp, s2 in inner_dyn:
+                    if len(s2.items) != 1 or isinstance(
+                            s2.items[0].expr, ast.Star):
+                        raise PlanError(
+                            "scalar subquery must select exactly one "
+                            "column"
+                        )
+                    sref, _si = resolve_subquery(
+                        ast.SubqueryRef(s2, f"_sc_sq{len(nodes)}")
+                    )
+                    nodes.append(JoinNode(DynamicFilterExecutor(
+                        scope.schema,
+                        filter_col=scope.resolve(lhs.name, lhs.table),
+                        cmp=cmp,
+                        pool_size=max(cfg.topn_pool_size,
+                                      2 * cfg.chunk_capacity),
+                    ), ref, sref))
+                    ref = ("node", len(nodes) - 1)
+                append_only_in = False
+                import dataclasses as _dc
+                iinfo = _dc.replace(iinfo, append_only=False)
             has_agg = bool(inner.group_by) or self._has_agg(inner)
             pk_positions: list[int] = []
             if has_agg:
@@ -1241,7 +1738,7 @@ class Planner:
                 items = self._expand_items(inner.items, scope)
                 b = Binder(scope)
                 proj = [(nm, b.bind(e)) for nm, e in items]
-                if not iinfo.append_only:
+                if not append_only_in:
                     if iinfo.stream_key is None:
                         raise PlanError(
                             "retractable subquery input without a "
@@ -1252,8 +1749,7 @@ class Planner:
                     )
                 execs.append(ProjectExecutor(scope.schema, proj))
                 out_schema = execs[-1].out_schema
-                append_only = iinfo.append_only
-            ref = iref
+                append_only = append_only_in
             if execs:
                 nodes.append(FragNode(Fragment(execs), ref))
                 ref = ("node", len(nodes) - 1)
@@ -1276,7 +1772,79 @@ class Planner:
             if select.where is not None else []
         )
 
+        def resolve_temporal(jn: ast.Join):
+            """stream JOIN t FOR SYSTEM_TIME AS OF PROCTIME(): probe
+            the build table's pk index at process time (ref
+            temporal_join.rs; planner requires key == build pk like
+            the reference's index-lookup form)."""
+            from risingwave_tpu.stream.temporal_join import (
+                TemporalJoinExecutor,
+            )
+
+            join_type = "inner" if jn.kind == "temporal" else "left_outer"
+            lref, left = resolve(jn.left)
+            rref, right = resolve(jn.right)
+            n_left = len(left.schema)
+            if not right.stream_key:
+                raise PlanError(
+                    "temporal join build side needs a PRIMARY KEY"
+                )
+            lkeys: list = []
+            ridx: list[int] = []
+            residual: list = []
+            for conj in (self._conjuncts(jn.on) if jn.on is not None
+                         else []):
+                kp = self._equi_pair(
+                    conj, left.scope, right.scope, n_left
+                )
+                if kp is None:
+                    residual.append(conj)
+                    continue
+                lk, rk = kp
+                if not isinstance(rk, InputRef):
+                    raise PlanError(
+                        "temporal join keys must be build-side columns"
+                    )
+                lkeys.append(lk)
+                ridx.append(rk.index)
+            if set(ridx) != set(right.stream_key):
+                raise PlanError(
+                    "temporal join requires equality keys covering the "
+                    "build side's PRIMARY KEY exactly "
+                    f"(got cols {sorted(ridx)}, pk "
+                    f"{sorted(right.stream_key)})"
+                )
+            order = [ridx.index(pk) for pk in right.stream_key]
+            join = TemporalJoinExecutor(
+                left.schema, right.schema,
+                [lkeys[i] for i in order], list(right.stream_key),
+                table_size=cfg.join_table_size, join_type=join_type,
+            )
+            nodes.append(JoinNode(join, lref, rref))
+            ref = ("node", len(nodes) - 1)
+            both = Scope(
+                join.out_schema,
+                tuple(left.scope.qualifiers)
+                + tuple(right.scope.qualifiers),
+            )
+            if residual:
+                b = Binder(both)
+                nodes.append(FragNode(Fragment([
+                    FilterExecutor(both.schema, b.bind(c))
+                    for c in residual
+                ]), ref))
+                ref = ("node", len(nodes) - 1)
+            # build-side changes never retract outputs: append-only
+            # follows the PROBE side alone
+            info = PlannedInput(
+                None, [], both, both.schema, None, None,
+                left.append_only, stream_key=left.stream_key,
+            )
+            return ref, info
+
         def resolve_join(jn: ast.Join):
+            if jn.kind in ("temporal", "temporal_left"):
+                return resolve_temporal(jn)
             join_type = KIND_MAP.get(jn.kind)
             if join_type is None:
                 raise PlanError(f"unsupported join kind {jn.kind!r}")
@@ -1311,6 +1879,63 @@ class Planner:
                 raise PlanError(
                     "JOIN requires at least one equality condition"
                 )
+            if residual and join_type != "inner":
+                # an ON predicate touching ONLY the null-padded side
+                # pushes below the join as a filter on that input —
+                # semantically exact for one-sided outer joins (rows
+                # failing it simply don't match, and the preserved side
+                # still pads).  TPC-H q13's `LEFT JOIN ... ON k AND
+                # o_comment NOT LIKE ...` is this shape.
+                pushable_side = None
+                if join_type == "left_outer":
+                    pushable_side = "right"
+                elif join_type == "right_outer":
+                    pushable_side = "left"
+                if pushable_side is not None:
+                    pin = right if pushable_side == "right" else left
+                    other = left if pushable_side == "right" else right
+                    kept: list = []
+                    pushed: list = []
+                    for conj in residual:
+                        # pushable iff every column ref resolves on the
+                        # padded side and NO unqualified ref also
+                        # resolves on the preserved side (ambiguous —
+                        # keep it so the full-scope bind raises instead
+                        # of silently filtering the wrong side)
+                        refs = self._column_refs(conj)
+                        ok = bool(refs)
+                        for r in refs:
+                            try:
+                                pin.scope.resolve(r.name, r.table)
+                            except Exception:
+                                ok = False
+                                break
+                            if r.table is None:
+                                try:
+                                    other.scope.resolve(r.name, None)
+                                    ok = False  # ambiguous
+                                    break
+                                except Exception:
+                                    pass
+                        if not ok:
+                            kept.append(conj)
+                            continue
+                        try:
+                            pushed.append(FilterExecutor(
+                                pin.scope.schema,
+                                Binder(pin.scope).bind(conj),
+                            ))
+                        except Exception:
+                            kept.append(conj)
+                    if pushed:
+                        src_ref = rref if pushable_side == "right" \
+                            else lref
+                        nodes.append(FragNode(Fragment(pushed), src_ref))
+                        if pushable_side == "right":
+                            rref = ("node", len(nodes) - 1)
+                        else:
+                            lref = ("node", len(nodes) - 1)
+                    residual = kept
             if residual and join_type != "inner":
                 # the count-based degree design assumes match == key
                 # equality; a residual predicate would need in-executor
@@ -1397,11 +2022,51 @@ class Planner:
         both = root.scope
         post_execs: list[Executor] = []
         b = Binder(both)
-        # WHERE conjuncts not consumed as comma-join equi-conditions
+        # WHERE conjuncts comparing a column against an uncorrelated
+        # scalar subquery peel off into dynamic-filter nodes (ref
+        # dynamic_filter.rs); the rest become post-join filters
+        where_dyn: list = []
+        for conj in list(where_conjs):
+            m = self._match_scalar_sub_cmp(conj)
+            if m is not None and isinstance(m[0], ast.ColumnRef) \
+                    and self._is_uncorrelated(m[2]):
+                where_dyn.append(m)
+                where_conjs.remove(conj)
         for conj in where_conjs:
             post_execs.append(
                 FilterExecutor(both.schema, b.bind(conj))
             )
+        if where_dyn:
+            from risingwave_tpu.stream.dynamic_filter import (
+                DynamicFilterExecutor,
+            )
+            ref = root_ref
+            if post_execs:
+                nodes.append(FragNode(Fragment(post_execs), ref))
+                ref = ("node", len(nodes) - 1)
+                post_execs = []
+            for lhs, cmp, sub in where_dyn:
+                if len(sub.items) != 1 or isinstance(
+                        sub.items[0].expr, ast.Star):
+                    raise PlanError(
+                        "scalar subquery must select exactly one column"
+                    )
+                sref, _sinfo = resolve_subquery(
+                    ast.SubqueryRef(sub, f"_sc_sq{len(nodes)}")
+                )
+                nodes.append(JoinNode(DynamicFilterExecutor(
+                    both.schema,
+                    filter_col=both.resolve(lhs.name, lhs.table),
+                    cmp=cmp,
+                    pool_size=max(cfg.topn_pool_size,
+                                  2 * cfg.chunk_capacity),
+                ), ref, sref))
+                ref = ("node", len(nodes) - 1)
+            root_ref = ref
+            # the dynamic filter's output retracts when the threshold
+            # moves, even over append-only inputs
+            import dataclasses as _dc
+            root = _dc.replace(root, append_only=False)
 
         has_agg = bool(select.group_by) or self._has_agg(select)
         # HAVING conjuncts comparing an aggregate against a scalar
@@ -1510,8 +2175,10 @@ class Planner:
         return [e]
 
     _SUB_CMPS = {"greater_than": "gt", "greater_than_or_equal": "ge",
-                 "less_than": "lt", "less_than_or_equal": "le"}
-    _SUB_FLIP = {"gt": "lt", "ge": "le", "lt": "gt", "le": "ge"}
+                 "less_than": "lt", "less_than_or_equal": "le",
+                 "equal": "eq"}
+    _SUB_FLIP = {"gt": "lt", "ge": "le", "lt": "gt", "le": "ge",
+                 "eq": "eq"}
 
     def _match_scalar_sub_cmp(self, c):
         """``lhs CMP (SELECT ...)`` → (lhs_ast, cmp, sub_select)."""

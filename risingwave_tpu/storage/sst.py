@@ -10,8 +10,15 @@ round-1 format, one file per SST:
 Each block holds varint-framed (key, value) records in key order with a
 crc32c trailer; the index stores each block's first key + offset/len.
 Point gets binary-search the index then scan one block; range scans
-merge blocks.  ``merge_iter`` merges multiple SSTs newest-first with
-tombstone handling — the LSM read path (compaction lands next round).
+merge blocks.  ``merge_scan`` merges multiple SSTs newest-first with
+tombstone handling — the LSM read path.
+
+``LsmTree`` adds the LSM lifecycle on top: L0 accumulates newest-first
+overlapping runs; levels 1..n hold one sorted run each; compaction
+merges a level into the next when it exceeds its budget, dropping
+tombstones at the bottommost level (ref compactor_runner.rs:70).
+``BlockCache`` is the foyer-block-cache analog for the serving read
+path (sstable_store.rs:208).
 """
 
 from __future__ import annotations
@@ -86,9 +93,38 @@ def write_sst(path: str, keys: list[bytes], values: list[bytes],
     )
 
 
+class BlockCache:
+    """LRU over decoded blocks, shared across readers (ref the foyer
+    hybrid block cache fronting SstableStore, sstable_store.rs:208 —
+    here memory-only; the 'disk tier' is the SST itself)."""
+
+    def __init__(self, capacity_blocks: int = 256):
+        from collections import OrderedDict
+        self._d: "OrderedDict[tuple, list]" = OrderedDict()
+        self.capacity = capacity_blocks
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key: tuple):
+        v = self._d.get(key)
+        if v is not None:
+            self._d.move_to_end(key)
+            self.hits += 1
+        else:
+            self.misses += 1
+        return v
+
+    def put(self, key: tuple, value) -> None:
+        self._d[key] = value
+        self._d.move_to_end(key)
+        while len(self._d) > self.capacity:
+            self._d.popitem(last=False)
+
+
 class SstReader:
-    def __init__(self, path: str):
+    def __init__(self, path: str, cache: "BlockCache | None" = None):
         self.path = path
+        self.cache = cache
         self._f = open(path, "rb")
         self._f.seek(-24, os.SEEK_END)
         tail = self._f.read(24)
@@ -115,6 +151,10 @@ class SstReader:
         return self.index["n"]
 
     def _read_block(self, bi: int):
+        if self.cache is not None:
+            hit = self.cache.get((self.path, bi))
+            if hit is not None:
+                return hit
         meta = self.index["blocks"][bi]
         self._f.seek(meta["offset"])
         data = self._f.read(meta["len"] + 4)
@@ -127,6 +167,8 @@ class SstReader:
         vb = vals.tobytes()
         for i in range(len(ko) - 1):
             out.append((kb[ko[i]:ko[i + 1]], vb[vo[i]:vo[i + 1]]))
+        if self.cache is not None:
+            self.cache.put((self.path, bi), out)
         return out
 
     def get(self, key: bytes) -> bytes | None:
@@ -152,8 +194,164 @@ class SstReader:
                 yield k, v
 
 
+class LsmTree:
+    """Leveled LSM over SST files with a JSON manifest.
+
+    Structure (ref Hummock levels + compactor, compactor_runner.rs:70):
+    - level 0: newest-first list of overlapping runs (one per sealed
+      write batch);
+    - level i>=1: at most ONE sorted run each.
+
+    Compaction policy: when L0 reaches ``l0_trigger`` runs, L0 + L1
+    merge into a new L1 run; when a level's run exceeds its byte
+    budget (``base_bytes * ratio**(i-1)``), it merges into the next
+    level.  Tombstones drop only when the output is the bottommost
+    populated level (deeper data could otherwise resurrect).  All
+    decisions are deterministic functions of the manifest — the
+    compaction determinism test replays byte-for-byte.
+    """
+
+    def __init__(self, root: str, cache: "BlockCache | None" = None,
+                 l0_trigger: int = 4, base_bytes: int = 4 << 20,
+                 ratio: int = 8):
+        self.root = root
+        self.cache = cache
+        self.l0_trigger = l0_trigger
+        self.base_bytes = base_bytes
+        self.ratio = ratio
+        os.makedirs(root, exist_ok=True)
+        self._manifest_path = os.path.join(root, "LSM_MANIFEST.json")
+        if os.path.exists(self._manifest_path):
+            with open(self._manifest_path) as f:
+                self.m = json.load(f)
+        else:
+            self.m = {"seq": 0, "levels": [[]]}
+        self._readers: dict[str, SstReader] = {}
+
+    # -- manifest -------------------------------------------------------
+    def _store(self) -> None:
+        tmp = self._manifest_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.m, f, indent=1)
+        os.replace(tmp, self._manifest_path)
+
+    def _reader(self, path: str) -> SstReader:
+        r = self._readers.get(path)
+        if r is None:
+            r = SstReader(os.path.join(self.root, path), self.cache)
+            self._readers[path] = r
+        return r
+
+    def _new_path(self) -> str:
+        self.m["seq"] += 1
+        return f"sst_{self.m['seq']:08d}.sst"
+
+    # -- writes ---------------------------------------------------------
+    def write_batch(self, pairs: list[tuple[bytes, bytes]]) -> None:
+        """Seal one sorted batch as a new L0 run (the shared-buffer →
+        SST upload); deletes pass TOMBSTONE values."""
+        if not pairs:
+            return
+        pairs = sorted(pairs)
+        path = self._new_path()
+        write_sst(os.path.join(self.root, path),
+                  [k for k, _ in pairs], [v for _, v in pairs])
+        self.m["levels"][0].insert(0, path)
+        self._store()
+        self.maybe_compact()
+
+    def delete_batch(self, keys: list[bytes]) -> None:
+        self.write_batch([(k, TOMBSTONE) for k in keys])
+
+    # -- compaction -----------------------------------------------------
+    def _level_bytes(self, i: int) -> int:
+        return sum(
+            os.path.getsize(os.path.join(self.root, p))
+            for p in self.m["levels"][i]
+        )
+
+    def maybe_compact(self) -> int:
+        """Run the deterministic policy to quiescence; returns the
+        number of compactions performed."""
+        n = 0
+        while True:
+            levels = self.m["levels"]
+            if len(levels[0]) >= self.l0_trigger:
+                self._compact_into(0)
+                n += 1
+                continue
+            done = True
+            for i in range(1, len(levels)):
+                budget = self.base_bytes * self.ratio ** (i - 1)
+                if levels[i] and self._level_bytes(i) > budget:
+                    self._compact_into(i)
+                    n += 1
+                    done = False
+                    break
+            if done:
+                return n
+
+    def _compact_into(self, i: int) -> None:
+        """Merge level i (+ the existing run of level i+1) into a new
+        level-i+1 run."""
+        levels = self.m["levels"]
+        while len(levels) <= i + 1:
+            levels.append([])
+        inputs = list(levels[i]) + list(levels[i + 1])
+        bottommost = all(not levels[j] for j in range(i + 2, len(levels)))
+        readers = [self._reader(p) for p in inputs]
+        keys: list[bytes] = []
+        vals: list[bytes] = []
+        for k, v in merge_scan(readers, keep_tombstones=not bottommost):
+            keys.append(k)
+            vals.append(v)
+        if keys:
+            out_path = self._new_path()
+            write_sst(os.path.join(self.root, out_path), keys, vals)
+            levels[i + 1] = [out_path]
+        else:
+            # everything tombstoned away: no output run, no orphan file
+            levels[i + 1] = []
+        levels[i] = []
+        self._store()
+        for p in inputs:
+            r = self._readers.pop(p, None)
+            if r is not None:
+                r.close()
+            try:
+                os.remove(os.path.join(self.root, p))
+            except OSError:
+                pass
+
+    # -- reads ----------------------------------------------------------
+    def _all_readers(self) -> list[SstReader]:
+        out = []
+        for level in self.m["levels"]:
+            for p in level:
+                out.append(self._reader(p))
+        return out
+
+    def get(self, key: bytes) -> bytes | None:
+        for r in self._all_readers():
+            v = r.get(key)
+            if v is not None:
+                return None if v == TOMBSTONE else v
+        return None
+
+    def scan(self, lo: bytes = b"", hi: bytes | None = None):
+        yield from merge_scan(self._all_readers(), lo, hi)
+
+    def file_count(self) -> int:
+        return sum(len(lv) for lv in self.m["levels"])
+
+    def close(self) -> None:
+        for r in self._readers.values():
+            r.close()
+        self._readers.clear()
+
+
 def merge_scan(readers: list[SstReader], lo: bytes = b"",
-               hi: bytes | None = None):
+               hi: bytes | None = None, keep_tombstones: bool = False):
     """K-way merge over SSTs, newest FIRST in ``readers``; per key the
     newest value wins; tombstones suppress (ref MergeIterator,
     src/storage/src/hummock/iterator/merge_inner.rs:62)."""
@@ -175,6 +373,6 @@ def merge_scan(readers: list[SstReader], lo: bytes = b"",
         if k == last_key:
             continue  # older generation shadowed
         last_key = k
-        if v == TOMBSTONE:
+        if v == TOMBSTONE and not keep_tombstones:
             continue
         yield k, v

@@ -32,6 +32,8 @@ _CMPS = {
     "ge": lambda v, t: v >= t,
     "lt": lambda v, t: v < t,
     "le": lambda v, t: v <= t,
+    # equality band (TPC-H q15: total_revenue = (SELECT max(...)))
+    "eq": lambda v, t: v == t,
 }
 
 

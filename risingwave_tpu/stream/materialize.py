@@ -258,6 +258,8 @@ class AppendOnlyMaterialize(Executor):
                 )
             else:
                 out = np.asarray(store)[sel]
+                if f.data_type.value == "numeric":
+                    out = out.astype(np.float64) / 10**f.decimal_scale
             if null is not None:
                 out = apply_null_mask(out, np.asarray(null)[sel])
             cols.append(out)

@@ -1,0 +1,104 @@
+"""Cold-start recovery: a fresh process rebuilds everything from
+data_dir alone.
+
+Ref: the meta node's durable metastore + DdlController recovery
+(src/meta/model/, src/meta/src/rpc/ddl_controller.rs:1096) — catalog,
+job topology, DML table state, and committed checkpoints all survive a
+process death; a new process replays the DDL log, reloads DML history,
+and resumes from the last committed epoch.
+"""
+
+import json
+
+from risingwave_tpu.sql import Engine
+from risingwave_tpu.sql.planner import PlannerConfig
+
+
+def _cfg() -> PlannerConfig:
+    return PlannerConfig(
+        chunk_capacity=128,
+        agg_table_size=512,
+        agg_emit_capacity=256,
+        mv_table_size=1 << 10,
+        mv_ring_size=1 << 11,
+        join_table_size=1 << 10,
+        join_bucket_cap=32,
+        join_out_capacity=1 << 11,
+    )
+
+
+def test_cold_start_recovery(tmp_path):
+    data = str(tmp_path / "data")
+    sink_path = str(tmp_path / "out.jsonl")
+
+    eng = Engine(_cfg(), data_dir=data)
+    eng.execute("CREATE TABLE t (k BIGINT, v BIGINT)")
+    rows1 = [(k, 10 * k + r) for k in range(40) for r in range(2)]
+    vals = ",".join(f"({a},{b})" for a, b in rows1)
+    eng.execute(f"INSERT INTO t VALUES {vals}")
+    eng.execute(
+        "CREATE MATERIALIZED VIEW mv AS "
+        "SELECT k, count(*) AS n, sum(v) AS s FROM t GROUP BY k"
+    )
+    # a cascaded MV exercises the DagJob/MvTap replay path
+    eng.execute(
+        "CREATE MATERIALIZED VIEW mv2 AS "
+        "SELECT k, s FROM mv WHERE s > 100"
+    )
+    eng.execute(
+        f"CREATE SINK snk FROM mv2 WITH "
+        f"(connector='file', path='{sink_path}')"
+    )
+    eng.execute("FLUSH")
+    want_mv = sorted(map(tuple, eng.execute("SELECT * FROM mv")))
+    want_mv2 = sorted(map(tuple, eng.execute("SELECT * FROM mv2")))
+    assert len(want_mv) == 40 and want_mv2
+
+    with open(sink_path) as f:
+        lines1 = [json.loads(x) for x in f]
+    delivered1 = [x for x in lines1 if x["op"] != "commit"]
+    assert delivered1, "sink delivered nothing before the restart"
+
+    # process dies with NO clean shutdown; a brand-new engine gets
+    # only data_dir — no DDL, no inserts
+    del eng
+    eng2 = Engine(_cfg(), data_dir=data)
+
+    names = sorted(e.name for e in eng2.catalog.list())
+    assert names == ["mv", "mv2", "snk", "t"]
+    got_mv = sorted(map(tuple, eng2.execute("SELECT * FROM mv")))
+    got_mv2 = sorted(map(tuple, eng2.execute("SELECT * FROM mv2")))
+    assert got_mv == want_mv
+    assert got_mv2 == want_mv2
+
+    # sink delivery continues from the recovered cursors: new rows are
+    # delivered exactly once, and the pre-restart rows are not re-sent
+    # (the last FLUSH committed them durably before the "crash")
+    rows2 = [(k, 1000 + k) for k in range(40)]
+    vals = ",".join(f"({a},{b})" for a, b in rows2)
+    eng2.execute(f"INSERT INTO t VALUES {vals}")
+    eng2.execute("FLUSH")
+
+    with open(sink_path) as f:
+        lines2 = [json.loads(x) for x in f]
+    new = lines2[len(lines1):]
+    assert new, "no post-restart delivery"
+    # closed-epoch reader protocol: fold UPDATE pairs per key, expect
+    # each key's final s to match the recomputed MV exactly once
+    final_mv2 = {int(r[0]): int(r[1])
+                 for r in eng2.execute("SELECT * FROM mv2")}
+    seen: dict[int, int] = {}
+    for rec in lines2:
+        if rec["op"] in ("insert", "update_insert"):
+            seen[int(rec["k"])] = int(rec["s"])
+        elif rec["op"] == "delete":
+            seen.pop(int(rec["k"]), None)
+    assert seen == final_mv2
+
+
+def test_cold_start_empty_dir(tmp_path):
+    """A data_dir with no catalog bootstraps to an empty engine."""
+    eng = Engine(_cfg(), data_dir=str(tmp_path / "data"))
+    assert eng.catalog.list() == []
+    eng.execute("CREATE TABLE t (k BIGINT)")
+    assert [e.name for e in eng.catalog.list()] == ["t"]

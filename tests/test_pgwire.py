@@ -81,6 +81,64 @@ def test_pgwire_concurrent_sessions(node):
         b.close()
 
 
+def test_pgwire_extended_protocol(node):
+    """Parse/Bind/Describe/Execute/Sync with a parameter — the message
+    flow psycopg/JDBC default to (ref pg_protocol.rs:340,
+    e2e_extended_mode)."""
+    n, host, port = node
+    c = MiniPgClient(host, port)
+    try:
+        c.query("CREATE TABLE t (k BIGINT, v BIGINT)")
+        c.query("INSERT INTO t VALUES (1,10),(2,20),(1,30),(3,7)")
+        c.query("""
+            CREATE MATERIALIZED VIEW m AS
+            SELECT k, count(*) AS n, sum(v) AS s FROM t GROUP BY k
+        """)
+        c.query("FLUSH")
+        cols, rows = c.execute_prepared(
+            "SELECT n, s FROM m WHERE k = $1", params=(1,)
+        )
+        assert cols == ["n", "s"]
+        assert rows == [("2", "40")]
+        # string parameter quoting round-trips
+        cols, rows = c.execute_prepared(
+            "SELECT count(*) AS c FROM m WHERE k = $1 OR k = $2",
+            params=(2, 3),
+        )
+        assert rows == [("2",)]
+        # error inside a batch discards until Sync; session survives
+        with pytest.raises(RuntimeError):
+            c.execute_prepared("SELECT nope FROM nowhere")
+        _, rows = c.execute_prepared("SELECT k FROM m WHERE k = $1",
+                                     params=(3,))
+        assert rows == [("3",)]
+    finally:
+        c.close()
+
+
+def test_pgwire_cleartext_auth():
+    """Password-gated startup (AuthenticationCleartextPassword)."""
+    from risingwave_tpu.sql import Engine
+
+    from risingwave_tpu.pgwire import pg_serve
+
+    eng = Engine(PlannerConfig(
+        chunk_capacity=64, agg_table_size=256, agg_emit_capacity=64,
+        mv_table_size=256, mv_ring_size=1024,
+    ))
+    server = pg_serve(eng, port=0, password="sekret")
+    try:
+        host, port = server.server_address
+        c = MiniPgClient(host, port, password="sekret")
+        _, rows = c.query("SHOW SOURCES")
+        assert rows == []
+        c.close()
+        with pytest.raises((RuntimeError, ConnectionError)):
+            MiniPgClient(host, port, password="wrong")
+    finally:
+        server.shutdown()
+
+
 def test_background_ticker_advances_jobs():
     """The barrier ticker (barrier_interval_ms) drives jobs on its own."""
     import time
