@@ -949,6 +949,50 @@ class Engine:
         return target, terminal, (ids[plan.mv_node], plan.mv_index), \
             (ids, list(src_rename.values())), False
 
+    @staticmethod
+    def _agg_shard_safe(agg, node, plan: DagPlan) -> bool:
+        """True when every group of ``agg`` is guaranteed shard-local:
+        its fragment directly consumes a join node, only filters
+        precede it (positions preserved), and its GROUP BY InputRefs
+        cover the join's probe-side equi-key InputRefs (rows route by
+        join key ⇒ group determines shard)."""
+        from risingwave_tpu.expr.node import InputRef as _IR
+        from risingwave_tpu.stream.executor import (
+            FilterExecutor as _F,
+        )
+        from risingwave_tpu.stream.hash_agg import (
+            HashAggExecutor as _A,
+        )
+
+        kind, key = node.input
+        if kind != "node" or not isinstance(plan.nodes[key], JoinNode):
+            return False
+        join = plan.nodes[key].join
+        # INNER only: an outer join's NULL-padded rows live on the
+        # UNMATCHED side's shard, not the shard of the (NULL) group
+        # key — the NULL group would split across shards
+        if getattr(join, "join_type", None) != "inner":
+            return False
+        for ex in node.fragment.executors:
+            if ex is agg:
+                break
+            if not isinstance(ex, _F):
+                return False
+        if not all(isinstance(k, _IR) for k in join.left_keys):
+            return False
+        group_idx = {
+            g.index for _, g in agg.group_by if isinstance(g, _IR)
+        }
+        jk = {k.index for k in join.left_keys}
+        if not jk <= group_idx:
+            return False
+        # only ONE shard-safe agg per chain (a second agg over reduced
+        # keys could merge groups across shards)
+        return all(
+            not isinstance(ex2, _A) or ex2 is agg
+            for ex2 in node.fragment.executors
+        )
+
     def _prime_temporal_builds(self, job: DagJob, node_ids) -> None:
         """Drain each temporal join's build-side source BEFORE any
         probe chunk flows: the build table must reflect the table's
@@ -1218,12 +1262,18 @@ class Engine:
 
         if any(isinstance(r, MvTap) for r in plan.sources.values()):
             return None
-        if any(not (hasattr(r, "impl") and hasattr(r, "next_base"))
-               for r in plan.sources.values()):
-            return None
+        # traceable sources generate per-shard inside the program;
+        # host-chunk sources (DML tables) enter on shard 0 and re-route
+        # at the first exchange edge — both shard
         joins = [i for i, n in enumerate(plan.nodes)
                  if isinstance(n, JoinNode)]
         if not joins:
+            return None
+        from risingwave_tpu.stream.temporal_join import (
+            TemporalJoinExecutor as _TJ,
+        )
+        if any(isinstance(plan.nodes[i].join, _TJ) for i in joins):
+            # temporal build tables replicate, not partition: meshless
             return None
         join_inputs: set = set()
         for i in joins:
@@ -1238,9 +1288,19 @@ class Engine:
                        for ex in n.fragment.executors):
                     return None
             else:
-                # post-join chain: per-key-safe only
-                if any(not isinstance(ex, (_F, _P, _M, _AOM))
-                       for ex in n.fragment.executors):
+                # post-join chain: per-key-safe only.  A HashAgg is
+                # per-key-safe when its GROUP BY keys cover the
+                # upstream join's equi keys (rows are routed by join
+                # key, so every such group lives on one shard)
+                from risingwave_tpu.stream.hash_agg import (
+                    HashAggExecutor as _A,
+                )
+                for ex in n.fragment.executors:
+                    if isinstance(ex, (_F, _P, _M, _AOM)):
+                        continue
+                    if isinstance(ex, _A) and self._agg_shard_safe(
+                            ex, n, plan):
+                        continue
                     return None
         n = min(par, len(jax.devices()))
         if n < 2:
@@ -1357,9 +1417,14 @@ class Engine:
                 job.maintenance_interval = maint
                 job.snapshot_interval = snap_iv
                 t0 = time.perf_counter()
-                rows = 0
-                for _ in range(chunks_per_barrier):
-                    rows += job.chunk_round()
+                if hasattr(job, "run_chunks"):
+                    # traceable sources batch the whole inter-barrier
+                    # window into one dispatch (q1 host-overhead fix)
+                    rows = job.run_chunks(chunks_per_barrier)
+                else:
+                    rows = 0
+                    for _ in range(chunks_per_barrier):
+                        rows += job.chunk_round()
                 job.inject_barrier()
                 dt = time.perf_counter() - t0
                 self.metrics.inc("stream_rows_total", rows, job=job.name)

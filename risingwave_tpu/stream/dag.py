@@ -260,10 +260,14 @@ class DagJob:
             for name, src in self.sources.items()
         }
         # ONE host materialization per tier, shared by the in-memory
-        # snapshot and the durable save
-        spill_host = {key: tier.snapshot() for key, tier in
-                      getattr(self, "_spill_tiers", {}).items()
-                      if tier.rows_absorbed}
+        # snapshot and the durable save; keys carry the shard index
+        spill_host = {
+            (idx, j, s): tier.snapshot()
+            for (idx, j), tiers in getattr(self, "_spill_tiers",
+                                           {}).items()
+            for s, tier in enumerate(tiers)
+            if tier.rows_absorbed
+        }
         snap = CheckpointSnapshot(
             epoch=epoch,
             states=_snapshot_copy(self.states),
@@ -275,9 +279,9 @@ class DagJob:
             # tier saves FIRST (see StreamingJob._commit_checkpoint): a
             # crash between the saves leaves the tier ahead, which
             # recovery rewinds; the reverse order loses absorbed groups
-            for (idx, j), host_state in spill_host.items():
+            for (idx, j, s), host_state in spill_host.items():
                 self.checkpoint_store.save(
-                    f"{self.name}@spill{idx}_{j}", epoch,
+                    self._spill_key(idx, j, s), epoch,
                     host_state, {},
                 )
             # device pytree handed over as-is: the store's block-digest
@@ -426,20 +430,34 @@ class DagJob:
         reader = self.sources[src_name]
         fused = hasattr(reader, "impl") and hasattr(reader, "next_base")
         if self.mesh is not None:
-            if not fused:
-                raise ValueError(
-                    "sharded DAGs need traceable sources (impl/next_base)"
-                )
             spec = self._sharding_spec()
-
-            def body(states, k0):
-                local = jax.tree.map(lambda x: x[0], states)
-                new_states = list(local)
-                chunk = reader.impl(k0[0], reader.cap)
-                self._propagate(
-                    new_states, [(("source", src_name), chunk)]
-                )
-                return jax.tree.map(lambda x: x[None], tuple(new_states))
+            if fused:
+                def body(states, k0):
+                    local = jax.tree.map(lambda x: x[0], states)
+                    new_states = list(local)
+                    chunk = reader.impl(k0[0], reader.cap)
+                    self._propagate(
+                        new_states, [(("source", src_name), chunk)]
+                    )
+                    return jax.tree.map(
+                        lambda x: x[None], tuple(new_states)
+                    )
+            else:
+                # host-chunk source (DML tables): the chunk arrives
+                # stacked [n_shards, ...] with rows on shard 0 only;
+                # the first exchange edge (join input) re-routes them
+                # to their key owners via all_to_all — the reference's
+                # dispatcher on a singleton source fragment
+                def body(states, chunk):
+                    local = jax.tree.map(lambda x: x[0], states)
+                    lchunk = jax.tree.map(lambda x: x[0], chunk)
+                    new_states = list(local)
+                    self._propagate(
+                        new_states, [(("source", src_name), lchunk)]
+                    )
+                    return jax.tree.map(
+                        lambda x: x[None], tuple(new_states)
+                    )
 
             prog = jax.jit(_shard_map(
                 body, mesh=self.mesh, in_specs=(spec, spec),
@@ -469,6 +487,20 @@ class DagJob:
         prog, fused = self._step_programs[src_name]
         reader = self.sources[src_name]
         if self.mesh is not None:
+            if not fused:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                chunk = reader.next_chunk()
+                host = jax.device_get(chunk)
+                empty = jax.tree.map(np.zeros_like, host)
+                stacked = jax.tree.map(
+                    lambda *xs: np.stack(xs),
+                    *([host] + [empty] * (self.n_shards - 1)),
+                )
+                stacked = jax.device_put(
+                    stacked, NamedSharding(self.mesh, P(self.AXIS))
+                )
+                self.states = prog(self.states, stacked)
+                return chunk.capacity
             # one cap-stride ordinal block per shard (split readers own
             # disjoint ordinal ranges, like the reference's source
             # splits)
@@ -820,9 +852,11 @@ class DagJob:
 
     # -- checkpoint / recovery ------------------------------------------
     def _commit_checkpoint(self, sealed) -> None:
-        if self.mesh is None:  # sink delivery is a host-side read;
-            self._drain_spill_tiers(sealed)
-            new_states = list(self.states)  # sharded plans exclude sinks
+        # spill tiers drain under the mesh too (per-shard tiers); only
+        # sink delivery stays meshless (sharded plans exclude sinks)
+        self._drain_spill_tiers(sealed)
+        if self.mesh is None:
+            new_states = list(self.states)
             for idx, node in enumerate(self.nodes):
                 if isinstance(node, FragNode):
                     new_states[idx] = deliver_sinks(
@@ -835,15 +869,15 @@ class DagJob:
     # -- spill-to-host (stream/spill.py) --------------------------------
     def _restore_spill_tiers(self, epoch: int) -> None:
         """Recovery companion: rewind host tiers via the shared policy
-        (see runtime.rewind_spill_tier)."""
+        (see runtime.rewind_spill_tier), one per shard."""
         for idx, j, ex in self._spill_sites():
             self._ensure_spill_tier(idx, j, ex)
-            key = f"{self.name}@spill{idx}_{j}"
-            self.checkpoint_store.invalidate(key)
-            rewind_spill_tier(
-                self.checkpoint_store, key, epoch,
-                self._spill_tiers[(idx, j)],
-            )
+            for s, tier in enumerate(self._spill_tiers[(idx, j)]):
+                key = self._spill_key(idx, j, s)
+                self.checkpoint_store.invalidate(key)
+                rewind_spill_tier(
+                    self.checkpoint_store, key, epoch, tier
+                )
 
     def _spill_sites(self):
         """[(node_idx, exec_idx, executor)] of spill-enabled aggs."""
@@ -856,6 +890,10 @@ class DagJob:
                     out.append((idx, j, ex))
         return out
 
+    def _spill_key(self, idx: int, j: int, s: int) -> str:
+        base = f"{self.name}@spill{idx}_{j}"
+        return base if self.n_shards == 1 else f"{base}_s{s}"
+
     def _ensure_spill_tier(self, idx: int, j: int, ex) -> None:
         if not hasattr(self, "_spill_tiers"):
             self._spill_tiers = {}
@@ -864,18 +902,24 @@ class DagJob:
         if key in self._spill_tiers:
             return
         from risingwave_tpu.stream.spill import AggSpillTier
-        self._spill_tiers[key] = AggSpillTier(
-            ex, getattr(ex, "spill_table_size", ex.table_size * 8)
-        )
+        # one host tier PER SHARD: the exchange partitions keys by
+        # vnode, so a shard's overflow groups live in that shard's
+        # tier and the structural-ownership invariant holds per shard
+        self._spill_tiers[key] = [
+            AggSpillTier(
+                ex, getattr(ex, "spill_table_size", ex.table_size * 8)
+            )
+            for _ in range(self.n_shards)
+        ]
 
-        def drain(states, idx=idx, j=j, ex=ex):
+        def drain_local(states, idx=idx, j=j, ex=ex):
             new_states = list(states)
             node_states = list(new_states[idx])
             node_states[j], chunk = ex.drain_spill(node_states[j])
             new_states[idx] = tuple(node_states)
             return tuple(new_states), chunk
 
-        def inject(states, chunk, idx=idx, j=j):
+        def inject_local(states, chunk, idx=idx, j=j):
             new_states = list(states)
             node = self.nodes[idx]
             node_states = list(new_states[idx])
@@ -892,28 +936,86 @@ class DagJob:
                 self._propagate(new_states, [(("node", idx), cur)])
             return tuple(new_states)
 
+        if self.mesh is None:
+            self._spill_progs[key] = (
+                jax.jit(drain_local, donate_argnums=(0,)),
+                jax.jit(inject_local, donate_argnums=(0,)),
+            )
+            return
+
+        # mesh: the SAME per-shard bodies run inside shard_map — the
+        # inject path may cross exchanges (all_to_all), which is valid
+        # only in the sharded program (mirrors _maintain's pattern)
+        spec = self._sharding_spec()
+
+        def drain_body(states):
+            local = jax.tree.map(lambda x: x[0], states)
+            out_states, chunk = drain_local(tuple(local))
+            return (
+                jax.tree.map(lambda x: x[None], tuple(out_states)),
+                jax.tree.map(lambda x: x[None], chunk),
+            )
+
+        def inject_body(states, chunk):
+            local = jax.tree.map(lambda x: x[0], states)
+            lchunk = jax.tree.map(lambda x: x[0], chunk)
+            out_states = inject_local(tuple(local), lchunk)
+            return jax.tree.map(lambda x: x[None], tuple(out_states))
+
         self._spill_progs[key] = (
-            jax.jit(drain, donate_argnums=(0,)),
-            jax.jit(inject, donate_argnums=(0,)),
+            jax.jit(_shard_map(
+                drain_body, mesh=self.mesh, in_specs=(spec,),
+                out_specs=(spec, spec), check_vma=False,
+            ), donate_argnums=(0,)),
+            jax.jit(_shard_map(
+                inject_body, mesh=self.mesh, in_specs=(spec, spec),
+                out_specs=spec, check_vma=False,
+            ), donate_argnums=(0,)),
         )
 
     def _drain_spill_tiers(self, sealed) -> None:
         """Snapshot-barrier hook: divert ring rows to host tiers and
-        inject their changelog downstream of each agg node."""
+        inject their changelog downstream of each agg node.  Under the
+        mesh every shard drains into its own tier; the merged
+        changelogs inject back shard-aligned through the sharded
+        program (exchanges included)."""
         import numpy as _np
         for idx, j, ex in self._spill_sites():
             self._ensure_spill_tier(idx, j, ex)
             key = (idx, j)
-            cnt = int(_np.asarray(self.states[idx][j].spill_count))
-            if cnt == 0:
+            counts = _np.asarray(self.states[idx][j].spill_count)
+            if int(counts.sum()) == 0:
                 continue
             drain_p, inject_p = self._spill_progs[key]
-            self.states, chunk = drain_p(self.states)
-            out = self._spill_tiers[key].process(
-                jax.device_get(chunk), sealed
+            tiers = self._spill_tiers[key]
+            if self.mesh is None:
+                self.states, chunk = drain_p(self.states)
+                out = tiers[0].process(jax.device_get(chunk), sealed)
+                if out is not None:
+                    self.states = inject_p(self.states, out)
+                continue
+            self.states, chunks = drain_p(self.states)
+            host = jax.device_get(chunks)  # stacked [n_shards, ...]
+            outs = []
+            for s in range(self.n_shards):
+                shard_chunk = jax.tree.map(lambda x: x[s], host)
+                outs.append(tiers[s].process(shard_chunk, sealed))
+            if all(o is None for o in outs):
+                continue
+            import numpy as _np2
+            proto = next(o for o in outs if o is not None)
+            empty = jax.tree.map(
+                lambda x: _np2.zeros_like(_np2.asarray(x)), proto
             )
-            if out is not None:
-                self.states = inject_p(self.states, out)
+            stacked = jax.tree.map(
+                lambda *xs: _np2.stack([_np2.asarray(x) for x in xs]),
+                *[o if o is not None else empty for o in outs],
+            )
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            stacked = jax.device_put(
+                stacked, NamedSharding(self.mesh, P(self.AXIS))
+            )
+            self.states = inject_p(self.states, stacked)
 
     def recover(self) -> None:
         """Reset to the last committed checkpoint (ref §3.5)."""
@@ -944,18 +1046,21 @@ class DagJob:
             for src in self.sources.values():
                 if hasattr(src, "offset"):
                     src.offset = 0
-            for tier in getattr(self, "_spill_tiers", {}).values():
-                tier.reset()
+            for tiers in getattr(self, "_spill_tiers", {}).values():
+                for tier in tiers:
+                    tier.reset()
             return
         snap = self.checkpoints[-1]
         self.states = _snapshot_copy(snap.states)
         for name, src in self.sources.items():
             restore_source(src, snap.source_state.get(name, {}))
-        for key, tier in getattr(self, "_spill_tiers", {}).items():
-            if snap.spill and key in snap.spill:
-                tier.restore(snap.spill[key])
-            else:
-                tier.reset()
+        for (idx, j), tiers in getattr(self, "_spill_tiers",
+                                       {}).items():
+            for s, tier in enumerate(tiers):
+                if snap.spill and (idx, j, s) in snap.spill:
+                    tier.restore(snap.spill[(idx, j, s)])
+                else:
+                    tier.reset()
 
     # -- serving (sharded) ----------------------------------------------
     def mv_rows(self, mv_executor, state_index):

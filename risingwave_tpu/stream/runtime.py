@@ -214,6 +214,9 @@ class StreamingJob:
         # the source chunk never materializes standalone — XLA fuses
         # generator arithmetic straight into the executor kernels
         self._fused = None
+        #: n-chunk fused programs (one dispatch per n chunks; host
+        #: dispatch overhead amortized n-fold), keyed by n
+        self._fused_multi: dict[int, Any] = {}
         if hasattr(source, "impl") and hasattr(source, "next_base"):
 
             def _fused(states, k0):
@@ -239,6 +242,46 @@ class StreamingJob:
         chunk = self.source.next_chunk()
         self.states, _ = self.fragment.step(self.states, chunk)
         return chunk.capacity
+
+    def run_chunks(self, n: int) -> int:
+        """n chunk steps in ONE dispatch when the source is traceable.
+
+        The stateless-query floor is per-dispatch host work (~hundreds
+        of µs of Python per XLA call), not device compute — a
+        ``fori_loop`` over n generator+step iterations inside one
+        program amortizes it n-fold (the q1 attribution fix)."""
+        if self.paused or n <= 0:
+            return 0
+        if self._fused is None or n == 1:
+            rows = 0
+            for _ in range(n):
+                rows += self.run_chunk()
+            return rows
+        prog = self._fused_multi.get(n)
+        if prog is None:
+            cap = self.source.cap
+            stride = cap * getattr(self.source, "num_splits", 1)
+
+            def _multi(states, k0):
+                def body(i, st):
+                    st2, _ = self.fragment._step_impl(
+                        st, self.source.impl(k0 + i * stride, cap)
+                    )
+                    return st2
+
+                return jax.lax.fori_loop(0, n, body, states)
+
+            prog = jax.jit(_multi, donate_argnums=(0,))
+            # bounded: chunks_per_barrier is runtime-mutable; distinct
+            # values each compile a program — keep only the newest few
+            if len(self._fused_multi) >= 4:
+                self._fused_multi.pop(next(iter(self._fused_multi)))
+            self._fused_multi[n] = prog
+        k0 = jnp.int64(self.source.next_base())
+        # the cursor already advanced one block; skip the other n-1
+        self.source.offset += self.source.cap * (n - 1)
+        self.states = prog(self.states, k0)
+        return self.source.cap * n
 
     def inject_barrier(self, barrier: Barrier | None = None) -> list:
         """Cross a barrier: one async dispatch (flush + drain +

@@ -72,6 +72,7 @@ def run() -> dict:
     if only:
         names = [n for n in names if n in only.split(",")]
     for name in names:
+        print(f"... running {name}", flush=True)
         view_file = os.path.join(QUERY_DIR, "views", f"{name}.slt.part")
         query_file = os.path.join(QUERY_DIR, f"{name}.slt.part")
         before = {e.name for e in eng.catalog.list()}
@@ -95,6 +96,8 @@ def run() -> dict:
         except Exception as e:
             results[name] = ("error", str(e)[:300])
         _drop_new(eng, before)
+        st, detail = results[name]
+        print(f"{name:6s} {st:5s} {detail[:120]}", flush=True)
     return results
 
 

@@ -750,8 +750,70 @@ def test_rescale_survives_recovery_with_stale_ddl_parallelism():
     eng.execute("ALTER MATERIALIZED VIEW v SET PARALLELISM 4")
     want = sorted(map(tuple, eng.execute("SELECT * FROM v")))
 
-    eng2 = build()          # DDL replans at parallelism 2
-    eng2.recover()
+    # cold start: bootstrap replays the DDL log (including the ALTER
+    # PARALLELISM) and restores the 4-shard checkpoint topology
+    eng2 = Engine(PlannerConfig(
+        chunk_capacity=128, agg_table_size=512, agg_emit_capacity=128,
+        mv_table_size=512, mv_ring_size=1024,
+    ), data_dir=data_dir)
     job2 = eng2.jobs[0]
     assert job2.sharded.n_shards == 4, "checkpoint topology not restored"
     assert sorted(map(tuple, eng2.execute("SELECT * FROM v"))) == want
+
+
+def test_sharded_dag_spill_over_join():
+    """Spill-to-host under the mesh (verdict r4 item 5): a sharded
+    join→agg job whose group cardinality is ~4x the device table
+    completes via PER-SHARD host tiers, matching the linear run."""
+    from risingwave_tpu.sql import Engine
+    from risingwave_tpu.sql.planner import PlannerConfig
+    from risingwave_tpu.stream.dag import DagJob
+
+    n_groups = 220  # >> agg_table_size(64)
+
+    def build(par):
+        eng = Engine(PlannerConfig(
+            chunk_capacity=128,
+            agg_table_size=64,
+            agg_emit_capacity=256,
+            join_table_size=1 << 10, join_bucket_cap=32,
+            join_out_capacity=1 << 12,
+            mv_table_size=1 << 10, mv_ring_size=1 << 12,
+            agg_spill_ring=1 << 10,
+        ))
+        if par:
+            eng.execute(f"SET streaming_parallelism = {par}")
+        eng.execute("CREATE TABLE item (id BIGINT, grp BIGINT, "
+                    "PRIMARY KEY (id))")
+        eng.execute("CREATE TABLE hit (item BIGINT, w BIGINT)")
+        for i in range(0, n_groups, 64):
+            vals = ",".join(f"({k},{k % 7})"
+                            for k in range(i, min(i + 64, n_groups)))
+            eng.execute(f"INSERT INTO item VALUES {vals}")
+        rows = [(i, 10 * i + r) for i in range(n_groups)
+                for r in range(2)]
+        for i in range(0, len(rows), 64):
+            vals = ",".join(f"({a},{b})" for a, b in rows[i:i + 64])
+            eng.execute(f"INSERT INTO hit VALUES {vals}")
+        eng.execute(
+            "CREATE MATERIALIZED VIEW mv AS SELECT h.item AS k, "
+            "count(*) AS n, sum(h.w) AS s FROM hit h "
+            "JOIN item i ON h.item = i.id GROUP BY h.item"
+        )
+        eng.execute("FLUSH")
+        eng.tick(barriers=4)
+        return eng
+
+    lin = build(0)
+    want = sorted(map(tuple, lin.execute("SELECT * FROM mv")))
+    assert len(want) == n_groups
+
+    sh = build(2)
+    job = sh.jobs[0]
+    assert isinstance(job, DagJob) and job.mesh is not None
+    got = sorted(map(tuple, sh.execute("SELECT * FROM mv")))
+    assert got == want
+    # the device table really was too small: per-shard tiers absorbed
+    tiers = getattr(job, "_spill_tiers", {})
+    absorbed = sum(t.rows_absorbed for ts in tiers.values() for t in ts)
+    assert tiers and absorbed > 0

@@ -97,9 +97,9 @@ def test_agg_spill_recovery(tmp_path):
     want = sorted(map(tuple, eng.execute("SELECT * FROM mv")))
     assert len(want) == 256
 
+    # cold start: the fresh engine bootstraps catalog + jobs + tier
+    # state from data_dir alone (no manual DDL re-execution)
     eng2 = spill_engine(data_dir=str(tmp_path))
-    build(eng2)
-    eng2.recover()
     got = sorted(map(tuple, eng2.execute("SELECT * FROM mv")))
     assert got == want
 
@@ -181,7 +181,9 @@ def test_dag_agg_spill_over_join():
     want = {i: (2, 10 * i + 10 * i + 1) for i in range(n_groups)}
     assert len(got) == n_groups, len(got)
     assert got == want
-    # the tier really absorbed rows
+    # the tier really absorbed rows (per-shard lists; meshless = 1)
     job = eng.jobs[0]
     tiers = getattr(job, "_spill_tiers", {})
-    assert tiers and any(t.rows_absorbed for t in tiers.values())
+    assert tiers and any(
+        t.rows_absorbed for ts in tiers.values() for t in ts
+    )
