@@ -843,10 +843,16 @@ class Engine:
         taps = {n: r for n, r in plan.sources.items()
                 if isinstance(r, MvTap)}
         if not taps:
+            # deep multiway plans run STAGED: per-node dispatches with
+            # host-driven join drains — fused drain loops embed each
+            # join's downstream subgraph and XLA compile memory blows
+            # up around 4+ chained joins (TPC-H q2/q8/q9)
+            n_joins = sum(isinstance(n, JoinNode) for n in plan.nodes)
             job = DagJob(
                 plan.sources, plan.nodes, name,
                 checkpoint_frequency=ckpt_freq,
                 checkpoint_store=self.checkpoint_store,
+                staged=n_joins >= 4,
             )
             self._prime_temporal_builds(job, range(len(job.nodes)))
             terminal = plan.nodes[plan.mv_node].fragment.executors[

@@ -134,6 +134,14 @@ class PlannerConfig:
     #: sides — replaces dense [size, bucket] buckets so hot keys have
     #: no per-key cap (ref JoinHashMap's unbounded per-key rows)
     join_pool_size: int = 1 << 16
+    #: force dense per-key bucket storage even for append-only sides.
+    #: Pool sides bound emission drains by the POOL size, which makes
+    #: `max_windows` large; on deep multiway plans (TPC-H q8/q9) the
+    #: drain while_loop bodies then embed the downstream subgraph and
+    #: XLA:CPU compile memory explodes.  Dense buckets bound drains by
+    #: bucket_cap — with out_capacity >= chunk*2*bucket_cap the plan
+    #: compiles FLAT (no drain loops).  Conformance runs set this.
+    join_force_dense: bool = False
     topn_pool_size: int = 4096
     topn_emit_capacity: int = 1024
     mv_table_size: int = 1 << 16
@@ -1958,8 +1966,10 @@ class Planner:
                 # append-only sides take the degree-adaptive shared
                 # pool (no per-key cap for hot-skew keys); retractable
                 # sides need delete-by-value and keep dense buckets
-                left_storage="pool" if left.append_only else "dense",
-                right_storage="pool" if right.append_only else "dense",
+                left_storage="pool" if left.append_only
+                and not cfg.join_force_dense else "dense",
+                right_storage="pool" if right.append_only
+                and not cfg.join_force_dense else "dense",
                 left_pool_size=cfg.join_pool_size,
                 right_pool_size=cfg.join_pool_size,
             )

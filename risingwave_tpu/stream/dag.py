@@ -104,6 +104,7 @@ class DagJob:
         checkpoint_store=None,
         mesh=None,
         exchanges: dict | None = None,
+        staged: bool = False,
     ):
         self.sources = dict(sources)
         self.nodes: list = list(nodes)
@@ -119,6 +120,19 @@ class DagJob:
         self.mesh = mesh
         self.exchanges = dict(exchanges or {})
         self.n_shards = int(mesh.devices.size) if mesh is not None else 1
+        #: staged execution (meshless): chunks hop between PER-NODE
+        #: jitted programs and join emission windows drain in HOST
+        #: loops (one pending readback per probed chunk) instead of
+        #: device while_loops.  The fused mode embeds each join's
+        #: downstream subgraph inside its drain loop body — on deep
+        #: multiway plans (TPC-H q2/q8/q9: 8-9 base tables) that
+        #: nesting blows up XLA:CPU compile memory (observed LLVM
+        #: OOM).  Staging is the reference's actor/exchange boundary:
+        #: compile size is linear in plan size, at the cost of host
+        #: hops — the right trade for wide analytic MVs.
+        self._staged_hint = staged
+        self.staged = False  # derived per-topology in _rebuild
+        self._staged_progs: dict = {}
         self.maintenance_interval = 1
         self._ckpts_since_maintain = 0
         self.snapshot_interval = 1
@@ -176,6 +190,16 @@ class DagJob:
         self._step_programs: dict[str, Any] = {}
         self._barrier_prog = None
         self._maintain_prog = None
+        self._staged_progs = {}
+        # staging is a property of the CURRENT topology: attach/merge
+        # can grow a fused job past the depth where fused drain loops
+        # blow up the compile — re-derive on every rebuild
+        n_joins = sum(
+            isinstance(n, JoinNode) for n in self.nodes if n is not None
+        )
+        self.staged = self.mesh is None and (
+            getattr(self, "_staged_hint", False) or n_joins >= 4
+        )
         self._pulls = self._compute_pulls()
 
     def _validate_ref(self, ref: Ref, at: int) -> None:
@@ -478,10 +502,154 @@ class DagJob:
                 return tuple(new_states)
         return jax.jit(fn, donate_argnums=(0,)), fused
 
+    # -- staged execution (host-hop scheduling) -------------------------
+    def _staged_prog(self, key, builder, donate: bool = True):
+        """Per-node jitted program cache.  ``donate`` donates arg 0
+        (the state, reassigned immediately after every call) — emit
+        programs must NOT donate (the same state feeds every window)."""
+        prog = self._staged_progs.get(key)
+        if prog is None:
+            prog = jax.jit(
+                builder(), donate_argnums=(0,) if donate else ()
+            )
+            self._staged_progs[key] = prog
+        return prog
+
+    def _staged_deliver(self, injections: list) -> None:
+        """Host-level chunk propagation, DEPTH-FIRST: each chunk flows
+        all the way downstream before the next emission window is even
+        gathered — breadth-first queuing held every cascaded window in
+        memory at once (a 7-join chain OOM'd the host).  Per-node
+        dispatches; join windows drain in host loops with ONE pending
+        readback per probed chunk."""
+        for ref, chunk in injections:
+            for idx in self._consumers.get(ref, ()):
+                node = self.nodes[idx]
+                if node is None:
+                    continue
+                if isinstance(node, FragNode):
+                    prog = self._staged_prog(
+                        ("frag", idx),
+                        lambda node=node: node.fragment._step_impl,
+                    )
+                    st, out = prog(self.states[idx], chunk)
+                    self._set_state(idx, st)
+                    if out is not None:
+                        self._staged_deliver([(("node", idx), out)])
+                else:
+                    if node.left == ref:
+                        self._staged_join(idx, chunk, "left")
+                    if node.right == ref:
+                        self._staged_join(idx, chunk, "right")
+
+    def _set_state(self, idx: int, st) -> None:
+        lst = list(self.states)
+        lst[idx] = st
+        self.states = tuple(lst)
+
+    def _staged_join(self, idx: int, chunk, side: str) -> None:
+        node = self.nodes[idx]
+        join = node.join
+        if not hasattr(join, "apply_begin"):
+            prog = self._staged_prog(
+                ("japply", idx, side),
+                lambda join=join, side=side:
+                    lambda st, c: join.apply(st, c, side),
+            )
+            st, out = prog(self.states[idx], chunk)
+            self._set_state(idx, st)
+            if out is not None:
+                self._staged_deliver([(("node", idx), out)])
+            return
+        begin = self._staged_prog(
+            ("jbegin", idx, side),
+            lambda join=join, side=side:
+                lambda st, c: join.apply_begin(st, c, side),
+        )
+        st, pending = begin(self.states[idx], chunk)
+        self._set_state(idx, st)
+        if not self._consumers.get(("node", idx)):
+            return
+        emit = self._staged_prog(
+            ("jemit", idx, side),
+            lambda join=join, side=side:
+                lambda st, pend, w: join.emit_window(
+                    join.build_rows_of(st, side), pend, w, side
+                ),
+            donate=False,
+        )
+        total = int(pending.total)  # the one host readback
+        n_w = max(1, -(-total // join.out_capacity))
+        n_w = min(n_w, join.max_windows(chunk.capacity))
+        for w in range(n_w):
+            out, probe_bound = emit(
+                self.states[idx], pending, jnp.int32(w)
+            )
+            self._set_state(idx, self.states[idx]._replace(
+                emit_overflow=self.states[idx].emit_overflow
+                + probe_bound
+            ))
+            # window w flows ALL the way down before w+1 is gathered
+            self._staged_deliver([(("node", idx), out)])
+
+    def _staged_flush_all(self, sealed) -> None:
+        for idx, node in enumerate(self.nodes):
+            if not isinstance(node, FragNode):
+                continue
+            frag = node.fragment
+            flush = self._staged_prog(
+                ("flush", idx),
+                lambda frag=frag: frag._flush_impl,
+            )
+            rounds = frag.MAX_DRAIN_ROUNDS + 64
+            for _ in range(rounds):
+                st, outs = flush(self.states[idx], sealed)
+                self._set_state(idx, st)
+                for out in outs:
+                    self._staged_deliver([(("node", idx), out)])
+                if not frag.has_pending_protocol():
+                    break
+                pend = self._staged_prog(
+                    ("pending", idx),
+                    lambda frag=frag: frag.pending_total,
+                )
+                if int(pend(self.states[idx])) == 0:
+                    break
+
+    def _staged_barrier(self, sealed):
+        """The barrier crossing, staged: flush → watermarks → EOWC
+        flush → clean + counters (same order as _barrier_impl)."""
+        self._staged_flush_all(sealed)
+
+        def wm_tail(states):
+            new_states = list(states)
+            self._wm_all(new_states)
+            return tuple(new_states)
+
+        prog_wm = self._staged_prog(("wm_tail",), lambda: wm_tail)
+        self.states = prog_wm(self.states)
+        self._staged_flush_all(sealed)
+
+        def clean_tail(states):
+            new_states = list(states)
+            self._clean_joins(new_states)
+            labels, counters = self._collect_counters(new_states)
+            self.counter_labels = labels
+            return tuple(new_states), counters
+
+        prog_cl = self._staged_prog(("clean_tail",), lambda: clean_tail)
+        self.states, counters = prog_cl(self.states)
+        return counters
+
     def run_chunk(self, src_name: str) -> int:
         """Pull one chunk from one source through its reachable subgraph."""
         if self.paused:
             return 0
+        if self.staged:
+            reader = self.sources[src_name]
+            chunk = reader.next_chunk()
+            self._staged_deliver([(("source", src_name), chunk)])
+            return chunk.capacity
         if src_name not in self._step_programs:
             self._step_programs[src_name] = self._make_step(src_name)
         prog, fused = self._step_programs[src_name]
@@ -785,11 +953,14 @@ class DagJob:
     def inject_barrier(self) -> None:
         self.barriers_seen += 1
         sealed = self.epoch.curr.value
-        if self._barrier_prog is None:
-            self._barrier_prog = self._make_barrier_prog()
-        self.states, self._counters = self._barrier_prog(
-            self.states, self._barrier_epoch_arg(sealed)
-        )
+        if self.staged:
+            self._counters = self._staged_barrier(sealed)
+        else:
+            if self._barrier_prog is None:
+                self._barrier_prog = self._make_barrier_prog()
+            self.states, self._counters = self._barrier_prog(
+                self.states, self._barrier_epoch_arg(sealed)
+            )
 
         if self.barriers_seen % self.checkpoint_frequency == 0:
             self._ckpts_since_maintain += 1
@@ -843,9 +1014,12 @@ class DagJob:
         for _ in range(64):
             if not residual:
                 break
-            self.states, self._counters = self._barrier_prog(
-                self.states, self._barrier_epoch_arg(sealed)
-            )
+            if self.staged:
+                self._counters = self._staged_barrier(sealed)
+            else:
+                self.states, self._counters = self._barrier_prog(
+                    self.states, self._barrier_epoch_arg(sealed)
+                )
             residual = check_counter_values(
                 self.name, self.counter_labels, np.asarray(self._counters)
             )
