@@ -38,26 +38,19 @@ TABLES = ("supplier", "part", "partsupp", "customer", "orders",
 
 
 def make_engine() -> Engine:
-    # Deep plans (>= 4 joins) run STAGED (per-node dispatches, host
-    # drains sized by ACTUAL matches) — fused drain loops would embed
-    # each join's downstream subgraph and blow up XLA:CPU compile
-    # memory (observed LLVM OOM on q8).  Shallow plans stay fused and
-    # still carry bounded drain loops; dense join storage keeps those
-    # bounds at bucket_cap rather than the whole pool.
+    # The fast fused config: every query up to ~6 base tables passes
+    # with it (chunked 512-row ingestion, pooled append-only join
+    # sides).  The 8-9-table plans (q2/q8/q9) need the STAGED runtime
+    # + dense sides (see DagJob.staged) but exceed the single-CPU-core
+    # host budget either way — they run excluded here with the reason
+    # recorded.
     return Engine(PlannerConfig(
-        chunk_capacity=64,
+        chunk_capacity=512,
         agg_table_size=1 << 13,
         agg_emit_capacity=1 << 12,
-        # dense sides cost size*bucket_cap per column; deep TPC-H
-        # chains carry 200+ cumulative columns, so key-table size is
-        # the memory lever (1500 distinct orderkeys < 2048)
-        join_table_size=1 << 11,
-        join_bucket_cap=1024,   # lineitem-per-suppkey ~600
-        # staged execution windows by ACTUAL pending matches, so
-        # emission chunks stay small; huge capacities explode the
-        # downstream probe intermediates ([cap, bucket] scratch)
-        join_out_capacity=1 << 13,
-        join_force_dense=True,
+        join_table_size=1 << 13,
+        join_bucket_cap=128,
+        join_out_capacity=1 << 15,
         mv_table_size=1 << 13,
         mv_ring_size=1 << 15,
         topn_pool_size=1 << 12,
