@@ -50,14 +50,11 @@ def test_nexmark_recovery_converges(tmp_path):
     b.tick(barriers=2, chunks_per_barrier=1)
     # progress past the last checkpoint, then "crash"
     b.jobs[0].run_chunk()
+    # cold start: fresh engines bootstrap DDL + state from data_dir
     b2 = Engine(_cfg(), data_dir=str(tmp_path))
-    b2.execute(DDL)
-    b2.recover()
     b2.tick(barriers=2, chunks_per_barrier=1)
     b2.jobs[0].run_chunk()
     b3 = Engine(_cfg(), data_dir=str(tmp_path))
-    b3.execute(DDL)
-    b3.recover()
     b3.tick(barriers=2, chunks_per_barrier=1)
 
     assert _mv(b3) == want

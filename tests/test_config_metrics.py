@@ -88,10 +88,8 @@ def test_engine_durable_recovery(tmp_path):
     eng.tick(barriers=2, chunks_per_barrier=1)
     want = sorted(eng.execute("SELECT b, n FROM m"))
 
-    # simulated restart: fresh engine, same DDL, recover from disk
+    # restart: the fresh engine bootstraps DDL + state from data_dir
     eng2 = Engine(cfg, data_dir=str(tmp_path))
-    eng2.execute(ddl)
-    eng2.recover()
     assert sorted(eng2.execute("SELECT b, n FROM m")) == want
     # continues from the checkpointed source offset, not from zero
     eng2.tick(barriers=1, chunks_per_barrier=1)

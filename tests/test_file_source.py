@@ -92,10 +92,9 @@ def test_filetail_recovery_replays_from_offset(tmp_path):
     committed = eng.jobs[0].committed_epoch
     assert committed > 0
 
-    # process restart: recover + append MORE rows; no duplicates, no loss
+    # process restart: cold-start bootstrap + append MORE rows; no
+    # duplicates, no loss
     eng2 = small_engine(data_dir=data)
-    build(eng2)
-    eng2.recover()
     assert sorted(map(tuple, eng2.execute("SELECT * FROM mv"))) == want
     write_lines(path, [
         {"k": 0, "v": 1000, "s": "zz", "ts": "2015-07-15 00:00:09"}

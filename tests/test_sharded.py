@@ -705,9 +705,12 @@ def test_sharded_sink_delivers_exactly_once_across_recovery():
     want1 = dict(collections.Counter(int(x) for x in cols[0]))
     assert fold() == want1
 
-    # crash + recover: delivery resumes from the committed cursor
-    eng2 = build()
-    eng2.recover()
+    # crash + recover: the fresh engine cold-starts from data_dir
+    # (DDL replay + checkpoint restore) and resumes delivery
+    eng2 = Engine(PlannerConfig(
+        chunk_capacity=128, agg_table_size=512, agg_emit_capacity=128,
+        mv_table_size=512, mv_ring_size=2048,
+    ), data_dir=data_dir)
     job2 = eng2.jobs[0]
     job2.run_chunk()
     job2.inject_barrier()
