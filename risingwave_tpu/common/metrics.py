@@ -70,6 +70,22 @@ class MetricsRegistry:
                 self._hists[key] = _HistSeries(_DEFAULT_BUCKETS)
             self._hists[key].observe(value)
 
+    def timer(self, name: str, **labels):
+        """Context manager observing elapsed seconds into a histogram
+        (the guarded-metrics ``start_timer`` analog) — used by the
+        storage service for compaction/vacuum durations."""
+        import time
+
+        class _Timer:
+            def __enter__(s):
+                s.t0 = time.perf_counter()
+                return s
+
+            def __exit__(s, *exc):
+                self.observe(name, time.perf_counter() - s.t0, **labels)
+
+        return _Timer()
+
     # ------------------------------------------------------------------
     def get(self, name: str, **labels) -> float:
         key = (name, tuple(sorted(labels.items())))

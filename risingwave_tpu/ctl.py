@@ -120,14 +120,83 @@ def cluster_info(engine) -> dict:
             for e in engine.catalog.list()
         ],
         "system_params": engine.system_params.to_dict(),
+        "storage": storage_info(engine) if engine.hummock is not None
+        else None,
     }
+
+
+# -- storage service (risectl hummock ... analog) -----------------------
+def storage_info(engine) -> dict:
+    """``storage version``: current version id/epoch, per-level file
+    counts and bytes, pin count, stall state, compactor liveness."""
+    if engine.hummock is None:
+        return {"enabled": False}
+    info = {"enabled": True, **engine.hummock.stats()}
+    if engine.compactor is not None:
+        info["compactor"] = {
+            "running": engine.compactor.running,
+            "tasks_run": engine.compactor.tasks_run,
+            "errors": engine.compactor.errors,
+        }
+    return info
+
+
+def storage_gc(engine) -> dict:
+    """``storage gc``: run one vacuum pass (delete SST objects no
+    pinned version references) and report the result."""
+    return engine.storage_vacuum()
+
+
+def _open_storage(data_dir: str):
+    """Read-only-ish HummockStorage over an existing data_dir (for the
+    offline CLI: inspect/GC without a running node)."""
+    import os
+
+    from risingwave_tpu.storage.hummock import (
+        HummockStorage,
+        LocalFsObjectStore,
+    )
+
+    return HummockStorage(
+        LocalFsObjectStore(os.path.join(data_dir, "hummock"))
+    )
+
+
+def _storage_main(argv: list[str]) -> None:
+    """``python -m risingwave_tpu.ctl storage {version|gc} <data_dir>``
+    — offline inspection/GC of a node's storage service state (risectl
+    hummock list-version / trigger-full-gc analogs)."""
+    import json
+
+    sub, data_dir = argv[0], argv[1]
+    storage = _open_storage(data_dir)
+    if sub == "version":
+        print(json.dumps(storage.stats(), indent=1))
+    elif sub == "gc":
+        deleted = storage.vacuum()
+        print(json.dumps({
+            "deleted_objects": deleted,
+            "remaining_objects": storage.stats()["objects"],
+        }, indent=1))
+    elif sub == "compact":
+        n = 0
+        while storage.compact_once():
+            n += 1
+        print(json.dumps({"tasks_run": n, **storage.stats()}, indent=1))
+    else:
+        raise SystemExit(f"unknown storage subcommand: {sub}")
 
 
 def main() -> None:  # pragma: no cover - thin CLI
     """``python -m risingwave_tpu.ctl <host> <port> <sql>`` — send one
     statement to a running node over pgwire (risectl's transport is
-    gRPC; ours is the SQL front door)."""
+    gRPC; ours is the SQL front door).  ``... ctl storage
+    {version|gc|compact} <data_dir>`` operates on storage offline."""
     import sys
+
+    if len(sys.argv) > 1 and sys.argv[1] == "storage":
+        _storage_main(sys.argv[2:])
+        return
 
     from risingwave_tpu.pgwire import SimpleClient
 

@@ -48,6 +48,9 @@ class SingleNode:
                 target=self._tick_loop, daemon=True
             )
             self._ticker.start()
+        # durable nodes run the storage service's background compactor
+        # (the fourth node role, embedded single-binary style)
+        self.engine.start_storage_service()
         # pgwire statements and the ticker share the engine lock
         server = pg_serve(self.engine, host, port, engine_lock=self._lock)
         return server
@@ -63,6 +66,7 @@ class SingleNode:
         self._stop.set()
         if self._ticker is not None:
             self._ticker.join(timeout=5)
+        self.engine.stop_storage_service()
 
 
 def main() -> None:

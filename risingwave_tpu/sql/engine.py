@@ -43,6 +43,7 @@ from risingwave_tpu.sql.planner import (
     PlannerConfig,
     UnaryPlan,
 )
+from risingwave_tpu.storage.checkpoint_store import _mc_encode_value
 from risingwave_tpu.stream.dag import DagJob, FragNode, JoinNode
 from risingwave_tpu.stream.runtime import StreamingJob
 
@@ -158,17 +159,37 @@ class Engine:
         #: parse time (ref: frontend SQL-UDF inlining)
         self.functions: dict[str, tuple] = {}
         self.meta_store = None
+        #: the Hummock-lite storage service (object store + versioned
+        #: manifest + background compactor + vacuum); built alongside
+        #: the checkpoint store whenever the engine is durable
+        self.hummock = None
+        self.compactor = None
         #: True while replaying the durable DDL/DML logs (suppresses
         #: re-logging)
         self._replaying = False
         if data_dir is not None:
+            import os as _os
+
             from risingwave_tpu.meta.store import MetaStore
             from risingwave_tpu.storage import CheckpointStore
+            from risingwave_tpu.storage.hummock import (
+                CompactorService,
+                HummockStorage,
+                LocalFsObjectStore,
+            )
             self.checkpoint_store = CheckpointStore(
                 data_dir,
                 keep_epochs=self.rw_config.storage.checkpoint_keep_epochs,
             )
             self.meta_store = MetaStore(data_dir)
+            self.hummock = HummockStorage(
+                LocalFsObjectStore(_os.path.join(data_dir, "hummock")),
+                metrics=self.metrics,
+            )
+            # not started: tests/embedded use drive compaction
+            # synchronously; long-running nodes call
+            # start_storage_service() (server.py does)
+            self.compactor = CompactorService(self.hummock)
             if self.meta_store.has_catalog():
                 self._bootstrap()
 
@@ -1417,11 +1438,15 @@ class Engine:
         snap_iv = int(self.system_params.get(
             "snapshot_interval_checkpoints"
         ))
+        stall_hook = self._storage_stall_hook \
+            if self.hummock is not None else None
         for _ in range(barriers):
             for job in self.jobs:
                 job.checkpoint_frequency = ckpt_freq
                 job.maintenance_interval = maint
                 job.snapshot_interval = snap_iv
+                if hasattr(job, "write_stall_hook"):
+                    job.write_stall_hook = stall_hook
                 t0 = time.perf_counter()
                 if hasattr(job, "run_chunks"):
                     # traceable sources batch the whole inter-barrier
@@ -1445,6 +1470,85 @@ class Engine:
         (ref §3.5: meta-driven recovery across all streaming jobs)."""
         for job in self.jobs:
             job.recover()
+
+    # -- storage service (Hummock-lite) ---------------------------------
+    def start_storage_service(self) -> None:
+        """Start the background compactor (the fourth node role);
+        server.py calls this, embedded tests drive synchronously."""
+        if self.compactor is not None:
+            self.compactor.start()
+
+    def stop_storage_service(self) -> None:
+        if self.compactor is not None:
+            self.compactor.stop()
+
+    def _storage_stall_hook(self) -> float:
+        """The barrier loop's write-stall gate: block while storage L0
+        is over the stall threshold (compaction behind ingest)."""
+        return self.hummock.wait_below_stall(timeout=5.0)
+
+    @staticmethod
+    def _mv_storage_range(name: str) -> tuple[bytes, bytes]:
+        """Key range of one MV in the shared storage keyspace (the
+        TableKey table-prefix scheme, hummock_sdk/src/key.rs)."""
+        lo = b"m:" + name.encode() + b"\x00"
+        return lo, lo[:-1] + b"\x01"
+
+    def storage_export_mv(self, name: str) -> dict:
+        """Export an MV's current rows into the storage service as an
+        epoch-stamped changelog batch (upserts + tombstones for rows
+        gone since the last export) — ONE new L0 SST, no merge I/O;
+        the compactor folds it down in the background."""
+        import pickle as _pickle
+
+        if self.hummock is None:
+            raise PlanError("storage export needs a durable data_dir")
+        entry = self.catalog.get(name)
+        if entry.kind != "mview" or entry.job is None:
+            raise PlanError(f"{name!r} is not a materialized view")
+        epoch = entry.job.committed_epoch
+        schema = entry.mv_executor.in_schema
+        pk = getattr(entry.mv_executor, "pk_indices",
+                     tuple(range(len(schema))))
+        lo, hi = self._mv_storage_range(name)
+        new: dict[bytes, bytes] = {}
+        for row in self._mv_rows(entry):
+            key = lo + b"".join(
+                _mc_encode_value(row[i], schema[i]) for i in pk
+            )
+            new[key] = _pickle.dumps(tuple(row), protocol=4)
+        stale = [k for k, _ in self.hummock.scan(lo, hi)
+                 if k not in new]
+        from risingwave_tpu.storage.sst import TOMBSTONE
+        batch = sorted(new.items()) + [(k, TOMBSTONE) for k in stale]
+        self.hummock.write_batch(batch, epoch=epoch)
+        self.metrics.inc("storage_mv_export_rows_total", len(new),
+                         job=name)
+        return {"mv": name, "epoch": epoch, "rows": len(new),
+                "deletes": len(stale)}
+
+    def storage_serve_mv(self, name: str) -> list:
+        """Serve an exported MV from the storage service through a
+        PINNED version — a consistent SST set even while the compactor
+        rewrites levels and vacuum deletes their inputs (the
+        BatchTable-over-Hummock read, SURVEY §3.4)."""
+        import pickle as _pickle
+
+        if self.hummock is None:
+            raise PlanError("storage serving needs a durable data_dir")
+        lo, hi = self._mv_storage_range(name)
+        with self.hummock.pin() as pv:
+            return [_pickle.loads(v) for _, v in pv.scan(lo, hi)]
+
+    def storage_vacuum(self) -> dict:
+        """GC pass: delete SST objects unreferenced by any pinned
+        version (checkpoint exports live outside the sst/ prefix and
+        are never touched)."""
+        if self.hummock is None:
+            raise PlanError("storage vacuum needs a durable data_dir")
+        deleted = self.hummock.vacuum()
+        return {"deleted_objects": deleted,
+                **{"remaining_objects": self.hummock.stats()["objects"]}}
 
     # -- serving reads ---------------------------------------------------
     @staticmethod

@@ -1,11 +1,15 @@
 """Storage layer: epoch-versioned persistence (the Hummock analog).
 
 Reference counterpart: ``src/storage`` (SURVEY.md §2.5) — an LSM over
-object storage.  Round-1 shape:
+object storage.  Current shape:
 
-- ``codec``          — C++ native memcomparable/varint-block codec
-- ``sst``            — block-based sorted-string-table files + merge reads
+- ``codec``            — C++ native memcomparable/varint-block codec
+- ``sst``              — block-based SSTs (bloom filters, k-way merge
+  reads) + the inline ``LsmTree`` lifecycle
 - ``checkpoint_store`` — epoch-versioned snapshot persistence + manifest
+- ``hummock``          — the storage *service*: object-store seam,
+  versioned manifest with pin/unpin, background compactor with write
+  stall, vacuum GC (the reference's fourth node role)
 
 Device state stays dense in HBM; the storage layer owns the host-side
 durability path (checkpoint upload, serving from closed epochs,
@@ -14,5 +18,21 @@ executor caches and Hummock.
 """
 
 from risingwave_tpu.storage.checkpoint_store import CheckpointStore
+from risingwave_tpu.storage.hummock import (
+    CompactorService,
+    HummockStorage,
+    InMemObjectStore,
+    LocalFsObjectStore,
+    ObjectStore,
+    StoreFaults,
+)
 
-__all__ = ["CheckpointStore"]
+__all__ = [
+    "CheckpointStore",
+    "CompactorService",
+    "HummockStorage",
+    "InMemObjectStore",
+    "LocalFsObjectStore",
+    "ObjectStore",
+    "StoreFaults",
+]

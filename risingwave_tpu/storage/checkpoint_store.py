@@ -31,6 +31,7 @@ contents can additionally be exported as SSTs for engine-free serving
 
 from __future__ import annotations
 
+import io
 import json
 import os
 import pickle
@@ -76,33 +77,46 @@ def _leaf_block_count(shape, dtype, block: int) -> int:
 
 
 class CheckpointStore:
+    """All durable I/O goes through an ``ObjectStore``
+    (storage/hummock/object_store.py) — the same seam the SST layer
+    uses, so chaos tests can swap an in-memory or fault-injecting
+    backend under the whole durability path."""
+
+    _MANIFEST = "MANIFEST.json"
+
     def __init__(self, root: str, keep_epochs: int = 2,
-                 full_interval: int = 16, block_elems: int = 1 << 9):
+                 full_interval: int = 16, block_elems: int = 1 << 9,
+                 object_store=None):
+        from risingwave_tpu.storage.hummock.object_store import (
+            LocalFsObjectStore,
+        )
         self.root = root
         self.keep_epochs = keep_epochs
         #: checkpoints between forced fulls (chain-length bound)
         self.full_interval = full_interval
         self.block_elems = block_elems
-        os.makedirs(root, exist_ok=True)
-        self._manifest_path = os.path.join(root, "MANIFEST.json")
+        self.store = object_store if object_store is not None \
+            else LocalFsObjectStore(root)
         #: per-job digest program + last digests (in-memory fast path;
         #: a restarted process re-bases with a full snapshot)
         self._digest_fns: dict[str, Any] = {}
         self._last_digests: dict[str, tuple[int, np.ndarray]] = {}
         self._since_full: dict[str, int] = {}
 
+    def _abs(self, key: str) -> str:
+        """Filesystem path for a key when the backend is local (the
+        legacy return-a-path surfaces, e.g. ``export_mv_sst``)."""
+        root = getattr(self.store, "root", None)
+        return os.path.join(root, key) if root is not None else key
+
     # -- manifest -------------------------------------------------------
     def _load_manifest(self) -> dict:
-        if not os.path.exists(self._manifest_path):
+        if not self.store.exists(self._MANIFEST):
             return {"jobs": {}}
-        with open(self._manifest_path) as f:
-            return json.load(f)
+        return json.loads(self.store.get(self._MANIFEST))
 
     def _store_manifest(self, m: dict) -> None:
-        tmp = self._manifest_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(m, f, indent=1)
-        os.replace(tmp, self._manifest_path)
+        self.store.put(self._MANIFEST, json.dumps(m, indent=1).encode())
 
     # -- digests --------------------------------------------------------
     def _digest_fn(self, job_name: str, leaves):
@@ -144,8 +158,6 @@ class CheckpointStore:
 
         ``states`` may be a DEVICE pytree — only dirty blocks are
         fetched for delta checkpoints."""
-        job_dir = os.path.join(self.root, job_name)
-        os.makedirs(job_dir, exist_ok=True)
         leaves, treedef = jax.tree.flatten(states)
         digest_jit, nblocks = self._digest_fn(job_name, leaves)
         digests = np.asarray(digest_jit(leaves))
@@ -166,13 +178,13 @@ class CheckpointStore:
                 job_name, {}).get("epochs", []):
             kind = "full"
 
-        path = os.path.join(job_dir, f"epoch_{epoch}")
+        key = f"{job_name}/epoch_{epoch}"
         if kind == "full":
             host = jax.device_get(leaves)
-            np.savez(path + ".npz.tmp.npz",
-                     **{f"leaf_{i}": np.asarray(l)
-                        for i, l in enumerate(host)})
-            os.replace(path + ".npz.tmp.npz", path + ".npz")
+            buf = io.BytesIO()
+            np.savez(buf, **{f"leaf_{i}": np.asarray(l)
+                             for i, l in enumerate(host)})
+            self.store.put(key + ".npz", buf.getvalue())
             self._since_full[job_name] = 0
         else:
             # fetch only dirty runs, flat per leaf
@@ -201,16 +213,15 @@ class CheckpointStore:
                         flat[s_el:e_el]
                     )
                     b = e + 1
-            np.savez(path + ".npz.tmp.npz", **payload)
-            os.replace(path + ".npz.tmp.npz", path + ".npz")
+            buf = io.BytesIO()
+            np.savez(buf, **payload)
+            self.store.put(key + ".npz", buf.getvalue())
             self._since_full[job_name] = since_full + 1
 
-        with open(path + ".meta.tmp", "wb") as f:
-            pickle.dump({
-                "treedef": treedef, "source_state": source_state,
-                "epoch": epoch, "kind": kind,
-            }, f)
-        os.replace(path + ".meta.tmp", path + ".meta")
+        self.store.put(key + ".meta", pickle.dumps({
+            "treedef": treedef, "source_state": source_state,
+            "epoch": epoch, "kind": kind,
+        }))
 
         m = self._load_manifest()
         job = m["jobs"].setdefault(job_name, {"epochs": []})
@@ -236,9 +247,7 @@ class CheckpointStore:
             for old in epochs_l[:idx]:
                 kinds.pop(str(old), None)
                 for suffix in (".npz", ".meta"):
-                    p = os.path.join(job_dir, f"epoch_{old}{suffix}")
-                    if os.path.exists(p):
-                        os.remove(p)
+                    self.store.delete(f"{job_name}/epoch_{old}{suffix}")
             job["epochs"] = epochs_l[idx:]
         self._store_manifest(m)
         # only after the manifest commit: a save that dies earlier must
@@ -264,9 +273,9 @@ class CheckpointStore:
         return list(job.get("epochs", [])) if job else []
 
     def checkpoint_bytes(self, job_name: str, epoch: int) -> int:
-        """On-disk payload size of one epoch (soak-test observability)."""
-        p = os.path.join(self.root, job_name, f"epoch_{epoch}.npz")
-        return os.path.getsize(p) if os.path.exists(p) else 0
+        """Stored payload size of one epoch (soak-test observability)."""
+        key = f"{job_name}/epoch_{epoch}.npz"
+        return self.store.size(key) if self.store.exists(key) else 0
 
     def checkpoint_kind(self, job_name: str, epoch: int) -> str | None:
         m = self._load_manifest()
@@ -298,17 +307,15 @@ class CheckpointStore:
                 break
         chain.reverse()
         base = chain[0]
-        path = os.path.join(self.root, job_name, f"epoch_{base}")
-        with open(path + ".meta", "rb") as f:
-            meta = pickle.load(f)
-        with np.load(path + ".npz") as z:
+        key = f"{job_name}/epoch_{base}"
+        meta = pickle.loads(self.store.get(key + ".meta"))
+        with np.load(io.BytesIO(self.store.get(key + ".npz"))) as z:
             leaves = [np.array(z[f"leaf_{i}"])
                       for i in range(len(z.files))]
         for e in chain[1:]:
-            dpath = os.path.join(self.root, job_name, f"epoch_{e}")
-            with open(dpath + ".meta", "rb") as f:
-                meta = pickle.load(f)
-            with np.load(dpath + ".npz") as z:
+            dkey = f"{job_name}/epoch_{e}"
+            meta = pickle.loads(self.store.get(dkey + ".meta"))
+            with np.load(io.BytesIO(self.store.get(dkey + ".npz"))) as z:
                 for key in z.files:
                     _, li, s_el = key.split("_")
                     li, s_el = int(li), int(s_el)
@@ -327,7 +334,7 @@ class CheckpointStore:
         this epoch without the job's device state — the reference's
         batch-scan-from-Hummock pattern (SURVEY.md §3.4).
         """
-        from risingwave_tpu.storage.sst import write_sst
+        from risingwave_tpu.storage.sst import build_sst_bytes
 
         rows = mv_executor.to_host(mv_state)
         schema = mv_executor.in_schema
@@ -340,11 +347,11 @@ class CheckpointStore:
             val = pickle.dumps(row, protocol=4)
             encoded.append((key, val))
         encoded.sort(key=lambda kv: kv[0])
-        job_dir = os.path.join(self.root, job_name)
-        os.makedirs(job_dir, exist_ok=True)
-        path = os.path.join(job_dir, f"mv_epoch_{epoch}.sst")
-        write_sst(path, [k for k, _ in encoded], [v for _, v in encoded])
-        return path
+        key = f"{job_name}/mv_epoch_{epoch}.sst"
+        data, _ = build_sst_bytes(
+            [k for k, _ in encoded], [v for _, v in encoded])
+        self.store.put(key, data)
+        return self._abs(key)
 
 
 def _mc_encode_value(v, field) -> bytes:

@@ -1,0 +1,55 @@
+"""Hummock-lite storage service (the shared-LSM-on-object-store analog).
+
+Reference counterpart: ``src/storage/src/hummock`` + the meta-side
+Hummock manager (SURVEY.md §2.5/§3.5) — RisingWave's fourth node role.
+Four pieces, mirroring the reference's split:
+
+- ``object_store``  — the S3 seam: ``LocalFsObjectStore`` /
+  ``InMemObjectStore`` with deterministic fault injection (the madsim
+  sim-object-store analog, src/object_store/src/object/sim/)
+- ``version``       — epoch-stamped ``HummockVersion`` + append-only
+  version deltas with pin/unpin for in-flight serving reads
+  (commit_epoch.rs:73, time_travel_version_cache.rs:65)
+- ``store``         — ``HummockStorage``: merge-free write path
+  (seal batch → upload SST → commit delta), pinned snapshot reads,
+  compaction task picking, vacuum GC of unreferenced objects
+- ``compactor``     — ``CompactorService``: the background thread that
+  takes compaction off the ingest path (compactor_runner.rs:70) and
+  whose L0-depth write stall backpressures the barrier loop
+"""
+
+from risingwave_tpu.storage.hummock.compactor import CompactorService
+from risingwave_tpu.storage.hummock.object_store import (
+    InMemObjectStore,
+    LocalFsObjectStore,
+    ObjectError,
+    ObjectStore,
+    StoreFaults,
+)
+from risingwave_tpu.storage.hummock.store import (
+    CompactionTask,
+    HummockStorage,
+    PinnedVersion,
+)
+from risingwave_tpu.storage.hummock.version import (
+    HummockVersion,
+    SstInfo,
+    VersionDelta,
+    VersionManager,
+)
+
+__all__ = [
+    "CompactionTask",
+    "CompactorService",
+    "HummockStorage",
+    "HummockVersion",
+    "InMemObjectStore",
+    "LocalFsObjectStore",
+    "ObjectError",
+    "ObjectStore",
+    "PinnedVersion",
+    "SstInfo",
+    "StoreFaults",
+    "VersionDelta",
+    "VersionManager",
+]

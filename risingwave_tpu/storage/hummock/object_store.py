@@ -1,0 +1,242 @@
+"""Object-store seam under the storage service (the S3 boundary).
+
+Reference counterpart: ``src/object_store`` — one trait
+(``ObjectStore``: upload/read/delete/list) with S3/GCS/filesystem/
+in-memory implementations, plus the deterministic *simulated* store
+madsim uses to kill uploads mid-flight
+(``src/object_store/src/object/sim/``).  Everything above this seam
+(SSTs, version manifest, checkpoints) speaks keys and bytes only, so
+chaos tests swap the backend without touching the LSM.
+
+Fault injection is **deterministic** (counter-addressed, no RNG): a
+``StoreFaults`` rule fires on the Nth matching operation, either
+*before* the object is stored (upload lost with the process) or
+*after* (the object is durable but the caller dies before committing
+its manifest — the orphan-SST case vacuum must reap).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+class ObjectError(IOError):
+    """An object-store operation failed (injected or real)."""
+
+
+@dataclass
+class _FaultRule:
+    op: str               # "put" | "get" | "delete"
+    substr: str           # only keys containing this match
+    after: int            # skip this many matching ops first
+    mode: str             # "before" (op lost) | "after" (op durable)
+    times: int            # how many firings before the rule retires
+    hits: int = 0
+    seen: int = 0
+
+
+@dataclass
+class StoreFaults:
+    """Injectable latency + error schedule shared by both stores."""
+
+    put_latency_s: float = 0.0
+    get_latency_s: float = 0.0
+    rules: list[_FaultRule] = field(default_factory=list)
+    #: totals for test assertions
+    injected_errors: int = 0
+
+    def fail(self, op: str, substr: str = "", after: int = 0,
+             mode: str = "before", times: int = 1) -> None:
+        """Arm one deterministic failure: the ``after``-th matching op
+        (0-based) raises ``ObjectError``; with ``mode='after'`` the
+        store mutation still lands first (crash-after-upload)."""
+        assert op in ("put", "get", "delete") and mode in ("before",
+                                                           "after")
+        self.rules.append(_FaultRule(op, substr, after, mode, times))
+
+    # -- hooks called by the stores -------------------------------------
+    def _match(self, op: str, key: str) -> "_FaultRule | None":
+        for r in self.rules:
+            if r.op != op or r.substr not in key or r.hits >= r.times:
+                continue
+            r.seen += 1
+            if r.seen > r.after:
+                r.hits += 1
+                return r
+        return None
+
+    def before(self, op: str, key: str) -> "_FaultRule | None":
+        lat = self.put_latency_s if op == "put" else self.get_latency_s
+        if lat:
+            time.sleep(lat)
+        r = self._match(op, key)
+        if r is not None and r.mode == "before":
+            self.injected_errors += 1
+            raise ObjectError(f"injected {op} fault (lost): {key}")
+        return r
+
+    def after(self, rule: "_FaultRule | None", op: str,
+              key: str) -> None:
+        if rule is not None and rule.mode == "after":
+            self.injected_errors += 1
+            raise ObjectError(f"injected {op} fault (durable): {key}")
+
+
+class ObjectStore:
+    """Key → immutable bytes.  ``put`` is atomic (no torn reads)."""
+
+    faults: StoreFaults | None = None
+
+    # -- interface ------------------------------------------------------
+    def put(self, key: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def get(self, key: str) -> bytes:
+        raise NotImplementedError
+
+    def open(self, key: str):
+        """Seekable binary reader (SSTs read footer-first)."""
+        raise NotImplementedError
+
+    def delete(self, key: str) -> None:
+        raise NotImplementedError
+
+    def exists(self, key: str) -> bool:
+        raise NotImplementedError
+
+    def list(self, prefix: str = "") -> list[str]:
+        raise NotImplementedError
+
+    def size(self, key: str) -> int:
+        raise NotImplementedError
+
+    # -- shared fault plumbing ------------------------------------------
+    def _pre(self, op: str, key: str):
+        return self.faults.before(op, key) if self.faults else None
+
+    def _post(self, rule, op: str, key: str) -> None:
+        if self.faults:
+            self.faults.after(rule, op, key)
+
+
+class InMemObjectStore(ObjectStore):
+    """Dict-backed store for tests/chaos (the sim object store)."""
+
+    def __init__(self, faults: StoreFaults | None = None):
+        self._d: dict[str, bytes] = {}
+        self._lock = threading.Lock()
+        self.faults = faults
+
+    def put(self, key: str, data: bytes) -> None:
+        rule = self._pre("put", key)
+        with self._lock:
+            self._d[key] = bytes(data)
+        self._post(rule, "put", key)
+
+    def get(self, key: str) -> bytes:
+        rule = self._pre("get", key)
+        with self._lock:
+            if key not in self._d:
+                raise ObjectError(f"no such object: {key}")
+            data = self._d[key]
+        self._post(rule, "get", key)
+        return data
+
+    def open(self, key: str):
+        return io.BytesIO(self.get(key))
+
+    def delete(self, key: str) -> None:
+        rule = self._pre("delete", key)
+        with self._lock:
+            self._d.pop(key, None)
+        self._post(rule, "delete", key)
+
+    def exists(self, key: str) -> bool:
+        with self._lock:
+            return key in self._d
+
+    def list(self, prefix: str = "") -> list[str]:
+        with self._lock:
+            return sorted(k for k in self._d if k.startswith(prefix))
+
+    def size(self, key: str) -> int:
+        with self._lock:
+            if key not in self._d:
+                raise ObjectError(f"no such object: {key}")
+            return len(self._d[key])
+
+
+class LocalFsObjectStore(ObjectStore):
+    """Filesystem-backed store; atomic put via tmp + rename."""
+
+    def __init__(self, root: str, faults: StoreFaults | None = None):
+        self.root = root
+        self.faults = faults
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        assert ".." not in key.split("/"), key
+        return os.path.join(self.root, key)
+
+    def put(self, key: str, data: bytes) -> None:
+        rule = self._pre("put", key)
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+        self._post(rule, "put", key)
+
+    def get(self, key: str) -> bytes:
+        rule = self._pre("get", key)
+        try:
+            with open(self._path(key), "rb") as f:
+                data = f.read()
+        except FileNotFoundError as e:
+            raise ObjectError(f"no such object: {key}") from e
+        self._post(rule, "get", key)
+        return data
+
+    def open(self, key: str):
+        rule = self._pre("get", key)
+        try:
+            f = open(self._path(key), "rb")
+        except FileNotFoundError as e:
+            raise ObjectError(f"no such object: {key}") from e
+        self._post(rule, "get", key)
+        return f
+
+    def delete(self, key: str) -> None:
+        rule = self._pre("delete", key)
+        try:
+            os.remove(self._path(key))
+        except FileNotFoundError:
+            pass
+        self._post(rule, "delete", key)
+
+    def exists(self, key: str) -> bool:
+        return os.path.exists(self._path(key))
+
+    def list(self, prefix: str = "") -> list[str]:
+        out = []
+        for dirpath, _, files in os.walk(self.root):
+            rel = os.path.relpath(dirpath, self.root)
+            rel = "" if rel == "." else rel + "/"
+            for name in files:
+                if name.endswith(".tmp"):
+                    continue  # torn put, never visible
+                key = rel + name
+                if key.startswith(prefix):
+                    out.append(key)
+        return sorted(out)
+
+    def size(self, key: str) -> int:
+        try:
+            return os.path.getsize(self._path(key))
+        except FileNotFoundError as e:
+            raise ObjectError(f"no such object: {key}") from e

@@ -1,0 +1,416 @@
+"""HummockStorage: merge-free ingest, pinned reads, compaction, vacuum.
+
+Reference counterpart: ``HummockStorage`` + ``SstableStore``
+(src/storage/src/hummock/store/hummock_storage.rs:673,
+sstable_store.rs:208) with the meta-side manager's task scheduling and
+orphan GC (src/meta/src/hummock/manager/).
+
+The write path is the whole point: ``write_batch`` seals a sorted
+batch, uploads ONE new SST object and commits a version delta adding
+it to L0 — **no merge I/O ever happens on the ingest path**.  Merging
+is the background ``CompactorService``'s job (compactor.py), which
+picks tasks from level budgets here, executes them off-thread, and
+commits results as version deltas.  Serving reads pin a version so
+the SST set under them stays stable (and vacuum-safe) while the
+compactor rewrites levels underneath.  When L0 outruns the compactor,
+``stalled()`` trips and the barrier loop's write-stall hook blocks in
+``wait_below_stall`` — Hummock's write-limit backpressure.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+from risingwave_tpu.storage.hummock.version import (
+    HummockVersion,
+    SstInfo,
+    VersionManager,
+)
+from risingwave_tpu.storage.sst import (
+    TOMBSTONE,
+    BlockCache,
+    SstReader,
+    build_sst_bytes,
+    merge_scan,
+    output_is_bottommost,
+)
+
+SST_PREFIX = "sst/"
+
+
+@dataclass
+class CompactionTask:
+    """One unit of background work: merge ``inputs`` into a single run
+    at ``out_level``.  ``drop_tombstones`` is decided at pick time
+    under the version lock; it stays valid for the task's lifetime
+    because data only flows downward and every compaction that could
+    populate a deeper level would need one of this task's (locked)
+    levels as its input."""
+
+    task_id: int
+    in_level: int
+    out_level: int
+    inputs: list[SstInfo]
+    drop_tombstones: bool
+    epoch: int
+    #: filled by execution
+    outputs: list[SstInfo] = field(default_factory=list)
+    in_bytes: int = 0
+
+
+class PinnedVersion:
+    """A serving handle over one pinned version (context manager)."""
+
+    def __init__(self, storage: "HummockStorage", pin_id: int,
+                 version: HummockVersion):
+        self._storage = storage
+        self._pin_id = pin_id
+        self.version = version
+        self._released = False
+
+    # newest-first reader order: L0 front-to-back, then level 1, 2, ...
+    def _readers(self):
+        return [self._storage._reader(s.key)
+                for lv in self.version.levels for s in lv]
+
+    def get(self, key: bytes) -> bytes | None:
+        m = self._storage.metrics
+        for lv in self.version.levels:
+            for s in lv:
+                r = self._storage._reader(s.key)
+                if not r.may_contain(key):
+                    if m is not None:
+                        m.inc("storage_bloom_filter_total",
+                              result="skip")
+                    continue
+                v = r.get(key)
+                if m is not None:
+                    m.inc("storage_bloom_filter_total",
+                          result="hit" if v is not None else "miss")
+                if v is not None:
+                    return None if v == TOMBSTONE else v
+        return None
+
+    def scan(self, lo: bytes = b"", hi: bytes | None = None):
+        yield from merge_scan(self._readers(), lo, hi)
+
+    def release(self) -> None:
+        if not self._released:
+            self._released = True
+            self._storage.versions.unpin(self._pin_id)
+            self._storage._update_gauges()
+
+    def __enter__(self) -> "PinnedVersion":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __del__(self):  # best-effort: never leak a pin
+        try:
+            self.release()
+        except Exception:
+            pass
+
+
+class HummockStorage:
+    """The storage-service facade over one object store."""
+
+    def __init__(self, store, cache: "BlockCache | None" = None,
+                 metrics=None, l0_trigger: int = 4,
+                 base_bytes: int = 4 << 20, ratio: int = 8,
+                 stall_l0: int = 12, bloom_bits_per_key: int = 10,
+                 version_base_interval: int = 64):
+        self.store = store
+        self.cache = cache if cache is not None else BlockCache(512)
+        self.metrics = metrics
+        self.l0_trigger = l0_trigger
+        self.base_bytes = base_bytes
+        self.ratio = ratio
+        #: L0 run count at/over which ingest must stall (write limit)
+        self.stall_l0 = stall_l0
+        self.bloom_bits_per_key = bloom_bits_per_key
+        self.versions = VersionManager(
+            store, base_interval=version_base_interval)
+        self._lock = threading.RLock()
+        #: commits signal this: stalled writers + the compactor wait
+        self._commit_cv = threading.Condition(self._lock)
+        self._readers: dict[str, SstReader] = {}
+        #: uploaded-but-uncommitted object keys vacuum must not touch
+        self._protected: set[str] = set()
+        #: levels owned by in-flight compaction tasks
+        self._busy_levels: set[int] = set()
+        self._next_task = 1
+        #: write-path purity counter: merges performed on ingest (0)
+        self.write_path_merges = 0
+        # next SST id: past the largest object present (orphans from a
+        # crashed run included, so a reused id can never alias one)
+        ids = [int(k[len(SST_PREFIX):].split(".")[0])
+               for k in store.list(SST_PREFIX)]
+        self._next_sst = (max(ids) + 1) if ids else 1
+        self._update_gauges()
+
+    # -- plumbing -------------------------------------------------------
+    def _reader(self, key: str) -> SstReader:
+        with self._lock:
+            r = self._readers.get(key)
+            if r is None:
+                r = SstReader(store=self.store, key=key,
+                              cache=self.cache)
+                self._readers[key] = r
+            return r
+
+    def _alloc_sst_key(self) -> str:
+        with self._lock:
+            key = f"{SST_PREFIX}{self._next_sst:012d}.sst"
+            self._next_sst += 1
+            self._protected.add(key)
+            return key
+
+    def _upload_sst(self, pairs: list[tuple[bytes, bytes]]) -> SstInfo:
+        """Build + upload one SST; the key stays vacuum-protected
+        until its delta commits (or the caller aborts)."""
+        key = self._alloc_sst_key()
+        try:
+            data, meta = build_sst_bytes(
+                [k for k, _ in pairs], [v for _, v in pairs],
+                bloom_bits_per_key=self.bloom_bits_per_key,
+            )
+            self.store.put(key, data)
+        except BaseException:
+            # failed upload: whatever (if anything) landed is garbage
+            # this process will never commit — expose it to vacuum
+            with self._lock:
+                self._protected.discard(key)
+            raise
+        if self.metrics is not None:
+            self.metrics.inc("storage_sst_uploads_total")
+            self.metrics.inc("storage_sst_upload_bytes_total",
+                             len(data))
+        return SstInfo(key=key, first_key=meta.first_key,
+                       last_key=meta.last_key,
+                       n_records=meta.n_records, size=meta.size)
+
+    def _update_gauges(self) -> None:
+        if self.metrics is None:
+            return
+        v = self.versions.current
+        self.metrics.set_gauge("storage_l0_runs", v.l0_depth())
+        self.metrics.set_gauge("storage_version_id", v.vid)
+        self.metrics.set_gauge("storage_pinned_versions",
+                               self.versions.pinned_count())
+        self.metrics.set_gauge("storage_sst_files", v.file_count())
+
+    # -- write path (NO merge I/O) --------------------------------------
+    def write_batch(self, pairs: list[tuple[bytes, bytes]],
+                    epoch: int = 0) -> SstInfo | None:
+        """Seal one batch as a new L0 run: upload + version delta.
+        Later duplicates win within the batch; deletes pass TOMBSTONE
+        values (``delete_batch``)."""
+        if not pairs:
+            return None
+        dedup = dict(pairs)  # last write wins within the batch
+        sst = self._upload_sst(sorted(dedup.items()))
+        with self._commit_cv:
+            self.versions.commit(epoch, adds={0: [sst]}, removes={})
+            self._protected.discard(sst.key)
+            self._update_gauges()
+            self._commit_cv.notify_all()
+        return sst
+
+    def delete_batch(self, keys: list[bytes], epoch: int = 0) -> None:
+        self.write_batch([(k, TOMBSTONE) for k in keys], epoch)
+
+    # -- reads ----------------------------------------------------------
+    def pin(self) -> PinnedVersion:
+        pin_id, version = self.versions.pin()
+        self._update_gauges()
+        return PinnedVersion(self, pin_id, version)
+
+    def get(self, key: bytes) -> bytes | None:
+        with self.pin() as pv:
+            return pv.get(key)
+
+    def scan(self, lo: bytes = b"", hi: bytes | None = None):
+        pv = self.pin()
+        try:
+            yield from pv.scan(lo, hi)
+        finally:
+            pv.release()
+
+    # -- write stall / backpressure -------------------------------------
+    def l0_depth(self) -> int:
+        return self.versions.current.l0_depth()
+
+    def stalled(self) -> bool:
+        """The Hummock write-limit condition: L0 deeper than the stall
+        threshold means compaction is behind; ingest must wait."""
+        return self.l0_depth() >= self.stall_l0
+
+    def wait_below_stall(self, timeout: float = 5.0) -> float:
+        """Block until L0 drops below the stall threshold (or timeout);
+        returns seconds stalled.  The barrier loop's stall hook."""
+        if not self.stalled():
+            return 0.0
+        t0 = time.monotonic()
+        deadline = t0 + timeout
+        with self._commit_cv:
+            while self.stalled():
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._commit_cv.wait(remaining)
+        waited = time.monotonic() - t0
+        if self.metrics is not None and waited > 0:
+            self.metrics.inc("storage_write_stall_seconds_total",
+                             waited)
+        return waited
+
+    # -- compaction (executed by CompactorService) ----------------------
+    def pending_compaction_level(self) -> int | None:
+        """The deterministic policy over the CURRENT version, ignoring
+        levels already owned by in-flight tasks."""
+        v = self.versions.current
+        levels = v.levels
+        if v.l0_depth() >= self.l0_trigger \
+                and not self._busy_levels & {0, 1}:
+            return 0
+        for i in range(1, len(levels)):
+            budget = self.base_bytes * self.ratio ** (i - 1)
+            if levels[i] and v.level_bytes(i) > budget \
+                    and not self._busy_levels & {i, i + 1}:
+                return i
+        return None
+
+    def pick_compaction(self) -> CompactionTask | None:
+        """Claim one task (locks its level pair until commit/abort)."""
+        with self._lock:
+            i = self.pending_compaction_level()
+            if i is None:
+                return None
+            v = self.versions.current
+            levels = v.levels
+            inputs = list(levels[i])
+            if i + 1 < len(levels):
+                inputs += list(levels[i + 1])
+            # tombstone drop is legal ONLY into the bottommost
+            # non-empty level (see sst.output_is_bottommost); decided
+            # under the lock and stable for the task lifetime
+            drop = output_is_bottommost(levels, i + 1)
+            task = CompactionTask(
+                task_id=self._next_task, in_level=i, out_level=i + 1,
+                inputs=inputs, drop_tombstones=drop,
+                epoch=v.max_committed_epoch,
+            )
+            self._next_task += 1
+            self._busy_levels |= {i, i + 1}
+            return task
+
+    def execute_compaction(self, task: CompactionTask) -> None:
+        """The merge itself — runs OFF the write path (compactor
+        thread), reading input SSTs and uploading the merged run."""
+        readers = [self._reader(s.key) for s in task.inputs]
+        pairs: list[tuple[bytes, bytes]] = []
+        for k, v in merge_scan(readers,
+                               keep_tombstones=not task.drop_tombstones):
+            pairs.append((k, v))
+            task.in_bytes += len(k) + len(v)
+        if pairs:
+            task.outputs = [self._upload_sst(pairs)]
+
+    def commit_compaction(self, task: CompactionTask) -> None:
+        """Commit the task as one version delta; input SSTs leave the
+        version (vacuum reclaims them once unpinned)."""
+        with self._commit_cv:
+            in_keys = [s.key for s in task.inputs]
+            self.versions.commit(
+                task.epoch,
+                adds={task.out_level: task.outputs},
+                removes={task.in_level: in_keys,
+                         task.out_level: in_keys},
+            )
+            for s in task.outputs:
+                self._protected.discard(s.key)
+            self._busy_levels -= {task.in_level, task.out_level}
+            if self.metrics is not None:
+                self.metrics.inc("storage_compaction_tasks_total",
+                                 level=str(task.in_level))
+                self.metrics.inc("storage_compaction_bytes_total",
+                                 task.in_bytes)
+            self._update_gauges()
+            self._commit_cv.notify_all()
+
+    def abort_compaction(self, task: CompactionTask) -> None:
+        """Release the task's level locks; any uploaded output stays
+        as an orphan for vacuum (the crash path does the same without
+        this courtesy call)."""
+        with self._commit_cv:
+            for s in task.outputs:
+                self._protected.discard(s.key)
+            self._busy_levels -= {task.in_level, task.out_level}
+            self._commit_cv.notify_all()
+
+    def compact_once(self) -> bool:
+        """Pick + execute + commit one task synchronously (the ctl
+        'trigger compaction' surface and the service's inner step)."""
+        task = self.pick_compaction()
+        if task is None:
+            return False
+        try:
+            self.execute_compaction(task)
+        except BaseException:
+            self.abort_compaction(task)
+            raise
+        self.commit_compaction(task)
+        return True
+
+    # -- vacuum / GC ----------------------------------------------------
+    def vacuum(self, extra_refs: "set[str] | frozenset[str]" = frozenset(),
+               ) -> int:
+        """Delete SST objects unreferenced by the current version, any
+        pinned version, in-flight uploads, or ``extra_refs`` (retained
+        checkpoint exports).  Returns the number of objects deleted
+        (the meta vacuum's orphan-object GC)."""
+        with self._lock:
+            keep = self.versions.referenced_keys()
+            keep |= self._protected
+            keep |= set(extra_refs)
+            deleted = 0
+            for key in self.store.list(SST_PREFIX):
+                if key in keep:
+                    continue
+                r = self._readers.pop(key, None)
+                if r is not None:
+                    r.close()
+                self.store.delete(key)
+                deleted += 1
+            if self.metrics is not None and deleted:
+                self.metrics.inc("storage_gc_objects_total", deleted)
+            return deleted
+
+    # -- introspection ---------------------------------------------------
+    def stats(self) -> dict:
+        """The ctl 'storage version' surface."""
+        v = self.versions.current
+        return {
+            "version_id": v.vid,
+            "max_committed_epoch": v.max_committed_epoch,
+            "l0_runs": v.l0_depth(),
+            "levels": [
+                {"level": i, "files": len(lv),
+                 "bytes": sum(s.size for s in lv)}
+                for i, lv in enumerate(v.levels)
+            ],
+            "pinned_versions": self.versions.pinned_count(),
+            "stalled": self.stalled(),
+            "stall_l0": self.stall_l0,
+            "objects": len(self.store.list(SST_PREFIX)),
+        }
+
+    def close(self) -> None:
+        with self._lock:
+            for r in self._readers.values():
+                r.close()
+            self._readers.clear()

@@ -1,0 +1,263 @@
+"""Versioned manifest: epoch-stamped versions + append-only deltas.
+
+Reference counterpart: the meta-side Hummock manager's version
+bookkeeping — ``commit_epoch`` bumps a ``HummockVersion`` by applying a
+``HummockVersionDelta`` (src/meta/src/hummock/manager/commit_epoch.rs:
+73), compute nodes pin versions for in-flight reads, and time travel
+replays archived deltas (time_travel_version_cache.rs:65).
+
+Shape here: every mutation of the SST set (ingest upload, compaction
+commit) appends ONE delta object ``version/delta_<vid>.json`` to the
+object store and applies it in memory.  Reopen = latest base snapshot
++ later deltas replayed in vid order — a crash between an SST upload
+and its delta commit leaves an *orphan object* that no version
+references (vacuum reaps it), never a corrupt version.  Pins hold a
+full immutable ``HummockVersion`` so serving reads keep a consistent
+SST set while the compactor rewrites levels underneath them.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import dataclass, field
+
+_DELTA_FMT = "version/delta_{:012d}.json"
+_BASE_FMT = "version/base_{:012d}.json"
+_DELTA_PREFIX = "version/delta_"
+_BASE_PREFIX = "version/base_"
+
+
+@dataclass(frozen=True)
+class SstInfo:
+    """Immutable SST descriptor carried by versions and deltas."""
+
+    key: str            # object-store key
+    first_key: bytes
+    last_key: bytes
+    n_records: int
+    size: int
+
+    def to_json(self) -> dict:
+        return {
+            "key": self.key,
+            "first_key": self.first_key.hex(),
+            "last_key": self.last_key.hex(),
+            "n_records": self.n_records,
+            "size": self.size,
+        }
+
+    @staticmethod
+    def from_json(d: dict) -> "SstInfo":
+        return SstInfo(
+            key=d["key"],
+            first_key=bytes.fromhex(d["first_key"]),
+            last_key=bytes.fromhex(d["last_key"]),
+            n_records=d["n_records"],
+            size=d["size"],
+        )
+
+
+@dataclass(frozen=True)
+class HummockVersion:
+    """One immutable version of the LSM shape.
+
+    ``levels[0]`` is newest-first overlapping runs; deeper levels hold
+    at most one sorted run each (mirroring ``LsmTree``).
+    """
+
+    vid: int
+    max_committed_epoch: int
+    levels: tuple[tuple[SstInfo, ...], ...]
+
+    def all_keys(self) -> set[str]:
+        return {s.key for lv in self.levels for s in lv}
+
+    def l0_depth(self) -> int:
+        return len(self.levels[0]) if self.levels else 0
+
+    def level_bytes(self, i: int) -> int:
+        return sum(s.size for s in self.levels[i])
+
+    def file_count(self) -> int:
+        return sum(len(lv) for lv in self.levels)
+
+    def to_json(self) -> dict:
+        return {
+            "vid": self.vid,
+            "max_committed_epoch": self.max_committed_epoch,
+            "levels": [[s.to_json() for s in lv] for lv in self.levels],
+        }
+
+    @staticmethod
+    def from_json(d: dict) -> "HummockVersion":
+        return HummockVersion(
+            vid=d["vid"],
+            max_committed_epoch=d["max_committed_epoch"],
+            levels=tuple(
+                tuple(SstInfo.from_json(s) for s in lv)
+                for lv in d["levels"]
+            ),
+        )
+
+    @staticmethod
+    def empty() -> "HummockVersion":
+        return HummockVersion(vid=0, max_committed_epoch=0,
+                              levels=((),))
+
+
+@dataclass
+class VersionDelta:
+    """One append-only version-log entry: SST add/remove per level.
+
+    ``adds[level]`` lists new SSTs; for L0 they PREPEND (newest first),
+    deeper levels hold the single new run.  ``removes[level]`` lists
+    object keys leaving that level (compaction inputs).
+    """
+
+    vid: int
+    epoch: int
+    adds: dict[int, list[SstInfo]] = field(default_factory=dict)
+    removes: dict[int, list[str]] = field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        return {
+            "vid": self.vid,
+            "epoch": self.epoch,
+            "adds": {str(lv): [s.to_json() for s in ss]
+                     for lv, ss in self.adds.items()},
+            "removes": {str(lv): ks for lv, ks in self.removes.items()},
+        }
+
+    @staticmethod
+    def from_json(d: dict) -> "VersionDelta":
+        return VersionDelta(
+            vid=d["vid"],
+            epoch=d["epoch"],
+            adds={int(lv): [SstInfo.from_json(s) for s in ss]
+                  for lv, ss in d["adds"].items()},
+            removes={int(lv): ks for lv, ks in d["removes"].items()},
+        )
+
+
+def apply_delta(v: HummockVersion, d: VersionDelta) -> HummockVersion:
+    """Pure application of one delta (replay = fold over the log)."""
+    n_levels = max(
+        [len(v.levels)] + [lv + 1 for lv in d.adds]
+        + [lv + 1 for lv in d.removes]
+    )
+    levels = [list(v.levels[i]) if i < len(v.levels) else []
+              for i in range(n_levels)]
+    for lv, keys in d.removes.items():
+        gone = set(keys)
+        levels[lv] = [s for s in levels[lv] if s.key not in gone]
+    for lv, ssts in d.adds.items():
+        if lv == 0:
+            # newest-first: this delta's runs go to the front in order
+            levels[0] = list(ssts) + levels[0]
+        else:
+            levels[lv] = levels[lv] + list(ssts)
+    return HummockVersion(
+        vid=d.vid,
+        max_committed_epoch=max(v.max_committed_epoch, d.epoch),
+        levels=tuple(tuple(lv) for lv in levels),
+    )
+
+
+class VersionManager:
+    """Owns the version log on the object store + the pin table.
+
+    Thread-safe: ingest and the compactor commit deltas concurrently;
+    serving reads pin/unpin.  Every ``base_interval`` deltas a full
+    base snapshot re-anchors the log and older entries are pruned
+    (deltas ≤ the base vid are ignored on replay, so a crash between
+    the base write and the prune leaves a replayable log).
+    """
+
+    def __init__(self, store, base_interval: int = 64):
+        self.store = store
+        self.base_interval = base_interval
+        self._lock = threading.RLock()
+        #: pin_id -> version; pinned versions keep their SSTs reachable
+        self._pins: dict[int, HummockVersion] = {}
+        self._next_pin = 1
+        self._deltas_since_base = 0
+        self.current = self._replay()
+
+    # -- log ------------------------------------------------------------
+    def _replay(self) -> HummockVersion:
+        base_keys = self.store.list(_BASE_PREFIX)
+        v = HummockVersion.empty()
+        if base_keys:
+            v = HummockVersion.from_json(
+                json.loads(self.store.get(base_keys[-1]))
+            )
+        n = 0
+        for key in self.store.list(_DELTA_PREFIX):
+            d = VersionDelta.from_json(json.loads(self.store.get(key)))
+            if d.vid <= v.vid:
+                continue  # pre-base entry not yet pruned
+            v = apply_delta(v, d)
+            n += 1
+        self._deltas_since_base = n
+        return v
+
+    def commit(self, epoch: int, adds: dict[int, list[SstInfo]],
+               removes: dict[int, list[str]]) -> HummockVersion:
+        """Append one delta (atomic object put) and apply it."""
+        with self._lock:
+            delta = VersionDelta(
+                vid=self.current.vid + 1, epoch=epoch,
+                adds=adds, removes=removes,
+            )
+            # the delta object IS the commit point: a crash before this
+            # put leaves only orphan SSTs, never a half-applied version
+            self.store.put(
+                _DELTA_FMT.format(delta.vid),
+                json.dumps(delta.to_json()).encode(),
+            )
+            self.current = apply_delta(self.current, delta)
+            self._deltas_since_base += 1
+            if self._deltas_since_base >= self.base_interval:
+                self._write_base()
+            return self.current
+
+    def _write_base(self) -> None:
+        v = self.current
+        self.store.put(_BASE_FMT.format(v.vid),
+                       json.dumps(v.to_json()).encode())
+        self._deltas_since_base = 0
+        # prune superseded log entries (safe: replay ignores them)
+        for key in self.store.list(_DELTA_PREFIX):
+            vid = int(key[len(_DELTA_PREFIX):-len(".json")])
+            if vid <= v.vid:
+                self.store.delete(key)
+        for key in self.store.list(_BASE_PREFIX)[:-1]:
+            self.store.delete(key)
+
+    # -- pins -----------------------------------------------------------
+    def pin(self) -> tuple[int, HummockVersion]:
+        """Pin the current version for a serving read; the pinned SST
+        set stays vacuum-safe until unpinned (ref pinned snapshots)."""
+        with self._lock:
+            pin_id = self._next_pin
+            self._next_pin += 1
+            self._pins[pin_id] = self.current
+            return pin_id, self.current
+
+    def unpin(self, pin_id: int) -> None:
+        with self._lock:
+            self._pins.pop(pin_id, None)
+
+    def pinned_count(self) -> int:
+        with self._lock:
+            return len(self._pins)
+
+    def referenced_keys(self) -> set[str]:
+        """Object keys reachable from the current or any pinned
+        version — the vacuum keep-set."""
+        with self._lock:
+            keys = self.current.all_keys()
+            for v in self._pins.values():
+                keys |= v.all_keys()
+            return keys

@@ -1,24 +1,33 @@
 """Block-based sorted-string-table files + k-way merge reads.
 
 Reference counterpart: ``src/storage/src/hummock/sstable/`` (block
-format, builder, multi-SST iterators — SURVEY.md §2.5).  Simplified
-round-1 format, one file per SST:
+format, builder, bloom filters, multi-SST iterators — SURVEY.md §2.5).
+Simplified format, one object per SST:
 
     [block 0][block 1]...[block k-1][index json][footer]
     footer = index_offset (8B LE) + index_len (8B LE) + magic (8B)
 
 Each block holds varint-framed (key, value) records in key order with a
-crc32c trailer; the index stores each block's first key + offset/len.
-Point gets binary-search the index then scan one block; range scans
-merge blocks.  ``merge_scan`` merges multiple SSTs newest-first with
-tombstone handling — the LSM read path.
+crc32c trailer; the index stores each block's first key + offset/len,
+the SST's key range, and a per-SST bloom filter over full keys.  Point
+gets consult the bloom then binary-search the index and scan one block;
+range scans merge blocks.  ``merge_scan`` merges multiple SSTs
+newest-first with tombstone handling — the LSM read path — skipping
+readers whose key range misses the scan window.
+
+All I/O goes through the ``ObjectStore`` seam
+(``storage/hummock/object_store.py``); the legacy path-based API keeps
+working via a local-filesystem store.
 
 ``LsmTree`` adds the LSM lifecycle on top: L0 accumulates newest-first
 overlapping runs; levels 1..n hold one sorted run each; compaction
 merges a level into the next when it exceeds its budget, dropping
-tombstones at the bottommost level (ref compactor_runner.rs:70).
-``BlockCache`` is the foyer-block-cache analog for the serving read
-path (sstable_store.rs:208).
+tombstones ONLY when the output is the bottommost non-empty level
+(ref compactor_runner.rs:70).  With ``auto_compact=False`` the write
+path performs no merge I/O and a background driver (the hummock
+``CompactorService``) calls ``compact_one`` instead.  ``BlockCache``
+is the foyer-block-cache analog for the serving read path
+(sstable_store.rs:208).
 """
 
 from __future__ import annotations
@@ -35,6 +44,7 @@ from risingwave_tpu.storage import codec
 MAGIC = b"RWTPUSST"
 TOMBSTONE = b"\xff\xfe__tombstone__"
 DEFAULT_BLOCK_BYTES = 64 * 1024
+DEFAULT_BLOOM_BITS_PER_KEY = 10
 
 
 @dataclass
@@ -43,54 +53,114 @@ class SstMeta:
     first_key: bytes
     last_key: bytes
     n_records: int
+    size: int = 0
 
 
-def write_sst(path: str, keys: list[bytes], values: list[bytes],
-              block_bytes: int = DEFAULT_BLOCK_BYTES) -> SstMeta:
-    """Write sorted (key, value) pairs; keys must be pre-sorted unique."""
+# -- bloom filter -------------------------------------------------------
+def _bloom_hashes(key: bytes) -> tuple[int, int]:
+    """Double hashing (h1 + i*h2) — two crc32c passes, h2 forced odd."""
+    h1 = codec.crc32c(key)
+    h2 = codec.crc32c(b"\x9e" + key) | 1
+    return h1, h2
+
+
+def bloom_build(keys: list[bytes], bits_per_key: int) -> dict:
+    """Build the per-SST filter; returned dict embeds in the index."""
+    m = max(64, len(keys) * bits_per_key)
+    m = (m + 7) & ~7  # whole bytes
+    k = max(1, min(8, round(0.69 * bits_per_key)))
+    bits = bytearray(m // 8)
+    for key in keys:
+        h1, h2 = _bloom_hashes(key)
+        for i in range(k):
+            b = (h1 + i * h2) % m
+            bits[b >> 3] |= 1 << (b & 7)
+    return {"m": m, "k": k, "bits": bytes(bits).hex()}
+
+
+def bloom_may_contain(bloom: dict, key: bytes,
+                      bits: bytes | None = None) -> bool:
+    """Probe a filter dict; pass pre-decoded ``bits`` on hot paths."""
+    if bits is None:
+        bits = bytes.fromhex(bloom["bits"])
+    m, k = bloom["m"], bloom["k"]
+    h1, h2 = _bloom_hashes(key)
+    for i in range(k):
+        b = (h1 + i * h2) % m
+        if not bits[b >> 3] & (1 << (b & 7)):
+            return False
+    return True
+
+
+# -- builder ------------------------------------------------------------
+def build_sst_bytes(
+    keys: list[bytes], values: list[bytes],
+    block_bytes: int = DEFAULT_BLOCK_BYTES,
+    bloom_bits_per_key: int = DEFAULT_BLOOM_BITS_PER_KEY,
+) -> tuple[bytes, SstMeta]:
+    """Serialize sorted (key, value) pairs to one SST object in memory;
+    keys must be pre-sorted unique."""
     assert len(keys) == len(values)
     index = []
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        i = 0
-        offset = 0
-        while i < len(keys):
-            # greedy block packing
-            j = i
-            sz = 0
-            while j < len(keys) and (sz < block_bytes or j == i):
-                sz += len(keys[j]) + len(values[j]) + 10
-                j += 1
-            blk_keys = keys[i:j]
-            blk_vals = values[i:j]
-            ko = np.cumsum([0] + [len(k) for k in blk_keys]).astype(np.int64)
-            vo = np.cumsum([0] + [len(v) for v in blk_vals]).astype(np.int64)
-            kpool = np.frombuffer(b"".join(blk_keys), np.uint8)
-            vpool = np.frombuffer(b"".join(blk_vals), np.uint8)
-            block = codec.block_encode(kpool, ko, vpool, vo)
-            crc = struct.pack("<I", codec.crc32c(block))
-            f.write(block)
-            f.write(crc)
-            index.append({
-                "first_key": blk_keys[0].hex(),
-                "offset": offset,
-                "len": len(block),
-            })
-            offset += len(block) + 4
-            i = j
-        index_bytes = json.dumps({
-            "blocks": index, "n": len(keys),
-        }).encode()
-        f.write(index_bytes)
-        f.write(struct.pack("<QQ", offset, len(index_bytes)))
-        f.write(MAGIC)
-    os.replace(tmp, path)
-    return SstMeta(
-        path=path,
+    out = bytearray()
+    i = 0
+    offset = 0
+    while i < len(keys):
+        # greedy block packing
+        j = i
+        sz = 0
+        while j < len(keys) and (sz < block_bytes or j == i):
+            sz += len(keys[j]) + len(values[j]) + 10
+            j += 1
+        blk_keys = keys[i:j]
+        blk_vals = values[i:j]
+        ko = np.cumsum([0] + [len(k) for k in blk_keys]).astype(np.int64)
+        vo = np.cumsum([0] + [len(v) for v in blk_vals]).astype(np.int64)
+        kpool = np.frombuffer(b"".join(blk_keys), np.uint8)
+        vpool = np.frombuffer(b"".join(blk_vals), np.uint8)
+        block = codec.block_encode(kpool, ko, vpool, vo)
+        out += block
+        out += struct.pack("<I", codec.crc32c(block))
+        index.append({
+            "first_key": blk_keys[0].hex(),
+            "offset": offset,
+            "len": len(block),
+        })
+        offset += len(block) + 4
+        i = j
+    index_bytes = json.dumps({
+        "blocks": index, "n": len(keys),
+        "first_key": keys[0].hex() if keys else "",
+        "last_key": keys[-1].hex() if keys else "",
+        "bloom": bloom_build(keys, bloom_bits_per_key)
+        if bloom_bits_per_key else None,
+    }).encode()
+    out += index_bytes
+    out += struct.pack("<QQ", offset, len(index_bytes))
+    out += MAGIC
+    meta = SstMeta(
+        path="",
         first_key=keys[0] if keys else b"",
         last_key=keys[-1] if keys else b"",
         n_records=len(keys),
+        size=len(out),
     )
+    return bytes(out), meta
+
+
+def write_sst(path: str, keys: list[bytes], values: list[bytes],
+              block_bytes: int = DEFAULT_BLOCK_BYTES,
+              bloom_bits_per_key: int = DEFAULT_BLOOM_BITS_PER_KEY,
+              ) -> SstMeta:
+    """Write sorted (key, value) pairs to a local file (atomic)."""
+    data, meta = build_sst_bytes(keys, values, block_bytes,
+                                 bloom_bits_per_key)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)
+    meta.path = path
+    return meta
 
 
 class BlockCache:
@@ -122,20 +192,38 @@ class BlockCache:
 
 
 class SstReader:
-    def __init__(self, path: str, cache: "BlockCache | None" = None):
-        self.path = path
+    """Reader over one SST — a local path or an object-store key."""
+
+    def __init__(self, path: str | None = None,
+                 cache: "BlockCache | None" = None, *,
+                 store=None, key: str | None = None):
+        if store is not None:
+            assert key is not None
+            self.path = key
+            self._f = store.open(key)
+        else:
+            assert path is not None
+            self.path = path
+            self._f = open(path, "rb")
         self.cache = cache
-        self._f = open(path, "rb")
         self._f.seek(-24, os.SEEK_END)
         tail = self._f.read(24)
         index_offset, index_len = struct.unpack("<QQ", tail[:16])
         if tail[16:] != MAGIC:
-            raise ValueError(f"{path}: bad magic")
+            raise ValueError(f"{self.path}: bad magic")
         self._f.seek(index_offset)
         self.index = json.loads(self._f.read(index_len))
         self._block_first_keys = [
             bytes.fromhex(b["first_key"]) for b in self.index["blocks"]
         ]
+        #: key range + bloom (absent in pre-bloom SSTs)
+        self.first_key = bytes.fromhex(self.index.get("first_key", ""))
+        self.last_key = bytes.fromhex(self.index.get("last_key", ""))
+        self._bloom = self.index.get("bloom")
+        self._bloom_bits = bytes.fromhex(self._bloom["bits"]) \
+            if self._bloom else b""
+        self.bloom_checks = 0
+        self.bloom_negatives = 0
 
     def close(self) -> None:
         self._f.close()
@@ -149,6 +237,32 @@ class SstReader:
     @property
     def n_records(self) -> int:
         return self.index["n"]
+
+    def may_contain(self, key: bytes) -> bool:
+        """Cheap SST-level prune: key range, then the bloom filter."""
+        if self.index["n"] == 0:
+            return False
+        if self.last_key and not (self.first_key <= key <= self.last_key):
+            return False
+        if self._bloom is None:
+            return True
+        self.bloom_checks += 1
+        if bloom_may_contain(self._bloom, key, self._bloom_bits):
+            return True
+        self.bloom_negatives += 1
+        return False
+
+    def overlaps(self, lo: bytes, hi: bytes | None) -> bool:
+        """Does [first_key, last_key] intersect the scan window?"""
+        if self.index["n"] == 0:
+            return False
+        if not self.last_key:
+            return True  # legacy SST without a recorded range
+        if self.last_key < lo:
+            return False
+        if hi is not None and self.first_key >= hi:
+            return False
+        return True
 
     def _read_block(self, bi: int):
         if self.cache is not None:
@@ -173,6 +287,8 @@ class SstReader:
 
     def get(self, key: bytes) -> bytes | None:
         import bisect
+        if not self.may_contain(key):
+            return None
         bi = bisect.bisect_right(self._block_first_keys, key) - 1
         if bi < 0:
             return None
@@ -184,6 +300,8 @@ class SstReader:
     def scan(self, lo: bytes = b"", hi: bytes | None = None):
         """Yield (key, value) with lo <= key < hi."""
         import bisect
+        if not self.overlaps(lo, hi):
+            return
         start = max(bisect.bisect_right(self._block_first_keys, lo) - 1, 0)
         for bi in range(start, len(self.index["blocks"])):
             for k, v in self._read_block(bi):
@@ -194,8 +312,20 @@ class SstReader:
                 yield k, v
 
 
+def output_is_bottommost(levels, out_level: int) -> bool:
+    """True iff a compaction writing into ``out_level`` produces the
+    bottommost NON-EMPTY level — i.e. no level strictly deeper holds
+    any run.  Only then may tombstones drop: any deeper run could hold
+    an older value of a deleted key, and dropping the tombstone above
+    it would resurrect that value on the next merge read.  The inline
+    cascade preserves this invariant implicitly; a task-based external
+    compactor (hummock ``CompactorService``) MUST consult it per task
+    (ref compactor_runner.rs:70 bottom-level check)."""
+    return all(not levels[j] for j in range(out_level + 1, len(levels)))
+
+
 class LsmTree:
-    """Leveled LSM over SST files with a JSON manifest.
+    """Leveled LSM over SST objects with a JSON manifest.
 
     Structure (ref Hummock levels + compactor, compactor_runner.rs:70):
     - level 0: newest-first list of overlapping runs (one per sealed
@@ -206,39 +336,55 @@ class LsmTree:
     merge into a new L1 run; when a level's run exceeds its byte
     budget (``base_bytes * ratio**(i-1)``), it merges into the next
     level.  Tombstones drop only when the output is the bottommost
-    populated level (deeper data could otherwise resurrect).  All
-    decisions are deterministic functions of the manifest — the
-    compaction determinism test replays byte-for-byte.
+    non-empty level (``output_is_bottommost`` — deeper data could
+    otherwise resurrect).  All decisions are deterministic functions
+    of the manifest — the compaction determinism test replays
+    byte-for-byte.
+
+    With ``auto_compact=False`` the write path never merges: a
+    background driver calls ``compact_one`` (the hummock compactor
+    split).  All I/O goes through ``self.store`` (default: local
+    filesystem rooted at ``root``).
     """
+
+    _MANIFEST = "LSM_MANIFEST.json"
 
     def __init__(self, root: str, cache: "BlockCache | None" = None,
                  l0_trigger: int = 4, base_bytes: int = 4 << 20,
-                 ratio: int = 8):
+                 ratio: int = 8, *, store=None, auto_compact: bool = True,
+                 metrics=None,
+                 bloom_bits_per_key: int = DEFAULT_BLOOM_BITS_PER_KEY):
+        from risingwave_tpu.storage.hummock.object_store import (
+            LocalFsObjectStore,
+        )
         self.root = root
         self.cache = cache
         self.l0_trigger = l0_trigger
         self.base_bytes = base_bytes
         self.ratio = ratio
-        os.makedirs(root, exist_ok=True)
-        self._manifest_path = os.path.join(root, "LSM_MANIFEST.json")
-        if os.path.exists(self._manifest_path):
-            with open(self._manifest_path) as f:
-                self.m = json.load(f)
+        self.auto_compact = auto_compact
+        self.metrics = metrics
+        self.bloom_bits_per_key = bloom_bits_per_key
+        self.store = store if store is not None \
+            else LocalFsObjectStore(root)
+        #: merge I/O performed by THIS object (the write-path purity
+        #: assertion surface: with auto_compact=False it stays 0)
+        self.compactions_run = 0
+        if self.store.exists(self._MANIFEST):
+            self.m = json.loads(self.store.get(self._MANIFEST))
         else:
             self.m = {"seq": 0, "levels": [[]]}
         self._readers: dict[str, SstReader] = {}
 
     # -- manifest -------------------------------------------------------
-    def _store(self) -> None:
-        tmp = self._manifest_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(self.m, f, indent=1)
-        os.replace(tmp, self._manifest_path)
+    def _store_manifest(self) -> None:
+        self.store.put(self._MANIFEST, json.dumps(self.m, indent=1)
+                       .encode())
 
     def _reader(self, path: str) -> SstReader:
         r = self._readers.get(path)
         if r is None:
-            r = SstReader(os.path.join(self.root, path), self.cache)
+            r = SstReader(store=self.store, key=path, cache=self.cache)
             self._readers[path] = r
         return r
 
@@ -249,47 +395,60 @@ class LsmTree:
     # -- writes ---------------------------------------------------------
     def write_batch(self, pairs: list[tuple[bytes, bytes]]) -> None:
         """Seal one sorted batch as a new L0 run (the shared-buffer →
-        SST upload); deletes pass TOMBSTONE values."""
+        SST upload); deletes pass TOMBSTONE values.  Performs no merge
+        I/O itself unless ``auto_compact``."""
         if not pairs:
             return
         pairs = sorted(pairs)
         path = self._new_path()
-        write_sst(os.path.join(self.root, path),
-                  [k for k, _ in pairs], [v for _, v in pairs])
+        data, _ = build_sst_bytes(
+            [k for k, _ in pairs], [v for _, v in pairs],
+            bloom_bits_per_key=self.bloom_bits_per_key,
+        )
+        self.store.put(path, data)
         self.m["levels"][0].insert(0, path)
-        self._store()
-        self.maybe_compact()
+        self._store_manifest()
+        if self.auto_compact:
+            self.maybe_compact()
 
     def delete_batch(self, keys: list[bytes]) -> None:
         self.write_batch([(k, TOMBSTONE) for k in keys])
 
     # -- compaction -----------------------------------------------------
     def _level_bytes(self, i: int) -> int:
-        return sum(
-            os.path.getsize(os.path.join(self.root, p))
-            for p in self.m["levels"][i]
-        )
+        return sum(self.store.size(p) for p in self.m["levels"][i])
+
+    def l0_depth(self) -> int:
+        return len(self.m["levels"][0])
+
+    def pending_compaction(self) -> int | None:
+        """The deterministic policy: the input level of the next due
+        compaction, or None at quiescence."""
+        levels = self.m["levels"]
+        if len(levels[0]) >= self.l0_trigger:
+            return 0
+        for i in range(1, len(levels)):
+            budget = self.base_bytes * self.ratio ** (i - 1)
+            if levels[i] and self._level_bytes(i) > budget:
+                return i
+        return None
+
+    def compact_one(self) -> bool:
+        """Run at most ONE compaction task (the external-driver step);
+        returns whether anything was compacted."""
+        i = self.pending_compaction()
+        if i is None:
+            return False
+        self._compact_into(i)
+        return True
 
     def maybe_compact(self) -> int:
         """Run the deterministic policy to quiescence; returns the
         number of compactions performed."""
         n = 0
-        while True:
-            levels = self.m["levels"]
-            if len(levels[0]) >= self.l0_trigger:
-                self._compact_into(0)
-                n += 1
-                continue
-            done = True
-            for i in range(1, len(levels)):
-                budget = self.base_bytes * self.ratio ** (i - 1)
-                if levels[i] and self._level_bytes(i) > budget:
-                    self._compact_into(i)
-                    n += 1
-                    done = False
-                    break
-            if done:
-                return n
+        while self.compact_one():
+            n += 1
+        return n
 
     def _compact_into(self, i: int) -> None:
         """Merge level i (+ the existing run of level i+1) into a new
@@ -298,30 +457,40 @@ class LsmTree:
         while len(levels) <= i + 1:
             levels.append([])
         inputs = list(levels[i]) + list(levels[i + 1])
-        bottommost = all(not levels[j] for j in range(i + 2, len(levels)))
+        # tombstones drop ONLY into the bottommost non-empty level;
+        # deeper runs may hold older values a dropped tombstone would
+        # resurrect (the task-based compactor hits this case routinely:
+        # L0→L1 while L2 holds data)
+        bottommost = output_is_bottommost(levels, i + 1)
         readers = [self._reader(p) for p in inputs]
         keys: list[bytes] = []
         vals: list[bytes] = []
+        in_bytes = 0
         for k, v in merge_scan(readers, keep_tombstones=not bottommost):
             keys.append(k)
             vals.append(v)
+            in_bytes += len(k) + len(v)
         if keys:
             out_path = self._new_path()
-            write_sst(os.path.join(self.root, out_path), keys, vals)
+            data, _ = build_sst_bytes(
+                keys, vals, bloom_bits_per_key=self.bloom_bits_per_key)
+            self.store.put(out_path, data)
             levels[i + 1] = [out_path]
         else:
             # everything tombstoned away: no output run, no orphan file
             levels[i + 1] = []
         levels[i] = []
-        self._store()
+        self._store_manifest()
+        self.compactions_run += 1
+        if self.metrics is not None:
+            self.metrics.inc("storage_compaction_tasks_total",
+                             level=str(i))
+            self.metrics.inc("storage_compaction_bytes_total", in_bytes)
         for p in inputs:
             r = self._readers.pop(p, None)
             if r is not None:
                 r.close()
-            try:
-                os.remove(os.path.join(self.root, p))
-            except OSError:
-                pass
+            self.store.delete(p)
 
     # -- reads ----------------------------------------------------------
     def _all_readers(self) -> list[SstReader]:
@@ -333,7 +502,18 @@ class LsmTree:
 
     def get(self, key: bytes) -> bytes | None:
         for r in self._all_readers():
+            # bloom + key-range prune before any block I/O
+            if not r.may_contain(key):
+                if self.metrics is not None:
+                    self.metrics.inc("storage_bloom_filter_total",
+                                     result="skip")
+                continue
             v = r.get(key)
+            if self.metrics is not None:
+                self.metrics.inc(
+                    "storage_bloom_filter_total",
+                    result="hit" if v is not None else "miss",
+                )
             if v is not None:
                 return None if v == TOMBSTONE else v
         return None
@@ -354,11 +534,14 @@ def merge_scan(readers: list[SstReader], lo: bytes = b"",
                hi: bytes | None = None, keep_tombstones: bool = False):
     """K-way merge over SSTs, newest FIRST in ``readers``; per key the
     newest value wins; tombstones suppress (ref MergeIterator,
-    src/storage/src/hummock/iterator/merge_inner.rs:62)."""
+    src/storage/src/hummock/iterator/merge_inner.rs:62).  Readers whose
+    key range misses [lo, hi) never open a block."""
     import heapq
 
     iters = []
     for gen, r in enumerate(readers):
+        if not r.overlaps(lo, hi):
+            continue
         it = r.scan(lo, hi)
         first = next(it, None)
         if first is not None:

@@ -179,6 +179,14 @@ class StreamingJob:
         #: checkpoints between in-memory snapshot copies
         self.snapshot_interval = 1
         self._ckpts_since_snapshot = 0
+        #: storage-service backpressure (the Hummock write-limit
+        #: contract): when set, every barrier crossing first calls
+        #: this hook, which blocks while the storage L0 is deeper than
+        #: its stall threshold — ingest yields to the compactor
+        #: instead of burying it.  Returns seconds stalled.
+        self.write_stall_hook = None
+        #: cumulative seconds this job spent write-stalled
+        self.stall_seconds = 0.0
         self.states = fragment.init_states()
         self.epoch = EpochPair.first()
         self.barriers_seen = 0
@@ -307,6 +315,11 @@ class StreamingJob:
             )
         if barrier.mutation is not None:
             self._apply_mutation(barrier.mutation)
+        if self.write_stall_hook is not None:
+            # the barrier loop is the ingest clock: stalling HERE (not
+            # per chunk) applies backpressure at epoch granularity
+            # without touching the fused steady-state dispatch
+            self.stall_seconds += self.write_stall_hook()
 
         epoch_val = barrier.epoch.prev.value
         self.states, outs, self._counters = self.fragment.barrier(

@@ -1,0 +1,179 @@
+"""Compaction stress: sustained ingest + concurrent serving reads.
+
+The acceptance harness for the storage-service split (ISSUE 1): with
+the background ``CompactorService`` running,
+
+- the ingest path performs ZERO merge I/O (``write_path_merges == 0``
+  — compaction happens only in the service),
+- the write-stall contract keeps the observed L0 run count at or
+  below the stall threshold,
+- concurrent serving reads through pinned versions see a consistent
+  view with zero errors while the compactor rewrites levels and
+  vacuum deletes their inputs underneath them,
+- after a final vacuum the object store holds exactly the SSTs
+  referenced by live versions.
+
+Run standalone (prints one JSON summary line)::
+
+    python scripts/compaction_stress.py --seconds 20
+
+or the short ``slow``-marked pytest wrapper
+(tests/test_hummock.py::test_compaction_stress_short).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import struct
+import sys
+import threading
+import time
+
+sys.path.insert(0, ".")  # repo root
+
+from risingwave_tpu.common.metrics import MetricsRegistry  # noqa: E402
+from risingwave_tpu.storage.hummock import (  # noqa: E402
+    CompactorService,
+    HummockStorage,
+    InMemObjectStore,
+)
+from risingwave_tpu.storage.hummock.store import SST_PREFIX  # noqa: E402
+
+
+def _k(i: int) -> bytes:
+    return struct.pack(">Q", i)
+
+
+def run(seconds: float = 20.0, batch_rows: int = 256,
+        key_space: int = 50_000, l0_trigger: int = 4,
+        stall_l0: int = 12, vacuum_every_s: float = 0.5) -> dict:
+    metrics = MetricsRegistry()
+    storage = HummockStorage(
+        InMemObjectStore(), metrics=metrics, l0_trigger=l0_trigger,
+        base_bytes=1 << 16, ratio=4, stall_l0=stall_l0,
+    )
+    svc = CompactorService(storage, poll_interval_s=0.001).start()
+
+    stop = threading.Event()
+    state = {
+        "read_errors": 0, "reads": 0, "scans": 0,
+        "max_l0_observed": 0, "batches": 0, "stall_s": 0.0,
+        "vacuum_deleted": 0,
+    }
+    #: committed model, guarded: readers verify against it
+    model: dict[bytes, bytes] = {}
+    model_lock = threading.Lock()
+
+    def reader_loop():
+        # race-free serving invariant: everything read under ONE pin
+        # is immutable — scans repeat identically and point gets agree
+        # with the scan, no matter what the compactor/vacuum/writer do
+        # concurrently.  Any exception is a read error too.
+        while not stop.is_set():
+            try:
+                with storage.pin() as pv:
+                    a = list(pv.scan(_k(0), _k(512)))
+                    for k, v in a[:32]:
+                        state["reads"] += 1
+                        if pv.get(k) != v:
+                            state["read_errors"] += 1
+                    if list(pv.scan(_k(0), _k(512))) != a:
+                        state["read_errors"] += 1
+                    state["scans"] += 1
+            except Exception:
+                state["read_errors"] += 1
+
+    def vacuum_loop():
+        while not stop.is_set():
+            try:
+                state["vacuum_deleted"] += storage.vacuum()
+            except Exception:
+                state["read_errors"] += 1
+            stop.wait(vacuum_every_s)
+
+    readers = [threading.Thread(target=reader_loop, daemon=True)
+               for _ in range(2)]
+    vac = threading.Thread(target=vacuum_loop, daemon=True)
+    for t in readers:
+        t.start()
+    vac.start()
+
+    deadline = time.monotonic() + seconds
+    step = 0
+    while time.monotonic() < deadline:
+        step += 1
+        base = (step * batch_rows) % key_space
+        pairs = [(_k((base + j) % key_space),
+                  f"s{step}".encode()) for j in range(batch_rows)]
+        storage.write_batch(pairs, epoch=step)
+        with model_lock:
+            model.update(pairs)
+        if step % 13 == 0:
+            dels = [_k((base + j) % key_space)
+                    for j in range(0, batch_rows, 7)]
+            storage.delete_batch(dels, epoch=step)
+            with model_lock:
+                for d in dels:
+                    model.pop(d, None)
+        # the write-stall contract: ingest yields to the compactor
+        state["stall_s"] += storage.wait_below_stall(timeout=10.0)
+        state["batches"] = step
+        state["max_l0_observed"] = max(state["max_l0_observed"],
+                                       storage.l0_depth())
+
+    stop.set()
+    for t in readers:
+        t.join(timeout=5)
+    vac.join(timeout=5)
+    svc.stop()
+    svc.drain()
+
+    # final verification: full scan equals the committed model
+    got = dict(storage.scan())
+    want = dict(sorted(model.items()))
+    mismatches = sum(1 for k in want if got.get(k) != want[k])
+    mismatches += sum(1 for k in got if k not in want)
+    state["read_errors"] += mismatches
+    storage.vacuum()
+    live = set(storage.store.list(SST_PREFIX))
+    orphans = live - storage.versions.referenced_keys()
+
+    summary = {
+        **state,
+        "seconds": seconds,
+        "stall_l0": stall_l0,
+        "verified_rows": len(want),
+        "final_mismatches": mismatches,
+        "orphan_objects_after_vacuum": len(orphans),
+        "compactor_tasks": svc.tasks_run,
+        "compactor_errors": svc.errors,
+        "write_path_merges": storage.write_path_merges,
+        "final_l0": storage.l0_depth(),
+        "stalled_final": storage.stalled(),
+        "storage": storage.stats(),
+    }
+    return summary
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--seconds", type=float, default=20.0)
+    p.add_argument("--batch-rows", type=int, default=256)
+    p.add_argument("--key-space", type=int, default=50_000)
+    p.add_argument("--l0-trigger", type=int, default=4)
+    p.add_argument("--stall-l0", type=int, default=12)
+    args = p.parse_args()
+    summary = run(seconds=args.seconds, batch_rows=args.batch_rows,
+                  key_space=args.key_space, l0_trigger=args.l0_trigger,
+                  stall_l0=args.stall_l0)
+    print(json.dumps(summary))
+    ok = (summary["read_errors"] == 0
+          and summary["max_l0_observed"] <= summary["stall_l0"]
+          and summary["write_path_merges"] == 0
+          and summary["orphan_objects_after_vacuum"] == 0)
+    raise SystemExit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

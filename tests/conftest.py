@@ -23,3 +23,10 @@ import jax  # noqa: E402
 # interpreter start; force pure-CPU here so tests never touch the tunnel
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running stress tests excluded from the tier-1 run",
+    )
