@@ -183,6 +183,38 @@ def hash64_columns(columns: Sequence, seed: int = 0) -> jnp.ndarray:
     return jnp.where(state == ~np.uint64(0), ~np.uint64(1), state)
 
 
+def hash64_partial(columns: Sequence, seed: int = 0) -> jnp.ndarray:
+    """Unfinalized mix state after folding ``columns``.
+
+    Split-hash support for probes that re-derive a compound hash per
+    iteration (the fused (hash, rank) join probe): fold the expensive
+    prefix once, then ``hash64_extend`` the varying suffix column and
+    ``hash64_finish`` per probe round.  The composition
+    ``hash64_finish(hash64_extend(hash64_partial([a]), b))`` is EXACTLY
+    ``hash64_columns([a, b])`` — entries placed by one are found by the
+    other."""
+    state = None
+    for raw in columns:
+        for col in normalize_null_col(raw):
+            state = _hash64_one(col, state, seed)
+    if state is None:
+        raise ValueError("no key columns")
+    return state
+
+
+def hash64_extend(state: jnp.ndarray, col) -> jnp.ndarray:
+    """Fold one more column into a ``hash64_partial`` state."""
+    out = state
+    for c in normalize_null_col(col):
+        out = _hash64_one(c, out, 0)
+    return out
+
+
+def hash64_finish(state: jnp.ndarray) -> jnp.ndarray:
+    """Finalize a partial state (the sentinel remap of hash64_columns)."""
+    return jnp.where(state == ~np.uint64(0), ~np.uint64(1), state)
+
+
 def _hash64_one(col, state, seed):
     if isinstance(col, StrCol):
         cap, width = col.data.shape

@@ -25,6 +25,7 @@ VNODE_COUNT, then vnode→shard by contiguous ranges), so elastic rescale
 
 from __future__ import annotations
 
+import inspect
 from typing import Sequence
 
 import jax
@@ -32,6 +33,30 @@ import jax.numpy as jnp
 
 from risingwave_tpu.common.chunk import Chunk, NCol, StrCol
 from risingwave_tpu.common.hash import VNODE_COUNT, compute_vnodes
+
+try:  # jax >= 0.8 (top-level export)
+    from jax import shard_map as _shard_map_impl
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+#: the replication/varying-manual-axes check kwarg was renamed across
+#: jax releases (check_rep -> check_vma); resolve the spelling once so
+#: every shard_map site works on whichever jax the container bakes in
+_CHECK_KW = next(
+    (kw for kw in ("check_vma", "check_rep")
+     if kw in inspect.signature(_shard_map_impl).parameters),
+    None,
+)
+
+
+def shard_map_nocheck(body, *, mesh, in_specs, out_specs):
+    """``shard_map`` with the replication check disabled, under whatever
+    keyword this jax spells it (check_vma / check_rep) — the per-shard
+    streaming bodies intentionally mix replicated and varying values."""
+    kw = {_CHECK_KW: False} if _CHECK_KW else {}
+    return _shard_map_impl(
+        body, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+    )
 
 
 def shard_of_vnode(vnodes: jnp.ndarray, n_shards: int,

@@ -105,6 +105,30 @@ def inline_udfs(stmt, udfs: dict, depth: int = 0):
     return _ast_map(stmt, expand)
 
 
+def _empty_chunk(schema: Schema, cap: int) -> Chunk:
+    """All-invalid chunk prototype (shape-only trace input for audits)."""
+    import jax.numpy as jnp
+
+    from risingwave_tpu.common.chunk import NCol, StrCol
+
+    cols = []
+    for f in schema:
+        if f.data_type.is_string:
+            col = StrCol(
+                jnp.zeros((cap, f.str_width), jnp.uint8),
+                jnp.zeros((cap,), jnp.int32),
+            )
+        else:
+            col = jnp.zeros((cap,), f.data_type.physical_dtype)
+        if f.nullable:
+            col = NCol(col, jnp.zeros((cap,), jnp.bool_))
+        cols.append(col)
+    return Chunk(
+        tuple(cols), jnp.zeros((cap,), jnp.int8),
+        jnp.zeros((cap,), jnp.bool_), schema,
+    )
+
+
 def _join_exchange_keys(key_exprs, chunk):
     """Evaluate join keys for vnode routing, nullability-normalized.
 
@@ -1470,6 +1494,124 @@ class Engine:
         (ref §3.5: meta-driven recovery across all streaming jobs)."""
         for job in self.jobs:
             job.recover()
+
+    def collect_join_metrics(self) -> None:
+        """Export join-path observability into the Prometheus registry.
+
+        ONE device readback per join node (gauges are snapshots, not
+        stream counters), so this runs on demand — the scrape/ctl
+        surface and tests call it; the steady-state loop never does
+        (a sync readback stalls async dispatch; see bench.py).
+
+        Gauges per join node:
+        - ``join_probe_calls_per_chunk``: trace-time lookup_or_insert
+          calls in the compiled update path (the fused (hash, rank)
+          probe keeps this at 1 per append-only side);
+        - ``join_probe_iters_per_chunk``: device probe-loop trips;
+        - ``join_pool_occupancy``: bump-allocator fill of each pool
+          side (live cursor / capacity);
+        - ``join_emit_window_fill_ratio``: staged emission rows over
+          drained window capacity (small = oversized out_capacity);
+        - ``join_drain_windows_per_chunk``: emission windows per probe
+          chunk (1 = no amplification re-dispatch).
+        """
+        import numpy as _np
+
+        from risingwave_tpu.stream.hash_join import PoolSideState
+
+        for job in self.jobs:
+            if not isinstance(job, DagJob):
+                continue
+            for idx, node in enumerate(job.nodes):
+                if not isinstance(node, JoinNode):
+                    continue
+                jstate = job.states[idx]
+                if not hasattr(jstate, "chunks"):
+                    continue  # non-HashJoin two-input node
+                labels = {"job": job.name, "node": str(idx)}
+                chunks = max(int(_np.asarray(jstate.chunks)), 1)
+                self.metrics.set_gauge(
+                    "join_probe_iters_per_chunk",
+                    float(_np.asarray(jstate.probe_iters)) / chunks,
+                    **labels,
+                )
+                out_cap = node.join.out_capacity
+                windows = max(int(_np.asarray(jstate.emit_windows)), 1)
+                self.metrics.set_gauge(
+                    "join_emit_window_fill_ratio",
+                    float(_np.asarray(jstate.emit_rows))
+                    / (windows * out_cap),
+                    **labels,
+                )
+                self.metrics.set_gauge(
+                    "join_drain_windows_per_chunk",
+                    windows / chunks, **labels,
+                )
+                for side_name in ("left", "right"):
+                    s = getattr(jstate, side_name)
+                    if not isinstance(s, PoolSideState):
+                        continue
+                    from risingwave_tpu.stream.hash_join import (
+                        _pool_capacity,
+                    )
+                    self.metrics.set_gauge(
+                        "join_pool_occupancy",
+                        float(_np.asarray(s.pool_len))
+                        / _pool_capacity(s.rows),
+                        side=side_name, **labels,
+                    )
+
+    def audit_join_probe_counts(self) -> dict:
+        """Trace each join's append-only update path and record how
+        many table probes the compiled program performs per chunk —
+        the regression guard behind the fused (hash, rank) design
+        (exactly ONE lookup_or_insert per append-only side per chunk).
+
+        Pure trace (jax.eval_shape — nothing executes, no state is
+        touched).  Returns ``{(job, node_idx, side):
+        {"lookup_or_insert": n, "lookup": m}}`` and exports each count
+        as a ``join_probe_calls_per_chunk`` gauge."""
+        import jax as _jax
+
+        from risingwave_tpu.state.hash_table import (
+            PROBE_STATS,
+            reset_probe_stats,
+        )
+
+        out: dict = {}
+        for job in self.jobs:
+            if not isinstance(job, DagJob):
+                continue
+            for idx, node in enumerate(job.nodes):
+                if not isinstance(node, JoinNode):
+                    continue
+                join = node.join
+                if not hasattr(join, "storage_of"):
+                    continue
+                for side in ("left", "right"):
+                    if join.storage_of(side) != "pool":
+                        continue
+                    schema = join.left_schema if side == "left" \
+                        else join.right_schema
+                    keys = join.left_keys if side == "left" \
+                        else join.right_keys
+                    clean = getattr(join, f"{side}_clean", None)
+                    proto = _empty_chunk(schema, 4)
+                    sstate = getattr(job.states[idx], side)
+                    reset_probe_stats()
+                    _jax.eval_shape(
+                        lambda s, c, keys=keys, clean=clean:
+                            join._update_side_pool(s, c, keys, clean),
+                        sstate, proto,
+                    )
+                    stats = dict(PROBE_STATS)
+                    out[(job.name, idx, side)] = stats
+                    self.metrics.set_gauge(
+                        "join_probe_calls_per_chunk",
+                        stats["lookup_or_insert"],
+                        job=job.name, node=str(idx), side=side,
+                    )
+        return out
 
     # -- storage service (Hummock-lite) ---------------------------------
     def start_storage_service(self) -> None:

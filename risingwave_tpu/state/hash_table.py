@@ -41,7 +41,26 @@ import jax.numpy as jnp
 import numpy as np
 
 from risingwave_tpu.common.chunk import NCol, StrCol
-from risingwave_tpu.common.hash import hash64_columns
+from risingwave_tpu.common.hash import (
+    hash64_columns,
+    hash64_extend,
+    hash64_finish,
+    hash64_partial,
+)
+
+#: trace-time probe accounting: how many table-probe loops a compiled
+#: program contains.  Incremented while TRACING (each jitted program
+#: traces once), so wrapping a trace of an update function between
+#: ``reset_probe_stats()`` and a read yields exactly the per-dispatch
+#: probe-call count of the compiled artifact — the regression guard for
+#: "one lookup_or_insert per side per chunk" (scripts/profile_q8.py
+#: --assert and tests/test_join_pool_fused.py).
+PROBE_STATS = {"lookup": 0, "lookup_or_insert": 0}
+
+
+def reset_probe_stats() -> None:
+    for k in PROBE_STATS:
+        PROBE_STATS[k] = 0
 
 
 def _gather_key(col, idx):
@@ -216,6 +235,7 @@ class HashTable:
     # ------------------------------------------------------------------
     def _probe(self, key_cols: Sequence, valid: jnp.ndarray, insert: bool,
                hashes: jnp.ndarray | None = None):
+        PROBE_STATS["lookup_or_insert" if insert else "lookup"] += 1
         size = self.size
         cap = valid.shape[0]
         if hashes is None:
@@ -353,3 +373,323 @@ class HashTable:
         """Key column values at ``slots`` (drop-sentinel aware gathers)."""
         return tuple(_gather_key(c, jnp.minimum(slots, self.size - 1))
                      for c in self.key_cols)
+
+
+# ---------------------------------------------------------------------------
+# TagTable: the fused (key-hash, rank) table behind pool join sides.
+# ---------------------------------------------------------------------------
+
+#: reserved tag values (the tag hash remaps into [2, 2^64))
+EMPTY_TAG = np.uint64(0)
+TOMB_TAG = np.uint64(1)
+
+
+def pair_tag(hashes: jnp.ndarray, rank: jnp.ndarray) -> jnp.ndarray:
+    """The 64-bit identity tag of a ``(key-hash, rank)`` pair.
+
+    ``hash64_columns([h, rank])`` remapped off the EMPTY/TOMB
+    sentinels.  The tag doubles as the slot hash (``tag % size``), so a
+    probe costs ONE random gather per iteration."""
+    return finish_tag(hash64_extend(hash64_partial([hashes]), rank))
+
+
+def finish_tag(state: jnp.ndarray) -> jnp.ndarray:
+    raw = hash64_finish(state)
+    return jnp.where(raw < np.uint64(2), raw + np.uint64(2), raw)
+
+
+@jax.tree_util.register_pytree_node_class
+class TagTable:
+    """Open-addressing table over ONE packed uint64 tag array.
+
+    The generic ``HashTable`` gathers occupied + tombstone + every key
+    column per probe iteration — ~5 random DRAM reads per row per
+    round, which IS the probe cost at multi-M-entry sizes.  Pool join
+    sides only ever key by ``(key-hash, rank)``, whose identity
+    compresses into a single 64-bit tag with reserved values for
+    empty/tombstone: a probe iteration is ONE gather, a claim ONE
+    scatter.  Tag collisions merge two (hash, rank) pairs with
+    probability ~n²/2⁶⁴ — the same order as the key-hash collisions
+    the pool design already accepts.
+
+    Value arrays (pool position, degree, clean key) live beside the
+    table in the executor state, addressed by slot.
+    """
+
+    __slots__ = ("tags", "size")
+
+    def __init__(self, tags: jnp.ndarray, size: int):
+        self.tags = tags
+        self.size = size
+
+    def tree_flatten(self):
+        return (self.tags,), self.size
+
+    @classmethod
+    def tree_unflatten(cls, size, children):
+        return cls(children[0], size)
+
+    @staticmethod
+    def create(size: int) -> "TagTable":
+        if size & (size - 1):
+            raise ValueError(f"size {size} must be a power of two")
+        return TagTable(jnp.zeros((size,), jnp.uint64), size)
+
+    # -- occupancy ------------------------------------------------------
+    @property
+    def occupied(self) -> jnp.ndarray:
+        return self.tags >= np.uint64(2)
+
+    def count(self) -> jnp.ndarray:
+        return jnp.sum((self.tags >= np.uint64(2)).astype(jnp.int32))
+
+    def tombstone_count(self) -> jnp.ndarray:
+        return jnp.sum((self.tags == TOMB_TAG).astype(jnp.int32))
+
+    # -- probes ---------------------------------------------------------
+    def _probe_tags(self, tag_vals: jnp.ndarray, valid: jnp.ndarray,
+                    insert: bool):
+        """Generic one-gather probe over precomputed tags.
+
+        Returns ``(tags', slots, found, inserted, overflow)``."""
+        PROBE_STATS["lookup_or_insert" if insert else "lookup"] += 1
+        size = self.size
+        cap = valid.shape[0]
+        row_idx = jnp.arange(cap, dtype=jnp.int32)
+        sentinel = jnp.int32(size)
+        home = (tag_vals % np.uint64(size)).astype(jnp.int32)
+        max_iters = min(size + 2, 1024)
+
+        def cond(carry):
+            _, _, done, _, _, iters = carry
+            return jnp.any(~done) & (iters < max_iters)
+
+        def body(carry):
+            tags, slots, done, inserted, off, iters = carry
+            cand = (home + off) % size
+            t = tags[cand]  # THE one random gather
+            tomb = t == TOMB_TAG
+            empty = t == EMPTY_TAG
+            match = t == tag_vals
+            hit = ~done & match
+            slots = jnp.where(hit, cand, slots)
+            done = done | hit
+            if insert:
+                want = ~done & empty
+                m = 4 * cap
+                scratch_idx = cand % m
+                claim = jnp.full((m,), cap, jnp.int32).at[
+                    jnp.where(want, scratch_idx, m)
+                ].min(jnp.where(want, row_idx, cap), mode="drop")
+                won = want & (claim[scratch_idx] == row_idx)
+                pos = jnp.where(won, cand, sentinel)
+                tags = tags.at[pos].set(tag_vals, mode="drop")
+                slots = jnp.where(won, cand, slots)
+                inserted = inserted | won
+                done = done | won
+                advance = ~done & ((~empty & ~match) | tomb)
+            else:
+                miss = ~done & empty
+                done = done | miss
+                advance = ~done & ((~empty & ~match) | tomb)
+            off = jnp.where(advance, off + 1, off)
+            return tags, slots, done, inserted, off, iters + 1
+
+        init = (
+            self.tags,
+            jnp.full((cap,), sentinel, jnp.int32),
+            ~valid,
+            jnp.zeros((cap,), jnp.bool_),
+            jnp.zeros((cap,), jnp.int32),
+            jnp.int32(0),
+        )
+        carry = body(init)
+        tags, slots, done, inserted, _, _ = jax.lax.while_loop(
+            cond, body, carry
+        )
+        overflow = ~done
+        found = valid & done & ~inserted & (slots < size)
+        return tags, slots, found, overflow, inserted
+
+    def lookup_pair_counted(self, hashes: jnp.ndarray, rank: jnp.ndarray,
+                            valid: jnp.ndarray):
+        """Find (hash, rank) entries; ``(slots, found, bound_count)``
+        with the probe-bound overflow folded to a loud counter (the
+        lookup_counted contract)."""
+        _, slots, found, overflow, _ = self._probe_tags(
+            pair_tag(hashes, rank), valid, insert=False
+        )
+        return slots, found, jnp.sum((overflow & valid).astype(jnp.int64))
+
+    # -- the fused two-phase ranked insert ------------------------------
+    def lookup_or_insert_ranked(self, hashes: jnp.ndarray,
+                                chunk_rank: jnp.ndarray,
+                                degree: jnp.ndarray,
+                                valid: jnp.ndarray):
+        """Fused two-phase find-or-claim of ``(hash, degree[head] +
+        chunk_rank)`` — ONE probe loop replacing the former key-table +
+        rank-index pair of ``lookup_or_insert`` passes (the q8
+        join-update cost halver).
+
+        Each valid row resolves its key's HEAD entry ``(hash, 0)``,
+        reads the key's pre-chunk degree at the head slot, switches its
+        target to ``(hash, degree + chunk_rank)``, and find-or-claims
+        it, all in the same loop.  A row whose head chain hits
+        true-empty knows its key is absent (degree 0) and jumps
+        straight to phase 2; its ``chunk_rank == 0`` sibling claims the
+        head in the same loop.
+
+        ``degree`` is only read, never written — callers scatter the
+        per-key insert totals at the returned head slots afterwards, so
+        every row sees the PRE-chunk degree regardless of loop order.
+
+        Returns ``(table', slots, rank, head_slot, inserted, existed,
+        overflow, iters)``:
+
+        - ``slots int32 [cap]`` — resolved (hash, rank) slot (size
+          sentinel on overflow/invalid);
+        - ``rank int32 [cap]`` — resolved target rank;
+        - ``head_slot int32 [cap]`` — the key's (hash, 0) slot where
+          this row learned it (rows that claimed or matched the head;
+          size sentinel otherwise — every key's chunk_rank==0 row
+          always knows it);
+        - ``inserted bool [cap]`` — row claimed a fresh slot;
+        - ``existed bool [cap]`` — target entry was already present (a
+          stranded entry from an earlier overflow; callers overwrite
+          its payload and count the loss loudly);
+        - ``overflow bool [cap]`` — probe bound exhausted;
+        - ``iters int32 ()`` — loop trips (device probe-effort counter).
+        """
+        PROBE_STATS["lookup_or_insert"] += 1
+        size = self.size
+        cap = valid.shape[0]
+        row_idx = jnp.arange(cap, dtype=jnp.int32)
+        sentinel = jnp.int32(size)
+        # split hash: fold the 64-bit key hash once; re-finalize with
+        # the (varying) rank on phase switches only
+        base = hash64_partial([hashes])
+
+        def tag_of(r):
+            return finish_tag(hash64_extend(base, r))
+
+        max_iters = min(2 * size + 4, 1024)
+
+        def cond(carry):
+            done = carry[2]
+            iters = carry[-1]
+            return jnp.any(~done) & (iters < max_iters)
+
+        def body(carry):
+            (tags, slots, done, inserted, existed, phase2, target,
+             target_tag, head_slot, off, iters) = carry
+            cand = ((target_tag % np.uint64(size)).astype(jnp.int32)
+                    + off) % size
+            t = tags[cand]  # THE one random gather
+            tomb = t == TOMB_TAG
+            empty = t == EMPTY_TAG
+            match = t == target_tag
+
+            # -- phase 1: resolve the head (hash, 0) -------------------
+            p1 = ~done & ~phase2
+            head_hit = p1 & match
+            # gather degree only at head hits; other rows read slot 0
+            # (one hot cache line) instead of a random miss
+            d = degree[jnp.where(head_hit, cand, 0)]
+            new_rank = d + chunk_rank
+            head_slot = jnp.where(head_hit, cand, head_slot)
+            # degree-0 head hit with chunk_rank 0: the target IS the
+            # head entry, already present (stranded) — take it
+            done_h = head_hit & (new_rank == 0)
+            slots = jnp.where(done_h, cand, slots)
+            existed = existed | done_h
+            done = done | done_h
+            sw_hit = head_hit & (new_rank > 0)
+            # head absent (true empty terminates its chain): degree 0;
+            # rows with chunk_rank > 0 move on — their rank-0 sibling
+            # claims the head
+            sw_empty = p1 & empty & (chunk_rank > 0)
+            switched = sw_hit | sw_empty
+            phase2 = phase2 | switched
+            new_target = jnp.where(sw_hit, new_rank, chunk_rank)
+            target = jnp.where(switched, new_target, target)
+            target_tag = jnp.where(
+                switched, tag_of(new_target), target_tag
+            )
+            off = jnp.where(switched, 0, off)
+
+            # -- phase 2: find-or-claim (hash, target) -----------------
+            hit2 = ~done & phase2 & ~switched & match
+            slots = jnp.where(hit2, cand, slots)
+            existed = existed | hit2
+            done = done | hit2
+
+            # claims (same scratch-race as _probe): phase-1 rank-0 rows
+            # claim the head; phase-2 rows claim their target entry
+            want = ~done & ~switched & empty & (phase2 | (chunk_rank == 0))
+            m = 4 * cap
+            scratch_idx = cand % m
+            claim = jnp.full((m,), cap, jnp.int32).at[
+                jnp.where(want, scratch_idx, m)
+            ].min(jnp.where(want, row_idx, cap), mode="drop")
+            won = want & (claim[scratch_idx] == row_idx)
+            pos = jnp.where(won, cand, sentinel)
+            tags = tags.at[pos].set(target_tag, mode="drop")
+            slots = jnp.where(won, cand, slots)
+            head_slot = jnp.where(won & (target == 0), cand, head_slot)
+            inserted = inserted | won
+            done = done | won
+            advance = ~done & ~switched & ((~empty & ~match) | tomb)
+            off = jnp.where(advance, off + 1, off)
+            return (tags, slots, done, inserted, existed, phase2,
+                    target, target_tag, head_slot, off, iters + 1)
+
+        init = (
+            self.tags,
+            jnp.full((cap,), sentinel, jnp.int32),
+            ~valid,
+            jnp.zeros((cap,), jnp.bool_),
+            jnp.zeros((cap,), jnp.bool_),
+            jnp.zeros((cap,), jnp.bool_),
+            jnp.zeros((cap,), jnp.int32),
+            tag_of(jnp.zeros((cap,), jnp.int32)),
+            jnp.full((cap,), sentinel, jnp.int32),
+            jnp.zeros((cap,), jnp.int32),
+            jnp.int32(0),
+        )
+        # first round unrolled, as in _probe: most rows resolve both
+        # phases in a couple of rounds at sane load factors
+        carry = body(init)
+        (tags, slots, done, inserted, existed, _, target, _,
+         head_slot, _, iters) = jax.lax.while_loop(cond, body, carry)
+        overflow = ~done
+        table = TagTable(tags, size)
+        return (table, slots, target, head_slot, inserted,
+                existed & valid, overflow, iters)
+
+    # -- maintenance ----------------------------------------------------
+    def clear_where(self, pred: jnp.ndarray) -> "TagTable":
+        """Bulk-evict slots where ``pred [size]`` (state cleaning);
+        cleared slots become tombstones so probe chains stay intact."""
+        dead = pred & self.occupied
+        return TagTable(
+            jnp.where(dead, TOMB_TAG, self.tags), self.size
+        )
+
+    def clear_slots(self, slots: jnp.ndarray,
+                    mask: jnp.ndarray) -> "TagTable":
+        """Tombstone specific slots (e.g. un-claim on pool overflow)."""
+        pos = jnp.where(mask, slots, jnp.int32(self.size))
+        return TagTable(
+            self.tags.at[pos].set(TOMB_TAG, mode="drop"), self.size
+        )
+
+    def rehashed(self) -> tuple["TagTable", jnp.ndarray]:
+        """Rebuild without tombstones; ``(fresh, moved int32 [size])``
+        maps old slot -> new slot (size sentinel for dead slots) so
+        callers permute their per-slot value arrays alongside."""
+        live = self.occupied
+        fresh = TagTable.create(self.size)
+        tags, new_slots, _, _, _ = fresh._probe_tags(
+            self.tags, live, insert=True
+        )
+        return TagTable(tags, self.size), new_slots

@@ -51,10 +51,7 @@ from risingwave_tpu.stream.runtime import (
     rewind_spill_tier,
 )
 
-try:  # jax >= 0.8
-    from jax import shard_map as _shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map
+from risingwave_tpu.parallel.exchange import shard_map_nocheck
 
 #: a dataflow edge endpoint: ("source", name) or ("node", node_id)
 Ref = tuple
@@ -133,6 +130,9 @@ class DagJob:
         self._staged_hint = staged
         self.staged = False  # derived per-topology in _rebuild
         self._staged_progs: dict = {}
+        #: n-round fused programs (one dispatch per n scheduling rounds;
+        #: per-dispatch host overhead amortized n-fold), keyed by n
+        self._fused_multi: dict[int, Any] = {}
         self.maintenance_interval = 1
         self._ckpts_since_maintain = 0
         self.snapshot_interval = 1
@@ -191,6 +191,7 @@ class DagJob:
         self._barrier_prog = None
         self._maintain_prog = None
         self._staged_progs = {}
+        self._fused_multi = {}
         # staging is a property of the CURRENT topology: attach/merge
         # can grow a fused job past the depth where fused drain loops
         # blow up the compile — re-derive on every rebuild
@@ -483,9 +484,9 @@ class DagJob:
                         lambda x: x[None], tuple(new_states)
                     )
 
-            prog = jax.jit(_shard_map(
+            prog = jax.jit(shard_map_nocheck(
                 body, mesh=self.mesh, in_specs=(spec, spec),
-                out_specs=spec, check_vma=False,
+                out_specs=spec,
             ))
             return prog, fused
         if fused:
@@ -715,6 +716,74 @@ class DagJob:
                 rows += self.run_chunk(name)
         return rows
 
+    def run_chunks(self, n: int) -> int:
+        """n scheduling rounds in ONE dispatch when every source is
+        traceable.
+
+        The linear runtime's multi-chunk fusion (StreamingJob.
+        run_chunks, the q1 attribution fix) extended to DAGs: a
+        ``fori_loop`` over n rounds — each round generating and
+        propagating every source's chunks through the whole reachable
+        subgraph, join emission windows draining in the loop body's
+        device ``while_loop`` — amortizes the per-dispatch host cost
+        n-fold.  For q8's binary-join DAG that cost was 2n dispatches
+        per barrier (one per source chunk); now it is one.
+
+        Falls back to per-chunk dispatch for host-chunk sources,
+        staged plans (whose compile size must stay linear), and
+        sharded meshes (their per-shard base ordinals ride a different
+        calling convention)."""
+        if self.paused or n <= 0:
+            return 0
+        fusable = self.mesh is None and not self.staged and all(
+            hasattr(src, "impl") and hasattr(src, "next_base")
+            for src in self.sources.values()
+        ) and len(self.sources) > 0
+        if n == 1 or not fusable:
+            rows = 0
+            for _ in range(n):
+                rows += self.chunk_round()
+            return rows
+        prog = self._fused_multi.get(n)
+        if prog is None:
+            pulls = list(self._pulls)
+            readers = dict(self.sources)
+            strides = {
+                nm: readers[nm].cap * getattr(readers[nm], "num_splits", 1)
+                for nm, _ in pulls
+            }
+
+            def _multi(states, k0s):
+                def body(i, st):
+                    new_states = list(st)
+                    for nm, k in pulls:
+                        for rep in range(k):
+                            base = k0s[nm] + (i * k + rep) * strides[nm]
+                            chunk = readers[nm].impl(base, readers[nm].cap)
+                            self._propagate(
+                                new_states, [(("source", nm), chunk)]
+                            )
+                    return tuple(new_states)
+
+                return jax.lax.fori_loop(0, n, body, states)
+
+            prog = jax.jit(_multi, donate_argnums=(0,))
+            # bounded cache: chunks_per_barrier is runtime-mutable and
+            # each distinct n compiles a program — keep the newest few
+            if len(self._fused_multi) >= 4:
+                self._fused_multi.pop(next(iter(self._fused_multi)))
+            self._fused_multi[n] = prog
+        k0s = {}
+        rows = 0
+        for nm, k in self._pulls:
+            reader = self.sources[nm]
+            # next_base() consumed one cap block; skip the other n*k-1
+            k0s[nm] = jnp.int64(reader.next_base())
+            reader.offset += reader.cap * (n * k - 1)
+            rows += reader.cap * n * k
+        self.states = prog(self.states, k0s)
+        return rows
+
     # -- barrier program ------------------------------------------------
     def _flush_node(self, new_states: list, idx: int, epoch) -> None:
         """Flush one fragment node; emissions cross downstream nodes.
@@ -940,9 +1009,9 @@ class DagJob:
             counters = jax.lax.psum(counters, self.AXIS)
             return jax.tree.map(lambda x: x[None], new_states), counters
 
-        return jax.jit(_shard_map(
+        return jax.jit(shard_map_nocheck(
             body, mesh=self.mesh, in_specs=(spec, spec),
-            out_specs=(spec, P()), check_vma=False,
+            out_specs=(spec, P()),
         ))
 
     def _barrier_epoch_arg(self, sealed):
@@ -1000,9 +1069,9 @@ class DagJob:
                     out = self._maintain_impl(tuple(local))
                     return jax.tree.map(lambda x: x[None], out)
 
-                self._maintain_prog = jax.jit(_shard_map(
+                self._maintain_prog = jax.jit(shard_map_nocheck(
                     body, mesh=self.mesh, in_specs=(spec,),
-                    out_specs=spec, check_vma=False,
+                    out_specs=spec,
                 ))
         self.states = self._maintain_prog(self.states)
         if self._counters is None:
@@ -1137,13 +1206,13 @@ class DagJob:
             return jax.tree.map(lambda x: x[None], tuple(out_states))
 
         self._spill_progs[key] = (
-            jax.jit(_shard_map(
+            jax.jit(shard_map_nocheck(
                 drain_body, mesh=self.mesh, in_specs=(spec,),
-                out_specs=(spec, spec), check_vma=False,
+                out_specs=(spec, spec),
             ), donate_argnums=(0,)),
-            jax.jit(_shard_map(
+            jax.jit(shard_map_nocheck(
                 inject_body, mesh=self.mesh, in_specs=(spec, spec),
-                out_specs=spec, check_vma=False,
+                out_specs=spec,
             ), donate_argnums=(0,)),
         )
 

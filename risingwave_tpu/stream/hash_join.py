@@ -78,6 +78,7 @@ from risingwave_tpu.common.types import Field, Schema
 from risingwave_tpu.expr.node import Expr
 from risingwave_tpu.state.hash_table import (
     HashTable,
+    TagTable,
     _scatter_key,
     gather_key,
 )
@@ -94,6 +95,16 @@ def _empty_store(f: Field, size: int, bucket: int):
     if f.nullable:
         return NCol(col, jnp.zeros((size, bucket), jnp.bool_))
     return col
+
+
+def _pool_capacity(rows: tuple) -> int:
+    """Row capacity of a pool side's flat stores (static shape)."""
+    store = rows[0]
+    while isinstance(store, NCol):
+        store = store.data
+    if isinstance(store, StrCol):
+        return store.lens.shape[0]
+    return store.shape[0]
 
 
 def _gather_bucket(store, slots):
@@ -128,6 +139,15 @@ def _scatter_rows(store, pos, col):
 
 def _rank_by(group: jnp.ndarray, active: jnp.ndarray) -> jnp.ndarray:
     """Stable rank of each active row among rows with equal ``group``."""
+    rank, _, _ = _rank_by_sorted(group, active)
+    return rank
+
+
+def _rank_by_sorted(group: jnp.ndarray, active: jnp.ndarray):
+    """``_rank_by`` that also returns its sort artifacts ``(rank,
+    order, seg_id)`` so callers can derive further per-group reductions
+    (``_totals_from_sort``) without paying a second argsort — the
+    chunk-sized sort is a fixed per-chunk cost worth amortizing."""
     cap = group.shape[0]
     key = jnp.where(active, group, jnp.uint64(0xFFFFFFFFFFFFFFFF))
     order = jnp.argsort(key, stable=True)
@@ -139,7 +159,20 @@ def _rank_by(group: jnp.ndarray, active: jnp.ndarray) -> jnp.ndarray:
         jnp.maximum, jnp.where(is_new, jnp.arange(cap, dtype=jnp.int32), 0)
     )
     rank_sorted = jnp.arange(cap, dtype=jnp.int32) - start
-    return jnp.zeros((cap,), jnp.int32).at[order].set(rank_sorted)
+    seg_id = jnp.cumsum(is_new) - 1
+    rank = jnp.zeros((cap,), jnp.int32).at[order].set(rank_sorted)
+    return rank, order, seg_id
+
+
+def _totals_from_sort(order, seg_id, values) -> jnp.ndarray:
+    """Per-row group total of ``values`` using a prior
+    ``_rank_by_sorted`` decomposition (no second sort)."""
+    cap = order.shape[0]
+    sums = jax.ops.segment_sum(
+        values[order].astype(jnp.int32), seg_id, num_segments=cap
+    )
+    totals_sorted = sums[seg_id]
+    return jnp.zeros((cap,), jnp.int32).at[order].set(totals_sorted)
 
 
 def _group_totals(group: jnp.ndarray, values: jnp.ndarray) -> jnp.ndarray:
@@ -169,38 +202,47 @@ class SideState(NamedTuple):
 
 
 class PoolSideState(NamedTuple):
-    """Degree-adaptive side storage: a SHARED row pool instead of dense
-    per-key buckets.
+    """Degree-adaptive side storage: ONE fused ``(key-hash, rank)``
+    table over a bump-allocated shared row pool.
 
     The reference stores unbounded rows per key behind ``JoinHashMap``
     (src/stream/src/executor/join/hash_join.rs:169); dense
     ``[size, bucket_cap]`` buckets cap hot keys (nexmark's hot sellers)
-    and waste HBM on cold ones.  TPU-first re-design: rows live in ONE
-    flat ``[pool]`` store addressed by an open-addressed INDEX keyed by
-    ``(join-key-hash, rank)`` — rank r of key k sits wherever the index
-    hashes (hash(k), r).  Properties:
+    and waste HBM on cold ones.  TPU-first re-design (round-6 fusion of
+    the former key table + rank index pair): the rank-r row of key k
+    owns the open-addressed entry for ``(hash(k), r)``, and the key's
+    rank-0 entry doubles as its HEAD — the per-key degree counter
+    ``count`` lives at the head slot.  Properties:
 
+    - ONE ``lookup_or_insert`` per chunk: the fused two-phase probe
+      (``HashTable.lookup_or_insert_ranked``) resolves head + target in
+      a single loop, where the old layout paid a key-table pass AND a
+      rank-index pass into separate 2^22-entry tables (the q8
+      attribution's dominant cost);
     - no per-key cap: a hot key may fill the whole pool;
     - O(1) vectorized random access by (key, rank) — exactly what the
       output-centric windowed emission gathers — with no chain walks
-      (pointer chasing is TPU-hostile; open addressing is one hash +
-      bounded vectorized probe);
-    - stable under key-table rehash (the index is keyed by the key's
-      HASH, not its slot);
-    - watermark cleaning via a per-row ``clean_vals`` copy of the
-      window key: closed windows clear by ONE vectorized mask.
+      (pointer chasing is TPU-hostile);
+    - pool rows claim CONTIGUOUS positions per chunk (``pool_len`` +
+      prefix-sum offsets, a bump allocator): the row-store scatters hit
+      a dense window instead of spraying the whole multi-M-row pool
+      (locality), and maintenance compacts dead rows wholesale;
+    - watermark cleaning via a per-slot ``slot_clean`` copy of the
+      window key: closed windows tombstone by ONE vectorized mask, and
+      their pool rows are reclaimed by the next compaction.
 
     Append-only sides only (the bench/windowed-join shape): deletes
     would need value→rank search; retractable sides keep the dense
     bucket layout.
     """
 
-    key_table: HashTable   # join key -> slot; degree = count[slot]
-    count: jnp.ndarray     # int32 [size] live rows per key
-    index: HashTable       # (key-hash u64, rank i32) -> pool position
+    table: TagTable        # packed (key-hash, rank) tags -> entry slot
+    count: jnp.ndarray     # int32 [size] key degree, kept at its head
+    pool_pos: jnp.ndarray  # int32 [size] entry slot -> pool position
+    slot_clean: jnp.ndarray  # int64 [size] watermark-cleaning key value
     rows: tuple            # [pool] stores, one per input column
-    clean_vals: jnp.ndarray  # int64 [pool] watermark-cleaning key value
-    overflow: jnp.ndarray  # int64 — rows that found no pool space
+    pool_len: jnp.ndarray  # int32 () bump-allocator cursor
+    overflow: jnp.ndarray  # int64 — rows that found no table/pool space
     inconsistency: jnp.ndarray  # int64 — retractions on append-only side
 
 
@@ -208,6 +250,13 @@ class JoinState(NamedTuple):
     left: SideState
     right: SideState
     emit_overflow: jnp.ndarray  # int64 — matches dropped by out capacity
+    # -- observability counters (device scalars; exported as Prometheus
+    # -- gauges by Engine.collect_join_metrics, never read in the hot
+    # -- loop) ---------------------------------------------------------
+    chunks: jnp.ndarray        # int64 — probe chunks applied
+    probe_iters: jnp.ndarray   # int64 — fused update-probe loop trips
+    emit_rows: jnp.ndarray     # int64 — staged emission rows (all wins)
+    emit_windows: jnp.ndarray  # int64 — emission windows drained
 
 
 class JoinEmit(NamedTuple):
@@ -391,17 +440,17 @@ class HashJoinExecutor:
                 return NCol(col, jnp.zeros((pool,), jnp.bool_))
             return col
 
+        # ONE fused tag table sized for the pool: total live entries ==
+        # live pool rows (a key's head IS its rank-0 entry), so the
+        # load factor matches the old rank index — and the old
+        # key-value key table is gone entirely
         return PoolSideState(
-            key_table=HashTable.create(
-                self._key_protos(schema, keys), size
-            ),
-            count=jnp.zeros((size,), jnp.int32),
-            index=HashTable.create(
-                [jnp.zeros((1,), jnp.uint64), jnp.zeros((1,), jnp.int32)],
-                pool,
-            ),
+            table=TagTable.create(pool),
+            count=jnp.zeros((pool,), jnp.int32),
+            pool_pos=jnp.zeros((pool,), jnp.int32),
+            slot_clean=jnp.zeros((pool,), jnp.int64),
             rows=tuple(flat_store(f) for f in schema),
-            clean_vals=jnp.zeros((pool,), jnp.int64),
+            pool_len=jnp.zeros((), jnp.int32),
             overflow=jnp.zeros((), jnp.int64),
             inconsistency=jnp.zeros((), jnp.int64),
         )
@@ -433,6 +482,10 @@ class HashJoinExecutor:
         return JoinState(
             left=left, right=right,
             emit_overflow=jnp.zeros((), jnp.int64),
+            chunks=jnp.zeros((), jnp.int64),
+            probe_iters=jnp.zeros((), jnp.int64),
+            emit_rows=jnp.zeros((), jnp.int64),
+            emit_windows=jnp.zeros((), jnp.int64),
         )
 
     # ------------------------------------------------------------------
@@ -542,17 +595,28 @@ class HashJoinExecutor:
         )
 
     def _update_side_pool(self, side: PoolSideState, chunk: Chunk,
-                          keys: Sequence[Expr], clean_spec):
-        """Apply an append-only chunk to a pool side: claim key slots,
-        assign each inserted row rank ``count[slot] + in-chunk rank``,
-        and place it at the index position of ``(key-hash, rank)``.
+                          keys: Sequence[Expr], clean_spec,
+                          key_cols=None, null_keys=None, h=None):
+        """Apply an append-only chunk to a pool side with ONE fused
+        (key-hash, rank) probe: each row resolves its key's head,
+        learns the pre-chunk degree, and claims the entry for
+        ``(hash, degree + in-chunk rank)`` in a single loop; pool rows
+        then take bump-allocated contiguous positions.
 
         Ranks stay contiguous per key (cleaning removes whole keys
-        only), so the emission's (key, j) addressing always lands."""
-        size = side.key_table.size
-        key_cols, null_keys = _null_stripped_keys(
-            [e.eval(chunk) for e in keys]
-        )
+        only), so the emission's (key, j) addressing always lands.
+
+        ``key_cols``/``null_keys``/``h`` accept the caller's already-
+        computed values (apply_begin hashes the same chunk for its
+        probe pass).
+
+        Returns ``(new_side, probe_iters int32)``."""
+        size = side.table.size
+        pool = _pool_capacity(side.rows)
+        if key_cols is None:
+            key_cols, null_keys = _null_stripped_keys(
+                [e.eval(chunk) for e in keys]
+            )
         signs = chunk.signs()
         joinable = chunk.valid if null_keys is None \
             else chunk.valid & ~null_keys
@@ -560,50 +624,63 @@ class HashJoinExecutor:
         # append-only contract: retractions are a loud inconsistency
         n_bad = jnp.sum((joinable & (signs < 0)).astype(jnp.int64))
 
-        h = hash64_columns(key_cols)
-        key_table, slots, _, overflow = side.key_table.lookup_or_insert(
-            key_cols, is_ins, hashes=h
+        if h is None:
+            h = hash64_columns(key_cols)
+        cr, sort_order, sort_seg = _rank_by_sorted(h, is_ins)
+        (table, slots, _, head_slot, inserted, existed, over,
+         iters) = side.table.lookup_or_insert_ranked(
+            h, cr, side.count, is_ins
         )
-        is_ins = is_ins & ~overflow
-        safe = jnp.minimum(slots, size - 1)
+        got = is_ins & ~over
+        # a target entry that already existed means a prior overflow
+        # stranded it while count stalled: this insert overwrites that
+        # live pool row.  Count it so maintenance fails loudly instead
+        # of silently losing a row.
+        n_overwrite = jnp.sum((got & existed).astype(jnp.int64))
 
-        # rank = pre-chunk degree + stable rank among this chunk's
-        # inserts of the same key
-        rank = side.count[safe] + _rank_by(slots.astype(jnp.uint64), is_ins)
-        index, pos, idx_new, over_idx = side.index.lookup_or_insert(
-            [h, rank], is_ins
-        )
-        got = is_ins & ~over_idx
-        # an (h, rank) entry that already existed means a prior index
-        # overflow stranded a higher-rank entry while count stalled:
-        # this insert overwrites that live pool row.  Count it so
-        # maintenance fails loudly instead of silently losing the row.
-        n_overwrite = jnp.sum((got & ~idx_new).astype(jnp.int64))
-        pool = side.index.size
-        tgt = jnp.where(got, jnp.minimum(pos, pool - 1), jnp.int32(pool))
+        # -- bump allocator: accepted rows take consecutive positions --
+        offs = jnp.cumsum(got, dtype=jnp.int32) - 1
+        pos = side.pool_len + offs
+        fits = pos < pool
+        dropped = got & ~fits
+        # un-claim entries whose row found no pool space (loud overflow)
+        table = table.clear_slots(slots, dropped & inserted)
+        got = got & fits
+        tgt = jnp.where(got, pos, jnp.int32(pool))
         rows = tuple(
             _scatter_key(store, tgt, col, pool)
             for store, col in zip(side.rows, chunk.columns)
         )
+        safe_slot = jnp.minimum(slots, size - 1)
+        spos = jnp.where(got, safe_slot, jnp.int32(size))
+        pool_pos = side.pool_pos.at[spos].set(tgt, mode="drop")
         if clean_spec is not None:
             ckey = key_cols[clean_spec[0]].astype(jnp.int64)
-            clean_vals = side.clean_vals.at[tgt].set(ckey, mode="drop")
+            slot_clean = side.slot_clean.at[spos].set(ckey, mode="drop")
         else:
-            clean_vals = side.clean_vals
+            slot_clean = side.slot_clean
+        # degree update: each key's rank-0 row (which always knows the
+        # head slot) scatters the key's accepted-insert total — every
+        # probe above saw the PRE-chunk degree.  Totals reuse the rank
+        # sort's decomposition: no second argsort.
+        rep = got & (cr == 0) & (head_slot < size)
+        key_tot = _totals_from_sort(sort_order, sort_seg, got)
         count = side.count.at[
-            jnp.where(got, safe, jnp.int32(size))
-        ].add(1, mode="drop")
-        n_over = jnp.sum((is_ins & over_idx).astype(jnp.int64)) + \
-            jnp.sum(overflow.astype(jnp.int64)) + n_overwrite
+            jnp.where(rep, head_slot, jnp.int32(size))
+        ].add(jnp.where(rep, key_tot, 0), mode="drop")
+        pool_len = side.pool_len + jnp.sum(got, dtype=jnp.int32)
+        n_over = jnp.sum((is_ins & over).astype(jnp.int64)) + \
+            jnp.sum(dropped.astype(jnp.int64)) + n_overwrite
         return PoolSideState(
-            key_table=key_table,
+            table=table,
             count=count,
-            index=index,
+            pool_pos=pool_pos,
+            slot_clean=slot_clean,
             rows=rows,
-            clean_vals=clean_vals,
+            pool_len=pool_len,
             overflow=side.overflow + n_over,
             inconsistency=side.inconsistency + n_bad,
-        )
+        ), iters
 
     def _bucket_row_hash(self, side: SideState, safe_slots) -> jnp.ndarray:
         """Row hashes of a side's buckets gathered at [cap] slots."""
@@ -640,32 +717,42 @@ class HashJoinExecutor:
 
         old_count = own.count  # own per-key row counts BEFORE the chunk
         own_clean = self.left_clean if side == "left" else self.right_clean
-        if self.storage_of(side) == "pool":
-            own2 = self._update_side_pool(own, chunk, keys, own_clean)
-        else:
-            own2 = self._update_side(own, chunk, keys)
-
         key_cols, null_keys = _null_stripped_keys(
             [e.eval(chunk) for e in keys]
         )
+        probe_hash = hash64_columns(key_cols)
+        upd_iters = jnp.zeros((), jnp.int32)
+        if self.storage_of(side) == "pool":
+            own2, upd_iters = self._update_side_pool(
+                own, chunk, keys, own_clean,
+                key_cols=key_cols, null_keys=null_keys, h=probe_hash,
+            )
+        else:
+            own2 = self._update_side(own, chunk, keys)
+
         signs = chunk.signs()
         active = chunk.valid & (signs != 0)
         joinable = active if null_keys is None else active & ~null_keys
 
         # probe the build (other) side: per-row key slot + live rows
-        bsize = other.key_table.size
-        probe_hash = hash64_columns(key_cols)
-        slots, found, probe_over = other.key_table.lookup_counted(
-            key_cols, joinable, hashes=probe_hash
-        )
-        safe = jnp.minimum(slots, bsize - 1)
         if self.storage_of("right" if side == "left" else "left") \
                 == "pool":
-            # pool build side: degree from the key table's count; rows
-            # are addressed at emission time by (key-hash, rank)
+            # pool build side: ONE fused-table probe of the key's HEAD
+            # entry (hash, 0) yields its degree; rows are addressed at
+            # emission time by (key-hash, rank)
+            bsize = other.table.size
+            slots, found, probe_over = other.table.lookup_pair_counted(
+                probe_hash, jnp.zeros((cap,), jnp.int32), joinable
+            )
+            safe = jnp.minimum(slots, bsize - 1)
             m = jnp.where(found, other.count[safe], 0).astype(jnp.int32)
             rank_to_idx = jnp.zeros((cap, 1), jnp.int32)
         else:
+            bsize = other.key_table.size
+            slots, found, probe_over = other.key_table.lookup_counted(
+                key_cols, joinable, hashes=probe_hash
+            )
+            safe = jnp.minimum(slots, bsize - 1)
             occ = other.occupied[safe] & found[:, None]        # [cap, B]
             m = jnp.sum(occ, axis=1).astype(jnp.int32)
             # rank -> bucket index of the k-th live row (occupied
@@ -699,10 +786,16 @@ class HashJoinExecutor:
             "right" if side == "left" else "left"
         )
         if other_pres:
-            oslots, ofound, _ = own2.key_table.lookup_counted(
-                key_cols, joinable
-            )
-            osafe = jnp.minimum(oslots, own2.key_table.size - 1)
+            if self.storage_of(side) == "pool":
+                oslots, ofound, _ = own2.table.lookup_pair_counted(
+                    probe_hash, jnp.zeros((cap,), jnp.int32), joinable
+                )
+                osafe = jnp.minimum(oslots, own2.table.size - 1)
+            else:
+                oslots, ofound, _ = own2.key_table.lookup_counted(
+                    key_cols, joinable
+                )
+                osafe = jnp.minimum(oslots, own2.key_table.size - 1)
             oldc = old_count[osafe]
             newc = own2.count[osafe]
             eligible = joinable & ofound
@@ -737,11 +830,20 @@ class HashJoinExecutor:
             down_end=down_end,
             total=U + P + S + D,
         )
+        total = U + P + S + D
         new_state = JoinState(
             left=own2 if side == "left" else state.left,
             right=own2 if side == "right" else state.right,
             emit_overflow=state.emit_overflow
             + probe_over.astype(jnp.int64),
+            chunks=state.chunks + 1,
+            probe_iters=state.probe_iters + upd_iters.astype(jnp.int64),
+            emit_rows=state.emit_rows + total.astype(jnp.int64),
+            # window 0 always materializes; amplified chunks drain
+            # ceil(total / out_capacity) windows
+            emit_windows=state.emit_windows + jnp.maximum(
+                (total + self.out_capacity - 1) // self.out_capacity, 1
+            ).astype(jnp.int64),
         )
         return new_state, pending
 
@@ -799,14 +901,20 @@ class HashJoinExecutor:
         build_rows, build_index = build_rows
         probe_bound = jnp.int64(0)
         if build_index is not None:
-            # pool build side: ONE vectorized (key-hash, rank) index
-            # lookup resolves every build row this window needs
+            # pool build side: ONE vectorized (key-hash, rank) fused-
+            # table lookup resolves every build row this window needs;
+            # the entry's pool_pos value addresses the bump-allocated
+            # row store
+            btable, bpool_pos = build_index
             need = in_pairs | in_trans
-            pool = build_index.size
-            pos, bfound, probe_bound = build_index.lookup_counted(
-                [p.probe_hash[r], j.astype(jnp.int32)], need
+            pool = _pool_capacity(build_rows)
+            bslot, bfound, probe_bound = btable.lookup_pair_counted(
+                p.probe_hash[r], j.astype(jnp.int32), need
             )
-            bpos = jnp.minimum(pos, pool - 1)
+            bpos = jnp.clip(
+                bpool_pos[jnp.minimum(bslot, btable.size - 1)],
+                0, pool - 1,
+            )
             # a needed-but-missing build row (pool overflow hole) is
             # dropped; the overflow counter already records the loss
             valid_out = valid_out & (~need | bfound)
@@ -897,11 +1005,12 @@ class HashJoinExecutor:
             probe_bound
 
     def build_rows_of(self, state: JoinState, side: str) -> tuple:
-        """(row stores, index-or-None) of the build side for
-        emit_window — the index addresses pool-stored rows."""
+        """(row stores, addressing-or-None) of the build side for
+        emit_window — pool sides address rows via the fused
+        (hash, rank) table + its pool_pos values."""
         build = state.right if side == "left" else state.left
         if isinstance(build, PoolSideState):
-            return build.rows, build.index
+            return build.rows, (build.table, build.pool_pos)
         return build.rows, None
 
     # ------------------------------------------------------------------
@@ -956,31 +1065,31 @@ class HashJoinExecutor:
             )
 
         def rebuild_pool(s: PoolSideState) -> PoolSideState:
-            # the index is keyed by the JOIN KEY's hash, so a key-table
-            # rehash never invalidates it — rebuild only the key table
-            fresh, moved = s.key_table.rehashed()
-            return PoolSideState(
-                key_table=fresh,
+            # pool rows are addressed INDIRECTLY through pool_pos, so a
+            # table rehash permutes only the dense per-slot companions —
+            # the multi-M-row stores never move here
+            fresh, moved = s.table.rehashed()
+            return s._replace(
+                table=fresh,
                 count=permute_dense(s.count, moved),
-                index=s.index,
-                rows=s.rows,
-                clean_vals=s.clean_vals,
-                overflow=s.overflow,
-                inconsistency=s.inconsistency,
+                pool_pos=permute_dense(s.pool_pos, moved),
+                slot_clean=permute_dense(s.slot_clean, moved),
             )
 
-        def rebuild_pool_index(s: PoolSideState) -> PoolSideState:
-            # cleaning tombstones the index too; relocate pool rows
-            # with their index entries once tombstones dominate
-            fresh, moved = s.index.rehashed()
-            return PoolSideState(
-                key_table=s.key_table,
-                count=s.count,
-                index=fresh,
+        def compact_pool(s: PoolSideState) -> PoolSideState:
+            # bump allocation never reuses positions: once enough rows
+            # are dead (cleaned keys / stranded overwrites), relocate
+            # the live rows to a dense prefix and reset the cursor
+            pool = _pool_capacity(s.rows)
+            occ = s.table.occupied
+            new_pos = jnp.cumsum(occ, dtype=jnp.int32) - 1
+            moved = jnp.full((pool,), pool, jnp.int32).at[
+                jnp.where(occ, s.pool_pos, pool)
+            ].set(jnp.where(occ, new_pos, pool), mode="drop")
+            return s._replace(
                 rows=tuple(permute_dense(r, moved) for r in s.rows),
-                clean_vals=permute_dense(s.clean_vals, moved),
-                overflow=s.overflow,
-                inconsistency=s.inconsistency,
+                pool_pos=jnp.where(occ, new_pos, s.pool_pos),
+                pool_len=jnp.sum(occ, dtype=jnp.int32),
             )
 
         sides = {}
@@ -988,12 +1097,14 @@ class HashJoinExecutor:
             s = getattr(state, name)
             if isinstance(s, PoolSideState):
                 s = jax.lax.cond(
-                    s.key_table.tombstone_count() > s.key_table.size // 4,
+                    s.table.tombstone_count() > s.table.size // 4,
                     rebuild_pool, lambda x: x, s,
                 )
+                pool = _pool_capacity(s.rows)
+                dead = s.pool_len - s.table.count()
                 s = jax.lax.cond(
-                    s.index.tombstone_count() > s.index.size // 4,
-                    rebuild_pool_index, lambda x: x, s,
+                    (s.pool_len >= pool - pool // 4) & (dead > pool // 8),
+                    compact_pool, lambda x: x, s,
                 )
                 sides[name] = s
             else:
@@ -1001,28 +1112,27 @@ class HashJoinExecutor:
                     s.key_table.tombstone_count() > s.key_table.size // 4,
                     rebuild, lambda x: x, s,
                 )
-        return JoinState(sides["left"], sides["right"], state.emit_overflow)
+        return state._replace(left=sides["left"], right=sides["right"])
 
     def clean_below(self, state: JoinState, side: str, key_col_idx: int,
                     threshold) -> JoinState:
         """Watermark state cleaning on a window key column (q8 pattern)."""
         s = getattr(state, side)
-        key = s.key_table.key_cols[key_col_idx]
-        stale = s.key_table.occupied & (key < threshold)
         if isinstance(s, PoolSideState):
-            # whole keys evict together (ranks stay contiguous); pool
-            # rows clear by their stored clean-key value in ONE mask
-            stale_pool = s.index.occupied & (s.clean_vals < threshold)
-            cleaned = PoolSideState(
-                key_table=s.key_table.clear_where(stale),
+            # the fused table stores (hash, rank), not raw keys — every
+            # entry carries its window-key value in slot_clean, so a
+            # whole closed window tombstones in ONE mask (heads and
+            # rank entries together: the window key is part of the join
+            # key, so all of a key's entries share the value).  Dead
+            # pool rows linger until the next compaction.
+            stale = s.table.occupied & (s.slot_clean < threshold)
+            cleaned = s._replace(
+                table=s.table.clear_where(stale),
                 count=jnp.where(stale, 0, s.count),
-                index=s.index.clear_where(stale_pool),
-                rows=s.rows,
-                clean_vals=s.clean_vals,
-                overflow=s.overflow,
-                inconsistency=s.inconsistency,
             )
         else:
+            key = s.key_table.key_cols[key_col_idx]
+            stale = s.key_table.occupied & (key < threshold)
             cleaned = SideState(
                 key_table=s.key_table.clear_where(stale),
                 rows=s.rows,
@@ -1032,5 +1142,5 @@ class HashJoinExecutor:
                 inconsistency=s.inconsistency,
             )
         if side == "left":
-            return JoinState(cleaned, state.right, state.emit_overflow)
-        return JoinState(state.left, cleaned, state.emit_overflow)
+            return state._replace(left=cleaned)
+        return state._replace(right=cleaned)

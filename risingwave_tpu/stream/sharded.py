@@ -20,10 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:  # jax >= 0.8
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from risingwave_tpu.parallel.exchange import shard_map_nocheck
 
 from risingwave_tpu.common.chunk import Chunk
 from risingwave_tpu.parallel.exchange import shuffle_chunk
@@ -73,21 +70,19 @@ class ShardedJob:
 
         spec = P(self.AXIS)
         self._step = jax.jit(
-            shard_map(
+            shard_map_nocheck(
                 self._local_step,
                 mesh=self.mesh,
                 in_specs=(spec, spec),
                 out_specs=spec,
-                check_vma=False,
             )
         )
         self._flush = jax.jit(
-            shard_map(
+            shard_map_nocheck(
                 self._local_flush,
                 mesh=self.mesh,
                 in_specs=(spec, spec),
                 out_specs=(spec, spec),
-                check_vma=False,
             )
         )
 

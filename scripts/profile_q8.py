@@ -9,7 +9,26 @@ over identical inputs:
   3. emission window 0 materialization (emit_window)
   4. the full per-chunk step (everything incl. extra windows + MV)
 
-Usage: JAX_PLATFORMS=cpu python scripts/profile_q8.py
+Usage:
+  JAX_PLATFORMS=cpu python scripts/profile_q8.py            # timings
+  JAX_PLATFORMS=cpu python scripts/profile_q8.py --assert   # regression
+  ... --assert --small    # reduced state sizes (the CI/pytest wrapper)
+
+``--assert`` turns the structural q8 invariants into hard failures so
+probe-count and dispatch-count regressions fail loudly instead of
+silently re-widening the join gap (exit 1 + named violation):
+
+  - exactly ONE lookup_or_insert per append-only join side per chunk
+    (trace-time probe audit of the fused (hash, rank) pool update);
+  - the whole inter-barrier window dispatches as ONE fused program
+    (DagJob.run_chunks) — zero per-chunk host dispatches;
+  - steady-state probe effort stays bounded (device probe_iters per
+    chunk within budget — load-factor / tombstone regressions show up
+    here);
+  - steady-state emission drains in ONE window per chunk (out_capacity
+    sizing regressions show up as extra drain-loop trips);
+  - join state error counters (overflow/inconsistency/emit_overflow)
+    all zero.
 """
 
 from __future__ import annotations
@@ -26,8 +45,17 @@ import jax.numpy as jnp  # noqa: E402
 
 from risingwave_tpu.sql import Engine  # noqa: E402
 from risingwave_tpu.sql.planner import PlannerConfig  # noqa: E402
+from risingwave_tpu.stream.runtime import _snapshot_copy  # noqa: E402
 
 CAP = 8192
+
+#: steady-state per-chunk budget on fused-probe loop trips: the ranked
+#: probe resolves in ~4 rounds at bench load factors; tombstone pileup
+#: or an overfull table shows up as a climb well past this
+PROBE_ITERS_BUDGET = 24
+#: steady-state emission windows per probe chunk (q8 emits a few
+#: hundred matches per 8k chunk — one out_capacity window covers it)
+DRAIN_WINDOWS_BUDGET = 1.25
 
 
 def timeit(name, fn, n=20):
@@ -42,14 +70,24 @@ def timeit(name, fn, n=20):
     return dt
 
 
-def main():
-    eng = Engine(PlannerConfig(
-        chunk_capacity=CAP,
-        agg_table_size=1 << 18, agg_emit_capacity=4096,
-        join_left_table_size=1 << 22, join_right_table_size=1 << 18,
-        join_pool_size=1 << 22, join_out_capacity=1 << 15,
-        mv_table_size=1 << 18, mv_ring_size=1 << 23,
-    ))
+def build_engine(small: bool, cap: int) -> Engine:
+    if small:
+        cfg = PlannerConfig(
+            chunk_capacity=cap,
+            agg_table_size=1 << 12, agg_emit_capacity=1024,
+            join_left_table_size=1 << 14, join_right_table_size=1 << 14,
+            join_pool_size=1 << 18, join_out_capacity=1 << 10,
+            mv_table_size=1 << 12, mv_ring_size=1 << 16,
+        )
+    else:
+        cfg = PlannerConfig(
+            chunk_capacity=cap,
+            agg_table_size=1 << 18, agg_emit_capacity=4096,
+            join_left_table_size=1 << 22, join_right_table_size=1 << 18,
+            join_pool_size=1 << 22, join_out_capacity=1 << 15,
+            mv_table_size=1 << 18, mv_ring_size=1 << 23,
+        )
+    eng = Engine(cfg)
     eng.execute("""
     CREATE SOURCE person (
         id BIGINT, name VARCHAR, date_time TIMESTAMP,
@@ -68,6 +106,105 @@ def main():
     JOIN TUMBLE(auction, date_time, INTERVAL '1' SECOND) a
     ON p.id = a.seller AND p.window_start = a.window_start;
     """)
+    return eng
+
+
+def run_assert(small: bool) -> int:
+    """The regression-assertion mode (per-stage budget check)."""
+    cap = 1024 if small else CAP
+    eng = build_engine(small, cap)
+    failures: list[str] = []
+
+    # dispatch count: the inter-barrier window must be ONE fused
+    # dispatch — count per-chunk host dispatches under the fused path
+    from risingwave_tpu.stream.dag import DagJob
+    per_chunk_calls = {"n": 0}
+    orig_run_chunk = DagJob.run_chunk
+
+    def counting_run_chunk(self, src):
+        per_chunk_calls["n"] += 1
+        return orig_run_chunk(self, src)
+
+    DagJob.run_chunk = counting_run_chunk
+    try:
+        eng.tick(barriers=2, chunks_per_barrier=8)
+    finally:
+        DagJob.run_chunk = orig_run_chunk
+    if per_chunk_calls["n"] != 0:
+        failures.append(
+            f"dispatch-count: {per_chunk_calls['n']} per-chunk host "
+            "dispatches — the inter-barrier window no longer runs as "
+            "one fused DagJob.run_chunks program"
+        )
+
+    # probe count: exactly one lookup_or_insert per pool side per chunk
+    audit = eng.audit_join_probe_counts()
+    if not audit:
+        failures.append("probe-count: no pool join sides found to audit")
+    for (jname, node, jside), stats in audit.items():
+        if stats["lookup_or_insert"] != 1 or stats["lookup"] != 0:
+            failures.append(
+                f"probe-count: {jname} node {node} {jside} update "
+                f"compiles {stats['lookup_or_insert']} lookup_or_insert"
+                f" + {stats['lookup']} lookup calls (want exactly 1+0)"
+            )
+
+    # device-counter budgets (one readback, post-run)
+    eng.collect_join_metrics()
+    m = eng.metrics
+    job = eng.jobs[0]
+    from risingwave_tpu.stream.dag import JoinNode
+    jidx = next(i for i, n in enumerate(job.nodes)
+                if isinstance(n, JoinNode))
+    labels = dict(job=job.name, node=str(jidx))
+    iters = m.get("join_probe_iters_per_chunk", **labels)
+    if iters > PROBE_ITERS_BUDGET:
+        failures.append(
+            f"probe-effort: {iters:.1f} fused-probe loop trips per "
+            f"chunk (budget {PROBE_ITERS_BUDGET}) — table load factor "
+            "or tombstone pileup regressed"
+        )
+    windows = m.get("join_drain_windows_per_chunk", **labels)
+    if windows > DRAIN_WINDOWS_BUDGET:
+        failures.append(
+            f"drain-loop: {windows:.2f} emission windows per chunk "
+            f"(budget {DRAIN_WINDOWS_BUDGET}) — out_capacity sizing "
+            "or emission staging regressed"
+        )
+
+    # error counters must be clean (the audit barrier would raise, but
+    # assert explicitly so this mode stands alone)
+    import numpy as np
+    st = job.states[jidx]
+    for sname in ("left", "right"):
+        s = getattr(st, sname)
+        for attr in ("overflow", "inconsistency"):
+            v = int(np.asarray(getattr(s, attr)))
+            if v:
+                failures.append(f"counters: {sname}.{attr} = {v}")
+    if int(np.asarray(st.emit_overflow)):
+        failures.append(
+            f"counters: emit_overflow = {int(np.asarray(st.emit_overflow))}"
+        )
+
+    if failures:
+        print("profile_q8 --assert: FAIL", flush=True)
+        for f in failures:
+            print(f"  - {f}", flush=True)
+        return 1
+    print(
+        "profile_q8 --assert: OK — 1 probe/side/chunk, fused dispatch, "
+        f"probe iters/chunk {iters:.1f} <= {PROBE_ITERS_BUDGET}, "
+        f"windows/chunk {windows:.2f} <= {DRAIN_WINDOWS_BUDGET}",
+        flush=True,
+    )
+    return 0
+
+
+def main():
+    if "--assert" in sys.argv:
+        sys.exit(run_assert(small="--small" in sys.argv))
+    eng = build_engine(False, CAP)
     eng.tick(barriers=2, chunks_per_barrier=2)  # warm state + compile
     job = eng.jobs[0]
     from risingwave_tpu.stream.dag import JoinNode
@@ -94,9 +231,13 @@ def main():
         chunk = reader.impl(k0, reader.cap)
         return prep._step_impl(states, chunk)
 
-    @jax.jit
-    def join_begin(jstate, chunk):
-        return join.apply_begin(jstate, chunk, "left")
+    # donated, as the real step program runs it: the state updates in
+    # place; an un-donated trace would copy the multi-hundred-MB side
+    # state every call and time the memcpy, not the join
+    join_begin = jax.jit(
+        lambda jstate, chunk: join.apply_begin(jstate, chunk, "left"),
+        donate_argnums=(0,),
+    )
 
     @jax.jit
     def emit0(jstate, pending):
@@ -108,10 +249,24 @@ def main():
     st_prep = job.states[prep_idx]
     _, chunk = gen_prep(st_prep, k0)
     timeit("gen + wm + tumble", lambda: gen_prep(st_prep, k0)[1])
-    jstate = job.states[jidx]
+    jstate = _snapshot_copy(job.states[jidx])
     st2, pending = join_begin(jstate, chunk)
-    timeit("join apply_begin", lambda: join_begin(jstate, chunk)[1])
-    timeit("emit window 0", lambda: emit0(st2, pending)[0])
+
+    def begin_threaded():
+        # thread the donated state: measures the steady-state in-place
+        # update cost
+        nonlocal_state = begin_threaded.state
+        st, pend = join_begin(nonlocal_state, chunk)
+        begin_threaded.state = st
+        return pend
+
+    begin_threaded.state = st2
+    timeit("join apply_begin (donated)", begin_threaded)
+    st3 = begin_threaded.state
+    _, pending = jax.jit(
+        lambda jstate, chunk: join.apply_begin(jstate, chunk, "left")
+    )(st3, chunk)
+    timeit("emit window 0", lambda: emit0(st3, pending)[0])
     print("max_windows:", join.max_windows(CAP),
           "out_capacity:", join.out_capacity)
     print("pending total (this chunk):", int(pending.total))

@@ -24,6 +24,13 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
+# NOTE: do NOT enable jax's persistent compilation cache
+# (jax_compilation_cache_dir) for this suite: on the baked-in jax
+# 0.4.37 CPU build, cache-served executables return corrupted outputs
+# for the donated streaming-state programs (observed: garbage overflow
+# counters in test_cold_start/test_chaos on the second run), turning
+# correct code into red tests.
+
 
 def pytest_configure(config):
     config.addinivalue_line(

@@ -363,8 +363,9 @@ def test_pool_join_10x_skew_matches_brute_force():
 
 
 def test_pool_join_watermark_cleaning_bounds_state():
-    """clean_below on a pool side evicts whole keys and their pool rows
-    in one mask; the index stays rank-consistent for survivors."""
+    """clean_below on a pool side evicts whole keys (all their fused
+    (hash, rank) entries) in one mask; ranks stay consistent for
+    survivors and compaction reclaims the dead pool rows."""
     import jax.numpy as jnp
 
     j = _pool_join()
@@ -373,10 +374,11 @@ def test_pool_join_watermark_cleaning_bounds_state():
     lrows = [(k, 10 * k + i) for k in range(8) for i in range(5)]
     txt = "I I\n" + "\n".join(f"+ {k} {v}" for k, v in lrows)
     st, _ = j.apply(st, Chunk.from_pretty(txt, names=["k", "a"]), "left")
-    assert int(st.left.index.count()) == 40
+    assert int(st.left.table.count()) == 40
+    assert int(st.left.pool_len) == 40
 
     st = j.clean_below(st, "left", 0, 5)  # drop keys 0..4
-    assert int(st.left.index.count()) == 15  # 3 keys x 5 rows remain
+    assert int(st.left.table.count()) == 15  # 3 keys x 5 rows remain
 
     # survivors still join correctly (ranks intact)
     st, pending = j.apply_begin(st, _rc("""

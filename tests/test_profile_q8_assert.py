@@ -1,0 +1,29 @@
+"""Slow wrapper around ``scripts/profile_q8.py --assert``: the q8
+join-path regression gate (probe counts, fused dispatch, probe-effort
+and drain-window budgets) as a pytest target.
+
+Run with: ``pytest -m slow tests/test_profile_q8_assert.py``
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_profile_q8_assert_small():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "profile_q8.py"),
+         "--assert", "--small"],
+        env=env, capture_output=True, text=True, timeout=1800, cwd=ROOT,
+    )
+    assert out.returncode == 0, (
+        f"profile_q8 --assert failed:\n{out.stdout}\n{out.stderr[-2000:]}"
+    )
+    assert "profile_q8 --assert: OK" in out.stdout

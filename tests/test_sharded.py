@@ -109,10 +109,7 @@ def test_shuffle_carries_string_columns():
     from jax.sharding import PartitionSpec as P
     from risingwave_tpu.parallel.exchange import shuffle_chunk
 
-    try:
-        from jax import shard_map
-    except ImportError:
-        from jax.experimental.shard_map import shard_map
+    from risingwave_tpu.parallel.exchange import shard_map_nocheck
 
     schema = Schema.of(("g", DataType.INT64), ("s", DataType.VARCHAR))
     mesh = make_mesh(8)
@@ -136,9 +133,8 @@ def test_shuffle_carries_string_columns():
         out = shuffle_chunk(chunk, [chunk.column(0)], "shard", 8)
         return jax.tree.map(lambda x: x[None], out)
 
-    f = jax.jit(shard_map(
+    f = jax.jit(shard_map_nocheck(
         body, mesh=mesh, in_specs=(P("shard"),), out_specs=P("shard"),
-        check_vma=False,
     ))
     out = f(jnp.zeros((8,), jnp.int32))
     leaves = jax.tree.map(np.asarray, out)
@@ -492,10 +488,7 @@ def test_sharded_exchange_carries_ncol():
     from risingwave_tpu.common.types import Field
     from risingwave_tpu.parallel.exchange import shuffle_chunk
 
-    try:
-        from jax import shard_map
-    except ImportError:
-        from jax.experimental.shard_map import shard_map
+    from risingwave_tpu.parallel.exchange import shard_map_nocheck
 
     schema = Schema((
         Field("g", DataType.INT64, nullable=True),
@@ -518,9 +511,8 @@ def test_sharded_exchange_carries_ncol():
         out = shuffle_chunk(chunk, [chunk.column(0)], "shard", 8)
         return jax.tree.map(lambda x: x[None], out)
 
-    f = jax.jit(shard_map(
+    f = jax.jit(shard_map_nocheck(
         body, mesh=mesh, in_specs=(P("shard"),), out_specs=P("shard"),
-        check_vma=False,
     ))
     out = f(jnp.zeros((8,), jnp.int32))
     leaves = jax.tree.map(np.asarray, out)
