@@ -1,0 +1,14 @@
+"""Cluster-lite control plane: meta service + compute workers over
+localhost JSON-RPC (the multi-process split of the four node roles)."""
+
+from risingwave_tpu.cluster.meta_service import (  # noqa: F401
+    MetaFrontend,
+    MetaService,
+)
+from risingwave_tpu.cluster.rpc import (  # noqa: F401
+    RpcClient,
+    RpcError,
+    RpcServer,
+    parse_addr,
+)
+from risingwave_tpu.cluster.worker import ComputeWorker  # noqa: F401

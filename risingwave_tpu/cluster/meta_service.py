@@ -1,0 +1,578 @@
+"""MetaService: the cluster's coordination brain (meta node role).
+
+Reference counterparts, collapsed into one object:
+
+- ``ClusterController`` worker registry + heartbeat expiry
+  (src/meta/src/manager/cluster.rs) — workers register, beat, and are
+  declared dead after ``heartbeat_timeout_s`` of silence;
+- ``DdlController`` + streaming job placement
+  (src/meta/src/rpc/ddl_controller.rs) — DDL lands in the durable
+  catalog log, streaming jobs are scheduled onto compute workers
+  (job-level placement: least-loaded live worker, MV-on-MV co-located
+  with its upstream job);
+- ``GlobalBarrierWorker`` (src/meta/src/barrier/worker.rs:378) — the
+  global checkpoint protocol: one *round* injects a barrier into every
+  job on every worker, collects per-job epoch seals, and only when ALL
+  jobs sealed the round commits ONE cluster epoch through the
+  versioned manifest (storage/hummock/version.py) — so a snapshot
+  read pinned at that commit sees every MV at the same round;
+- recovery (SURVEY.md §3.5) — on missed heartbeats the worker is
+  marked dead, its jobs are reassigned to survivors and recovered
+  from their last durable checkpoint; counter-addressed sources make
+  the replay exact, so the cluster converges to the byte-identical
+  result of an undisturbed run.
+
+Pacing contract: compute workers have NO self-ticker — every chunk
+and barrier a job processes is driven by a meta ``tick()`` round.
+That makes the meta the global serializer for checkpoint-store
+commits (one barrier RPC in flight at a time), which is what keeps
+the shared manifest single-writer without a distributed lock.
+"""
+
+from __future__ import annotations
+
+import bisect
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+
+from risingwave_tpu.cluster.rpc import (
+    RpcClient,
+    RpcError,
+    RpcServer,
+)
+from risingwave_tpu.common.metrics import MetricsRegistry
+from risingwave_tpu.meta.store import MetaStore
+
+
+@dataclass
+class WorkerInfo:
+    """One registered compute worker (ref WorkerNode)."""
+
+    worker_id: int
+    host: str
+    port: int
+    pid: int | None = None
+    alive: bool = True
+    last_seen: float = field(default_factory=time.monotonic)
+    #: job names assigned to this worker
+    jobs: set = field(default_factory=set)
+    client: RpcClient | None = None
+
+    @property
+    def addr(self) -> str:
+        return f"{self.host}:{self.port}"
+
+
+@dataclass
+class JobInfo:
+    """One placed streaming job (ref TableFragments / StreamingJob).
+
+    ``mvs`` lists every MV/sink riding the job (MV-on-MV attaches to
+    its upstream's job, exactly like the engine merges DagJobs).
+    ``seal_log`` records (round, committed_epoch) per successful
+    barrier — the map recovery uses to translate a recovered epoch
+    back into a round position.
+    """
+
+    name: str
+    ddl: list = field(default_factory=list)
+    mvs: list = field(default_factory=list)
+    worker_id: int | None = None
+    #: cluster round this job has sealed up to
+    rounds: int = 0
+    #: (round, epoch_value) per sealed barrier, round-ascending
+    seal_log: list = field(default_factory=list)
+    #: epoch value serving reads pin for this job (last CLUSTER commit)
+    pinned_epoch: int = 0
+
+
+class MetaService:
+    """The meta node.  ``start()`` brings up the RPC server and the
+    heartbeat monitor; tests may also drive every method in-process."""
+
+    def __init__(self, data_dir: str, heartbeat_timeout_s: float = 3.0,
+                 metrics: MetricsRegistry | None = None,
+                 serve_retry_timeout_s: float = 60.0,
+                 rpc_timeout_s: float = 180.0):
+        from risingwave_tpu.storage.hummock.object_store import (
+            LocalFsObjectStore,
+        )
+        from risingwave_tpu.storage.hummock.version import VersionManager
+
+        self.data_dir = data_dir
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self.serve_retry_timeout_s = serve_retry_timeout_s
+        self.rpc_timeout_s = rpc_timeout_s
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        #: durable DDL log — the same store a single node replays, so a
+        #: restarted meta (or a single-node takeover) can rebuild the
+        #: cluster catalog
+        self.store = MetaStore(data_dir)
+        #: the cluster-epoch commit point: an (empty) version delta in
+        #: the shared manifest per global commit — workers never touch
+        #: the manifest in cluster mode, meta is its single writer
+        self.versions = VersionManager(
+            LocalFsObjectStore(os.path.join(data_dir, "hummock"))
+        )
+        self._lock = threading.RLock()
+        #: serializes barrier rounds AND failover reassignment: a job
+        #: is never adopted while one of its barrier RPCs is in flight
+        self._tick_lock = threading.Lock()
+        self.workers: dict[int, WorkerInfo] = {}
+        self.jobs: dict[str, JobInfo] = {}
+        #: mv/sink name -> owning JobInfo name
+        self._mv_to_job: dict[str, str] = {}
+        #: non-job DDL in arrival order (sources/tables/SETs/functions)
+        #: — shipped to a worker the first time a job needs them
+        self.prelude: list[str] = []
+        self._next_worker = 1
+        #: committed cluster epoch (round number, 0 = nothing committed)
+        self.cluster_epoch = 0
+        self.failovers = 0
+        self._server: RpcServer | None = None
+        self._monitor: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._set_worker_gauges()
+
+    # -- lifecycle ------------------------------------------------------
+    @property
+    def rpc_port(self) -> int:
+        return self._server.port if self._server is not None else 0
+
+    def start(self, host: str = "127.0.0.1", port: int = 0,
+              monitor: bool = True) -> "MetaService":
+        self._stop.clear()
+        self._server = RpcServer(self, host, port).start()
+        if monitor:
+            self._monitor = threading.Thread(
+                target=self._monitor_loop, name="meta-monitor",
+                daemon=True,
+            )
+            self._monitor.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=5)
+            self._monitor = None
+        if self._server is not None:
+            self._server.stop()
+            self._server = None
+        with self._lock:
+            for w in self.workers.values():
+                if w.client is not None:
+                    w.client.close()
+
+    # -- worker registry / heartbeats -----------------------------------
+    def rpc_register_worker(self, host: str, port: int,
+                            pid: int | None = None) -> dict:
+        with self._lock:
+            wid = self._next_worker
+            self._next_worker += 1
+            w = WorkerInfo(wid, host, int(port), pid)
+            w.client = RpcClient(host, int(port),
+                                 timeout=self.rpc_timeout_s)
+            self.workers[wid] = w
+            self._set_worker_gauges()
+        # a fresh worker can pick up any stranded jobs immediately
+        self._assign_pending()
+        return {"worker_id": wid, "cluster_epoch": self.cluster_epoch}
+
+    def rpc_heartbeat(self, worker_id: int) -> dict:
+        with self._lock:
+            w = self.workers.get(int(worker_id))
+            if w is None or not w.alive:
+                # a dead-marked worker must re-register: its jobs may
+                # already run elsewhere (ref: expired workers rejoin
+                # through the registration path)
+                raise ValueError(f"unknown or expired worker {worker_id}")
+            w.last_seen = time.monotonic()
+        return {"ok": True, "cluster_epoch": self.cluster_epoch}
+
+    def live_workers(self) -> list[WorkerInfo]:
+        with self._lock:
+            return [w for w in self.workers.values() if w.alive]
+
+    def _set_worker_gauges(self) -> None:
+        self.metrics.set_gauge(
+            "cluster_live_workers",
+            sum(1 for w in self.workers.values() if w.alive),
+        )
+        self.metrics.set_gauge("cluster_jobs", len(self.jobs))
+
+    def _monitor_loop(self) -> None:
+        interval = min(self.heartbeat_timeout_s / 4, 0.5)
+        while not self._stop.wait(interval):
+            self.check_heartbeats()
+
+    def check_heartbeats(self) -> None:
+        """One monitor pass: refresh age gauges, expire silent workers,
+        reassign their jobs (also called directly by tests)."""
+        now = time.monotonic()
+        expired: list[WorkerInfo] = []
+        with self._lock:
+            for w in self.workers.values():
+                if not w.alive:
+                    continue
+                age = now - w.last_seen
+                self.metrics.set_gauge(
+                    "cluster_worker_heartbeat_age_seconds", age,
+                    worker=str(w.worker_id),
+                )
+                if age > self.heartbeat_timeout_s:
+                    expired.append(w)
+        for w in expired:
+            self._on_worker_dead(w)
+        if expired or any(j.worker_id is None
+                          for j in self.jobs.values()):
+            self._assign_pending()
+
+    def _on_worker_dead(self, w: WorkerInfo) -> None:
+        # under the tick lock: never declare dead / reassign while one
+        # of the worker's barrier RPCs is still in flight (a stale
+        # barrier finishing late must not interleave checkpoint writes
+        # with the new owner's)
+        with self._tick_lock:
+            with self._lock:
+                if not w.alive:
+                    return
+                w.alive = False
+                self.failovers += 1
+                self.metrics.inc("cluster_failovers_total")
+                self.metrics.remove_series(
+                    "cluster_worker_heartbeat_age_seconds",
+                    worker=str(w.worker_id),
+                )
+                for name in list(w.jobs):
+                    self.jobs[name].worker_id = None
+                w.jobs.clear()
+                if w.client is not None:
+                    w.client.close()
+                self._set_worker_gauges()
+
+    # -- DDL / placement -------------------------------------------------
+    def rpc_execute_ddl(self, sql: str) -> dict:
+        return self.execute_ddl(sql)
+
+    def execute_ddl(self, sql: str) -> dict:
+        """Apply one or more statements at the cluster level: job DDL
+        places a streaming job, everything else joins the prelude all
+        future jobs replay."""
+        from risingwave_tpu.sql import ast
+        from risingwave_tpu.sql.parser import parse_with_text
+
+        placed: list[str] = []
+        for text, stmt in parse_with_text(sql):
+            if isinstance(stmt, (ast.CreateMaterializedView,
+                                 ast.CreateSink)):
+                self._place_job(text, stmt.name)
+                placed.append(stmt.name)
+            elif isinstance(stmt, ast.Insert):
+                self._forward_dml(text, stmt.table)
+            else:
+                self.store.append_ddl(text)
+                self.prelude.append(text)
+        return {"ok": True, "placed": placed,
+                "cluster_epoch": self.cluster_epoch}
+
+    def _co_located_job(self, text: str) -> "JobInfo | None":
+        """MV-on-MV placement: a query referencing an existing MV must
+        land on that MV's job (the engine attaches it to the same
+        DagJob there)."""
+        import re
+
+        for mv, jname in self._mv_to_job.items():
+            if re.search(rf"\b{re.escape(mv)}\b", text):
+                return self.jobs[jname]
+        return None
+
+    def _place_job(self, text: str, name: str) -> None:
+        if name in self._mv_to_job:
+            raise ValueError(f"{name!r} already exists")
+        self.store.append_ddl(text)
+        upstream = self._co_located_job(text)
+        if upstream is not None:
+            # ship only the prelude delta the job hasn't seen yet plus
+            # the new statement; the worker attaches it to the live job
+            sent = len(upstream.ddl) - len(upstream.mvs)
+            delta = self.prelude[sent:] + [text]
+            upstream.ddl.extend(delta)
+            upstream.mvs.append(name)
+            with self._lock:
+                self._mv_to_job[name] = upstream.name
+            if upstream.worker_id is not None:
+                w = self.workers[upstream.worker_id]
+                w.client.call("adopt", ddl=delta, name=upstream.name,
+                              recover=False)
+            return
+        job = JobInfo(name=name, ddl=list(self.prelude) + [text],
+                      mvs=[name])
+        # a job created after commits joins at the current round: it
+        # seals the NEXT round with everyone else
+        job.rounds = self.cluster_epoch
+        with self._lock:
+            self.jobs[name] = job
+            self._mv_to_job[name] = name
+            self._set_worker_gauges()
+        self._assign_pending()
+
+    def _forward_dml(self, text: str, table: str) -> None:
+        """INSERTs fan out to every worker whose catalog has the table
+        (each job's private reader consumes its worker-local history —
+        the same per-job readers a single node plans)."""
+        delivered = 0
+        for w in self.live_workers():
+            try:
+                w.client.call("execute", sql=text)
+                delivered += 1
+            except RpcError as e:
+                # a worker without the table answers KeyError("relation
+                # ... does not exist") — that worker just isn't a host
+                if "does not exist" in str(e):
+                    continue
+                raise
+            except (ConnectionError, OSError):
+                continue  # heartbeat monitor will expire it
+        if delivered == 0:
+            raise ValueError(
+                f"INSERT into {table!r}: no live worker has the table "
+                "(create it and place a job first)"
+            )
+        # durable only once at least one host accepted it (rejected
+        # statements must not resurrect at replay)
+        self.store.append_dml_sql(text)
+
+    def _assign_pending(self) -> None:
+        """Place every unassigned job on the least-loaded live worker;
+        adoption recovers the job from its last durable checkpoint."""
+        while True:
+            with self._lock:
+                pending = [j for j in self.jobs.values()
+                           if j.worker_id is None]
+                live = [w for w in self.workers.values() if w.alive]
+                if not pending or not live:
+                    return
+                job = pending[0]
+                target = min(live,
+                             key=lambda w: (len(w.jobs), w.worker_id))
+            try:
+                res = target.client.call(
+                    "adopt", ddl=job.ddl, name=job.name, recover=True
+                )
+            except (RpcError, ConnectionError, OSError):
+                # adoption failed: leave unassigned; the monitor loop
+                # retries (and may expire the worker first)
+                return
+            recovered = int(res.get("committed_epoch", 0))
+            with self._lock:
+                if job.worker_id is not None:
+                    continue  # raced with another assigner
+                job.worker_id = target.worker_id
+                target.jobs.add(job.name)
+                self._rewind_job(job, recovered)
+
+    def _rewind_job(self, job: JobInfo, epoch: int) -> None:
+        """Translate a recovered committed epoch back into the round
+        the job actually reached (its checkpoint may include a round
+        meta never saw acknowledged)."""
+        epochs = [e for _, e in job.seal_log]
+        if epoch <= 0:
+            # no durable checkpoint: the job replays every round it
+            # was credited with (fresh state, sources at zero)
+            if job.seal_log:
+                job.rounds = job.seal_log[0][0] - 1
+            else:
+                job.rounds = min(job.rounds, self.cluster_epoch)
+            job.seal_log = []
+            return
+        i = bisect.bisect_right(epochs, epoch)
+        if i > 0 and epochs[i - 1] == epoch:
+            job.seal_log = job.seal_log[:i]
+            job.rounds = job.seal_log[-1][0]
+        elif i == len(epochs):
+            # sealed + checkpointed, died before acking: credit the
+            # in-flight round
+            round_ = (job.seal_log[-1][0] + 1) if job.seal_log \
+                else job.rounds + 1
+            job.seal_log.append((round_, epoch))
+            job.rounds = round_
+        else:
+            # an epoch meta never recorded, older than later seals —
+            # cannot happen with meta-serialized rounds; resync hard
+            job.seal_log = job.seal_log[:i]
+            job.rounds = job.seal_log[-1][0] if job.seal_log else 0
+
+    # -- the global checkpoint protocol ---------------------------------
+    def rpc_tick(self, chunks_per_barrier: int = 1) -> dict:
+        return self.tick(chunks_per_barrier)
+
+    def tick(self, chunks_per_barrier: int = 1) -> dict:
+        """Drive ONE global barrier round: every job seals round
+        ``cluster_epoch + 1``; when all have, commit the cluster epoch
+        through the versioned manifest.  Incomplete rounds (dead or
+        unassigned workers) commit nothing — the cluster epoch never
+        moves past a hole, and survivors run at most one round ahead."""
+        t0 = time.perf_counter()
+        with self._tick_lock:
+            target = self.cluster_epoch + 1
+            with self._lock:
+                jobs = list(self.jobs.values())
+            if not jobs:
+                return {"round": target, "committed": False,
+                        "jobs": 0, "sealed": 0}
+            self.metrics.set_gauge("cluster_epoch_in_flight", target)
+            sealed = 0
+            for job in jobs:
+                if job.rounds >= target:
+                    sealed += 1
+                    continue
+                with self._lock:
+                    w = self.workers.get(job.worker_id) \
+                        if job.worker_id is not None else None
+                if w is None or not w.alive:
+                    continue
+                try:
+                    res = w.client.call(
+                        "barrier", job=job.name,
+                        chunks=int(chunks_per_barrier),
+                    )
+                except (RpcError, ConnectionError, OSError):
+                    continue  # monitor expires the worker; round stalls
+                epoch = int(res["committed_epoch"])
+                with self._lock:
+                    job.rounds = target
+                    job.seal_log.append((target, epoch))
+                sealed += 1
+            committed = sealed == len(jobs)
+            if committed:
+                self._commit_cluster_epoch(target, jobs)
+                self.metrics.observe(
+                    "cluster_barrier_commit_seconds",
+                    time.perf_counter() - t0,
+                )
+            return {"round": target, "committed": committed,
+                    "jobs": len(jobs), "sealed": sealed,
+                    "cluster_epoch": self.cluster_epoch}
+
+    def _commit_cluster_epoch(self, round_: int,
+                              jobs: list[JobInfo]) -> None:
+        """All jobs sealed ``round_``: ONE manifest delta records the
+        global consistency point, then serving pins move forward —
+        a snapshot read after this sees every MV at the same round."""
+        epoch_val = min(j.seal_log[-1][1] for j in jobs)
+        self.versions.commit_cluster_epoch(epoch_val)
+        with self._lock:
+            self.cluster_epoch = round_
+            for j in jobs:
+                j.pinned_epoch = j.seal_log[-1][1]
+                # seal_log only needs entries recovery can rewind to;
+                # everything at/before the global commit is final
+                if len(j.seal_log) > 64:
+                    j.seal_log = j.seal_log[-64:]
+        self.metrics.set_gauge("cluster_epoch_committed", round_)
+        self.metrics.set_gauge("cluster_manifest_epoch", epoch_val)
+
+    # -- serving reads ---------------------------------------------------
+    def rpc_serve(self, sql: str) -> dict:
+        cols, rows = self.serve(sql)
+        return {"cols": cols, "rows": rows}
+
+    def serve(self, sql: str):
+        """Route a serving read to the MV's owning worker, pinned at
+        the job's last cluster-committed epoch.  While the owner is
+        dead/unassigned (failover in progress) the read WAITS for the
+        reassignment instead of erroring — reads never observe partial
+        state and never fail across a worker kill."""
+        from risingwave_tpu.sql import ast
+        from risingwave_tpu.sql.parser import parse
+
+        stmts = parse(sql)
+        if len(stmts) != 1 or not isinstance(stmts[0], ast.Select):
+            raise ValueError("cluster serving handles a single SELECT")
+        sel = stmts[0]
+        if not isinstance(sel.from_, ast.TableRef):
+            raise ValueError(
+                "cluster serving reads are SELECT ... FROM <mv>"
+            )
+        mv = sel.from_.name
+        deadline = time.monotonic() + self.serve_retry_timeout_s
+        while True:
+            with self._lock:
+                jname = self._mv_to_job.get(mv)
+                if jname is None:
+                    raise ValueError(f"{mv!r} is not a placed MV")
+                job = self.jobs[jname]
+                w = self.workers.get(job.worker_id) \
+                    if job.worker_id is not None else None
+                pin = job.pinned_epoch
+            if w is not None and w.alive:
+                try:
+                    res = w.client.call("serve", sql=sql,
+                                        query_epoch=pin)
+                    return res["cols"], [tuple(r) for r in res["rows"]]
+                except RpcError:
+                    raise  # the engine refused: final
+                except (ConnectionError, OSError):
+                    pass  # owner died mid-read: wait for reassignment
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"no live owner for {mv!r} within "
+                    f"{self.serve_retry_timeout_s}s"
+                )
+            time.sleep(0.05)
+
+    # -- introspection ----------------------------------------------------
+    def rpc_cluster_state(self) -> dict:
+        return self.state()
+
+    def rpc_metrics(self) -> dict:
+        return {"prometheus": self.metrics.render_prometheus()}
+
+    def state(self) -> dict:
+        """The ctl/dashboard surface (risectl cluster-info analog)."""
+        now = time.monotonic()
+        with self._lock:
+            return {
+                "cluster_epoch": self.cluster_epoch,
+                "manifest_epoch":
+                    self.versions.current.max_committed_epoch,
+                "failovers": self.failovers,
+                "workers": [
+                    {"id": w.worker_id, "addr": w.addr,
+                     "alive": w.alive, "pid": w.pid,
+                     "heartbeat_age_s": round(now - w.last_seen, 3),
+                     "jobs": sorted(w.jobs)}
+                    for w in self.workers.values()
+                ],
+                "jobs": [
+                    {"name": j.name, "mvs": list(j.mvs),
+                     "worker": j.worker_id, "rounds": j.rounds,
+                     "pinned_epoch": j.pinned_epoch,
+                     "committed_epoch":
+                         j.seal_log[-1][1] if j.seal_log else 0}
+                    for j in self.jobs.values()
+                ],
+            }
+
+
+class MetaFrontend:
+    """The thin pgwire façade over a MetaService: SELECTs route to
+    workers through the pinned epoch, everything else is cluster DDL.
+    Duck-types Engine.query, so ``pgwire.pg_serve`` hosts it as-is
+    (the frontend node stays a router, exactly the reference split)."""
+
+    def __init__(self, meta: MetaService):
+        self.meta = meta
+
+    def query(self, sql: str):
+        from risingwave_tpu.sql import ast
+        from risingwave_tpu.sql.parser import parse
+
+        stmts = parse(sql)
+        if len(stmts) == 1 and isinstance(stmts[0], ast.Select):
+            return self.meta.serve(sql)
+        self.meta.execute_ddl(sql)
+        return [], []

@@ -1,0 +1,185 @@
+"""Line-delimited JSON-RPC over localhost TCP (stdlib only).
+
+Reference counterpart: the tonic gRPC mesh between the four node
+roles (``src/rpc_client``, proto/*.proto — MetaClient, StreamClient,
+ComputeClient).  The reference's service surface is wide because every
+subsystem speaks protobuf; this repo's control plane needs exactly one
+transport primitive — *call a named method on a peer and get a JSON
+answer* — so the whole layer is a newline-framed JSON request/response
+protocol any language (or ``nc``) can speak:
+
+    -> {"id": 1, "method": "heartbeat", "params": {"worker_id": 2}}
+    <- {"id": 1, "result": {"ok": true, "cluster_epoch": 7}}
+    <- {"id": 1, "error": "unknown worker 2"}          (on failure)
+
+Server: a threaded TCP server dispatching ``rpc_<method>`` attributes
+on a handler object (one thread per connection, many concurrent
+callers).  Client: one persistent connection, serialized calls,
+transparent reconnect-once on a broken socket.
+
+Error split (the failover-correctness contract): ``RpcError`` means
+the PEER ANSWERED with a failure — the application decision is final
+(an unknown MV stays unknown on retry).  ``ConnectionError``/
+``OSError`` means the peer is unreachable — the caller may retry
+against a reassigned owner.  MetaService.serve leans on exactly this
+split to keep serving reads error-free across a worker kill.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import threading
+
+
+class RpcError(RuntimeError):
+    """The remote handler raised: the call was delivered and REFUSED
+    (retrying the same call cannot succeed)."""
+
+
+def _json_default(o):
+    """Serialize numpy scalars (engine rows carry them) and stray
+    bytes; anything else is a programming error worth surfacing."""
+    if hasattr(o, "item"):
+        return o.item()
+    if isinstance(o, bytes):
+        return o.decode("utf-8", errors="replace")
+    raise TypeError(f"not JSON-serializable: {type(o).__name__}")
+
+
+def _dumps(obj) -> bytes:
+    return json.dumps(obj, separators=(",", ":"),
+                      default=_json_default).encode() + b"\n"
+
+
+class _RpcHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        target = self.server.target
+        while True:
+            line = self.rfile.readline()
+            if not line:
+                return
+            try:
+                req = json.loads(line)
+            except json.JSONDecodeError:
+                return  # garbage on the control socket: drop the peer
+            rid = req.get("id")
+            method = req.get("method", "")
+            fn = getattr(target, f"rpc_{method}", None)
+            if fn is None:
+                resp = {"id": rid, "error": f"unknown method {method!r}"}
+            else:
+                try:
+                    resp = {"id": rid,
+                            "result": fn(**(req.get("params") or {}))}
+                except Exception as e:  # handler errors travel back
+                    resp = {"id": rid,
+                            "error": f"{type(e).__name__}: {e}"}
+            try:
+                self.wfile.write(_dumps(resp))
+                self.wfile.flush()
+            except (BrokenPipeError, ConnectionError, ValueError):
+                return
+
+
+class RpcServer(socketserver.ThreadingTCPServer):
+    """Serve ``rpc_*`` methods of ``target`` on (host, port); port 0
+    binds an ephemeral port (read it back from ``.port``)."""
+
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, target, host: str = "127.0.0.1", port: int = 0):
+        super().__init__((host, port), _RpcHandler)
+        self.target = target
+        self.host = host
+        self.port = self.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "RpcServer":
+        self._thread = threading.Thread(
+            target=self.serve_forever, name=f"rpc-{self.port}",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.shutdown()
+        self.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+class RpcClient:
+    """One persistent connection to a peer; calls serialize on a lock
+    (the meta→worker control channel is low-rate by design)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 120.0):
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+        self._lock = threading.Lock()
+        self._sock: socket.socket | None = None
+        self._file = None
+        self._next_id = 1
+
+    def _connect(self):
+        self._sock = socket.create_connection(
+            (self.host, self.port), timeout=self.timeout
+        )
+        self._file = self._sock.makefile("rwb")
+
+    def _roundtrip(self, payload: bytes) -> dict:
+        if self._sock is None:
+            self._connect()
+        self._file.write(payload)
+        self._file.flush()
+        line = self._file.readline()
+        if not line:
+            raise ConnectionError("rpc peer closed the connection")
+        return json.loads(line)
+
+    def call(self, method: str, **params):
+        """Invoke one remote method.  Raises ``RpcError`` for remote
+        handler failures, ``ConnectionError``/``OSError`` when the
+        peer is unreachable (one silent reconnect is attempted for
+        idle-dropped sockets)."""
+        with self._lock:
+            rid = self._next_id
+            self._next_id += 1
+            payload = _dumps(
+                {"id": rid, "method": method, "params": params}
+            )
+            try:
+                resp = self._roundtrip(payload)
+            except (ConnectionError, OSError, json.JSONDecodeError):
+                self._close_locked()
+                self._connect()
+                resp = self._roundtrip(payload)
+            if resp.get("error") is not None:
+                raise RpcError(resp["error"])
+            return resp.get("result")
+
+    def _close_locked(self) -> None:
+        try:
+            if self._file is not None:
+                self._file.close()
+            if self._sock is not None:
+                self._sock.close()
+        except OSError:
+            pass
+        self._sock = None
+        self._file = None
+
+    def close(self) -> None:
+        with self._lock:
+            self._close_locked()
+
+
+def parse_addr(addr: str) -> tuple[str, int]:
+    """'host:port' → (host, port) for CLI flags."""
+    host, _, port = addr.rpartition(":")
+    return host or "127.0.0.1", int(port)

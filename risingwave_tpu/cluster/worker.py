@@ -1,0 +1,144 @@
+"""ComputeWorker: one compute-node process in the cluster.
+
+Reference counterpart: the compute node (``src/compute``) — hosts
+streaming actors, answers the meta's barrier injections, serves batch
+reads over its local state, and reports liveness through heartbeats
+(src/compute/src/server.rs; heartbeats in meta's ClusterController).
+
+Shape here: an ``Engine`` in ``role="compute"`` (shared durable
+checkpoint store under the cluster ``data_dir``, no meta store, no
+hummock manifest — meta owns both), driven ENTIRELY by meta RPCs:
+
+- ``adopt``  — execute the job's DDL (skipping objects already in the
+  local catalog) and recover it from its last durable checkpoint; the
+  placement AND the failover path are the same call;
+- ``barrier`` — process N chunks + inject one barrier for ONE job
+  (the meta drives rounds job-by-job, so the shared checkpoint
+  manifest has a single writer at any instant);
+- ``serve``  — a batch read, optionally pinned at ``query_epoch``
+  (the meta passes its last cluster-committed epoch);
+- ``execute`` — generic statement forwarding (INSERT fan-out).
+
+A worker has no self-ticker: if the meta dies, the cluster freezes
+consistently instead of diverging.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from risingwave_tpu.cluster.rpc import RpcClient, RpcServer, parse_addr
+
+
+class ComputeWorker:
+    def __init__(self, meta_addr: str, data_dir: str, config=None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 heartbeat_interval_s: float = 0.5):
+        from risingwave_tpu.sql.engine import Engine
+
+        self.meta_host, self.meta_port = parse_addr(meta_addr)
+        self.engine = Engine(config, data_dir=data_dir, role="compute")
+        self.host = host
+        self._port_req = port
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self.worker_id: int | None = None
+        self._lock = threading.Lock()
+        self._server: RpcServer | None = None
+        self._meta_client: RpcClient | None = None
+        self._hb_thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        #: heartbeats delivered (introspection/tests)
+        self.heartbeats_sent = 0
+
+    @property
+    def port(self) -> int:
+        return self._server.port if self._server is not None else 0
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self, heartbeat: bool = True) -> "ComputeWorker":
+        self._stop.clear()
+        self._server = RpcServer(self, self.host, self._port_req).start()
+        self._meta_client = RpcClient(self.meta_host, self.meta_port,
+                                      timeout=30.0)
+        res = self._meta_client.call(
+            "register_worker", host=self.host, port=self.port,
+            pid=os.getpid(),
+        )
+        self.worker_id = int(res["worker_id"])
+        if heartbeat:
+            self._hb_thread = threading.Thread(
+                target=self._heartbeat_loop,
+                name=f"worker-{self.worker_id}-hb", daemon=True,
+            )
+            self._hb_thread.start()
+        return self
+
+    def _heartbeat_loop(self) -> None:
+        # independent of the engine lock: a worker busy compiling or
+        # crossing a barrier still beats (liveness != idleness)
+        while not self._stop.wait(self.heartbeat_interval_s):
+            try:
+                self._meta_client.call("heartbeat",
+                                       worker_id=self.worker_id)
+                self.heartbeats_sent += 1
+            except Exception:
+                # meta unreachable or expired us; keep trying — a
+                # revived meta needs re-registration, which operators
+                # do by restarting the worker
+                time.sleep(self.heartbeat_interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=5)
+            self._hb_thread = None
+        if self._server is not None:
+            self._server.stop()
+            self._server = None
+        if self._meta_client is not None:
+            self._meta_client.close()
+            self._meta_client = None
+
+    # -- RPC surface ----------------------------------------------------
+    def rpc_ping(self) -> dict:
+        return {"ok": True, "worker_id": self.worker_id,
+                "jobs": [j.name for j in self.engine.jobs]}
+
+    def rpc_adopt(self, ddl: list, name: str,
+                  recover: bool = True) -> dict:
+        """Adopt (or extend) a streaming job: replay its DDL, then
+        recover from the last durable checkpoint (exact replay: the
+        checkpoint holds state + source cursors of the same commit)."""
+        with self._lock:
+            epoch = self.engine.adopt_job(list(ddl), name,
+                                          recover=recover)
+        return {"ok": True, "committed_epoch": epoch}
+
+    def rpc_barrier(self, job: str, chunks: int = 1) -> dict:
+        """Process ``chunks`` chunks + one barrier for one job — the
+        meta's global round, applied locally."""
+        with self._lock:
+            epoch = self.engine.tick_job(job, int(chunks))
+        return {"ok": True, "committed_epoch": epoch}
+
+    def rpc_serve(self, sql: str, query_epoch: int = 0) -> dict:
+        """Batch read; ``query_epoch`` pins the retained checkpoint of
+        the meta's last cluster commit (reads never see state a global
+        commit hasn't covered)."""
+        qe = int(query_epoch or 0)
+        with self._lock:
+            if qe:
+                self.engine.session_config.set("query_epoch", qe)
+            try:
+                cols, rows = self.engine.query(sql)
+            finally:
+                if qe:
+                    self.engine.session_config.set("query_epoch", 0)
+        return {"cols": cols, "rows": [list(r) for r in rows]}
+
+    def rpc_execute(self, sql: str) -> dict:
+        with self._lock:
+            self.engine.execute(sql)
+        return {"ok": True}

@@ -51,12 +51,32 @@ class StateConfig:
 
 
 @dataclass
+class ClusterConfig:
+    """Control-plane knobs (ref meta config: heartbeat/barrier
+    sections of src/common/src/config/mod.rs)."""
+
+    meta_host: str = "127.0.0.1"
+    meta_rpc_port: int = 4600
+    #: worker → meta liveness cadence
+    heartbeat_interval_s: float = 0.5
+    #: silence after which meta declares a worker dead and fails over
+    heartbeat_timeout_s: float = 3.0
+    #: how long a serving read waits for a reassigned owner before
+    #: erroring (covers adopt + recover + first compile on a survivor)
+    serve_retry_timeout_s: float = 60.0
+    #: meta → worker control RPC deadline (barrier rounds include
+    #: first-compile latency on fresh workers)
+    rpc_timeout_s: float = 180.0
+
+
+@dataclass
 class RwConfig:
     """Top-level node config (ref RwConfig, config/mod.rs:81)."""
 
     streaming: StreamingConfig = field(default_factory=StreamingConfig)
     storage: StorageConfig = field(default_factory=StorageConfig)
     state: StateConfig = field(default_factory=StateConfig)
+    cluster: ClusterConfig = field(default_factory=ClusterConfig)
 
     @staticmethod
     def from_dict(d: dict) -> "RwConfig":

@@ -86,6 +86,16 @@ class MetricsRegistry:
 
         return _Timer()
 
+    def remove_series(self, name: str, **labels) -> None:
+        """Drop one labeled series (counter/gauge/histogram).  The
+        control plane retires a dead worker's per-worker gauges so the
+        scrape surface reflects the live membership, not tombstones."""
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            self._counters.pop(key, None)
+            self._gauges.pop(key, None)
+            self._hists.pop(key, None)
+
     # ------------------------------------------------------------------
     def get(self, name: str, **labels) -> float:
         key = (name, tuple(sorted(labels.items())))

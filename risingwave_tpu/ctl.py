@@ -187,15 +187,77 @@ def _storage_main(argv: list[str]) -> None:
         raise SystemExit(f"unknown storage subcommand: {sub}")
 
 
+# -- cluster control plane (risectl cluster ... analog) -----------------
+def _meta_state(meta_addr: str) -> dict:
+    from risingwave_tpu.cluster.rpc import RpcClient, parse_addr
+
+    host, port = parse_addr(meta_addr)
+    client = RpcClient(host, port, timeout=30.0)
+    try:
+        return client.call("cluster_state")
+    finally:
+        client.close()
+
+
+def cluster_workers(meta_addr: str) -> list[dict]:
+    """``ctl cluster workers``: live/dead workers with heartbeat ages
+    and their job assignments (risectl cluster-info's worker table)."""
+    return _meta_state(meta_addr)["workers"]
+
+
+def cluster_jobs(meta_addr: str) -> list[dict]:
+    """``ctl cluster jobs``: placed streaming jobs — owner worker,
+    sealed rounds, last committed and pinned epochs."""
+    return _meta_state(meta_addr)["jobs"]
+
+
+def cluster_epochs(meta_addr: str) -> dict:
+    """``ctl cluster epochs``: the global checkpoint positions — the
+    committed cluster epoch (round), the manifest's epoch stamp, and
+    each job's serving pin."""
+    s = _meta_state(meta_addr)
+    return {
+        "cluster_epoch": s["cluster_epoch"],
+        "manifest_epoch": s["manifest_epoch"],
+        "failovers": s["failovers"],
+        "jobs": {
+            j["name"]: {"pinned_epoch": j["pinned_epoch"],
+                        "committed_epoch": j["committed_epoch"],
+                        "rounds": j["rounds"]}
+            for j in s["jobs"]
+        },
+    }
+
+
+def _cluster_main(argv: list[str]) -> None:
+    """``python -m risingwave_tpu.ctl cluster {workers|jobs|epochs}
+    <meta_host:rpc_port>`` — online introspection of a running meta
+    (mirrors the offline ``ctl storage`` pattern, but against the live
+    control plane)."""
+    import json
+
+    sub, addr = argv[0], argv[1]
+    fn = {"workers": cluster_workers, "jobs": cluster_jobs,
+          "epochs": cluster_epochs}.get(sub)
+    if fn is None:
+        raise SystemExit(f"unknown cluster subcommand: {sub}")
+    print(json.dumps(fn(addr), indent=1))
+
+
 def main() -> None:  # pragma: no cover - thin CLI
     """``python -m risingwave_tpu.ctl <host> <port> <sql>`` — send one
     statement to a running node over pgwire (risectl's transport is
     gRPC; ours is the SQL front door).  ``... ctl storage
-    {version|gc|compact} <data_dir>`` operates on storage offline."""
+    {version|gc|compact} <data_dir>`` operates on storage offline;
+    ``... ctl cluster {workers|jobs|epochs} <meta_addr>`` talks to a
+    running meta service."""
     import sys
 
     if len(sys.argv) > 1 and sys.argv[1] == "storage":
         _storage_main(sys.argv[2:])
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "cluster":
+        _cluster_main(sys.argv[2:])
         return
 
     from risingwave_tpu.pgwire import SimpleClient

@@ -27,7 +27,16 @@ time.
 from __future__ import annotations
 
 import json
+import logging
 import os
+
+log = logging.getLogger(__name__)
+
+
+class MetaStoreCorruption(RuntimeError):
+    """A NON-tail log line failed to decode: the log is damaged beyond
+    the crash-mid-append case and silently truncating it would drop
+    acknowledged DDL/DML — recovery must stop loudly instead."""
 
 
 class MetaStore:
@@ -40,6 +49,10 @@ class MetaStore:
 
     # -- append ---------------------------------------------------------
     def _append(self, path: str, obj: dict) -> None:
+        # flush + fsync BEFORE returning: an append is acknowledged
+        # (DDL applied, INSERT accepted) only once it is durable — a
+        # worker SIGKILLed right after this call replays the line; one
+        # killed mid-write leaves a torn tail ``_lines`` drops
         line = json.dumps(obj, separators=(",", ":")) + "\n"
         with open(path, "a") as f:
             f.write(line)
@@ -55,20 +68,59 @@ class MetaStore:
             {"rows": [list(r) for r in rows]},
         )
 
+    def append_dml_sql(self, sql: str) -> None:
+        """Cluster mode: the meta durably logs forwarded DML statements
+        (the per-table row logs stay the single-node representation)."""
+        self._append(os.path.join(self.root, "dml_sql.jsonl"),
+                     {"sql": sql})
+
+    def dml_sql_log(self) -> list[str]:
+        return [e["sql"] for e in self._lines(
+            os.path.join(self.root, "dml_sql.jsonl")
+        )]
+
     # -- read -----------------------------------------------------------
     @staticmethod
     def _lines(path: str) -> list[dict]:
+        """Replay one JSONL log.  A torn TAIL line (crash mid-append:
+        missing newline and/or truncated JSON) is dropped with a
+        warning — it was never acknowledged.  A damaged line anywhere
+        ELSE raises ``MetaStoreCorruption``: silently truncating there
+        would drop acknowledged history after it."""
         if not os.path.exists(path):
             return []
-        out = []
         with open(path) as f:
-            for line in f:
-                if not line.endswith("\n"):
-                    break  # torn tail from a crash mid-append
-                try:
-                    out.append(json.loads(line))
-                except json.JSONDecodeError:
+            lines = f.readlines()
+        out = []
+        for i, line in enumerate(lines):
+            last = i == len(lines) - 1
+            torn = not line.endswith("\n")
+            if torn and not last:
+                raise MetaStoreCorruption(
+                    f"{path}:{i + 1}: embedded unterminated line"
+                )
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError as e:
+                if last:
+                    log.warning(
+                        "%s: dropping torn trailing line %d "
+                        "(crash mid-append): %s", path, i + 1, e,
+                    )
                     break
+                raise MetaStoreCorruption(
+                    f"{path}:{i + 1}: undecodable line mid-log"
+                ) from e
+            if torn:
+                # parses but the newline never landed: the fsync that
+                # acknowledges the append covers the newline, so this
+                # write was still in flight — not acknowledged, drop it
+                log.warning(
+                    "%s: dropping unterminated trailing line %d "
+                    "(crash mid-append)", path, i + 1,
+                )
+                break
+            out.append(obj)
         return out
 
     def ddl_log(self) -> list[str]:

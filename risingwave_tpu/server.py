@@ -1,16 +1,28 @@
-"""Single-node server: engine + barrier ticker + pgwire front door.
+"""Node entrypoint: single-binary, meta, or compute-worker process.
 
-Reference counterpart: the single-binary modes (``src/cmd_all/src/
-single_node.rs``) that bundle frontend + meta + compute into one
-process.  Here: one Engine, a background barrier loop paced by the
-``barrier_interval_ms`` system param, and the wire server.
+Reference counterparts: the single-binary mode (``src/cmd_all/src/
+single_node.rs``) bundling frontend + meta + compute into one process,
+and the per-role binaries (``src/cmd/src/bin/{meta,compute}_node.rs``)
+the multi-process deployment launches.
 
+    # everything in one process (the default)
     python -m risingwave_tpu.server --port 4566 --data-dir ./data
+
+    # a 1-meta + 2-compute cluster over one shared data_dir
+    python -m risingwave_tpu.server --role meta --port 4566 \
+        --rpc-port 4600 --data-dir ./data
+    python -m risingwave_tpu.server --role compute \
+        --meta 127.0.0.1:4600 --data-dir ./data   # run twice
+
+The meta process hosts the pgwire front door: DDL places streaming
+jobs on workers, SELECTs route to the owning worker pinned at the
+last cluster-committed epoch (cluster/meta_service.py).
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import threading
 import time
 
@@ -63,18 +75,109 @@ class SingleNode:
             self.engine.tick(barriers, chunks_per_barrier)
 
     def stop(self) -> None:
+        """Orderly shutdown: stop the ticker, then seal + commit ONE
+        final barrier before the compactor/pgwire go away — every
+        acked write (chunks processed since the last barrier) lands in
+        a committed checkpoint instead of dying with the process."""
         self._stop.set()
         if self._ticker is not None:
             self._ticker.join(timeout=5)
-        self.engine.stop_storage_service()
+            self._ticker = None
+        try:
+            with self._lock:
+                if self.engine.jobs:
+                    # chunks_per_barrier=0: flush/commit what already
+                    # flowed, pull nothing new on the way out
+                    self.engine.tick(barriers=1, chunks_per_barrier=0)
+        finally:
+            self.engine.stop_storage_service()
+
+
+def _run_meta(args) -> None:
+    from risingwave_tpu.cluster import MetaFrontend, MetaService
+    from risingwave_tpu.pgwire import pg_serve
+
+    meta = MetaService(
+        args.data_dir or "./data",
+        heartbeat_timeout_s=args.heartbeat_timeout,
+    ).start(args.host, args.rpc_port)
+    front = MetaFrontend(meta)
+    server = pg_serve(front, args.host, args.port)
+    print(json.dumps({
+        "role": "meta", "pgwire_port": args.port,
+        "rpc_port": meta.rpc_port,
+    }), flush=True)
+
+    stop = threading.Event()
+
+    def tick_loop():
+        while not stop.is_set():
+            t0 = time.monotonic()
+            try:
+                meta.tick()
+            except Exception:
+                pass  # incomplete rounds retry next interval
+            elapsed = time.monotonic() - t0
+            stop.wait(max(args.barrier_interval_ms / 1000.0 - elapsed,
+                          0.0))
+
+    threading.Thread(target=tick_loop, daemon=True).start()
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        stop.set()
+        meta.stop()
+        server.shutdown()
+
+
+def _run_compute(args) -> None:
+    from risingwave_tpu.cluster import ComputeWorker
+    from risingwave_tpu.common.config import RwConfig
+
+    config = RwConfig.from_dict(json.loads(args.config_json)) \
+        if args.config_json else None
+    worker = ComputeWorker(
+        args.meta, args.data_dir or "./data", config=config,
+        host=args.host, port=args.rpc_port,
+        heartbeat_interval_s=args.heartbeat_interval,
+    ).start()
+    print(json.dumps({
+        "role": "compute", "worker_id": worker.worker_id,
+        "port": worker.port,
+    }), flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        worker.stop()
 
 
 def main() -> None:
-    p = argparse.ArgumentParser(description="risingwave_tpu single node")
+    p = argparse.ArgumentParser(description="risingwave_tpu node")
+    p.add_argument("--role", choices=["single", "meta", "compute"],
+                   default="single")
     p.add_argument("--host", default="127.0.0.1")
-    p.add_argument("--port", type=int, default=4566)
+    p.add_argument("--port", type=int, default=4566,
+                   help="pgwire port (single/meta roles)")
+    p.add_argument("--rpc-port", type=int, default=0,
+                   help="control RPC port (meta/compute; 0 = ephemeral)")
+    p.add_argument("--meta", default="127.0.0.1:4600",
+                   help="meta RPC address (compute role)")
     p.add_argument("--data-dir", default=None)
+    p.add_argument("--config-json", default=None,
+                   help="RwConfig overrides as JSON (compute role)")
+    p.add_argument("--heartbeat-interval", type=float, default=0.5)
+    p.add_argument("--heartbeat-timeout", type=float, default=3.0)
+    p.add_argument("--barrier-interval-ms", type=int, default=1000)
     args = p.parse_args()
+
+    if args.role == "meta":
+        _run_meta(args)
+        return
+    if args.role == "compute":
+        _run_compute(args)
+        return
     node = SingleNode(data_dir=args.data_dir)
     server = node.start(args.host, args.port)
     print(f"listening on {args.host}:{args.port} (psql -h {args.host} "

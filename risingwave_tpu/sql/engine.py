@@ -150,7 +150,7 @@ def _join_exchange_keys(key_exprs, chunk):
 
 class Engine:
     def __init__(self, config: "PlannerConfig | RwConfig | None" = None,
-                 data_dir: str | None = None):
+                 data_dir: str | None = None, role: str = "single"):
         self.catalog = Catalog()
         if isinstance(config, RwConfig):
             self.rw_config = config
@@ -191,7 +191,19 @@ class Engine:
         #: True while replaying the durable DDL/DML logs (suppresses
         #: re-logging)
         self._replaying = False
-        if data_dir is not None:
+        #: "single" owns every durable subsystem; "compute" is a
+        #: cluster worker — it shares the cluster's checkpoint store
+        #: but the META process owns the DDL log and the version
+        #: manifest (a second VersionManager over the same object
+        #: store would fork the version chain)
+        self.role = role
+        if data_dir is not None and role == "compute":
+            from risingwave_tpu.storage import CheckpointStore
+            self.checkpoint_store = CheckpointStore(
+                data_dir,
+                keep_epochs=self.rw_config.storage.checkpoint_keep_epochs,
+            )
+        elif data_dir is not None:
             import os as _os
 
             from risingwave_tpu.meta.store import MetaStore
@@ -248,6 +260,9 @@ class Engine:
 
         result = None
         for text, stmt in parse_with_text(sql):
+            # the statement's raw SQL, recorded as the catalog entry's
+            # definition (re-parseable — job export/adoption ships it)
+            self._stmt_text = text
             if isinstance(stmt, ast.CreateFunction):
                 result = self._create_function(stmt)
             else:
@@ -261,6 +276,12 @@ class Engine:
                 if self.meta_store is not None and not self._replaying:
                     self.meta_store.append_ddl(text)
         return result
+
+    def _definition_text(self, stmt) -> str:
+        """The statement's original SQL (stashed by execute()) — the
+        catalog entry's re-parseable definition, shipped verbatim when
+        a job is exported/adopted across processes."""
+        return getattr(self, "_stmt_text", None) or str(stmt)
 
     def _create_function(self, stmt: ast.CreateFunction):
         """Register a SQL UDF (ref: frontend SQL UDF inlining)."""
@@ -556,7 +577,7 @@ class Engine:
                   stmt.watermark.delay.micros)
         return CatalogEntry(
             stmt.name, "source", schema, reader_factory=factory,
-            watermark=wm, append_only=True, definition=str(stmt),
+            watermark=wm, append_only=True, definition=self._definition_text(stmt),
         )
 
     @staticmethod
@@ -610,7 +631,7 @@ class Engine:
             if stmt.primary_key else None
         return CatalogEntry(
             stmt.name, "source", schema, reader_factory=factory,
-            watermark=wm, append_only=True, definition=str(stmt),
+            watermark=wm, append_only=True, definition=self._definition_text(stmt),
             dml=dml, stream_key=pk,
         )
 
@@ -639,7 +660,7 @@ class Engine:
 
         return CatalogEntry(
             stmt.name, "source", schema, reader_factory=factory,
-            watermark=wm, append_only=True, definition=str(stmt),
+            watermark=wm, append_only=True, definition=self._definition_text(stmt),
         )
 
     def _datagen_source(self, stmt: ast.CreateSource) -> CatalogEntry:
@@ -651,7 +672,7 @@ class Engine:
 
         return CatalogEntry(
             stmt.name, "source", schema, reader_factory=factory,
-            watermark=wm, append_only=True, definition=str(stmt),
+            watermark=wm, append_only=True, definition=self._definition_text(stmt),
         )
 
     def _refresh_dml_widths(self) -> None:
@@ -1404,7 +1425,7 @@ class Engine:
             dag_nodes=dag_meta[0] if dag_meta else None,
             dag_sources=dag_meta[1] if dag_meta else None,
             stream_key=list(getattr(mv_exec, "pk_indices", [])) or None,
-            definition=str(stmt),
+            definition=self._definition_text(stmt),
         )
         self.catalog.create(entry)
         if is_new:
@@ -1439,7 +1460,7 @@ class Engine:
             job=job, mv_executor=sink_exec,
             dag_nodes=dag_meta[0] if dag_meta else None,
             dag_sources=dag_meta[1] if dag_meta else None,
-            definition=str(stmt),
+            definition=self._definition_text(stmt),
         )
         self.catalog.create(entry)
         if is_new:
@@ -1489,11 +1510,92 @@ class Engine:
                     "committed_epoch", job.committed_epoch, job=job.name
                 )
 
+    def tick_job(self, name: str, chunks_per_barrier: int = 1) -> int:
+        """Advance ONE job a single barrier round (the cluster worker's
+        barrier RPC — meta drives each job's rounds individually so a
+        reassigned job can catch up while the rest hold).  Returns the
+        job's committed epoch after the barrier."""
+        job = self._job_by_name(name)
+        ckpt_freq = int(self.system_params.get("checkpoint_frequency"))
+        job.checkpoint_frequency = ckpt_freq
+        job.maintenance_interval = int(self.system_params.get(
+            "maintenance_interval_checkpoints"
+        ))
+        job.snapshot_interval = int(self.system_params.get(
+            "snapshot_interval_checkpoints"
+        ))
+        t0 = time.perf_counter()
+        if hasattr(job, "run_chunks"):
+            rows = job.run_chunks(chunks_per_barrier)
+        else:
+            rows = 0
+            for _ in range(chunks_per_barrier):
+                rows += job.chunk_round()
+        job.inject_barrier()
+        dt = time.perf_counter() - t0
+        self.metrics.inc("stream_rows_total", rows, job=job.name)
+        self.metrics.observe("barrier_latency_seconds", dt, job=job.name)
+        self.metrics.set_gauge("committed_epoch", job.committed_epoch,
+                               job=job.name)
+        return job.committed_epoch
+
+    def _job_by_name(self, name: str):
+        for job in self.jobs:
+            if job.name == name:
+                return job
+        raise ValueError(f"unknown streaming job {name!r}")
+
     def recover(self) -> None:
         """Restore every job from its last committed checkpoint
         (ref §3.5: meta-driven recovery across all streaming jobs)."""
         for job in self.jobs:
             job.recover()
+
+    # -- cluster job export / adoption ----------------------------------
+    def export_job_ddl(self, name: str) -> list[str]:
+        """The DDL statements that recreate one MV/sink's job on a
+        fresh engine: every source/table definition (in catalog order —
+        cheap and closed over any FROM reference), then the entry's own
+        definition.  The meta service ships exactly this shape when it
+        places or reassigns a job."""
+        entry = self.catalog.get(name)
+        ddls = [e.definition for e in self.catalog.list("source")
+                if e.definition]
+        if entry.definition:
+            ddls.append(entry.definition)
+        return ddls
+
+    def adopt_job(self, ddl: list[str], name: str,
+                  recover: bool = True) -> int:
+        """Replay a shipped job's DDL, skipping objects this engine
+        already has (a survivor adopting its second job reuses its
+        sources), then recover the job from the last durable
+        checkpoint — state AND source cursors rewind to the same
+        commit, so replay is exact.  Returns the recovered committed
+        epoch (0 = fresh job, nothing durable yet)."""
+        from risingwave_tpu.sql.parser import parse_with_text
+
+        for sql in ddl:
+            for text, stmt in parse_with_text(sql):
+                nm = getattr(stmt, "name", None)
+                if isinstance(stmt, (ast.CreateSource,
+                                     ast.CreateMaterializedView,
+                                     ast.CreateSink)) \
+                        and nm in self.catalog:
+                    continue
+                if isinstance(stmt, ast.CreateFunction) \
+                        and nm in self.functions:
+                    continue
+                if isinstance(stmt, ast.DropStatement) \
+                        and nm not in self.catalog:
+                    continue  # dropped before this worker ever saw it
+                self.execute(text)
+        entry = self.catalog.get(name)
+        if entry.job is None:
+            raise ValueError(f"{name!r} did not produce a streaming job")
+        if recover:
+            entry.job.recover()
+        return entry.job.committed_epoch
 
     def collect_join_metrics(self) -> None:
         """Export join-path observability into the Prometheus registry.
@@ -1722,13 +1824,16 @@ class Engine:
                 raise PlanError(
                     "query_epoch needs a durable data_dir"
                 )
-            epochs = self.checkpoint_store.epochs(entry.name)
+            # checkpoints live under the JOB's name — an MV attached
+            # to a shared DagJob (MV-on-MV) reads its job's snapshot
+            ckpt_name = entry.job.name
+            epochs = self.checkpoint_store.epochs(ckpt_name)
             if qe not in epochs:
                 raise PlanError(
                     f"epoch {qe} is not retained for {entry.name} "
                     f"(retained: {epochs})"
                 )
-            _, states, _ = self.checkpoint_store.load(entry.name, qe)
+            _, states, _ = self.checkpoint_store.load(ckpt_name, qe)
             st = states
             for i in entry.mv_state_index:
                 st = st[i]

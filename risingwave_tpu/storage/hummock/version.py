@@ -222,6 +222,23 @@ class VersionManager:
                 self._write_base()
             return self.current
 
+    def commit_cluster_epoch(self, epoch: int) -> HummockVersion:
+        """Record a cluster-wide consistency point: an EMPTY delta
+        whose only effect is advancing ``max_committed_epoch``.
+
+        This is the cluster control plane's global commit (ref meta's
+        ``commit_epoch`` bumping the version even for SST-less
+        epochs): every streaming job has sealed the round, so the
+        manifest — the single durable authority readers trust —
+        advances exactly once per global checkpoint.  Crash-safe for
+        the same reason ingest commits are: the delta object IS the
+        commit; a meta killed before the put never half-commits."""
+        return self.commit(epoch, adds={}, removes={})
+
+    @property
+    def max_committed_epoch(self) -> int:
+        return self.current.max_committed_epoch
+
     def _write_base(self) -> None:
         v = self.current
         self.store.put(_BASE_FMT.format(v.vid),

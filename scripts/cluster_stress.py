@@ -1,0 +1,218 @@
+"""Cluster stress: sustained ingest + worker SIGKILL + pinned reads.
+
+The acceptance harness for the cluster-lite control plane (ISSUE 3):
+a 1-meta + N-compute cluster (workers are REAL processes) maintaining
+two nexmark MVs under continuous global barrier rounds while
+
+- one worker is SIGKILLed mid-stream (its jobs are reassigned to
+  survivors and replayed from the last committed cluster epoch),
+- concurrent serving reads — routed through the meta's pinned epoch —
+  run across the failover and must observe only committed state with
+  ZERO errors,
+- after the target number of committed rounds, every MV's contents
+  must be byte-identical to an undisturbed single-node run of the
+  same config and round count.
+
+Run standalone (prints one JSON summary line)::
+
+    python scripts/cluster_stress.py --rounds 24 --assert
+
+or the short ``slow``-marked pytest wrapper
+(tests/test_cluster_stress.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, ".")  # repo root
+
+CONFIG = {
+    "streaming": {"chunk_size": 256},
+    "state": {"agg_table_size": 1 << 10, "agg_emit_capacity": 256,
+              "mv_table_size": 1 << 10, "mv_ring_size": 1 << 12},
+    "storage": {"checkpoint_keep_epochs": 4},
+}
+
+DDL = [
+    """CREATE SOURCE bid (
+        auction BIGINT, bidder BIGINT, price BIGINT,
+        channel VARCHAR, url VARCHAR, date_time TIMESTAMP
+    ) WITH (connector = 'nexmark', nexmark.table = 'bid')""",
+    """CREATE MATERIALIZED VIEW q7 AS
+    SELECT window_start, max(price) AS max_price, count(*) AS bids
+    FROM TUMBLE(bid, date_time, INTERVAL '1' SECOND)
+    GROUP BY window_start""",
+    """CREATE MATERIALIZED VIEW qcnt AS
+    SELECT auction % 16 AS a, count(*) AS n, sum(price) AS vol
+    FROM bid GROUP BY auction % 16""",
+]
+
+READS = [
+    "SELECT window_start, max_price, bids FROM q7",
+    "SELECT a, n, vol FROM qcnt",
+]
+
+
+def _spawn_worker(meta_port: int, data_dir: str, idx: int):
+    env = dict(os.environ, JAX_PLATFORMS=os.environ.get(
+        "JAX_PLATFORMS", "cpu"))
+    return subprocess.Popen(
+        [sys.executable, "-m", "risingwave_tpu.server",
+         "--role", "compute", "--meta", f"127.0.0.1:{meta_port}",
+         "--data-dir", data_dir, "--config-json", json.dumps(CONFIG),
+         "--heartbeat-interval", "0.25"],
+        stdout=subprocess.DEVNULL,
+        stderr=open(os.path.join(data_dir, f"worker{idx}.log"), "wb"),
+        env=env,
+    )
+
+
+def run(rounds: int = 24, workers: int = 2, kill_at_round: int = 8,
+        chunks_per_barrier: int = 1, readers: int = 2,
+        data_dir: str | None = None) -> dict:
+    from risingwave_tpu.cluster import MetaService
+    from risingwave_tpu.common.config import RwConfig
+    from risingwave_tpu.sql.engine import Engine
+
+    data_dir = data_dir or tempfile.mkdtemp(prefix="cluster_stress_")
+    meta = MetaService(data_dir, heartbeat_timeout_s=4.0)
+    meta.start(port=0)
+    procs = [_spawn_worker(meta.rpc_port, data_dir, i)
+             for i in range(workers)]
+    state = {"reads": 0, "read_errors": [], "rounds_committed": 0,
+             "retries": 0}
+    stop = threading.Event()
+
+    def read_loop():
+        while not stop.is_set():
+            for sql in READS:
+                try:
+                    meta.serve(sql)
+                    state["reads"] += 1
+                except Exception as e:  # noqa: BLE001
+                    state["read_errors"].append(repr(e))
+            time.sleep(0.02)
+
+    try:
+        deadline = time.monotonic() + 120
+        while len(meta.live_workers()) < workers:
+            if time.monotonic() > deadline:
+                raise TimeoutError("workers never registered")
+            for p in procs:
+                if p.poll() is not None:
+                    raise RuntimeError(
+                        f"worker died at startup (logs in {data_dir})")
+            time.sleep(0.25)
+
+        for sql in DDL:
+            meta.execute_ddl(sql)
+
+        threads = [threading.Thread(target=read_loop, daemon=True)
+                   for _ in range(readers)]
+        for t in threads:
+            t.start()
+
+        killed_pid = None
+        t_start = time.monotonic()
+        for r in range(1, rounds + 1):
+            round_deadline = time.monotonic() + 240
+            while True:
+                res = meta.tick(chunks_per_barrier)
+                if res["committed"]:
+                    break
+                state["retries"] += 1
+                if time.monotonic() > round_deadline:
+                    raise TimeoutError(f"round {r} never committed")
+                time.sleep(0.2)
+            state["rounds_committed"] = r
+            if r == kill_at_round and killed_pid is None:
+                st = meta.state()
+                victim = next(w for w in st["workers"] if w["alive"]
+                              and w["jobs"])
+                killed_pid = victim["pid"]
+                os.kill(killed_pid, signal.SIGKILL)
+        wall = time.monotonic() - t_start
+
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+
+        cluster_rows = [sorted(tuple(v) for v in meta.serve(sql)[1])
+                        for sql in READS]
+
+        # undisturbed single-node reference (same config + rounds)
+        eng = Engine(RwConfig.from_dict(CONFIG))
+        for sql in DDL:
+            eng.execute(sql)
+        eng.tick(barriers=rounds, chunks_per_barrier=chunks_per_barrier)
+        single_rows = [
+            sorted(tuple(int(x) for x in r) for r in eng.execute(sql))
+            for sql in READS
+        ]
+        mismatches = sum(c != s
+                         for c, s in zip(cluster_rows, single_rows))
+
+        return {
+            "rounds": rounds,
+            "rounds_committed": state["rounds_committed"],
+            "workers": workers,
+            "killed_pid": killed_pid,
+            "failovers": meta.failovers,
+            "cluster_epoch": meta.cluster_epoch,
+            "manifest_epoch": meta.versions.max_committed_epoch,
+            "reads": state["reads"],
+            "read_errors": len(state["read_errors"]),
+            "read_error_samples": state["read_errors"][:3],
+            "tick_retries": state["retries"],
+            "mv_mismatches": mismatches,
+            "mv_rows": [len(r) for r in cluster_rows],
+            "wall_seconds": round(wall, 2),
+            "data_dir": data_dir,
+        }
+    finally:
+        stop.set()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+        meta.stop()
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--rounds", type=int, default=24)
+    p.add_argument("--workers", type=int, default=2)
+    p.add_argument("--kill-at-round", type=int, default=8)
+    p.add_argument("--chunks-per-barrier", type=int, default=1)
+    p.add_argument("--readers", type=int, default=2)
+    p.add_argument("--assert", dest="check", action="store_true",
+                   help="exit nonzero unless converged with 0 read "
+                        "errors and exactly one failover")
+    args = p.parse_args()
+    summary = run(rounds=args.rounds, workers=args.workers,
+                  kill_at_round=args.kill_at_round,
+                  chunks_per_barrier=args.chunks_per_barrier,
+                  readers=args.readers)
+    print(json.dumps(summary))
+    if args.check:
+        ok = (summary["read_errors"] == 0
+              and summary["mv_mismatches"] == 0
+              and summary["failovers"] == 1
+              and summary["rounds_committed"] == summary["rounds"])
+        raise SystemExit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,254 @@
+"""Cluster-lite control plane: RPC, placement, global epoch commit,
+heartbeat failover (in-process workers; process-level SIGKILL chaos
+lives in test_chaos.py)."""
+
+import time
+
+import pytest
+
+from risingwave_tpu.cluster import (
+    ComputeWorker,
+    MetaService,
+    RpcClient,
+    RpcError,
+    RpcServer,
+)
+from risingwave_tpu.common.config import RwConfig
+
+
+def _cfg():
+    return RwConfig.from_dict({
+        "streaming": {"chunk_size": 128},
+        "state": {"agg_table_size": 512, "agg_emit_capacity": 128,
+                  "mv_table_size": 512, "mv_ring_size": 1024},
+        "storage": {"checkpoint_keep_epochs": 4},
+    })
+
+
+def _rows(served):
+    return sorted(tuple(r) for r in served[1])
+
+
+def _single_rows(eng, sql):
+    return sorted(tuple(int(v) for v in r) for r in eng.execute(sql))
+
+
+# -- transport -----------------------------------------------------------
+class _EchoTarget:
+    def rpc_echo(self, x):
+        return {"x": x}
+
+    def rpc_boom(self):
+        raise ValueError("no")
+
+
+def test_rpc_roundtrip_and_errors():
+    server = RpcServer(_EchoTarget()).start()
+    try:
+        c = RpcClient("127.0.0.1", server.port, timeout=5)
+        assert c.call("echo", x=[1, "a", None]) == {"x": [1, "a", None]}
+        with pytest.raises(RpcError, match="no"):
+            c.call("boom")
+        with pytest.raises(RpcError, match="unknown method"):
+            c.call("nope")
+        # the connection survives remote errors
+        assert c.call("echo", x=2) == {"x": 2}
+        c.close()
+    finally:
+        server.stop()
+
+
+# -- the full control-plane loop -----------------------------------------
+def test_cluster_commit_failover_convergence(tmp_path):
+    """1 meta + 2 in-process workers, 2 MVs: global rounds commit ONE
+    cluster epoch; a silently-dying worker is expired by heartbeat
+    timeout, its job reassigned and replayed from the last committed
+    epoch; final MV contents match an undisturbed single-node run."""
+    from risingwave_tpu.sql.engine import Engine
+
+    ddl = [
+        "CREATE SOURCE t (k BIGINT, v BIGINT) "
+        "WITH (connector='datagen')",
+        "CREATE MATERIALIZED VIEW m1 AS "
+        "SELECT k % 8 AS g, count(*) AS n FROM t GROUP BY k % 8",
+        "CREATE MATERIALIZED VIEW m2 AS "
+        "SELECT k % 4 AS g, sum(v) AS s FROM t GROUP BY k % 4",
+    ]
+    meta = MetaService(str(tmp_path), heartbeat_timeout_s=1.0)
+    meta.start(port=0)
+    addr = f"127.0.0.1:{meta.rpc_port}"
+    w1 = ComputeWorker(addr, str(tmp_path), config=_cfg(),
+                       heartbeat_interval_s=0.2).start()
+    w2 = ComputeWorker(addr, str(tmp_path), config=_cfg(),
+                       heartbeat_interval_s=0.2).start()
+    try:
+        for sql in ddl:
+            meta.execute_ddl(sql)
+        jobs = {j["name"]: j for j in meta.state()["jobs"]}
+        # job-level placement spreads jobs across both workers
+        assert jobs["m1"]["worker"] != jobs["m2"]["worker"]
+
+        for _ in range(3):
+            res = meta.tick(1)
+            assert res["committed"], res
+        assert meta.cluster_epoch == 3
+        # the cluster epoch is durable in the shared version manifest
+        assert meta.versions.max_committed_epoch > 0
+
+        # reads route through the pinned epoch (committed state only)
+        assert _rows(meta.serve("SELECT g, n FROM m1")) == [
+            (g, 48) for g in range(8)
+        ]
+
+        # kill the worker owning m2 WITHOUT stopping heartbeats cleanly
+        victim, survivor = (w1, w2) \
+            if jobs["m2"]["worker"] == w1.worker_id else (w2, w1)
+        victim.stop()
+        deadline = time.monotonic() + 10
+        while meta.failovers == 0:
+            meta.check_heartbeats()
+            assert time.monotonic() < deadline, "failover never fired"
+            time.sleep(0.1)
+
+        # incomplete rounds must not advance the cluster epoch
+        for _ in range(3):
+            deadline = time.monotonic() + 30
+            while True:
+                res = meta.tick(1)
+                if res["committed"]:
+                    break
+                meta.check_heartbeats()
+                assert time.monotonic() < deadline
+                time.sleep(0.1)
+        assert meta.cluster_epoch == 6
+        st = {j["name"]: j for j in meta.state()["jobs"]}
+        assert st["m2"]["worker"] == survivor.worker_id
+
+        got1 = _rows(meta.serve("SELECT g, n FROM m1"))
+        got2 = _rows(meta.serve("SELECT g, s FROM m2"))
+
+        # undisturbed single-node reference: same config, same rounds
+        eng = Engine(_cfg())
+        for sql in ddl:
+            eng.execute(sql)
+        eng.tick(barriers=6, chunks_per_barrier=1)
+        assert got1 == _single_rows(eng, "SELECT g, n FROM m1")
+        assert got2 == _single_rows(eng, "SELECT g, s FROM m2")
+        assert meta.failovers == 1
+    finally:
+        w1.stop()
+        w2.stop()
+        meta.stop()
+
+
+def test_mv_on_mv_colocates_and_serves(tmp_path):
+    """An MV over another MV lands on the upstream's job/worker (the
+    engine attaches it to the same DagJob there); both serve."""
+    meta = MetaService(str(tmp_path), heartbeat_timeout_s=5.0)
+    meta.start(port=0, monitor=False)
+    addr = f"127.0.0.1:{meta.rpc_port}"
+    w1 = ComputeWorker(addr, str(tmp_path), config=_cfg(),
+                       heartbeat_interval_s=0.5).start()
+    w2 = ComputeWorker(addr, str(tmp_path), config=_cfg(),
+                       heartbeat_interval_s=0.5).start()
+    try:
+        meta.execute_ddl(
+            "CREATE SOURCE t (k BIGINT, v BIGINT) "
+            "WITH (connector='datagen')"
+        )
+        meta.execute_ddl(
+            "CREATE MATERIALIZED VIEW base AS "
+            "SELECT k % 4 AS g, count(*) AS n FROM t GROUP BY k % 4"
+        )
+        meta.execute_ddl(
+            "CREATE MATERIALIZED VIEW top1 AS "
+            "SELECT g, n FROM base WHERE g < 2"
+        )
+        st = meta.state()
+        jobs = {j["name"]: j for j in st["jobs"]}
+        assert "top1" not in jobs  # rides the upstream job
+        assert jobs["base"]["mvs"] == ["base", "top1"]
+        for _ in range(2):
+            assert meta.tick(1)["committed"]
+        assert _rows(meta.serve("SELECT g, n FROM base")) == [
+            (g, 64) for g in range(4)
+        ]
+        assert _rows(meta.serve("SELECT g, n FROM top1")) == [
+            (0, 64), (1, 64)
+        ]
+    finally:
+        w1.stop()
+        w2.stop()
+        meta.stop()
+
+
+def test_insert_forwarding_reaches_table_hosts(tmp_path):
+    """INSERTs fan out to the workers whose catalogs hold the table;
+    the owning job materializes them on the next global round."""
+    meta = MetaService(str(tmp_path), heartbeat_timeout_s=5.0)
+    meta.start(port=0, monitor=False)
+    addr = f"127.0.0.1:{meta.rpc_port}"
+    w1 = ComputeWorker(addr, str(tmp_path), config=_cfg(),
+                       heartbeat_interval_s=0.5).start()
+    try:
+        meta.execute_ddl("CREATE TABLE dt (k BIGINT, v BIGINT)")
+        with pytest.raises(ValueError, match="no live worker"):
+            meta.execute_ddl("INSERT INTO dt VALUES (0, 0)")
+        meta.execute_ddl(
+            "CREATE MATERIALIZED VIEW dv AS "
+            "SELECT k, sum(v) AS s FROM dt GROUP BY k"
+        )
+        meta.execute_ddl(
+            "INSERT INTO dt VALUES (1, 10), (1, 5), (2, 7)"
+        )
+        for _ in range(2):
+            assert meta.tick(1)["committed"]
+        assert _rows(meta.serve("SELECT k, s FROM dv")) == [
+            (1, 15), (2, 7)
+        ]
+        # the statement is durable in the meta's DML log
+        assert meta.store.dml_sql_log() == [
+            "INSERT INTO dt VALUES (1, 10), (1, 5), (2, 7)"
+        ]
+    finally:
+        w1.stop()
+        meta.stop()
+
+
+def test_engine_export_adopt_roundtrip(tmp_path):
+    """Engine-level reassignment primitive: export a job's DDL from
+    one engine, adopt it on a fresh compute-role engine over the same
+    data_dir — state and source cursor resume at the exported
+    engine's last committed epoch."""
+    from risingwave_tpu.sql.engine import Engine
+
+    eng = Engine(_cfg(), data_dir=str(tmp_path))
+    eng.execute(
+        "CREATE SOURCE t (k BIGINT, v BIGINT) "
+        "WITH (connector='datagen');"
+        "CREATE MATERIALIZED VIEW em AS "
+        "SELECT k % 4 AS g, count(*) AS n FROM t GROUP BY k % 4"
+    )
+    eng.tick(barriers=2, chunks_per_barrier=1)
+    ddl = eng.export_job_ddl("em")
+    assert len(ddl) == 2 and "CREATE MATERIALIZED VIEW" in ddl[1]
+
+    adoptee = Engine(_cfg(), data_dir=str(tmp_path), role="compute")
+    # compute role: no meta store / no hummock manifest of its own
+    assert adoptee.meta_store is None and adoptee.hummock is None
+    epoch = adoptee.adopt_job(ddl, "em")
+    assert epoch == eng.jobs[0].committed_epoch > 0
+    assert _single_rows(adoptee, "SELECT g, n FROM em") \
+        == _single_rows(eng, "SELECT g, n FROM em")
+    # adoption is idempotent for already-present DDL
+    assert adoptee.adopt_job(ddl, "em") == epoch
+
+
+def test_serve_unknown_mv_is_final_error(tmp_path):
+    meta = MetaService(str(tmp_path), serve_retry_timeout_s=0.5)
+    meta.start(port=0, monitor=False)
+    try:
+        with pytest.raises(ValueError, match="not a placed MV"):
+            meta.serve("SELECT * FROM nope")
+    finally:
+        meta.stop()

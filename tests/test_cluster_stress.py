@@ -1,0 +1,24 @@
+"""Slow wrapper over scripts/cluster_stress.py (the ISSUE 3 acceptance
+harness), matching the compaction_stress pattern."""
+
+import pytest
+
+
+@pytest.mark.slow
+def test_cluster_stress_short(tmp_path):
+    import importlib
+    import sys
+
+    sys.path.insert(0, "scripts")
+    try:
+        cs = importlib.import_module("cluster_stress")
+    finally:
+        sys.path.pop(0)
+
+    summary = cs.run(rounds=10, workers=2, kill_at_round=4,
+                     readers=2, data_dir=str(tmp_path))
+    assert summary["read_errors"] == 0, summary["read_error_samples"]
+    assert summary["mv_mismatches"] == 0
+    assert summary["failovers"] == 1
+    assert summary["rounds_committed"] == summary["rounds"]
+    assert summary["reads"] > 0

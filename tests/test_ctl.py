@@ -38,6 +38,61 @@ def test_describe_job_and_cluster_info():
     assert ci["system_params"]["checkpoint_frequency"] == 1
 
 
+def test_ctl_cluster_subcommands(tmp_path):
+    """``ctl cluster {workers,jobs,epochs}`` against a RUNNING meta
+    (online RPC, mirroring the offline ``ctl storage`` pattern)."""
+    from risingwave_tpu.cluster import ComputeWorker, MetaService
+    from risingwave_tpu.common.config import RwConfig
+    from risingwave_tpu.ctl import (
+        cluster_epochs,
+        cluster_jobs,
+        cluster_workers,
+    )
+
+    cfg = RwConfig.from_dict({
+        "streaming": {"chunk_size": 64},
+        "state": {"agg_table_size": 256, "agg_emit_capacity": 64,
+                  "mv_table_size": 256, "mv_ring_size": 512},
+    })
+    meta = MetaService(str(tmp_path), heartbeat_timeout_s=5.0)
+    meta.start(port=0, monitor=False)
+    addr = f"127.0.0.1:{meta.rpc_port}"
+    w = ComputeWorker(addr, str(tmp_path), config=cfg,
+                      heartbeat_interval_s=0.5).start()
+    try:
+        meta.execute_ddl(
+            "CREATE SOURCE t (k BIGINT) WITH (connector='datagen');"
+            "CREATE MATERIALIZED VIEW cv AS "
+            "SELECT k % 2 AS b, count(*) AS n FROM t GROUP BY k % 2"
+        )
+        assert meta.tick(1)["committed"]
+
+        workers = cluster_workers(addr)
+        assert len(workers) == 1
+        assert workers[0]["alive"] is True
+        assert workers[0]["jobs"] == ["cv"]
+        assert workers[0]["heartbeat_age_s"] >= 0.0
+
+        jobs = cluster_jobs(addr)
+        assert jobs == [{
+            "name": "cv", "mvs": ["cv"],
+            "worker": w.worker_id, "rounds": 1,
+            "pinned_epoch": jobs[0]["pinned_epoch"],
+            "committed_epoch": jobs[0]["committed_epoch"],
+        }]
+        assert jobs[0]["pinned_epoch"] > 0
+        assert jobs[0]["pinned_epoch"] == jobs[0]["committed_epoch"]
+
+        ep = cluster_epochs(addr)
+        assert ep["cluster_epoch"] == 1
+        assert ep["manifest_epoch"] == jobs[0]["pinned_epoch"]
+        assert ep["failovers"] == 0
+        assert ep["jobs"]["cv"]["rounds"] == 1
+    finally:
+        w.stop()
+        meta.stop()
+
+
 def test_troublemaker_corruption_is_caught():
     """Injected op corruption must surface via consistency counters,
     never silently wrong results (ref RW_UNSAFE_ENABLE_INSANE_MODE)."""
