@@ -86,6 +86,9 @@ class JobInfo:
     seal_log: list = field(default_factory=list)
     #: epoch value serving reads pin for this job (last CLUSTER commit)
     pinned_epoch: int = 0
+    #: last durable (upload-acked) epoch the worker reported — the
+    #: cluster epoch commits only when this catches the round's seal
+    durable_epoch: int = 0
 
 
 class MetaService:
@@ -95,7 +98,8 @@ class MetaService:
     def __init__(self, data_dir: str, heartbeat_timeout_s: float = 3.0,
                  metrics: MetricsRegistry | None = None,
                  serve_retry_timeout_s: float = 60.0,
-                 rpc_timeout_s: float = 180.0):
+                 rpc_timeout_s: float = 180.0,
+                 durable_wait_s: float = 15.0):
         from risingwave_tpu.storage.hummock.object_store import (
             LocalFsObjectStore,
         )
@@ -105,6 +109,11 @@ class MetaService:
         self.heartbeat_timeout_s = heartbeat_timeout_s
         self.serve_retry_timeout_s = serve_retry_timeout_s
         self.rpc_timeout_s = rpc_timeout_s
+        #: how long one tick() waits for the round's checkpoint
+        #: uploads to ack before returning the round uncommitted
+        #: (retried by the next tick — rounds never commit past a
+        #: non-durable seal)
+        self.durable_wait_s = durable_wait_s
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         #: durable DDL log — the same store a single node replays, so a
         #: restarted meta (or a single-node takeover) can rebuild the
@@ -378,6 +387,8 @@ class MetaService:
         """Translate a recovered committed epoch back into the round
         the job actually reached (its checkpoint may include a round
         meta never saw acknowledged)."""
+        # the recovered epoch IS durable (adoption loads the manifest)
+        job.durable_epoch = max(epoch, 0)
         epochs = [e for _, e in job.seal_log]
         if epoch <= 0:
             # no durable checkpoint: the job replays every round it
@@ -410,11 +421,15 @@ class MetaService:
         return self.tick(chunks_per_barrier)
 
     def tick(self, chunks_per_barrier: int = 1) -> dict:
-        """Drive ONE global barrier round: every job seals round
-        ``cluster_epoch + 1``; when all have, commit the cluster epoch
-        through the versioned manifest.  Incomplete rounds (dead or
-        unassigned workers) commit nothing — the cluster epoch never
-        moves past a hole, and survivors run at most one round ahead."""
+        """Drive ONE global barrier round: every job SEALS round
+        ``cluster_epoch + 1`` (the barrier RPC returns as soon as the
+        epoch is sealed — its checkpoint upload runs in the worker's
+        background uploader); the cluster epoch commits through the
+        versioned manifest only when every job's upload has ACKED the
+        sealed epoch.  Incomplete rounds (dead/unassigned workers,
+        uploads still in flight) commit nothing — the cluster epoch
+        never moves past a hole, and survivors run at most one round
+        ahead."""
         t0 = time.perf_counter()
         with self._tick_lock:
             target = self.cluster_epoch + 1
@@ -441,12 +456,17 @@ class MetaService:
                     )
                 except (RpcError, ConnectionError, OSError):
                     continue  # monitor expires the worker; round stalls
-                epoch = int(res["committed_epoch"])
+                epoch = int(res.get("sealed_epoch",
+                                    res["committed_epoch"]))
                 with self._lock:
                     job.rounds = target
                     job.seal_log.append((target, epoch))
+                    job.durable_epoch = int(
+                        res.get("durable_epoch", epoch)
+                    )
                 sealed += 1
-            committed = sealed == len(jobs)
+            committed = sealed == len(jobs) \
+                and self._await_durable(jobs, target)
             if committed:
                 self._commit_cluster_epoch(target, jobs)
                 self.metrics.observe(
@@ -456,6 +476,45 @@ class MetaService:
             return {"round": target, "committed": committed,
                     "jobs": len(jobs), "sealed": sealed,
                     "cluster_epoch": self.cluster_epoch}
+
+    def _await_durable(self, jobs: list[JobInfo], target: int) -> bool:
+        """The seal-vs-ack split: poll each sealed job's worker until
+        its durable (upload-acked) epoch reaches the round's seal, or
+        the bounded wait expires (round retried by the next tick)."""
+        deadline = time.monotonic() + self.durable_wait_s
+        for job in jobs:
+            with self._lock:
+                if not job.seal_log:
+                    return False
+                want = job.seal_log[-1][1]
+                w = self.workers.get(job.worker_id) \
+                    if job.worker_id is not None else None
+            lag_gauge = lambda v: self.metrics.set_gauge(  # noqa: E731
+                "cluster_job_durable_lag_epochs", v, job=job.name,
+            )
+            if job.durable_epoch >= want:
+                lag_gauge(0)
+                continue
+            if w is None or not w.alive:
+                return False
+            while True:
+                try:
+                    res = w.client.call("job_epochs", job=job.name)
+                except (RpcError, ConnectionError, OSError):
+                    return False
+                with self._lock:
+                    job.durable_epoch = int(res.get("durable", 0))
+                lag_gauge(max(0, want - job.durable_epoch))
+                self.metrics.set_gauge(
+                    "cluster_job_upload_queue_depth",
+                    int(res.get("upload_queue", 0)), job=job.name,
+                )
+                if job.durable_epoch >= want:
+                    break
+                if time.monotonic() > deadline:
+                    return False
+                time.sleep(0.02)
+        return True
 
     def _commit_cluster_epoch(self, round_: int,
                               jobs: list[JobInfo]) -> None:
@@ -551,6 +610,9 @@ class MetaService:
                     {"name": j.name, "mvs": list(j.mvs),
                      "worker": j.worker_id, "rounds": j.rounds,
                      "pinned_epoch": j.pinned_epoch,
+                     "sealed_epoch":
+                         j.seal_log[-1][1] if j.seal_log else 0,
+                     "durable_epoch": j.durable_epoch,
                      "committed_epoch":
                          j.seal_log[-1][1] if j.seal_log else 0}
                     for j in self.jobs.values()

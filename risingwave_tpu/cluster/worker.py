@@ -90,6 +90,12 @@ class ComputeWorker:
                 time.sleep(self.heartbeat_interval_s)
 
     def stop(self) -> None:
+        try:
+            with self._lock:
+                # orderly exit: sealed epochs finish becoming durable
+                self.engine.drain_uploads()
+        except Exception:  # noqa: BLE001 — a failed upload rewinds
+            pass
         self._stop.set()
         if self._hb_thread is not None:
             self._hb_thread.join(timeout=5)
@@ -118,10 +124,22 @@ class ComputeWorker:
 
     def rpc_barrier(self, job: str, chunks: int = 1) -> dict:
         """Process ``chunks`` chunks + one barrier for one job — the
-        meta's global round, applied locally."""
+        meta's global round, applied locally.  Returns the SEALED
+        epoch immediately (the checkpoint upload runs in the job's
+        background uploader); meta polls ``job_epochs`` for the
+        durable ack before committing the cluster epoch."""
         with self._lock:
-            epoch = self.engine.tick_job(job, int(chunks))
-        return {"ok": True, "committed_epoch": epoch}
+            sealed = self.engine.tick_job(job, int(chunks))
+            positions = self.engine.job_epochs(job)
+        return {"ok": True, "committed_epoch": sealed,
+                "sealed_epoch": sealed,
+                "durable_epoch": positions["durable"]}
+
+    def rpc_job_epochs(self, job: str) -> dict:
+        """Seal-vs-durable positions of one job (also services its
+        pending upload acks — see Engine.job_epochs)."""
+        with self._lock:
+            return self.engine.job_epochs(job)
 
     def rpc_serve(self, sql: str, query_epoch: int = 0) -> dict:
         """Batch read; ``query_epoch`` pins the retained checkpoint of

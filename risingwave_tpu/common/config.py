@@ -103,10 +103,14 @@ _SYSTEM_PARAM_DEFS = {
     #: checkpoints between state-maintenance passes (rehash + counter
     #: checks); >1 amortizes the per-barrier device syncs
     "maintenance_interval_checkpoints": (1, True),
-    #: checkpoints between in-memory snapshot copies; >1 amortizes the
-    #: full-state device copy (recovery falls back up to N-1 extra
-    #: epochs; the reference uploads deltas instead — next round)
+    #: checkpoints between in-memory snapshots; >1 amortizes the
+    #: incremental shadow-snapshot dispatch (recovery falls back up to
+    #: N-1 extra epochs)
     "snapshot_interval_checkpoints": (1, True),
+    #: max sealed-but-not-yet-durable epochs in the async checkpoint
+    #: uploader before the barrier loop write-stalls (the checkpoint
+    #: analog of the storage L0-depth stall)
+    "checkpoint_upload_window": (4, True),
     "pause_on_next_bootstrap": (False, True),
 }
 

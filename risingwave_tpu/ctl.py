@@ -213,8 +213,9 @@ def cluster_jobs(meta_addr: str) -> list[dict]:
 
 def cluster_epochs(meta_addr: str) -> dict:
     """``ctl cluster epochs``: the global checkpoint positions — the
-    committed cluster epoch (round), the manifest's epoch stamp, and
-    each job's serving pin."""
+    committed cluster epoch (round), the manifest's epoch stamp, each
+    job's serving pin, and the async-checkpoint split (sealed vs
+    durable epoch + upload lag per job)."""
     s = _meta_state(meta_addr)
     return {
         "cluster_epoch": s["cluster_epoch"],
@@ -223,6 +224,11 @@ def cluster_epochs(meta_addr: str) -> dict:
         "jobs": {
             j["name"]: {"pinned_epoch": j["pinned_epoch"],
                         "committed_epoch": j["committed_epoch"],
+                        "sealed_epoch": j.get("sealed_epoch", 0),
+                        "durable_epoch": j.get("durable_epoch", 0),
+                        "upload_lag_epochs": max(
+                            0, j.get("sealed_epoch", 0)
+                            - j.get("durable_epoch", 0)),
                         "rounds": j["rounds"]}
             for j in s["jobs"]
         },

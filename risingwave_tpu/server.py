@@ -87,10 +87,14 @@ class SingleNode:
             with self._lock:
                 if self.engine.jobs:
                     # chunks_per_barrier=0: flush/commit what already
-                    # flowed, pull nothing new on the way out
+                    # flowed, pull nothing new on the way out (tick's
+                    # batch boundary also drains the upload queue)
                     self.engine.tick(barriers=1, chunks_per_barrier=0)
         finally:
-            self.engine.stop_storage_service()
+            try:
+                self.engine.drain_uploads()
+            finally:
+                self.engine.stop_storage_service()
 
 
 def _run_meta(args) -> None:

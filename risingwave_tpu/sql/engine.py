@@ -754,6 +754,14 @@ class Engine:
         dag.committed_epoch = job.committed_epoch
         dag.maintenance_interval = job.maintenance_interval
         dag.snapshot_interval = job.snapshot_interval
+        # the checkpoint pipeline migrates with the job: the uploader
+        # queue (FIFO, same job name) keeps in-flight epochs ordered
+        # ahead of the reseed below; the shadow is dropped — the state
+        # tree changed shape, the reseed re-bases it
+        dag.sealed_epoch = job.sealed_epoch
+        dag._uploader = job._uploader
+        dag.upload_window = job.upload_window
+        dag.metrics = job.metrics
         self.jobs[self.jobs.index(job)] = dag
         entry.job = dag
         entry.mv_state_index = (0,) + tuple(entry.mv_state_index)
@@ -1485,11 +1493,17 @@ class Engine:
         ))
         stall_hook = self._storage_stall_hook \
             if self.hummock is not None else None
+        upload_window = int(self.system_params.get(
+            "checkpoint_upload_window"
+        ))
         for _ in range(barriers):
             for job in self.jobs:
                 job.checkpoint_frequency = ckpt_freq
                 job.maintenance_interval = maint
                 job.snapshot_interval = snap_iv
+                job.upload_window = upload_window
+                if getattr(job, "metrics", None) is None:
+                    job.metrics = self.metrics
                 if hasattr(job, "write_stall_hook"):
                     job.write_stall_hook = stall_hook
                 t0 = time.perf_counter()
@@ -1506,9 +1520,15 @@ class Engine:
                 self.metrics.inc("stream_rows_total", rows, job=job.name)
                 self.metrics.observe("barrier_latency_seconds", dt,
                                      job=job.name)
-                self.metrics.set_gauge(
-                    "committed_epoch", job.committed_epoch, job=job.name
-                )
+        # batch boundary = durability point: uploads sealed inside the
+        # window pipelined against the barrier loop; they must land
+        # before tick() returns (tests/FLUSH/restart determinism).
+        # Cluster workers are driven via tick_job instead — there the
+        # seal/ack split is the meta's global protocol.
+        for job in self.jobs:
+            if hasattr(job, "drain_uploads"):
+                job.drain_uploads()
+            self._export_checkpoint_gauges(job)
 
     def tick_job(self, name: str, chunks_per_barrier: int = 1) -> int:
         """Advance ONE job a single barrier round (the cluster worker's
@@ -1524,6 +1544,11 @@ class Engine:
         job.snapshot_interval = int(self.system_params.get(
             "snapshot_interval_checkpoints"
         ))
+        job.upload_window = int(self.system_params.get(
+            "checkpoint_upload_window"
+        ))
+        if getattr(job, "metrics", None) is None:
+            job.metrics = self.metrics
         t0 = time.perf_counter()
         if hasattr(job, "run_chunks"):
             rows = job.run_chunks(chunks_per_barrier)
@@ -1535,9 +1560,80 @@ class Engine:
         dt = time.perf_counter() - t0
         self.metrics.inc("stream_rows_total", rows, job=job.name)
         self.metrics.observe("barrier_latency_seconds", dt, job=job.name)
+        self._export_checkpoint_gauges(job)
+        # the SEAL, not the durable commit: the cluster's global epoch
+        # advances only when every job's upload acks (meta polls
+        # job_epochs) — the per-job barrier RPC never blocks on I/O
+        return getattr(job, "sealed_epoch", job.committed_epoch)
+
+    def _export_checkpoint_gauges(self, job) -> None:
+        """Cheap (no device sync) checkpoint-pipeline gauges."""
         self.metrics.set_gauge("committed_epoch", job.committed_epoch,
                                job=job.name)
-        return job.committed_epoch
+        sealed = getattr(job, "sealed_epoch", job.committed_epoch)
+        self.metrics.set_gauge("sealed_epoch", sealed, job=job.name)
+        self.metrics.set_gauge(
+            "checkpoint_seal_lag_epochs",
+            max(0, sealed - job.committed_epoch), job=job.name,
+        )
+        if hasattr(job, "upload_queue_depth"):
+            self.metrics.set_gauge(
+                "checkpoint_upload_queue_depth",
+                job.upload_queue_depth(), job=job.name,
+            )
+        up = getattr(job, "_uploader", None)
+        if up is not None:
+            self.metrics.set_gauge("checkpoint_uploads_total",
+                                   up.uploads_total, job=job.name)
+            self.metrics.set_gauge("checkpoint_upload_seconds_total",
+                                   up.upload_seconds_total,
+                                   job=job.name)
+            self.metrics.set_gauge("checkpoint_upload_stall_seconds_total",
+                                   up.stall_seconds_total, job=job.name)
+
+    def job_epochs(self, name: str) -> dict:
+        """Seal-vs-durable positions of one job (the cluster meta polls
+        this to decide when a round's uploads have all acked).  Also
+        services the job's pending acks — the worker's barrier loop
+        only runs when meta drives it, so durable progress must be
+        observable between rounds."""
+        job = self._job_by_name(name)
+        if hasattr(job, "_process_upload_acks"):
+            job._process_upload_acks()
+        return {
+            "sealed": getattr(job, "sealed_epoch", job.committed_epoch),
+            "durable": job.committed_epoch,
+            "upload_queue": job.upload_queue_depth()
+            if hasattr(job, "upload_queue_depth") else 0,
+        }
+
+    def drain_uploads(self) -> None:
+        """Flush every job's checkpoint-upload queue (orderly stop)."""
+        for job in self.jobs:
+            if hasattr(job, "drain_uploads"):
+                job.drain_uploads()
+
+    def collect_checkpoint_metrics(self) -> None:
+        """Snapshot-pipeline observability requiring a device readback
+        (dirty-block ratio) — on-demand like collect_join_metrics; the
+        steady loop never calls it."""
+        for job in self.jobs:
+            self._export_checkpoint_gauges(job)
+            shadow = getattr(job, "_shadow", None)
+            if shadow is not None:
+                self.metrics.set_gauge(
+                    "snapshot_dirty_block_ratio",
+                    shadow.dirty_ratio(), job=job.name,
+                )
+                self.metrics.set_gauge(
+                    "snapshot_shadow_blocks", shadow.total_blocks,
+                    job=job.name,
+                )
+            if hasattr(job, "stall_seconds"):
+                self.metrics.set_gauge(
+                    "checkpoint_stall_seconds_total",
+                    job.stall_seconds, job=job.name,
+                )
 
     def _job_by_name(self, name: str):
         for job in self.jobs:

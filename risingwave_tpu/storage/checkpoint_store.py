@@ -13,8 +13,9 @@ Executor state here is a pytree of dense device arrays, not a KV map —
 so the natural delta is *dirty blocks of those arrays*:
 
 1. A jitted digest program hashes every state leaf in fixed-size blocks
-   ON DEVICE (splitmix-style position-mixed sum).  One small transfer
-   fetches all block digests.
+   ON DEVICE (storage/digest.py — the SAME scheme the in-memory shadow
+   snapshot uses, so on the async path the digest vector is computed
+   once per snapshot and handed in; the store never re-reads state).
 2. Blocks whose digest changed since the last checkpoint are fetched as
    flat slices (adjacent dirty blocks coalesce into runs) and written
    as a delta file — device→host traffic and disk bytes scale with the
@@ -22,6 +23,19 @@ so the natural delta is *dirty blocks of those arrays*:
 3. Every ``full_interval`` checkpoints (or when >50% of blocks are
    dirty) a full snapshot re-bases the chain, bounding restore length
    and letting GC reclaim old chains.
+
+Persistence is split into two phases so a background uploader can
+pipeline it (stream/checkpoint.py):
+
+- ``prepare()`` — the device→host fetch: stages the epoch's payload as
+  host arrays and decides full-vs-delta.  After it returns, the caller
+  may mutate/donate the device buffers.
+- ``commit()`` — npz/meta encode, object-store writes, manifest bump,
+  GC, digest-cache advance.
+
+``save()`` remains the synchronous composition of both.  A manifest
+lock serializes commits across jobs (one engine hosts several jobs,
+each with its own uploader thread, over ONE manifest file).
 
 Restore = nearest full ≤ target epoch + deltas replayed forward —
 exactly the reference's version + version-delta reconstruction.  MV
@@ -35,45 +49,26 @@ import io
 import json
 import os
 import pickle
+import threading
 from typing import Any
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from risingwave_tpu.common.hash import _MIX_K1 as _GOLD, _mix64
+from risingwave_tpu.storage.digest import (
+    DEFAULT_BLOCK_ELEMS,
+    digest_leaves,
+    leaf_block_count,
+    normalize_u64,
+)
 
-
-def _normalize_u64(x):
-    """Change-faithful view of any leaf as flat uint64 (1:1 elements).
-
-    float64 avoids 64-bit float bitcasts (unimplemented by the TPU x64
-    rewrite — see common/hash._key_words): frexp decomposes exactly
-    into a 53-bit integer mantissa + exponent, with inf/nan pinned to
-    sentinels so value flips never alias zero."""
-    if x.dtype == jnp.bool_:
-        v = x.astype(jnp.uint64)
-    elif x.dtype == jnp.float64:
-        m, e = jnp.frexp(x)
-        m2 = (m * (2.0 ** 53)).astype(jnp.int64)
-        m2 = jnp.where(jnp.isnan(x), jnp.int64(-(2 ** 62)), m2)
-        m2 = jnp.where(jnp.isposinf(x), jnp.int64(2 ** 62), m2)
-        m2 = jnp.where(jnp.isneginf(x), jnp.int64(-(2 ** 62) + 1), m2)
-        v = m2.astype(jnp.uint64) ^ (e.astype(jnp.uint64)
-                                     << np.uint64(53))
-    elif x.dtype == jnp.float32:
-        v = jax.lax.bitcast_convert_type(x, jnp.uint32).astype(jnp.uint64)
-    elif x.dtype.itemsize == 8:
-        v = jax.lax.bitcast_convert_type(x, jnp.uint64)
-    else:
-        u = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32}[x.dtype.itemsize]
-        v = jax.lax.bitcast_convert_type(x, u).astype(jnp.uint64)
-    return v.reshape(-1)
+# back-compat aliases (pre-round-7 internal names)
+_normalize_u64 = normalize_u64
 
 
 def _leaf_block_count(shape, dtype, block: int) -> int:
-    n = int(np.prod(shape)) if shape else 1
-    return max(1, -(-n // block))
+    return leaf_block_count(shape, block)
 
 
 class CheckpointStore:
@@ -85,7 +80,8 @@ class CheckpointStore:
     _MANIFEST = "MANIFEST.json"
 
     def __init__(self, root: str, keep_epochs: int = 2,
-                 full_interval: int = 16, block_elems: int = 1 << 9,
+                 full_interval: int = 16,
+                 block_elems: int = DEFAULT_BLOCK_ELEMS,
                  object_store=None):
         from risingwave_tpu.storage.hummock.object_store import (
             LocalFsObjectStore,
@@ -102,6 +98,9 @@ class CheckpointStore:
         self._digest_fns: dict[str, Any] = {}
         self._last_digests: dict[str, tuple[int, np.ndarray]] = {}
         self._since_full: dict[str, int] = {}
+        #: serializes manifest read-modify-write + digest-cache updates
+        #: across uploader threads (several jobs share one manifest)
+        self._lock = threading.RLock()
 
     def _abs(self, key: str) -> str:
         """Filesystem path for a key when the backend is local (the
@@ -126,72 +125,74 @@ class CheckpointStore:
         (stale digests are discarded with the program)."""
         sig = tuple((str(np.asarray(x).dtype) if not hasattr(x, "dtype")
                      else str(x.dtype), np.shape(x)) for x in leaves)
-        cached = self._digest_fns.get(job_name)
-        if cached is not None and cached[2] == sig:
-            return cached[0], cached[1]
-        if cached is not None:
-            self._last_digests.pop(job_name, None)
-            self._since_full.pop(job_name, None)
+        with self._lock:
+            cached = self._digest_fns.get(job_name)
+            if cached is not None and cached[2] == sig:
+                return cached[0], cached[1]
+            if cached is not None:
+                self._last_digests.pop(job_name, None)
+                self._since_full.pop(job_name, None)
+            block = self.block_elems
+            nblocks = [
+                leaf_block_count(np.shape(x), block) for x in leaves
+            ]
+
+            def digest(leaves):
+                return digest_leaves(
+                    [jnp.asarray(x) for x in leaves], nblocks, block
+                )
+
+            self._digest_fns[job_name] = (jax.jit(digest), nblocks, sig)
+            return self._digest_fns[job_name][0], nblocks
+
+    # -- checkpoint save: prepare (fetch) / commit (write) --------------
+    def prepare(self, job_name: str, epoch: int, leaves, shapes,
+                treedef, source_state: dict, digests=None) -> dict:
+        """Stage one epoch's payload on the host.
+
+        ``leaves`` may be device arrays of any shape (they are read as
+        flat element streams); ``digests`` (uint64 vector from the
+        shadow snapshot's update program) skips the digest pass.  After
+        this returns, the caller may freely mutate or donate the device
+        buffers — everything needed by ``commit`` is host-resident."""
         block = self.block_elems
-        nblocks = [
-            _leaf_block_count(np.shape(x), None, block) for x in leaves
-        ]
+        nblocks = [leaf_block_count(s, block) for s in shapes]
+        if digests is None:
+            digest_jit, nblocks = self._digest_fn(job_name, leaves)
+            digests = np.asarray(digest_jit(leaves))
+        else:
+            digests = np.asarray(digests).astype(np.uint64, copy=False)
 
-        def digest(leaves):
-            outs = []
-            for x, nb in zip(leaves, nblocks):
-                v = _normalize_u64(jnp.asarray(x))
-                pad = nb * block - v.shape[0]
-                v = jnp.pad(v, (0, pad))
-                idx = jnp.arange(v.shape[0], dtype=jnp.uint64)
-                h = _mix64(v ^ (idx * _GOLD) ^ _GOLD)
-                outs.append(jnp.sum(h.reshape(nb, block), axis=1))
-            return jnp.concatenate(outs)
+        with self._lock:
+            prev = self._last_digests.get(job_name)
+            since_full = self._since_full.get(job_name, 0)
+            # a re-save of an epoch already in the manifest
+            # (post-rescale re-base, re-seal after a crashed commit)
+            # must be FULL: a delta would overwrite a chain entry with
+            # a wrong-base delta
+            resave = epoch in self._load_manifest()["jobs"].get(
+                job_name, {}).get("epochs", [])
 
-        self._digest_fns[job_name] = (jax.jit(digest), nblocks, sig)
-        return self._digest_fns[job_name][0], nblocks
-
-    # -- checkpoint save/load -------------------------------------------
-    def save(self, job_name: str, epoch: int, states: Any,
-             source_state: dict) -> None:
-        """Persist one committed epoch (the 'SST upload' + commit).
-
-        ``states`` may be a DEVICE pytree — only dirty blocks are
-        fetched for delta checkpoints."""
-        leaves, treedef = jax.tree.flatten(states)
-        digest_jit, nblocks = self._digest_fn(job_name, leaves)
-        digests = np.asarray(digest_jit(leaves))
-
-        prev = self._last_digests.get(job_name)
-        since_full = self._since_full.get(job_name, 0)
         dirty = None
         if prev is not None and prev[1].shape == digests.shape:
             dirty = digests != prev[1]
         kind = "delta"
         if (dirty is None or since_full >= self.full_interval - 1
-                or int(dirty.sum()) * 2 > digests.shape[0]):
-            kind = "full"
-        # a re-save of an epoch already in the manifest (post-rescale
-        # re-base, re-seal after a crashed commit) must be FULL: a
-        # delta would overwrite a chain entry with a wrong-base delta
-        if epoch in self._load_manifest()["jobs"].get(
-                job_name, {}).get("epochs", []):
+                or int(dirty.sum()) * 2 > digests.shape[0] or resave):
             kind = "full"
 
-        key = f"{job_name}/epoch_{epoch}"
+        payload: dict[str, np.ndarray] = {}
         if kind == "full":
-            host = jax.device_get(leaves)
-            buf = io.BytesIO()
-            np.savez(buf, **{f"leaf_{i}": np.asarray(l)
-                             for i, l in enumerate(host)})
-            self.store.put(key + ".npz", buf.getvalue())
-            self._since_full[job_name] = 0
+            host = jax.device_get(
+                [jnp.asarray(x).reshape(-1) for x in leaves]
+            )
+            for i, (h, s) in enumerate(zip(host, shapes)):
+                payload[f"leaf_{i}"] = np.asarray(h).reshape(s)
         else:
             # fetch only dirty runs, flat per leaf
-            payload: dict[str, np.ndarray] = {}
             off = 0
-            block = self.block_elems
-            for i, (x, nb) in enumerate(zip(leaves, nblocks)):
+            for i, (x, nb, shape) in enumerate(
+                    zip(leaves, nblocks, shapes)):
                 leaf_dirty = dirty[off:off + nb]
                 off += nb
                 if not leaf_dirty.any():
@@ -213,53 +214,111 @@ class CheckpointStore:
                         flat[s_el:e_el]
                     )
                     b = e + 1
-            buf = io.BytesIO()
-            np.savez(buf, **payload)
+        return {
+            "job": job_name, "epoch": epoch, "kind": kind,
+            "payload": payload, "treedef": treedef,
+            "source_state": source_state, "digests": digests,
+        }
+
+    def commit(self, prep: dict) -> None:
+        """Write a prepared epoch: objects, manifest bump, GC, digest
+        cache — the durable commit point the uploader acks."""
+        job_name, epoch, kind = prep["job"], prep["epoch"], prep["kind"]
+        key = f"{job_name}/epoch_{epoch}"
+        buf = io.BytesIO()
+        np.savez(buf, **prep["payload"])
+        with self._lock:
             self.store.put(key + ".npz", buf.getvalue())
-            self._since_full[job_name] = since_full + 1
+            self.store.put(key + ".meta", pickle.dumps({
+                "treedef": prep["treedef"],
+                "source_state": prep["source_state"],
+                "epoch": epoch, "kind": kind,
+            }))
+            m = self._load_manifest()
+            job = m["jobs"].setdefault(job_name, {"epochs": []})
+            # idempotent per epoch: a re-save of an already-committed
+            # epoch (e.g. ALTER PARALLELISM re-basing state at the
+            # current epoch) REPLACES the entry — appending would leave
+            # duplicate epochs in GC/load bookkeeping (advisor r4)
+            if epoch not in job["epochs"]:
+                job["epochs"].append(epoch)
+            job.setdefault("kind", {})[str(epoch)] = kind
+            job["committed"] = epoch
+            # GC beyond keep_epochs — but never break a delta chain:
+            # keep everything back to the BASE FULL of the oldest epoch
+            # that must stay readable (ref: hummock version GC keeps
+            # deltas reachable from a checkpointed version)
+            kinds = job["kind"]
+            epochs_l = job["epochs"]
+            if len(epochs_l) > self.keep_epochs:
+                idx = len(epochs_l) - self.keep_epochs
+                while idx > 0 and \
+                        kinds.get(str(epochs_l[idx]), "full") != "full":
+                    idx -= 1
+                for old in epochs_l[:idx]:
+                    kinds.pop(str(old), None)
+                    for suffix in (".npz", ".meta"):
+                        self.store.delete(
+                            f"{job_name}/epoch_{old}{suffix}"
+                        )
+                job["epochs"] = epochs_l[idx:]
+            self._store_manifest(m)
+            # only after the manifest commit: a save that dies earlier
+            # must not leave the digest cache pointing at an orphan file
+            self._last_digests[job_name] = (epoch, prep["digests"])
+            self._since_full[job_name] = 0 if kind == "full" \
+                else self._since_full.get(job_name, 0) + 1
 
-        self.store.put(key + ".meta", pickle.dumps({
-            "treedef": treedef, "source_state": source_state,
-            "epoch": epoch, "kind": kind,
-        }))
+    def save(self, job_name: str, epoch: int, states: Any,
+             source_state: dict, digests=None) -> None:
+        """Persist one committed epoch synchronously (prepare+commit —
+        the 'SST upload' + commit in one call).
 
-        m = self._load_manifest()
-        job = m["jobs"].setdefault(job_name, {"epochs": []})
-        # idempotent per epoch: a re-save of an already-committed epoch
-        # (e.g. ALTER PARALLELISM re-basing state at the current epoch)
-        # REPLACES the entry — appending would leave duplicate epochs
-        # in GC/load bookkeeping (advisor r4)
-        if epoch not in job["epochs"]:
-            job["epochs"].append(epoch)
-        job.setdefault("kind", {})[str(epoch)] = kind
-        job["committed"] = epoch
-        # GC beyond keep_epochs — but never break a delta chain: keep
-        # everything back to the BASE FULL of the oldest epoch that
-        # must stay readable (ref: hummock version GC keeps deltas
-        # reachable from a checkpointed version)
-        kinds = job["kind"]
-        epochs_l = job["epochs"]
-        if len(epochs_l) > self.keep_epochs:
-            idx = len(epochs_l) - self.keep_epochs
-            while idx > 0 and \
-                    kinds.get(str(epochs_l[idx]), "full") != "full":
-                idx -= 1
-            for old in epochs_l[:idx]:
-                kinds.pop(str(old), None)
-                for suffix in (".npz", ".meta"):
-                    self.store.delete(f"{job_name}/epoch_{old}{suffix}")
-            job["epochs"] = epochs_l[idx:]
-        self._store_manifest(m)
-        # only after the manifest commit: a save that dies earlier must
-        # not leave the digest cache pointing at an orphan file
-        self._last_digests[job_name] = (epoch, digests)
+        ``states`` may be a DEVICE pytree — only dirty blocks are
+        fetched for delta checkpoints."""
+        leaves, treedef = jax.tree.flatten(states)
+        shapes = [np.shape(x) for x in leaves]
+        self.commit(self.prepare(
+            job_name, epoch, leaves, shapes, treedef, source_state,
+            digests=digests,
+        ))
 
     def invalidate(self, job_name: str) -> None:
         """Drop the in-memory digest cache for a job (called on any
         recovery rewind): the next save re-bases with a full snapshot
-        instead of a delta computed against post-rewind live state."""
-        self._last_digests.pop(job_name, None)
-        self._since_full.pop(job_name, None)
+        instead of a delta computed against post-rewind live state.
+        Also vacuums orphan epoch files a crashed upload left behind
+        (object written, manifest never bumped)."""
+        with self._lock:
+            self._last_digests.pop(job_name, None)
+            self._since_full.pop(job_name, None)
+        self.vacuum_orphans(job_name)
+
+    def vacuum_orphans(self, job_name: str) -> int:
+        """Delete ``epoch_N.{npz,meta}`` objects whose epoch the
+        manifest does not reference — the residue of a crash between
+        the object write and the manifest commit.  Called on recovery
+        rewinds, when no upload can be in flight for the job."""
+        removed = 0
+        with self._lock:
+            m = self._load_manifest()
+            known = {str(e) for e in m["jobs"].get(
+                job_name, {}).get("epochs", [])}
+            for key in self.store.list(job_name + "/"):
+                name = key.rsplit("/", 1)[-1]
+                if not name.startswith("epoch_"):
+                    continue  # mv_epoch_*.sst exports etc.
+                stem = name[len("epoch_"):]
+                for suffix in (".npz", ".meta"):
+                    if stem.endswith(suffix):
+                        stem = stem[:-len(suffix)]
+                        break
+                else:
+                    continue
+                if stem.isdigit() and stem not in known:
+                    self.store.delete(key)
+                    removed += 1
+        return removed
 
     def committed_epoch(self, job_name: str) -> int | None:
         m = self._load_manifest()
@@ -288,7 +347,13 @@ class CheckpointStore:
         """Load (epoch, states_host, source_state); latest if epoch None.
 
         Reconstructs delta checkpoints from the nearest full plus the
-        delta chain (the reference's version + version-deltas)."""
+        delta chain (the reference's version + version-deltas).  Holds
+        the manifest lock so a concurrent uploader commit's GC cannot
+        delete a chain file between the manifest read and the fetch."""
+        with self._lock:
+            return self._load_locked(job_name, epoch)
+
+    def _load_locked(self, job_name: str, epoch: int | None):
         if epoch is None:
             epoch = self.committed_epoch(job_name)
             if epoch is None:

@@ -43,6 +43,7 @@ from risingwave_tpu.stream.fragment import (
     collect_counters,
 )
 from risingwave_tpu.stream.runtime import (
+    CheckpointPipelineMixin,
     CheckpointSnapshot,
     _snapshot_copy,
     check_counter_values,
@@ -80,7 +81,7 @@ class JoinNode:
         return self.join.init_state()
 
 
-class DagJob:
+class DagJob(CheckpointPipelineMixin):
     """A streaming job over an arbitrary DAG of fragments and joins.
 
     ``sources`` maps names to chunk readers; ``nodes`` is a topological
@@ -143,8 +144,11 @@ class DagJob:
         self.checkpoints: list[CheckpointSnapshot] = []
         self.committed_epoch = 0
         self.paused = False
+        #: cumulative seconds stalled on checkpoint-upload backpressure
+        self.stall_seconds = 0.0
         self._counters = None
         self.counter_labels: list[str] = []
+        self._init_pipeline()
         self._rebuild()
 
     def _init_states(self):
@@ -278,8 +282,11 @@ class DagJob:
         self._snapshot_and_save(self.committed_epoch)
 
     def _snapshot_and_save(self, epoch: int) -> None:
-        """The shared checkpoint tail: in-memory snapshot + durable
-        save (used by both the barrier commit and topology reseeds)."""
+        """The shared checkpoint tail: incremental shadow snapshot +
+        async durable upload (used by both the barrier commit and
+        topology reseeds).  Sharded meshes keep the full-copy path —
+        the shadow programs are meshless and per-shard snapshot cost is
+        HBM-local."""
         src_state = {
             name: (src.state() if hasattr(src, "state") else {})
             for name, src in self.sources.items()
@@ -293,27 +300,35 @@ class DagJob:
             for s, tier in enumerate(tiers)
             if tier.rows_absorbed
         }
-        snap = CheckpointSnapshot(
-            epoch=epoch,
-            states=_snapshot_copy(self.states),
-            source_state=src_state,
-            spill=spill_host,
-        )
-        self.checkpoints = [snap]
-        if self.checkpoint_store is not None:
-            # tier saves FIRST (see StreamingJob._commit_checkpoint): a
-            # crash between the saves leaves the tier ahead, which
-            # recovery rewinds; the reverse order loses absorbed groups
-            for (idx, j, s), host_state in spill_host.items():
-                self.checkpoint_store.save(
-                    self._spill_key(idx, j, s), epoch,
-                    host_state, {},
-                )
-            # device pytree handed over as-is: the store's block-digest
-            # pass fetches only the epoch's dirty blocks
-            self.checkpoint_store.save(
-                self.name, epoch, snap.states, src_state
+        if self.mesh is not None:
+            snap = CheckpointSnapshot(
+                epoch=epoch,
+                states=_snapshot_copy(self.states),
+                source_state=src_state,
+                spill=spill_host,
             )
+            self.checkpoints = [snap]
+            self.sealed_epoch = epoch
+            self.committed_epoch = epoch
+            if self.checkpoint_store is not None:
+                # tier saves FIRST (see StreamingJob._commit_checkpoint):
+                # a crash between the saves leaves the tier ahead, which
+                # recovery rewinds; the reverse order loses absorbed
+                # groups
+                for (idx, j, s), host_state in spill_host.items():
+                    self.checkpoint_store.save(
+                        self._spill_key(idx, j, s), epoch,
+                        host_state, {},
+                    )
+                self.checkpoint_store.save(
+                    self.name, epoch, snap.states, src_state
+                )
+            return
+        spill_items = [
+            (self._spill_key(idx, j, s), host_state)
+            for (idx, j, s), host_state in spill_host.items()
+        ]
+        self._snapshot_commit(epoch, src_state, spill_host, spill_items)
 
     def downstream_closure(self, ref: Ref,
                            through_joins: bool = True) -> list[int]:
@@ -1040,6 +1055,9 @@ class DagJob:
             if self._ckpts_since_snapshot >= self.snapshot_interval:
                 self._ckpts_since_snapshot = 0
                 self._commit_checkpoint(sealed)
+        # cheap ack poll: committed_epoch (and deferred sink delivery)
+        # advances while uploads complete in the background
+        self._process_upload_acks()
         self.epoch = self.epoch.bump()
 
     # -- maintenance ----------------------------------------------------
@@ -1094,19 +1112,26 @@ class DagJob:
             )
 
     # -- checkpoint / recovery ------------------------------------------
+    def _deliver_all_sinks(self, epoch_val) -> None:
+        new_states = list(self.states)
+        for idx, node in enumerate(self.nodes):
+            if isinstance(node, FragNode):
+                new_states[idx] = deliver_sinks(
+                    node.fragment, new_states[idx], epoch_val
+                )
+        self.states = tuple(new_states)
+
     def _commit_checkpoint(self, sealed) -> None:
         # spill tiers drain under the mesh too (per-shard tiers); only
         # sink delivery stays meshless (sharded plans exclude sinks)
         self._drain_spill_tiers(sealed)
         if self.mesh is None:
-            new_states = list(self.states)
-            for idx, node in enumerate(self.nodes):
-                if isinstance(node, FragNode):
-                    new_states[idx] = deliver_sinks(
-                        node.fragment, new_states[idx], sealed
-                    )
-            self.states = tuple(new_states)
-        self.committed_epoch = sealed
+            up = self._ensure_uploader()
+            if up is None or up.pending() == 0:
+                self._deliver_all_sinks(sealed)
+            else:
+                # uploader behind: delivery advances on ack only
+                self._sinks_due = True
         self._snapshot_and_save(sealed)
 
     # -- spill-to-host (stream/spill.py) --------------------------------
@@ -1261,11 +1286,19 @@ class DagJob:
             self.states = inject_p(self.states, stacked)
 
     def recover(self) -> None:
-        """Reset to the last committed checkpoint (ref §3.5)."""
+        """Reset to the last committed checkpoint (ref §3.5).  Drains
+        the upload queue first — sealed epochs finish becoming durable
+        before the rewind target is chosen."""
         self._counters = None
+        if self._uploader is not None:
+            self._uploader.drain(raise_error=False)
+            self._process_upload_acks()
+            self._uploader.clear_error()
+            self._sinks_due = False
         if self.checkpoint_store is not None:
             # see StreamingJob.recover: rewinds invalidate the digest
             # cache so the next save re-bases with a full snapshot
+            # (and vacuum orphan files of a crashed upload)
             self.checkpoint_store.invalidate(self.name)
             loaded = self.checkpoint_store.load(self.name)
             if loaded is not None:
@@ -1280,6 +1313,7 @@ class DagJob:
                 else:
                     self.states = jax.device_put(states)
                 self.committed_epoch = epoch
+                self.sealed_epoch = epoch
                 for name, src in self.sources.items():
                     restore_source(src, src_state.get(name, {}))
                 self._restore_spill_tiers(epoch)
@@ -1294,7 +1328,7 @@ class DagJob:
                     tier.reset()
             return
         snap = self.checkpoints[-1]
-        self.states = _snapshot_copy(snap.states)
+        self.states = self._restore_in_memory(snap)
         for name, src in self.sources.items():
             restore_source(src, snap.source_state.get(name, {}))
         for (idx, j), tiers in getattr(self, "_spill_tiers",
@@ -1367,6 +1401,7 @@ class DagJob:
             for _ in range(chunks_per_barrier):
                 self.chunk_round()
             self.inject_barrier()
+        self.drain_uploads()
 
     @classmethod
     def binary(

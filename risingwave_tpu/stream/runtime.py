@@ -47,12 +47,16 @@ from risingwave_tpu.stream.message import Barrier, BarrierKind
 
 @dataclass
 class CheckpointSnapshot:
-    """A committed epoch: device copies of all state + source offsets.
+    """A committed epoch: device snapshot of all state + source offsets.
 
     ref: Hummock ``commit_epoch`` (src/meta/src/hummock/manager/
-    commit_epoch.rs:73) — the in-memory snapshot stays device-resident
-    (a jitted tree copy); only the durable store pays a device→host
-    transfer.
+    commit_epoch.rs:73) — the in-memory snapshot stays device-resident;
+    only the durable store pays a device→host transfer.
+
+    ``states is None`` marks a SHADOW-BACKED snapshot: the state lives
+    in the job's incremental ``ShadowSnapshot`` (stream/shadow.py) and
+    ``recover()`` restores from there — the full-copy tree is only
+    retained on paths that still take it (sharded meshes).
     """
 
     epoch: int
@@ -67,6 +71,139 @@ class CheckpointSnapshot:
 @jax.jit
 def _snapshot_copy(tree):
     return jax.tree.map(jnp.copy, tree)
+
+
+class CheckpointPipelineMixin:
+    """Incremental shadow snapshots + pipelined async durable uploads,
+    shared by StreamingJob and DagJob (see stream/shadow.py and
+    stream/checkpoint.py).
+
+    Contract: a snapshot barrier SEALS the epoch (``sealed_epoch``) in
+    one async device dispatch and enqueues persistence to a background
+    uploader; ``committed_epoch`` (the recovery/serving pin) advances
+    only when the upload ACKS.  Without a durable store, seal and
+    commit coincide (the shadow IS the commit).  The barrier loop
+    stalls only when the uploader falls more than ``upload_window``
+    epochs behind — the checkpoint analog of the L0-depth write stall.
+    """
+
+    #: max sealed-but-unacked epochs before the barrier loop stalls
+    upload_window: int = 4
+    #: optional MetricsRegistry (the engine attaches its own)
+    metrics = None
+    _shadow = None
+    _uploader = None
+    _sinks_due = False
+
+    def _init_pipeline(self) -> None:
+        self.sealed_epoch = 0
+        self._shadow = None
+        self._uploader = None
+        self._sinks_due = False
+
+    # -- uploader plumbing ----------------------------------------------
+    def _ensure_uploader(self):
+        if self._uploader is None and self.checkpoint_store is not None:
+            from risingwave_tpu.stream.checkpoint import (
+                CheckpointUploader,
+            )
+            self._uploader = CheckpointUploader(
+                self.checkpoint_store, self.name, metrics=self.metrics
+            )
+        return self._uploader
+
+    def _process_upload_acks(self) -> None:
+        """Cheap ack poll (no device work): advances committed_epoch
+        and runs deferred sink delivery once the queue is empty."""
+        up = self._uploader
+        if up is None:
+            return
+        acked = up.take_acked()
+        if acked:
+            self.committed_epoch = max(self.committed_epoch, acked[-1])
+        if self._sinks_due and up.pending() == 0 \
+                and self.committed_epoch > 0:
+            self._sinks_due = False
+            self._deliver_all_sinks(self.committed_epoch)
+
+    def upload_queue_depth(self) -> int:
+        return 0 if self._uploader is None else self._uploader.pending()
+
+    def drain_uploads(self, raise_error: bool = True) -> None:
+        """Block until every sealed epoch is durable (tick-batch
+        boundaries, orderly stop, recovery).  Within a batch the
+        uploads pipeline; the batch boundary is the freshness point."""
+        if self._uploader is not None:
+            self._uploader.drain(raise_error=raise_error)
+            self._process_upload_acks()
+
+    def _deliver_all_sinks(self, epoch_val) -> None:
+        """Subclass hook: drain sink ring buffers at ``epoch_val``."""
+
+    # -- the shared snapshot-commit tail ---------------------------------
+    def _snapshot_commit(self, epoch_val: int, src_state: dict,
+                         spill_host: dict, spill_items: list) -> None:
+        """Seal one epoch: shadow update (one async dispatch) +
+        uploader enqueue (or, with no store, the in-memory commit)."""
+        from risingwave_tpu.storage.digest import DEFAULT_BLOCK_ELEMS
+        from risingwave_tpu.stream.shadow import ShadowSnapshot
+
+        store = self.checkpoint_store
+        up = self._ensure_uploader()
+        if up is not None:
+            # bounded in-flight window (mirrors the L0-depth stall)
+            self.stall_seconds += up.wait_window(self.upload_window)
+            self._process_upload_acks()
+        if self._shadow is not None and (
+                not self._shadow.matches(self.states)
+                or self._shadow.digest_mode != (store is not None)):
+            # topology changed (or the job gained/lost a durable
+            # store): the shadow — and the store's digest chain —
+            # describe the OLD configuration; drain in-flight uploads,
+            # then rebuild from scratch (full re-base)
+            if up is not None:
+                up.drain()
+                self._process_upload_acks()
+            if store is not None:
+                store.invalidate(self.name)
+            self._shadow = None
+        if self._shadow is None:
+            self._shadow = ShadowSnapshot(
+                self.states,
+                block_elems=store.block_elems if store is not None
+                else DEFAULT_BLOCK_ELEMS,
+                digest=store is not None,
+            )
+            digests = self._shadow.digests
+        else:
+            if up is not None:
+                # the update donates the shadow buffers in-flight
+                # fetches still read — wait for the fetch point only
+                up.wait_fetched()
+            digests = self._shadow.update(self.states, epoch_val)
+        self.sealed_epoch = epoch_val
+        self.checkpoints = [CheckpointSnapshot(
+            epoch=epoch_val, states=None, source_state=src_state,
+            spill=spill_host,
+        )]
+        if store is not None:
+            from risingwave_tpu.stream.checkpoint import UploadTask
+            up.enqueue(UploadTask(
+                epoch=epoch_val, leaves=self._shadow.leaves,
+                digests=digests, shapes=self._shadow.shapes,
+                treedef=self._shadow.treedef, source_state=src_state,
+                spill=spill_items,
+            ))
+            self._process_upload_acks()
+        else:
+            self.committed_epoch = epoch_val
+
+    def _restore_in_memory(self, snap: CheckpointSnapshot):
+        """States tree for an in-memory recover: from the shadow when
+        the snapshot is shadow-backed, else the retained full copy."""
+        if snap.states is None:
+            return self._shadow.restore()
+        return _snapshot_copy(snap.states)
 
 
 def check_counter_values(name: str, labels: list[str],
@@ -150,7 +287,7 @@ def deliver_sinks(fragment: Fragment, states, epoch_val):
     return tuple(states)
 
 
-class StreamingJob:
+class StreamingJob(CheckpointPipelineMixin):
     """A linear source → fragment pipeline driven by the barrier loop.
 
     The fragment typically ends in a Materialize executor (the MV).
@@ -193,6 +330,7 @@ class StreamingJob:
         self.checkpoints: list[CheckpointSnapshot] = []
         #: committed epoch visible to batch reads (ref pinned snapshots)
         self.committed_epoch: int = 0
+        self._init_pipeline()
         self.paused = False
         #: counters vector from the last barrier program (device array;
         #: read back once per maintenance interval)
@@ -331,6 +469,9 @@ class StreamingJob:
                 self._maintain(epoch_val)
                 self._ckpts_since_maintain = 0
             self._commit_checkpoint(barrier)
+        # cheap ack poll keeps committed_epoch (and deferred sink
+        # delivery) advancing while uploads complete in the background
+        self._process_upload_acks()
         self.epoch = barrier.epoch
         return outs
 
@@ -388,54 +529,43 @@ class StreamingJob:
             if out is not None:
                 self.states = inject(self.states, out)
 
+    def _deliver_all_sinks(self, epoch_val) -> None:
+        self.states = deliver_sinks(self.fragment, self.states, epoch_val)
+
     def _commit_checkpoint(self, barrier: Barrier) -> None:
-        """Commit = snapshot + sink delivery + committed_epoch, all on
-        the SAME cadence: recovery rewinds to the last snapshot, so a
-        sink delivery or committed_epoch beyond it would be a lie
-        (duplicated sink rows / unrecoverable epochs)."""
+        """Seal one snapshot epoch: spill drain + sink delivery + the
+        incremental shadow update, then hand durable persistence to the
+        background uploader.  Recovery rewinds to the last DURABLE
+        epoch, so ``committed_epoch`` (and deferred sink delivery)
+        advance only on uploader ack; without a store, seal == commit
+        (the shadow is the recovery point)."""
         epoch_val = barrier.epoch.prev.value
         self._ckpts_since_snapshot += 1
         if self._ckpts_since_snapshot < self.snapshot_interval:
             return
         self._ckpts_since_snapshot = 0
         self._drain_spill_tiers(epoch_val)
-        self.states = deliver_sinks(self.fragment, self.states, epoch_val)
-        self.committed_epoch = epoch_val
+        up = self._ensure_uploader()
+        if up is None or up.pending() == 0:
+            # at-least-once delivery, same window as the synchronous
+            # path (rows delivered before their epoch is durable ride
+            # THIS epoch's snapshot via the advanced read_cursor)
+            self.states = deliver_sinks(
+                self.fragment, self.states, epoch_val
+            )
+        else:
+            # uploader behind: defer delivery to the ack poll
+            self._sinks_due = True
         src_state = self.source.state() if hasattr(self.source, "state") \
             else {}
-        # the in-memory snapshot device-copies the state in ONE jitted
-        # dispatch: the donated step/flush buffers would otherwise be
-        # invalidated under the snapshot (use-after-donation); durable
-        # persistence additionally pays the device→host transfer
         # ONE host materialization per tier, shared by the in-memory
         # snapshot and the durable save
         spill_host = {i: tier.snapshot() for i, _, _, tier in self._spill
                       if tier.rows_absorbed}
-        snap = CheckpointSnapshot(
-            epoch=epoch_val,
-            states=_snapshot_copy(self.states),
-            source_state=src_state,
-            spill=spill_host,
-        )
-        # retain only the latest committed snapshot in memory; the
-        # durable store keeps epoch history (ref: Hummock versions)
-        self.checkpoints = [snap]
-        if self.checkpoint_store is not None:
-            # tier saves FIRST: a crash between the two saves leaves the
-            # tier AHEAD of the job checkpoint, and recovery rewinds it
-            # to the nearest tier epoch <= the job's — absorbed groups
-            # are never silently lost and replayed rows never
-            # double-count (the reverse order had both failure modes)
-            for i in spill_host:
-                self.checkpoint_store.save(
-                    f"{self.name}@spill{i}", epoch_val,
-                    spill_host[i], {},
-                )
-            # device pytree handed over as-is: the store's block-digest
-            # pass fetches only the epoch's dirty blocks
-            self.checkpoint_store.save(
-                self.name, epoch_val, snap.states, src_state
-            )
+        spill_items = [(f"{self.name}@spill{i}", spill_host[i])
+                       for i in spill_host]
+        self._snapshot_commit(epoch_val, src_state, spill_host,
+                              spill_items)
 
     def _apply_mutation(self, mutation) -> None:
         if mutation.kind == "pause":
@@ -448,21 +578,31 @@ class StreamingJob:
     # -- recovery -------------------------------------------------------
     def recover(self) -> None:
         """Reset to the last committed checkpoint (ref §3.5 recovery:
-        rebuild actors + resume from last committed epoch).  Prefers the
-        durable store (survives process restarts) over the in-memory
-        snapshot."""
+        rebuild actors + resume from last committed epoch).  Drains the
+        upload queue first (sealed epochs finish becoming durable, a
+        failed upload is swallowed — the rewind IS its resolution),
+        then prefers the durable store (survives process restarts) over
+        the in-memory shadow."""
         self._counters = None
+        if self._uploader is not None:
+            self._uploader.drain(raise_error=False)
+            self._process_upload_acks()
+            self._uploader.clear_error()
+            self._sinks_due = False
         if self.checkpoint_store is not None:
             # any rewind invalidates the store's in-memory digest
             # cache: the next save must re-base with a full snapshot,
             # or a delta computed against post-rewind live state could
             # overwrite a valid chain entry with a wrong-base delta
+            # (invalidate also vacuums orphan files a crashed upload
+            # left between object write and manifest commit)
             self.checkpoint_store.invalidate(self.name)
             loaded = self.checkpoint_store.load(self.name)
             if loaded is not None:
                 epoch, states, src_state = loaded
                 self.states = jax.device_put(states)
                 self.committed_epoch = epoch
+                self.sealed_epoch = epoch
                 restore_source(self.source, src_state)
                 for i, _, _, tier in self._spill:
                     key = f"{self.name}@spill{i}"
@@ -480,8 +620,9 @@ class StreamingJob:
             return
         snap = self.checkpoints[-1]
         # copy: the next step donates its input buffers, which must not
-        # invalidate the retained snapshot
-        self.states = _snapshot_copy(snap.states)
+        # invalidate the retained snapshot (shadow-backed snapshots
+        # restore from the shadow tree — the shadow itself survives)
+        self.states = self._restore_in_memory(snap)
         restore_source(self.source, snap.source_state)
         for i, _, _, tier in self._spill:
             if snap.spill and i in snap.spill:
@@ -496,11 +637,13 @@ class StreamingJob:
         return self.run_chunk()
 
     def run(self, barriers: int, chunks_per_barrier: int) -> None:
-        """The steady-state loop (ref §3.3)."""
+        """The steady-state loop (ref §3.3).  Uploads pipeline within
+        the batch; the batch boundary drains them (durability point)."""
         for _ in range(barriers):
             for _ in range(chunks_per_barrier):
                 self.run_chunk()
             self.inject_barrier()
+        self.drain_uploads()
 
     def executor_state(self, idx: int):
         return self.states[idx]

@@ -29,10 +29,31 @@ jax.config.update("jax_enable_x64", True)
 # 0.4.37 CPU build, cache-served executables return corrupted outputs
 # for the donated streaming-state programs (observed: garbage overflow
 # counters in test_cold_start/test_chaos on the second run), turning
-# correct code into red tests.
+# correct code into red tests.  Enforced below — a configured cache
+# fails the session at start instead of producing flaky green/red runs.
+
+
+def _assert_no_persistent_compilation_cache():
+    import pytest
+
+    cache_dir = (
+        os.environ.get("JAX_COMPILATION_CACHE_DIR")
+        or getattr(jax.config, "jax_compilation_cache_dir", None)
+    )
+    if cache_dir:
+        pytest.exit(
+            "jax persistent compilation cache is enabled "
+            f"(jax_compilation_cache_dir={cache_dir!r}), but on this "
+            "jax 0.4.37 CPU build cache-served executables corrupt "
+            "donated streaming-state program outputs (garbage "
+            "overflow counters — see CHANGES.md PR 2).  Unset "
+            "JAX_COMPILATION_CACHE_DIR to run the suite.",
+            returncode=3,
+        )
 
 
 def pytest_configure(config):
+    _assert_no_persistent_compilation_cache()
     config.addinivalue_line(
         "markers",
         "slow: long-running stress tests excluded from the tier-1 run",

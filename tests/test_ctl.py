@@ -79,15 +79,22 @@ def test_ctl_cluster_subcommands(tmp_path):
             "worker": w.worker_id, "rounds": 1,
             "pinned_epoch": jobs[0]["pinned_epoch"],
             "committed_epoch": jobs[0]["committed_epoch"],
+            "sealed_epoch": jobs[0]["sealed_epoch"],
+            "durable_epoch": jobs[0]["durable_epoch"],
         }]
         assert jobs[0]["pinned_epoch"] > 0
         assert jobs[0]["pinned_epoch"] == jobs[0]["committed_epoch"]
+        # a committed round implies every upload acked: seal == durable
+        assert jobs[0]["durable_epoch"] == jobs[0]["sealed_epoch"]
 
         ep = cluster_epochs(addr)
         assert ep["cluster_epoch"] == 1
         assert ep["manifest_epoch"] == jobs[0]["pinned_epoch"]
         assert ep["failovers"] == 0
         assert ep["jobs"]["cv"]["rounds"] == 1
+        # the async-checkpoint split is visible in the ctl surface
+        assert ep["jobs"]["cv"]["sealed_epoch"] > 0
+        assert ep["jobs"]["cv"]["upload_lag_epochs"] == 0
     finally:
         w.stop()
         meta.stop()

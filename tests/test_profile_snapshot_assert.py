@@ -1,0 +1,27 @@
+"""Slow wrapper: the incremental-snapshot regression gate.
+
+Runs ``scripts/profile_snapshot.py --assert --small`` as the bench
+drivers do, so a dirty-block-scaling, sync-readback, unbounded-queue,
+or recovery-equivalence regression fails CI loudly (ISSUE 4 acceptance
+gate; mirrors test_profile_q8_assert)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+@pytest.mark.slow
+def test_profile_snapshot_assert_small():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(root, "scripts",
+                                      "profile_snapshot.py"),
+         "--assert", "--small"],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True, text=True, timeout=1800, cwd=root,
+    )
+    assert out.returncode == 0, \
+        f"profile_snapshot gate failed:\n{out.stdout}\n{out.stderr}"
+    assert "profile_snapshot --assert: OK" in out.stdout

@@ -124,21 +124,27 @@ def test_spill_tier_crash_between_saves(tmp_path):
     _feed(eng, n_keys=256, reps=1)
     job = eng.jobs[0]
     store = job.checkpoint_store
-    real_save = store.save
+    # the job's save now runs in the background uploader as
+    # prepare()+commit() (tier saves still go through save() first in
+    # the same task) — crash the JOB commit, after the tier save
+    real_commit = store.commit
 
-    def crashing_save(name, *a, **kw):
-        if name == job.name:
+    def crashing_commit(prep):
+        if prep["job"] == job.name:
             raise RuntimeError("simulated crash between saves")
-        return real_save(name, *a, **kw)
+        return real_commit(prep)
 
-    store.save = crashing_save
+    store.commit = crashing_commit
     try:
+        # the upload fails in the background; the error surfaces on
+        # the barrier loop at the tick's drain boundary
         eng.tick(barriers=4)
         raise AssertionError("commit should have crashed")
     except RuntimeError as e:
-        assert "simulated crash" in str(e)
+        assert "upload failed" in str(e) \
+            or "simulated crash" in str(e)
     finally:
-        store.save = real_save
+        store.commit = real_commit
 
     # recover: the job rewinds to the first commit; the aborted
     # commit's NEWER tier files must be skipped
