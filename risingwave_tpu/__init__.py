@@ -29,21 +29,35 @@ Layer map (mirrors reference layers, see SURVEY.md §1):
 """
 
 import os as _os
+import sys as _sys
 
-import jax as _jax
+# The serving tier (serve/, ``server.py --role serving``) is ENGINE-FREE:
+# it reads MV rows straight from shared SSTs and must never pay the jax
+# import (nor accidentally trace anything).  Skip the eager jax import
+# when the process declares itself jax-free — every compute-facing
+# module still imports jax itself, so a misrouted import in a serving
+# process shows up as ``"jax" in sys.modules`` (asserted by tests).
+_no_jax = bool(_os.environ.get("RWT_NO_JAX")) or (
+    "--role" in _sys.argv and "serving" in _sys.argv
+)
 
-# int64/timestamp/decimal columns are first-class in a SQL engine; enable
-# 64-bit types before any tracing happens.  Device kernels prefer int64 /
-# float32 paths (float64 is emulated on TPU and avoided in hot loops).
-_jax.config.update("jax_enable_x64", True)
+if not _no_jax:
+    import jax as _jax
 
-# Some environments install a PJRT plugin whose registration hook rewrites
-# ``jax_platforms`` (e.g. to "axon,cpu"), silently overriding the
-# JAX_PLATFORMS env var.  A SQL engine must honor the operator's explicit
-# platform choice (tests/dryruns pin cpu; benches pin the accelerator), so
-# re-assert the env var over any plugin override.
-if _os.environ.get("JAX_PLATFORMS"):
-    _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+    # int64/timestamp/decimal columns are first-class in a SQL engine;
+    # enable 64-bit types before any tracing happens.  Device kernels
+    # prefer int64 / float32 paths (float64 is emulated on TPU and
+    # avoided in hot loops).
+    _jax.config.update("jax_enable_x64", True)
+
+    # Some environments install a PJRT plugin whose registration hook
+    # rewrites ``jax_platforms`` (e.g. to "axon,cpu"), silently
+    # overriding the JAX_PLATFORMS env var.  A SQL engine must honor the
+    # operator's explicit platform choice (tests/dryruns pin cpu;
+    # benches pin the accelerator), so re-assert the env var over any
+    # plugin override.
+    if _os.environ.get("JAX_PLATFORMS"):
+        _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
 
 __version__ = "0.1.0"
 

@@ -1,14 +1,36 @@
 """Cluster-lite control plane: meta service + compute workers over
-localhost JSON-RPC (the multi-process split of the four node roles)."""
+localhost JSON-RPC (the multi-process split of the four node roles).
 
-from risingwave_tpu.cluster.meta_service import (  # noqa: F401
-    MetaFrontend,
-    MetaService,
-)
-from risingwave_tpu.cluster.rpc import (  # noqa: F401
-    RpcClient,
-    RpcError,
-    RpcServer,
-    parse_addr,
-)
-from risingwave_tpu.cluster.worker import ComputeWorker  # noqa: F401
+Exports resolve lazily (PEP 562): ``meta_service``/``worker`` pull in
+engine-side modules, but the engine-free serving tier only needs
+``cluster.rpc`` — importing the package must stay jax-free.
+"""
+
+_LAZY = {
+    "MetaFrontend": ("risingwave_tpu.cluster.meta_service",
+                     "MetaFrontend"),
+    "MetaService": ("risingwave_tpu.cluster.meta_service",
+                    "MetaService"),
+    "ComputeWorker": ("risingwave_tpu.cluster.worker", "ComputeWorker"),
+    "ServingWorker": ("risingwave_tpu.serve.worker", "ServingWorker"),
+    "RpcClient": ("risingwave_tpu.cluster.rpc", "RpcClient"),
+    "RpcError": ("risingwave_tpu.cluster.rpc", "RpcError"),
+    "RpcServer": ("risingwave_tpu.cluster.rpc", "RpcServer"),
+    "parse_addr": ("risingwave_tpu.cluster.rpc", "parse_addr"),
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name):
+    try:
+        mod_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        ) from None
+    import importlib
+
+    value = getattr(importlib.import_module(mod_name), attr)
+    globals()[name] = value
+    return value

@@ -59,6 +59,38 @@ class WorkerInfo:
     #: job names assigned to this worker
     jobs: set = field(default_factory=set)
     client: RpcClient | None = None
+    #: SST keys allocated to this worker for MV exports, not yet
+    #: returned in a barrier seal (released as orphans on death)
+    sst_keys: set = field(default_factory=set)
+
+    @property
+    def addr(self) -> str:
+        return f"{self.host}:{self.port}"
+
+
+@dataclass
+class ServingReplicaInfo:
+    """One registered serving replica (the stateless read tier).
+
+    ``pins`` maps manifest vid → meta-side pin id: the replica's HELD
+    version and its latest GRANT both stay pinned in the meta's
+    ``VersionManager``, so vacuum counts them in its keep-set — a
+    serving read can never lose an SST underneath it.  The lease
+    advances on heartbeats (the replica reports the vid it holds; the
+    meta releases older pins and pins the current version as the next
+    grant) and is reaped wholesale when the replica's heartbeat
+    expires."""
+
+    replica_id: int
+    host: str
+    port: int
+    pid: int | None = None
+    alive: bool = True
+    last_seen: float = field(default_factory=time.monotonic)
+    client: RpcClient | None = None
+    #: manifest vid -> VersionManager pin id
+    pins: dict = field(default_factory=dict)
+    granted_vid: int = 0
 
     @property
     def addr(self) -> str:
@@ -100,10 +132,11 @@ class MetaService:
                  serve_retry_timeout_s: float = 60.0,
                  rpc_timeout_s: float = 180.0,
                  durable_wait_s: float = 15.0):
-        from risingwave_tpu.storage.hummock.object_store import (
+        from risingwave_tpu.storage.hummock import (
+            CompactorService,
+            HummockStorage,
             LocalFsObjectStore,
         )
-        from risingwave_tpu.storage.hummock.version import VersionManager
 
         self.data_dir = data_dir
         self.heartbeat_timeout_s = heartbeat_timeout_s
@@ -119,17 +152,35 @@ class MetaService:
         #: restarted meta (or a single-node takeover) can rebuild the
         #: cluster catalog
         self.store = MetaStore(data_dir)
-        #: the cluster-epoch commit point: an (empty) version delta in
-        #: the shared manifest per global commit — workers never touch
-        #: the manifest in cluster mode, meta is its single writer
-        self.versions = VersionManager(
-            LocalFsObjectStore(os.path.join(data_dir, "hummock"))
+        #: the meta-owned storage service over the shared data_dir:
+        #: the version manifest (meta is its SINGLE writer — workers
+        #: upload SST objects under meta-allocated keys and hand the
+        #: descriptors back through barrier seals), the background
+        #: compactor, and pin-aware vacuum.  ``versions`` stays the
+        #: cluster-epoch commit point it always was.
+        self.hummock = HummockStorage(
+            LocalFsObjectStore(os.path.join(data_dir, "hummock")),
+            metrics=self.metrics,
         )
+        self.versions = self.hummock.versions
+        # gentler poll than the embedded default: the meta shares its
+        # core with the barrier loop and the RPC server
+        self.compactor = CompactorService(self.hummock,
+                                          poll_interval_s=0.05)
         self._lock = threading.RLock()
         #: serializes barrier rounds AND failover reassignment: a job
         #: is never adopted while one of its barrier RPCs is in flight
         self._tick_lock = threading.Lock()
         self.workers: dict[int, WorkerInfo] = {}
+        #: registered serving replicas (the stateless read tier)
+        self.serving: dict[int, ServingReplicaInfo] = {}
+        self._next_replica = 1
+        #: round-robin cursor for serving-read routing
+        self._serve_rr = 0
+        #: (job_name, round) -> uploaded-but-uncommitted MV export SST
+        #: descriptors; committed into the manifest with the round's
+        #: cluster epoch, replaced when a failover re-seals the round
+        self._pending_ssts: dict[tuple, list] = {}
         self.jobs: dict[str, JobInfo] = {}
         #: mv/sink name -> owning JobInfo name
         self._mv_to_job: dict[str, str] = {}
@@ -151,7 +202,8 @@ class MetaService:
         return self._server.port if self._server is not None else 0
 
     def start(self, host: str = "127.0.0.1", port: int = 0,
-              monitor: bool = True) -> "MetaService":
+              monitor: bool = True, compactor: bool = True,
+              ) -> "MetaService":
         self._stop.clear()
         self._server = RpcServer(self, host, port).start()
         if monitor:
@@ -160,10 +212,16 @@ class MetaService:
                 daemon=True,
             )
             self._monitor.start()
+        if compactor:
+            # the shared-storage compactor rides the meta process (the
+            # manifest's single writer); in-process tests may pass
+            # compactor=False and drive hummock.compact_once directly
+            self.compactor.start()
         return self
 
     def stop(self) -> None:
         self._stop.set()
+        self.compactor.stop()
         if self._monitor is not None:
             self._monitor.join(timeout=5)
             self._monitor = None
@@ -174,6 +232,9 @@ class MetaService:
             for w in self.workers.values():
                 if w.client is not None:
                     w.client.close()
+            for r in self.serving.values():
+                if r.client is not None:
+                    r.client.close()
 
     # -- worker registry / heartbeats -----------------------------------
     def rpc_register_worker(self, host: str, port: int,
@@ -211,6 +272,14 @@ class MetaService:
             sum(1 for w in self.workers.values() if w.alive),
         )
         self.metrics.set_gauge("cluster_jobs", len(self.jobs))
+        self.metrics.set_gauge(
+            "cluster_serving_replicas",
+            sum(1 for r in self.serving.values() if r.alive),
+        )
+        self.metrics.set_gauge(
+            "cluster_serving_pins",
+            sum(len(r.pins) for r in self.serving.values()),
+        )
 
     def _monitor_loop(self) -> None:
         interval = min(self.heartbeat_timeout_s / 4, 0.5)
@@ -219,9 +288,13 @@ class MetaService:
 
     def check_heartbeats(self) -> None:
         """One monitor pass: refresh age gauges, expire silent workers,
-        reassign their jobs (also called directly by tests)."""
+        reassign their jobs (also called directly by tests).  Serving
+        replicas expire on the same cadence — a dead replica's epoch
+        pin lease is reaped immediately so it can never block vacuum
+        forever."""
         now = time.monotonic()
         expired: list[WorkerInfo] = []
+        stale_serving: list[ServingReplicaInfo] = []
         with self._lock:
             for w in self.workers.values():
                 if not w.alive:
@@ -233,11 +306,33 @@ class MetaService:
                 )
                 if age > self.heartbeat_timeout_s:
                     expired.append(w)
+            for r in self.serving.values():
+                if r.alive and now - r.last_seen \
+                        > self.heartbeat_timeout_s:
+                    stale_serving.append(r)
         for w in expired:
             self._on_worker_dead(w)
+        for r in stale_serving:
+            self._on_serving_dead(r)
         if expired or any(j.worker_id is None
                           for j in self.jobs.values()):
             self._assign_pending()
+
+    def _on_serving_dead(self, r: ServingReplicaInfo) -> None:
+        """Reap one serving replica: drop it from routing and release
+        every pin of its lease (stale leases must not hold GC keep-set
+        entries for a process that will never read again)."""
+        with self._lock:
+            if not r.alive:
+                return
+            r.alive = False
+            for pin_id in r.pins.values():
+                self.versions.unpin(pin_id)
+            r.pins.clear()
+            if r.client is not None:
+                r.client.close()
+            self.serving.pop(r.replica_id, None)
+            self._set_worker_gauges()
 
     def _on_worker_dead(self, w: WorkerInfo) -> None:
         # under the tick lock: never declare dead / reassign while one
@@ -258,9 +353,111 @@ class MetaService:
                 for name in list(w.jobs):
                     self.jobs[name].worker_id = None
                 w.jobs.clear()
+                # allocated-but-never-sealed export keys become
+                # vacuumable orphans; keys already riding a sealed
+                # round stay protected in _pending_ssts
+                pending = {s["key"] for ssts in
+                           self._pending_ssts.values() for s in ssts}
+                for key in w.sst_keys - pending:
+                    self.hummock.release_external_sst_key(key)
+                w.sst_keys.clear()
                 if w.client is not None:
                     w.client.close()
                 self._set_worker_gauges()
+
+    # -- serving replicas: registry + epoch pin leases -------------------
+    def rpc_register_serving(self, host: str, port: int,
+                             pid: int | None = None) -> dict:
+        """Register a serving replica and grant its FIRST epoch pin
+        lease: the current manifest version is pinned meta-side BEFORE
+        the grant leaves, so every SST the replica can reach stays in
+        the vacuum keep-set from the very first read."""
+        with self._lock:
+            rid = self._next_replica
+            self._next_replica += 1
+            r = ServingReplicaInfo(rid, host, int(port), pid)
+            r.client = RpcClient(host, int(port),
+                                 timeout=self.rpc_timeout_s)
+            pin_id, version = self.versions.pin()
+            r.pins[version.vid] = pin_id
+            r.granted_vid = version.vid
+            self.serving[rid] = r
+            self._set_worker_gauges()
+        self.hummock._update_gauges()
+        return {
+            "replica_id": rid,
+            "granted_vid": r.granted_vid,
+            "cluster_epoch": self.cluster_epoch,
+            "manifest_epoch": self.versions.max_committed_epoch,
+        }
+
+    def rpc_serving_heartbeat(self, replica_id: int,
+                              vid: int = 0) -> dict:
+        """One lease round-trip: the replica reports the manifest vid
+        it HOLDS (acking older grants), the meta releases pins below
+        it, pins the current version as the next grant, and returns
+        the grant.  The replica only ever advances to granted vids, so
+        its held version is pinned at all times — vacuum can never
+        reap an SST under a live serving read."""
+        with self._lock:
+            r = self.serving.get(int(replica_id))
+            if r is None or not r.alive:
+                raise ValueError(
+                    f"unknown or expired serving replica {replica_id}"
+                )
+            r.last_seen = time.monotonic()
+            held = int(vid)
+            pin_id, version = self.versions.pin()
+            if version.vid in r.pins:
+                self.versions.unpin(pin_id)
+            else:
+                r.pins[version.vid] = pin_id
+            r.granted_vid = version.vid
+            # keep exactly the held version and the fresh grant; every
+            # pin in between was a grant the replica skipped past
+            keep = {held, version.vid}
+            for pv in [p for p in r.pins if p not in keep]:
+                self.versions.unpin(r.pins.pop(pv))
+            self._set_worker_gauges()
+        return {
+            "ok": True,
+            "granted_vid": r.granted_vid,
+            "cluster_epoch": self.cluster_epoch,
+            "manifest_epoch": self.versions.max_committed_epoch,
+        }
+
+    def rpc_unregister_serving(self, replica_id: int) -> dict:
+        with self._lock:
+            r = self.serving.get(int(replica_id))
+        if r is not None:
+            self._on_serving_dead(r)
+        return {"ok": True}
+
+    # -- external SST allocation (worker MV exports) ---------------------
+    def rpc_alloc_sst(self, worker_id: int) -> dict:
+        """Allocate one vacuum-protected SST key for a worker's MV
+        export upload (the single allocator keeps keys collision-free
+        across worker processes)."""
+        with self._lock:
+            w = self.workers.get(int(worker_id))
+            if w is None or not w.alive:
+                raise ValueError(f"unknown or expired worker {worker_id}")
+        key = self.hummock.alloc_external_sst_key()
+        with self._lock:
+            w.sst_keys.add(key)
+        return {"key": key}
+
+    # -- storage service (vacuum rides the meta) -------------------------
+    def storage_vacuum(self) -> dict:
+        """GC pass over the shared store: deletes SST objects
+        unreferenced by the current version, any serving pin lease, or
+        an in-flight allocation."""
+        deleted = self.hummock.vacuum()
+        return {"deleted_objects": deleted,
+                "remaining_objects": self.hummock.stats()["objects"]}
+
+    def rpc_storage_vacuum(self) -> dict:
+        return self.storage_vacuum()
 
     # -- DDL / placement -------------------------------------------------
     def rpc_execute_ddl(self, sql: str) -> dict:
@@ -458,12 +655,23 @@ class MetaService:
                     continue  # monitor expires the worker; round stalls
                 epoch = int(res.get("sealed_epoch",
                                     res["committed_epoch"]))
+                ssts = res.get("ssts") or []
                 with self._lock:
                     job.rounds = target
                     job.seal_log.append((target, epoch))
                     job.durable_epoch = int(
                         res.get("durable_epoch", epoch)
                     )
+                    # a failover re-seal replaces the dead attempt's
+                    # pending export (same round, recomputed bytes)
+                    for s in self._pending_ssts.pop((job.name, target),
+                                                    []):
+                        self.hummock.release_external_sst_key(s["key"])
+                    if ssts:
+                        self._pending_ssts[(job.name, target)] = ssts
+                        w.sst_keys.difference_update(
+                            {s["key"] for s in ssts}
+                        )
                 sealed += 1
             committed = sealed == len(jobs) \
                 and self._await_durable(jobs, target)
@@ -519,10 +727,31 @@ class MetaService:
     def _commit_cluster_epoch(self, round_: int,
                               jobs: list[JobInfo]) -> None:
         """All jobs sealed ``round_``: ONE manifest delta records the
-        global consistency point, then serving pins move forward —
-        a snapshot read after this sees every MV at the same round."""
+        global consistency point — carrying every MV export SST the
+        round's seals uploaded (newest round first, so L0 reader order
+        stays newest-first) — then serving pins move forward: a
+        snapshot read after this sees every MV at the same round."""
+        from risingwave_tpu.storage.hummock.version import SstInfo
+
         epoch_val = min(j.seal_log[-1][1] for j in jobs)
-        self.versions.commit_cluster_epoch(epoch_val)
+        with self._lock:
+            due = sorted(
+                [k for k in self._pending_ssts if k[1] <= round_],
+                key=lambda k: -k[1],
+            )
+            adds = [
+                SstInfo(
+                    key=s["key"],
+                    first_key=bytes.fromhex(s["first_key"]),
+                    last_key=bytes.fromhex(s["last_key"]),
+                    n_records=int(s["n_records"]),
+                    size=int(s["size"]),
+                )
+                for k in due for s in self._pending_ssts[k]
+            ]
+            for k in due:
+                del self._pending_ssts[k]
+        self.hummock.commit_external(epoch_val, adds)
         with self._lock:
             self.cluster_epoch = round_
             for j in jobs:
@@ -540,11 +769,16 @@ class MetaService:
         return {"cols": cols, "rows": rows}
 
     def serve(self, sql: str):
-        """Route a serving read to the MV's owning worker, pinned at
-        the job's last cluster-committed epoch.  While the owner is
-        dead/unassigned (failover in progress) the read WAITS for the
+        """Route a serving read.  SELECTs go ROUND-ROBIN across live
+        serving replicas (the stateless read tier over shared SSTs,
+        pinned at the last cluster-committed manifest epoch); when no
+        replica is registered, a replica refuses the statement shape
+        (``ServeUnsupported``), or every replica is unreachable, the
+        read falls back to the MV's OWNING worker pinned at the job's
+        last cluster-committed epoch.  While the owner is dead/
+        unassigned (failover in progress) the read WAITS for the
         reassignment instead of erroring — reads never observe partial
-        state and never fail across a worker kill."""
+        state and never fail across a worker OR replica kill."""
         from risingwave_tpu.sql import ast
         from risingwave_tpu.sql.parser import parse
 
@@ -558,6 +792,7 @@ class MetaService:
             )
         mv = sel.from_.name
         deadline = time.monotonic() + self.serve_retry_timeout_s
+        try_replicas = True
         while True:
             with self._lock:
                 jname = self._mv_to_job.get(mv)
@@ -567,6 +802,29 @@ class MetaService:
                 w = self.workers.get(job.worker_id) \
                     if job.worker_id is not None else None
                 pin = job.pinned_epoch
+                manifest_pin = self.versions.max_committed_epoch
+                replicas = [r for r in self.serving.values() if r.alive]
+                self._serve_rr += 1
+                start = self._serve_rr
+            if try_replicas and replicas:
+                for i in range(len(replicas)):
+                    r = replicas[(start + i) % len(replicas)]
+                    try:
+                        res = r.client.call("read", sql=sql,
+                                            min_epoch=manifest_pin)
+                        self.metrics.inc("cluster_serving_reads_total")
+                        return res["cols"], [tuple(row)
+                                             for row in res["rows"]]
+                    except RpcError as e:
+                        if "ServeUnsupported" in str(e):
+                            # statement shape needs the engine — the
+                            # owning worker serves it (and every retry
+                            # of this read)
+                            try_replicas = False
+                            break
+                        raise  # replica answered with a real failure
+                    except (ConnectionError, OSError):
+                        continue  # replica died mid-read: next one
             if w is not None and w.alive:
                 try:
                     res = w.client.call("serve", sql=sql,
@@ -605,6 +863,14 @@ class MetaService:
                      "heartbeat_age_s": round(now - w.last_seen, 3),
                      "jobs": sorted(w.jobs)}
                     for w in self.workers.values()
+                ],
+                "serving": [
+                    {"id": r.replica_id, "addr": r.addr,
+                     "alive": r.alive, "pid": r.pid,
+                     "heartbeat_age_s": round(now - r.last_seen, 3),
+                     "granted_vid": r.granted_vid,
+                     "pinned_vids": sorted(r.pins)}
+                    for r in self.serving.values()
                 ],
                 "jobs": [
                     {"name": j.name, "mvs": list(j.mvs),

@@ -67,6 +67,12 @@ class ComputeWorker:
             pid=os.getpid(),
         )
         self.worker_id = int(res["worker_id"])
+        # MV export SST keys come from the meta (single allocator:
+        # collision-free across workers, vacuum-protected until the
+        # round's cluster epoch commits them into the manifest)
+        self.engine.sst_key_allocator = lambda: self._meta_client.call(
+            "alloc_sst", worker_id=self.worker_id
+        )["key"]
         if heartbeat:
             self._hb_thread = threading.Thread(
                 target=self._heartbeat_loop,
@@ -126,14 +132,20 @@ class ComputeWorker:
         """Process ``chunks`` chunks + one barrier for one job — the
         meta's global round, applied locally.  Returns the SEALED
         epoch immediately (the checkpoint upload runs in the job's
-        background uploader); meta polls ``job_epochs`` for the
-        durable ack before committing the cluster epoch."""
+        background uploader) plus the round's MV export SSTs (row
+        diffs uploaded to the shared store under meta-allocated keys;
+        the META commits them into the manifest with the cluster
+        epoch, so the serving tier reads every MV at the same round);
+        meta polls ``job_epochs`` for the durable ack before
+        committing the cluster epoch."""
         with self._lock:
             sealed = self.engine.tick_job(job, int(chunks))
+            ssts = self.engine.export_mv_deltas(job, sealed)
             positions = self.engine.job_epochs(job)
         return {"ok": True, "committed_epoch": sealed,
                 "sealed_epoch": sealed,
-                "durable_epoch": positions["durable"]}
+                "durable_epoch": positions["durable"],
+                "ssts": ssts}
 
     def rpc_job_epochs(self, job: str) -> dict:
         """Seal-vs-durable positions of one job (also services its

@@ -1,19 +1,41 @@
 """Data-plane primitives: types, chunks, hashing, epochs.
 
 Reference counterpart: ``src/common`` (see SURVEY.md §2.2).
+
+Exports resolve lazily (PEP 562): ``common.types``/``common.chunk``
+import jax, but jax-free processes (the engine-free serving tier) need
+``common.metrics`` and must be able to import the package without
+paying — or even having — jax.
 """
 
-from risingwave_tpu.common.types import (  # noqa: F401
-    DataType,
-    Field,
-    Schema,
-)
-from risingwave_tpu.common.chunk import (  # noqa: F401
-    Chunk,
-    StrCol,
-    OP_INSERT,
-    OP_DELETE,
-    OP_UPDATE_DELETE,
-    OP_UPDATE_INSERT,
-)
-from risingwave_tpu.common.epoch import Epoch, EpochPair  # noqa: F401
+_LAZY = {
+    "DataType": ("risingwave_tpu.common.types", "DataType"),
+    "Field": ("risingwave_tpu.common.types", "Field"),
+    "Schema": ("risingwave_tpu.common.types", "Schema"),
+    "Chunk": ("risingwave_tpu.common.chunk", "Chunk"),
+    "StrCol": ("risingwave_tpu.common.chunk", "StrCol"),
+    "OP_INSERT": ("risingwave_tpu.common.chunk", "OP_INSERT"),
+    "OP_DELETE": ("risingwave_tpu.common.chunk", "OP_DELETE"),
+    "OP_UPDATE_DELETE": ("risingwave_tpu.common.chunk",
+                         "OP_UPDATE_DELETE"),
+    "OP_UPDATE_INSERT": ("risingwave_tpu.common.chunk",
+                         "OP_UPDATE_INSERT"),
+    "Epoch": ("risingwave_tpu.common.epoch", "Epoch"),
+    "EpochPair": ("risingwave_tpu.common.epoch", "EpochPair"),
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name):
+    try:
+        mod_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        ) from None
+    import importlib
+
+    value = getattr(importlib.import_module(mod_name), attr)
+    globals()[name] = value
+    return value

@@ -67,6 +67,11 @@ class ClusterConfig:
     #: meta → worker control RPC deadline (barrier rounds include
     #: first-compile latency on fresh workers)
     rpc_timeout_s: float = 180.0
+    #: serving replica → meta lease cadence (each heartbeat acks the
+    #: held manifest vid and receives the next epoch-pin grant)
+    serving_heartbeat_interval_s: float = 0.5
+    #: serving replica block-cache capacity (decoded SST blocks)
+    serving_cache_blocks: int = 1024
 
 
 @dataclass

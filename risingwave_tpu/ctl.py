@@ -211,6 +211,13 @@ def cluster_jobs(meta_addr: str) -> list[dict]:
     return _meta_state(meta_addr)["jobs"]
 
 
+def cluster_serving(meta_addr: str) -> list[dict]:
+    """``ctl cluster serving``: registered serving replicas — address,
+    liveness, heartbeat age, the granted manifest vid, and the epoch
+    pin lease (the vids vacuum keeps alive for each replica)."""
+    return _meta_state(meta_addr).get("serving", [])
+
+
 def cluster_epochs(meta_addr: str) -> dict:
     """``ctl cluster epochs``: the global checkpoint positions — the
     committed cluster epoch (round), the manifest's epoch stamp, each
@@ -244,7 +251,8 @@ def _cluster_main(argv: list[str]) -> None:
 
     sub, addr = argv[0], argv[1]
     fn = {"workers": cluster_workers, "jobs": cluster_jobs,
-          "epochs": cluster_epochs}.get(sub)
+          "epochs": cluster_epochs,
+          "serving": cluster_serving}.get(sub)
     if fn is None:
         raise SystemExit(f"unknown cluster subcommand: {sub}")
     print(json.dumps(fn(addr), indent=1))

@@ -1,0 +1,42 @@
+"""Serve-lite: the engine-free serving tier.
+
+Reference counterpart: the frontend/batch split for serving reads —
+stateless frontend nodes executing batch scans over shared Hummock
+storage at a pinned snapshot, without touching the streaming compute
+nodes (SURVEY.md §3.4; the Taurus read-replica-over-shared-pages move,
+PAPERS.md).
+
+A ``ServingWorker`` process opens the cluster's shared ``data_dir``
+through the ObjectStore seam, follows the version manifest at a
+META-PINNED epoch (pin leases counted by vacuum), and answers
+point-gets / pk-range scans over the ``m:<mv>\\0<pk>`` keyspace
+directly from SSTs — no Engine, no JAX on the read path.
+"""
+
+_LAZY = {
+    "ServingWorker": ("risingwave_tpu.serve.worker", "ServingWorker"),
+    "ServeUnsupported": ("risingwave_tpu.serve.worker",
+                         "ServeUnsupported"),
+    "ManifestFollower": ("risingwave_tpu.serve.reader",
+                         "ManifestFollower"),
+    "SstView": ("risingwave_tpu.serve.reader", "SstView"),
+    "MvSchema": ("risingwave_tpu.serve.reader", "MvSchema"),
+    "mv_key_range": ("risingwave_tpu.serve.reader", "mv_key_range"),
+    "schema_key": ("risingwave_tpu.serve.reader", "schema_key"),
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name):
+    try:
+        mod_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        ) from None
+    import importlib
+
+    value = getattr(importlib.import_module(mod_name), attr)
+    globals()[name] = value
+    return value

@@ -1,0 +1,315 @@
+"""Shared-storage read path for the serving tier (jax-free).
+
+Two pieces:
+
+- ``ManifestFollower`` — a READ-ONLY replica of the version manifest:
+  it replays base snapshots + deltas from the shared object store up
+  to a caller-supplied vid limit (the meta's pin-lease grant) and
+  never commits.  The single-writer invariant of the manifest
+  (``VersionManager`` in the owning process) is untouched — any number
+  of followers may trail it.
+
+- ``SstView`` — the serving read path over a follower's version:
+  newest-first point-gets with bloom/key-range pruning and k-way merge
+  range scans, fronted by one process-wide LRU ``BlockCache`` with
+  hit/miss/bytes gauges (the foyer-block-cache analog for the
+  stateless serving node).
+
+Also here: the MV schema document the export path publishes next to
+the data (``serve/schema/<mv>.json``) so a serving replica can encode
+pk probe keys and project columns WITHOUT the SQL binder (which would
+drag in jax).  ``kind`` strings are deliberately dumb — "string" /
+"decimal" / "float" / "int" — the full ``DataType`` never crosses the
+seam.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from collections import deque
+from dataclasses import dataclass
+
+from risingwave_tpu.storage.hummock.object_store import ObjectError
+from risingwave_tpu.storage.hummock.version import (
+    HummockVersion,
+    VersionDelta,
+    apply_delta,
+)
+from risingwave_tpu.storage.sst import (
+    TOMBSTONE,
+    BlockCache,
+    SstReader,
+    merge_scan,
+)
+
+_DELTA_PREFIX = "version/delta_"
+_BASE_PREFIX = "version/base_"
+_SCHEMA_PREFIX = "serve/schema/"
+
+
+def mv_key_range(name: str) -> tuple[bytes, bytes]:
+    """Key range of one MV in the shared storage keyspace (mirrors
+    Engine._mv_storage_range — the TableKey table-prefix scheme)."""
+    lo = b"m:" + name.encode() + b"\x00"
+    return lo, lo[:-1] + b"\x01"
+
+
+def schema_key(name: str) -> str:
+    return f"{_SCHEMA_PREFIX}{name}.json"
+
+
+def bytes_successor(b: bytes) -> bytes | None:
+    """Smallest byte string greater than every string prefixed by
+    ``b`` (None = no finite successor: all 0xff)."""
+    arr = bytearray(b)
+    while arr:
+        if arr[-1] != 0xFF:
+            arr[-1] += 1
+            return bytes(arr)
+        arr.pop()
+    return None
+
+
+@dataclass(frozen=True)
+class MvColumn:
+    name: str
+    kind: str     # "string" | "decimal" | "float" | "int"
+    scale: int
+    hidden: bool
+
+
+class MvSchema:
+    """The serving replica's view of one MV's shape, decoded from the
+    schema document the export path publishes."""
+
+    def __init__(self, doc: dict):
+        self.mv = doc["mv"]
+        self.columns = [
+            MvColumn(c["name"], c["kind"], int(c.get("scale", 0)),
+                     bool(c.get("hidden", False)))
+            for c in doc["columns"]
+        ]
+        self.pk: tuple[int, ...] = tuple(doc["pk"])
+        self._by_name = {c.name: i for i, c in enumerate(self.columns)}
+
+    @staticmethod
+    def load(store, name: str) -> "MvSchema | None":
+        try:
+            return MvSchema(json.loads(store.get(schema_key(name))))
+        except ObjectError:
+            return None
+
+    def index_of(self, name: str) -> int | None:
+        return self._by_name.get(name)
+
+    def output_indices(self) -> list[int]:
+        return [i for i, c in enumerate(self.columns) if not c.hidden]
+
+    def encode_pk_value(self, col: int, v) -> bytes:
+        """Memcomparable encoding of one pk component — the jax-free
+        twin of checkpoint_store._mc_encode_value (same bytes)."""
+        import numpy as np
+
+        from risingwave_tpu.storage import codec as C
+
+        c = self.columns[col]
+        if c.kind == "string":
+            return str(v).encode() + b"\x00"
+        if c.kind == "decimal":
+            scaled = int(round(float(v) * 10 ** c.scale))
+            return C.mc_encode_i64(np.asarray([scaled])).tobytes()
+        if c.kind == "float":
+            return C.mc_encode_f64(np.asarray([float(v)])).tobytes()
+        return C.mc_encode_i64(np.asarray([int(v)])).tobytes()
+
+
+class StaleLease(RuntimeError):
+    """The follower cannot reconstruct the granted vid from the pruned
+    log — the caller must request a fresh grant."""
+
+
+class ManifestFollower:
+    """Read-only manifest replica over the shared object store."""
+
+    def __init__(self, store):
+        self.store = store
+        self.version = HummockVersion.empty()
+        self._lock = threading.Lock()
+
+    @property
+    def vid(self) -> int:
+        return self.version.vid
+
+    def _list_vids(self, prefix: str) -> list[int]:
+        return [int(k[len(prefix):-len(".json")])
+                for k in self.store.list(prefix)]
+
+    def refresh(self, limit_vid: int | None = None) -> HummockVersion:
+        """Advance to exactly ``limit_vid`` (the pin-lease grant), or
+        to the newest logged version when None.  Never goes backwards.
+        Raises ``StaleLease`` when base pruning has removed the log
+        entries needed to reach ``limit_vid`` precisely — re-granting
+        (which always points at the writer's CURRENT vid) resolves it.
+        """
+        with self._lock:
+            v = self.version
+            if limit_vid is not None and limit_vid <= v.vid:
+                return v
+            delta_vids = sorted(self._list_vids(_DELTA_PREFIX))
+            base_vids = sorted(self._list_vids(_BASE_PREFIX))
+            target = limit_vid
+            if target is None:
+                target = max(delta_vids + base_vids + [v.vid])
+            # re-anchor on a base snapshot when the contiguous delta
+            # chain from our vid has been pruned away
+            chain_start = v.vid + 1
+            usable = [b for b in base_vids if v.vid < b <= target]
+            if usable and (not delta_vids
+                           or min(delta_vids) > chain_start):
+                base = max(usable)
+                v = HummockVersion.from_json(json.loads(
+                    self.store.get(_BASE_PREFIX
+                                   + f"{base:012d}.json")
+                ))
+                chain_start = base + 1
+            for vid in range(chain_start, target + 1):
+                key = _DELTA_PREFIX + f"{vid:012d}.json"
+                try:
+                    d = VersionDelta.from_json(
+                        json.loads(self.store.get(key))
+                    )
+                except ObjectError:
+                    raise StaleLease(
+                        f"delta {vid} pruned before follower reached it"
+                    ) from None
+                v = apply_delta(v, d)
+            if limit_vid is not None and v.vid < limit_vid:
+                raise StaleLease(
+                    f"cannot reach vid {limit_vid} (log ends at {v.vid})"
+                )
+            self.version = v
+            return v
+
+
+class SstView:
+    """Pinned-version reads over shared SSTs with a block cache.
+
+    Reads capture ONE version snapshot each, so a concurrent refresh
+    never tears a scan.  Readers are retained for the last
+    ``retain_versions`` refreshed versions (an in-flight read's
+    snapshot is always among them) and closed once unreferenced.
+    """
+
+    def __init__(self, store, cache_blocks: int = 1024,
+                 metrics=None, retain_versions: int = 4):
+        self.store = store
+        self.follower = ManifestFollower(store)
+        self.cache = BlockCache(cache_blocks)
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        self._readers: dict[str, SstReader] = {}
+        self._retained: deque[HummockVersion] = deque(
+            maxlen=max(2, retain_versions)
+        )
+        self._schemas: dict[str, MvSchema] = {}
+
+    # -- manifest -------------------------------------------------------
+    @property
+    def version(self) -> HummockVersion:
+        return self.follower.version
+
+    def refresh(self, limit_vid: int | None = None) -> HummockVersion:
+        v = self.follower.refresh(limit_vid)
+        with self._lock:
+            if not self._retained or self._retained[-1].vid != v.vid:
+                self._retained.append(v)
+            live = set()
+            for rv in self._retained:
+                live |= rv.all_keys()
+            for key in [k for k in self._readers if k not in live]:
+                try:
+                    self._readers.pop(key).close()
+                except Exception:  # noqa: BLE001 — best-effort close
+                    pass
+        self._export_gauges()
+        return v
+
+    def _export_gauges(self) -> None:
+        if self.metrics is None:
+            return
+        self.metrics.set_gauge("serving_pinned_epoch",
+                               self.version.max_committed_epoch)
+        self.metrics.set_gauge("serving_pinned_version_id",
+                               self.version.vid)
+        self.metrics.set_gauge("serving_block_cache_hits",
+                               self.cache.hits)
+        self.metrics.set_gauge("serving_block_cache_misses",
+                               self.cache.misses)
+        self.metrics.set_gauge("serving_block_cache_fill_bytes",
+                               self.cache.miss_bytes)
+        self.metrics.set_gauge("serving_block_cache_hit_ratio",
+                               self.cache.hit_ratio())
+
+    # -- schemas --------------------------------------------------------
+    def schema(self, mv: str) -> MvSchema | None:
+        s = self._schemas.get(mv)
+        if s is None:
+            s = MvSchema.load(self.store, mv)
+            if s is not None:
+                self._schemas[mv] = s
+        return s
+
+    # -- reads ----------------------------------------------------------
+    def _reader(self, key: str) -> SstReader:
+        with self._lock:
+            r = self._readers.get(key)
+            if r is None:
+                r = SstReader(store=self.store, key=key,
+                              cache=self.cache)
+                self._readers[key] = r
+            return r
+
+    def point_get(self, key: bytes,
+                  version: HummockVersion | None = None) -> bytes | None:
+        """Newest-first levels with bloom/key-range pruning (the
+        PinnedVersion.get read, replayed replica-side)."""
+        v = version if version is not None else self.version
+        m = self.metrics
+        for lv in v.levels:
+            for s in lv:
+                r = self._reader(s.key)
+                if not r.may_contain(key):
+                    if m is not None:
+                        m.inc("serving_bloom_filter_total",
+                              result="skip")
+                    continue
+                val = r.get(key)
+                if m is not None:
+                    m.inc("serving_bloom_filter_total",
+                          result="hit" if val is not None else "miss")
+                if val is not None:
+                    return None if val == TOMBSTONE else val
+        return None
+
+    def scan(self, lo: bytes = b"", hi: bytes | None = None,
+             version: HummockVersion | None = None):
+        v = version if version is not None else self.version
+        readers = [self._reader(s.key) for lv in v.levels for s in lv]
+        yield from merge_scan(readers, lo, hi)
+
+    def scan_mv(self, mv: str,
+                version: HummockVersion | None = None) -> list[bytes]:
+        """Raw pickled row payloads of one MV (the byte-identity
+        surface tests compare against Engine.storage_serve_mv)."""
+        lo, hi = mv_key_range(mv)
+        return [val for _, val in self.scan(lo, hi, version)]
+
+    def close(self) -> None:
+        with self._lock:
+            for r in self._readers.values():
+                try:
+                    r.close()
+                except Exception:  # noqa: BLE001
+                    pass
+            self._readers.clear()

@@ -1,0 +1,433 @@
+"""ServingWorker: a stateless serving replica (engine-free, jax-free).
+
+Reference counterpart: the frontend/batch serving split — stateless
+nodes that execute batch scans over SHARED storage at a pinned
+snapshot, scaling the read path independently of the streaming
+compute nodes (SURVEY.md §3.4; Taurus' read replicas over shared
+pages, PAPERS.md).  The Hazelcast-Jet tail-latency discipline applies:
+serve from the block cache and pinned SSTs, never from the barrier
+path.
+
+Shape here: NO Engine, NO JAX — the process imports only the parser
+(pure Python), the SST/manifest readers, and the RPC/metrics plumbing.
+It registers with the meta like a compute worker (heartbeats, expiry),
+holds a meta-side EPOCH PIN LEASE that advances per committed cluster
+epoch (the lease pins the replica's manifest version in the meta's
+VersionManager, so vacuum can never reap an SST under a live serving
+read), and answers the SELECT shapes a key-value read path can serve:
+
+- point-gets:      WHERE covers the MV's full pk with equalities;
+- pk-range scans:  predicates on the LEADING pk column (the
+  memcomparable encoding makes byte ranges == value ranges);
+- projection (named columns or *) and LIMIT/OFFSET.
+
+Anything else raises ``ServeUnsupported`` — the meta frontend falls
+back to the owning compute worker, so the SQL surface never narrows.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import sys
+import threading
+import time
+from dataclasses import dataclass
+
+from risingwave_tpu.cluster.rpc import RpcClient, RpcServer, parse_addr
+from risingwave_tpu.common.metrics import MetricsRegistry
+from risingwave_tpu.serve.reader import (
+    MvSchema,
+    SstView,
+    StaleLease,
+    bytes_successor,
+    mv_key_range,
+)
+from risingwave_tpu.storage.hummock.object_store import ObjectError
+
+
+class ServeUnsupported(ValueError):
+    """The statement needs the engine — route to the owning worker."""
+
+
+_CMP_OPS = ("equal", "less_than", "less_than_or_equal",
+            "greater_than", "greater_than_or_equal")
+
+
+@dataclass
+class ReadPlan:
+    mv: str
+    cols: list[int]
+    col_names: list[str]
+    #: "get" (point key) or "scan" (byte range)
+    mode: str
+    key: bytes = b""
+    lo: bytes = b""
+    hi: bytes | None = None
+    limit: int | None = None
+    offset: int = 0
+
+
+def _conjuncts(expr) -> list:
+    from risingwave_tpu.sql import ast
+
+    if isinstance(expr, ast.BinaryOp) and expr.op == "and":
+        return _conjuncts(expr.left) + _conjuncts(expr.right)
+    return [expr]
+
+
+def _flip(op: str) -> str:
+    return {
+        "less_than": "greater_than",
+        "less_than_or_equal": "greater_than_or_equal",
+        "greater_than": "less_than",
+        "greater_than_or_equal": "less_than_or_equal",
+    }.get(op, op)
+
+
+def plan_read(select, schema: MvSchema) -> ReadPlan:
+    """Compile one SELECT into a key-value read, or raise
+    ``ServeUnsupported`` (the meta falls back to the owning worker)."""
+    from risingwave_tpu.sql import ast
+
+    if select.group_by or select.having is not None or select.order_by:
+        raise ServeUnsupported(
+            "serving replicas handle projection/point/range reads only"
+        )
+    if not isinstance(select.from_, ast.TableRef) \
+            or select.from_.temporal:
+        raise ServeUnsupported("serving reads are SELECT ... FROM <mv>")
+    mv = select.from_.name
+
+    # projection
+    cols: list[int] = []
+    names: list[str] = []
+    if len(select.items) == 1 \
+            and isinstance(select.items[0].expr, ast.Star):
+        cols = schema.output_indices()
+        names = [schema.columns[i].name for i in cols]
+    else:
+        for item in select.items:
+            if not isinstance(item.expr, ast.ColumnRef):
+                raise ServeUnsupported(
+                    "serving projection supports plain columns"
+                )
+            idx = schema.index_of(item.expr.name)
+            if idx is None:
+                raise ValueError(
+                    f"column {item.expr.name!r} does not exist in {mv!r}"
+                )
+            cols.append(idx)
+            names.append(item.alias or item.expr.name)
+
+    lo, hi = mv_key_range(mv)
+    plan = ReadPlan(mv=mv, cols=cols, col_names=names, mode="scan",
+                    lo=lo, hi=hi, limit=select.limit,
+                    offset=select.offset or 0)
+    if select.where is None:
+        return plan
+
+    # predicates: col <cmp> literal over pk columns only
+    preds: list[tuple[int, str, object]] = []
+    for c in _conjuncts(select.where):
+        if not isinstance(c, ast.BinaryOp) or c.op not in _CMP_OPS:
+            raise ServeUnsupported("serving WHERE supports pk compares")
+        left, right, op = c.left, c.right, c.op
+        if isinstance(left, ast.Literal) \
+                and isinstance(right, ast.ColumnRef):
+            left, right, op = right, left, _flip(op)
+        if not (isinstance(left, ast.ColumnRef)
+                and isinstance(right, ast.Literal)):
+            raise ServeUnsupported("serving WHERE supports pk compares")
+        idx = schema.index_of(left.name)
+        if idx is None:
+            raise ValueError(
+                f"column {left.name!r} does not exist in {mv!r}"
+            )
+        if idx not in schema.pk:
+            raise ServeUnsupported(
+                f"serving WHERE is limited to pk columns "
+                f"(got {left.name!r})"
+            )
+        preds.append((idx, op, right.value))
+
+    eq = {i: v for i, op, v in preds if op == "equal"}
+    if len(eq) == len(preds) and set(eq) == set(schema.pk) \
+            and len(preds) == len(schema.pk):
+        plan.mode = "get"
+        plan.key = lo + b"".join(
+            schema.encode_pk_value(i, eq[i]) for i in schema.pk
+        )
+        return plan
+
+    # range: every predicate must sit on the LEADING pk column, where
+    # the memcomparable prefix makes byte order == value order
+    lead = schema.pk[0]
+    if any(i != lead for i, _, _ in preds):
+        raise ServeUnsupported(
+            "serving range scans bound the leading pk column"
+        )
+    lo_b, hi_b = lo, hi
+    for _, op, v in preds:
+        enc = schema.encode_pk_value(lead, v)
+        if op in ("equal", "greater_than_or_equal"):
+            lo_b = max(lo_b, lo + enc)
+        elif op == "greater_than":
+            succ = bytes_successor(enc)
+            lo_b = hi if succ is None else max(lo_b, lo + succ)
+        if op in ("equal", "less_than_or_equal"):
+            succ = bytes_successor(enc)
+            if succ is not None:
+                hi_b = min(hi_b, lo + succ)
+        elif op == "less_than":
+            hi_b = min(hi_b, lo + enc)
+    plan.lo, plan.hi = lo_b, hi_b
+    return plan
+
+
+class ServingWorker:
+    """One serving replica process (or in-process object in tests)."""
+
+    def __init__(self, meta_addr: str | None, data_dir: str,
+                 host: str = "127.0.0.1", port: int = 0,
+                 heartbeat_interval_s: float = 0.5,
+                 cache_blocks: int = 1024, store=None,
+                 metrics: MetricsRegistry | None = None):
+        if store is None:
+            from risingwave_tpu.storage.hummock.object_store import (
+                LocalFsObjectStore,
+            )
+            store = LocalFsObjectStore(os.path.join(data_dir, "hummock"))
+        self.metrics = metrics if metrics is not None \
+            else MetricsRegistry()
+        self.view = SstView(store, cache_blocks=cache_blocks,
+                            metrics=self.metrics)
+        self.meta_addr = meta_addr
+        self.host = host
+        self._port_req = port
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self.replica_id: int | None = None
+        self.reads_total = 0
+        self.read_errors = 0
+        #: meta's manifest epoch from the last heartbeat (lag gauge)
+        self._meta_manifest_epoch = 0
+        self._server: RpcServer | None = None
+        self._meta_client: RpcClient | None = None
+        self._hb_thread: threading.Thread | None = None
+        self._hb_lock = threading.Lock()
+        self._stop = threading.Event()
+
+    @property
+    def port(self) -> int:
+        return self._server.port if self._server is not None else 0
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self, heartbeat: bool = True) -> "ServingWorker":
+        self._stop.clear()
+        self._server = RpcServer(self, self.host, self._port_req).start()
+        if self.meta_addr is not None:
+            mh, mp = parse_addr(self.meta_addr)
+            self._meta_client = RpcClient(mh, mp, timeout=30.0)
+            res = self._meta_client.call(
+                "register_serving", host=self.host, port=self.port,
+                pid=os.getpid(),
+            )
+            self.replica_id = int(res["replica_id"])
+            self._meta_manifest_epoch = int(
+                res.get("manifest_epoch", 0)
+            )
+            self._refresh_to(int(res["granted_vid"]))
+            if heartbeat:
+                self._hb_thread = threading.Thread(
+                    target=self._heartbeat_loop,
+                    name=f"serving-{self.replica_id}-hb", daemon=True,
+                )
+                self._hb_thread.start()
+        else:
+            # standalone follower (offline inspection / single-node
+            # benches): trail the newest logged version, no lease
+            self.view.refresh(None)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=5)
+            self._hb_thread = None
+        if self._meta_client is not None:
+            try:
+                self._meta_client.call("unregister_serving",
+                                       replica_id=self.replica_id)
+            except Exception:  # noqa: BLE001 — meta reaps by timeout
+                pass
+            self._meta_client.close()
+            self._meta_client = None
+        if self._server is not None:
+            self._server.stop()
+            self._server = None
+        self.view.close()
+
+    # -- lease / refresh -------------------------------------------------
+    def _refresh_to(self, granted_vid: int) -> None:
+        try:
+            self.view.refresh(granted_vid)
+        except StaleLease:
+            # the grant outlived the pruned log tail: re-grant (the
+            # fresh grant always names the writer's current vid)
+            self._grant_refresh()
+
+    def _grant_refresh(self) -> None:
+        """One lease round-trip: report the held vid (acks the old pin),
+        receive + apply the next grant."""
+        if self._meta_client is None:
+            self.view.refresh(None)
+            return
+        with self._hb_lock:
+            for _ in range(8):
+                res = self._meta_client.call(
+                    "serving_heartbeat", replica_id=self.replica_id,
+                    vid=self.view.version.vid,
+                )
+                self._meta_manifest_epoch = int(
+                    res.get("manifest_epoch", 0)
+                )
+                try:
+                    self.view.refresh(int(res["granted_vid"]))
+                    break
+                except StaleLease:
+                    continue
+        self._export_lag_gauge()
+
+    def _export_lag_gauge(self) -> None:
+        self.metrics.set_gauge(
+            "serving_pinned_epoch_lag",
+            max(0, self._meta_manifest_epoch
+                - self.view.version.max_committed_epoch),
+        )
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(self.heartbeat_interval_s):
+            try:
+                self._grant_refresh()
+            except Exception:  # noqa: BLE001 — meta restart/unreachable
+                time.sleep(self.heartbeat_interval_s)
+
+    # -- the read path ---------------------------------------------------
+    def _plan(self, sql: str) -> ReadPlan:
+        from risingwave_tpu.sql import ast
+        from risingwave_tpu.sql.parser import parse
+
+        stmts = parse(sql)
+        if len(stmts) != 1 or not isinstance(stmts[0], ast.Select):
+            raise ServeUnsupported(
+                "serving replicas handle a single SELECT"
+            )
+        sel = stmts[0]
+        if not isinstance(sel.from_, ast.TableRef):
+            raise ServeUnsupported(
+                "serving reads are SELECT ... FROM <mv>"
+            )
+        schema = self.view.schema(sel.from_.name)
+        if schema is None:
+            raise ServeUnsupported(
+                f"no schema published for {sel.from_.name!r} "
+                "(not exported to shared storage yet)"
+            )
+        return plan_read(sel, schema)
+
+    def _ensure_epoch(self, min_epoch: int,
+                      timeout_s: float = 10.0) -> None:
+        """Catch up to the meta's pinned epoch before reading (a read
+        routed right after a cluster commit must see that commit)."""
+        if self.view.version.max_committed_epoch >= min_epoch:
+            return
+        deadline = time.monotonic() + timeout_s
+        while self.view.version.max_committed_epoch < min_epoch:
+            self._grant_refresh()
+            if self.view.version.max_committed_epoch >= min_epoch:
+                return
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"serving replica stuck behind pinned epoch "
+                    f"{min_epoch} (at "
+                    f"{self.view.version.max_committed_epoch})"
+                )
+            time.sleep(0.02)
+
+    def _execute(self, plan: ReadPlan, version):
+        rows: list[tuple] = []
+        if plan.mode == "get":
+            val = self.view.point_get(plan.key, version)
+            hits = [] if val is None else [pickle.loads(val)]
+        else:
+            hits = (pickle.loads(v)
+                    for _, v in self.view.scan(plan.lo, plan.hi,
+                                               version))
+        skip = plan.offset
+        for row in hits:
+            if skip > 0:
+                skip -= 1
+                continue
+            rows.append(tuple(row[i] for i in plan.cols))
+            if plan.limit is not None and len(rows) >= plan.limit:
+                break
+        return plan.col_names, rows
+
+    def read(self, sql: str, min_epoch: int = 0):
+        """Serve one SELECT at the leased (meta-pinned) epoch."""
+        t0 = time.perf_counter()
+        plan = self._plan(sql)  # ServeUnsupported propagates un-counted
+        try:
+            self._ensure_epoch(int(min_epoch or 0))
+            version = self.view.version
+            try:
+                cols, rows = self._execute(plan, version)
+            except ObjectError:
+                # an SST vanished under us (lease raced a vacuum —
+                # should not happen while the meta honors pins):
+                # re-grant and retry once before surfacing an error
+                self._grant_refresh()
+                version = self.view.version
+                cols, rows = self._execute(plan, version)
+        except BaseException:
+            self.read_errors += 1
+            self.metrics.inc("serving_read_errors_total")
+            raise
+        self.reads_total += 1
+        self.metrics.inc("serving_reads_total")
+        self.metrics.observe("serving_read_seconds",
+                             time.perf_counter() - t0)
+        self.view._export_gauges()
+        return cols, rows, version.max_committed_epoch
+
+    # -- RPC surface ----------------------------------------------------
+    def rpc_read(self, sql: str, min_epoch: int = 0) -> dict:
+        cols, rows, epoch = self.read(sql, min_epoch)
+        return {"cols": cols, "rows": [list(r) for r in rows],
+                "epoch": epoch}
+
+    def rpc_ping(self) -> dict:
+        return {
+            "ok": True,
+            "replica_id": self.replica_id,
+            "vid": self.view.version.vid,
+            "epoch": self.view.version.max_committed_epoch,
+            "jax_loaded": "jax" in sys.modules,
+        }
+
+    def rpc_state(self) -> dict:
+        return {
+            "replica_id": self.replica_id,
+            "vid": self.view.version.vid,
+            "pinned_epoch": self.view.version.max_committed_epoch,
+            "meta_manifest_epoch": self._meta_manifest_epoch,
+            "reads_total": self.reads_total,
+            "read_errors": self.read_errors,
+            "cache_hits": self.view.cache.hits,
+            "cache_misses": self.view.cache.misses,
+            "cache_hit_ratio": self.view.cache.hit_ratio(),
+            "jax_loaded": "jax" in sys.modules,
+        }
+
+    def rpc_metrics(self) -> dict:
+        return {"prometheus": self.metrics.render_prometheus()}

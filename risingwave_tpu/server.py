@@ -1,9 +1,9 @@
-"""Node entrypoint: single-binary, meta, or compute-worker process.
+"""Node entrypoint: single-binary, meta, compute, or serving process.
 
 Reference counterparts: the single-binary mode (``src/cmd_all/src/
 single_node.rs``) bundling frontend + meta + compute into one process,
-and the per-role binaries (``src/cmd/src/bin/{meta,compute}_node.rs``)
-the multi-process deployment launches.
+and the per-role binaries (``src/cmd/src/bin/{meta,compute}_node.rs``;
+the frontend/serving node) the multi-process deployment launches.
 
     # everything in one process (the default)
     python -m risingwave_tpu.server --port 4566 --data-dir ./data
@@ -14,9 +14,20 @@ the multi-process deployment launches.
     python -m risingwave_tpu.server --role compute \
         --meta 127.0.0.1:4600 --data-dir ./data   # run twice
 
+    # N stateless serving replicas (ENGINE-FREE: the process never
+    # imports jax — it reads MV rows straight from shared SSTs at the
+    # meta's pinned epoch)
+    python -m risingwave_tpu.server --role serving \
+        --meta 127.0.0.1:4600 --data-dir ./data   # run N times
+
 The meta process hosts the pgwire front door: DDL places streaming
-jobs on workers, SELECTs route to the owning worker pinned at the
-last cluster-committed epoch (cluster/meta_service.py).
+jobs on workers, SELECTs route round-robin across live serving
+replicas pinned at the last cluster-committed epoch (falling back to
+the owning worker — cluster/meta_service.py).
+
+Engine imports stay INSIDE the non-serving paths: ``--role serving``
+must boot without jax (the package __init__ skips the jax import for
+that role; see risingwave_tpu/__init__.py).
 """
 
 from __future__ import annotations
@@ -26,13 +37,11 @@ import json
 import threading
 import time
 
-from risingwave_tpu.sql.engine import Engine
-from risingwave_tpu.sql.planner import PlannerConfig
-
 
 class SingleNode:
-    def __init__(self, config: PlannerConfig | None = None,
-                 data_dir: str | None = None):
+    def __init__(self, config=None, data_dir: str | None = None):
+        from risingwave_tpu.sql.engine import Engine
+
         self.engine = Engine(config, data_dir=data_dir)
         self._stop = threading.Event()
         self._ticker: threading.Thread | None = None
@@ -157,9 +166,35 @@ def _run_compute(args) -> None:
         worker.stop()
 
 
+def _run_serving(args) -> None:
+    import sys
+
+    from risingwave_tpu.serve import ServingWorker
+
+    replica = ServingWorker(
+        args.meta, args.data_dir or "./data",
+        host=args.host, port=args.rpc_port,
+        heartbeat_interval_s=args.heartbeat_interval,
+        cache_blocks=args.serving_cache_blocks,
+    ).start()
+    print(json.dumps({
+        "role": "serving", "replica_id": replica.replica_id,
+        "port": replica.port,
+        # the engine-free contract, surfaced at the handshake: tests
+        # parse this line and assert jax never loaded
+        "jax_loaded": "jax" in sys.modules,
+    }), flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        replica.stop()
+
+
 def main() -> None:
     p = argparse.ArgumentParser(description="risingwave_tpu node")
-    p.add_argument("--role", choices=["single", "meta", "compute"],
+    p.add_argument("--role",
+                   choices=["single", "meta", "compute", "serving"],
                    default="single")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=4566,
@@ -167,13 +202,15 @@ def main() -> None:
     p.add_argument("--rpc-port", type=int, default=0,
                    help="control RPC port (meta/compute; 0 = ephemeral)")
     p.add_argument("--meta", default="127.0.0.1:4600",
-                   help="meta RPC address (compute role)")
+                   help="meta RPC address (compute/serving roles)")
     p.add_argument("--data-dir", default=None)
     p.add_argument("--config-json", default=None,
                    help="RwConfig overrides as JSON (compute role)")
     p.add_argument("--heartbeat-interval", type=float, default=0.5)
     p.add_argument("--heartbeat-timeout", type=float, default=3.0)
     p.add_argument("--barrier-interval-ms", type=int, default=1000)
+    p.add_argument("--serving-cache-blocks", type=int, default=1024,
+                   help="serving block-cache capacity (serving role)")
     args = p.parse_args()
 
     if args.role == "meta":
@@ -181,6 +218,9 @@ def main() -> None:
         return
     if args.role == "compute":
         _run_compute(args)
+        return
+    if args.role == "serving":
+        _run_serving(args)
         return
     node = SingleNode(data_dir=args.data_dir)
     server = node.start(args.host, args.port)

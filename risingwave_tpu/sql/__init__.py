@@ -5,8 +5,19 @@ Postgres-dialect parser), ``src/frontend`` (binder, planner, optimizer,
 stream fragmenter).  This frontend targets the streaming-SQL surface the
 benchmarks exercise (CREATE SOURCE/MV, windowed aggregation, joins,
 TopN) and widens round over round.
+
+``Engine`` resolves lazily (PEP 562): the engine imports jax, but the
+engine-free serving tier uses only ``sql.parser``/``sql.ast`` (pure
+Python) and must be able to import the package without loading jax.
 """
 
-from risingwave_tpu.sql.engine import Engine
-
 __all__ = ["Engine"]
+
+
+def __getattr__(name):
+    if name == "Engine":
+        from risingwave_tpu.sql.engine import Engine
+
+        globals()["Engine"] = Engine
+        return Engine
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

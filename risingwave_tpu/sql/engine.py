@@ -197,11 +197,31 @@ class Engine:
         #: manifest (a second VersionManager over the same object
         #: store would fork the version chain)
         self.role = role
+        #: shared object store for MV export SSTs in compute role (the
+        #: META owns the version manifest over the same store; workers
+        #: only upload objects and hand descriptors back)
+        self.shared_store = None
+        #: key allocator for exported SSTs (cluster workers point this
+        #: at the meta's ``alloc_sst`` RPC — single-allocator keys
+        #: never collide across workers and stay vacuum-protected
+        #: until their round commits)
+        self.sst_key_allocator = None
+        #: last exported (key → pickled row) per MV — the incremental
+        #: export diff base; seeded from the shared manifest on adopt
+        self._exported: dict[str, dict] = {}
         if data_dir is not None and role == "compute":
+            import os as _os
+
             from risingwave_tpu.storage import CheckpointStore
+            from risingwave_tpu.storage.hummock import (
+                LocalFsObjectStore,
+            )
             self.checkpoint_store = CheckpointStore(
                 data_dir,
                 keep_epochs=self.rw_config.storage.checkpoint_keep_epochs,
+            )
+            self.shared_store = LocalFsObjectStore(
+                _os.path.join(data_dir, "hummock")
             )
         elif data_dir is not None:
             import os as _os
@@ -730,10 +750,9 @@ class Engine:
         jobs use the leaner StreamingJob until something taps them."""
         job = entry.job
         if isinstance(job, DagJob):
-            if job.mesh is not None:
-                raise PlanError(
-                    "MV-on-MV over a sharded join job: next round"
-                )
+            # sharded join jobs attach downstream nodes per-shard (the
+            # whole DAG runs inside one shard_map; the caller validates
+            # the new chain is per-key-safe before mutating anything)
             return job, entry.mv_state_index[0]
         if not isinstance(job, StreamingJob):
             raise PlanError(
@@ -896,6 +915,33 @@ class Engine:
         for i in entry.mv_state_index:
             st = st[i]
         ex = entry.mv_executor
+        mesh = getattr(entry.job, "mesh", None)
+        if mesh is not None:
+            # sharded upstream: the snapshot is one STACKED chunk
+            # ([shard, cap, ...] leaves) consumed by backfill_node's
+            # shard_map program — each shard replays its own partition
+            import jax as _jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            n_shards = entry.job.n_shards
+            if isinstance(ex, MaterializeExecutor):
+                valid = st.table.occupied
+                cap = ex.table_size
+            elif isinstance(ex, AppendOnlyMaterialize):
+                valid = jnp.arange(ex.ring_size, dtype=jnp.int64)[
+                    None, :] < st.cursor[:, None]
+                cap = ex.ring_size
+            else:
+                raise PlanError("cannot backfill from a sink")
+            chunk = Chunk(
+                tuple(st.values),
+                jnp.zeros((n_shards, cap), jnp.int8),
+                valid,
+                ex.in_schema,
+            )
+            return _jax.device_put(
+                chunk, NamedSharding(mesh, P(entry.job.AXIS))
+            )
         if isinstance(ex, MaterializeExecutor):
             valid = st.table.occupied
             cap = ex.table_size
@@ -944,6 +990,14 @@ class Engine:
                     f"MV-on-MV over {type(entry.job).__name__} (sharded "
                     "upstream): next round"
                 )
+        mesh_jobs = {
+            self.catalog.get(tap.name).job
+            for tap in taps.values()
+            if getattr(self.catalog.get(tap.name).job, "mesh", None)
+            is not None
+        }
+        if mesh_jobs:
+            self._validate_mesh_attach(plan, taps, mesh_jobs)
 
         # attach: resolve every tap to its upstream job's MV node
         tap_refs: dict[str, int] = {}
@@ -1028,6 +1082,54 @@ class Engine:
         terminal = rewritten[plan.mv_node].fragment.executors[plan.mv_index]
         return target, terminal, (ids[plan.mv_node], plan.mv_index), \
             (ids, list(src_rename.values())), False
+
+    def _validate_mesh_attach(self, plan: DagPlan, taps: dict,
+                              mesh_jobs: set) -> None:
+        """MV-on-MV over a SHARDED join job (ROADMAP carry from round
+        6): the attached nodes run per-shard inside the upstream's
+        shard_map, which is correct exactly when every new node is a
+        per-key-safe chain over the tapped MV — a joined row's
+        changelog always lands on the shard owning its join key, so
+        project/filter/materialize over it stay shard-local.  Anything
+        that would merge rows ACROSS shards (aggs over reduced keys,
+        new joins, TopN) or pull a new un-sharded source still raises.
+        """
+        from risingwave_tpu.stream.executor import (
+            FilterExecutor as _F,
+            ProjectExecutor as _P,
+        )
+        from risingwave_tpu.stream.materialize import (
+            AppendOnlyMaterialize as _AOM,
+            MaterializeExecutor as _M,
+        )
+
+        if len(mesh_jobs) > 1 or any(
+            getattr(self.catalog.get(t.name).job, "mesh", None) is None
+            for t in taps.values()
+        ):
+            raise PlanError(
+                "MV-on-MV joining a sharded job with another job: "
+                "next round"
+            )
+        if len(taps) != len(plan.sources):
+            raise PlanError(
+                "MV-on-MV over a sharded join job cannot add new "
+                "sources: next round"
+            )
+        for n in plan.nodes:
+            if not isinstance(n, FragNode):
+                raise PlanError(
+                    "MV-on-MV over a sharded join job supports "
+                    "project/filter/materialize chains (no new "
+                    "joins): next round"
+                )
+            for ex in n.fragment.executors:
+                if not isinstance(ex, (_F, _P, _M, _AOM)):
+                    raise PlanError(
+                        "MV-on-MV over a sharded join job supports "
+                        "project/filter/materialize chains "
+                        f"(got {type(ex).__name__}): next round"
+                    )
 
     @staticmethod
     def _agg_shard_safe(agg, node, plan: DagPlan) -> bool:
@@ -1691,6 +1793,10 @@ class Engine:
             raise ValueError(f"{name!r} did not produce a streaming job")
         if recover:
             entry.job.recover()
+        # adoption moves the MV export diff base: whatever this engine
+        # exported in a previous ownership is stale against the shared
+        # manifest — re-seed from storage on the next export
+        self._exported.clear()
         return entry.job.committed_epoch
 
     def collect_join_metrics(self) -> None:
@@ -1834,38 +1940,152 @@ class Engine:
         lo = b"m:" + name.encode() + b"\x00"
         return lo, lo[:-1] + b"\x01"
 
-    def storage_export_mv(self, name: str) -> dict:
-        """Export an MV's current rows into the storage service as an
-        epoch-stamped changelog batch (upserts + tombstones for rows
-        gone since the last export) — ONE new L0 SST, no merge I/O;
-        the compactor folds it down in the background."""
+    def _mv_export_items(self, entry: CatalogEntry) -> dict:
+        """(storage key → pickled row) of an MV's CURRENT rows in the
+        shared ``m:<name>\\0<pk>`` keyspace — the export seam both the
+        single-node ``storage_export_mv`` and the cluster worker's
+        per-barrier delta export build on."""
         import pickle as _pickle
 
-        if self.hummock is None:
-            raise PlanError("storage export needs a durable data_dir")
-        entry = self.catalog.get(name)
-        if entry.kind != "mview" or entry.job is None:
-            raise PlanError(f"{name!r} is not a materialized view")
-        epoch = entry.job.committed_epoch
         schema = entry.mv_executor.in_schema
         pk = getattr(entry.mv_executor, "pk_indices",
                      tuple(range(len(schema))))
-        lo, hi = self._mv_storage_range(name)
+        lo, _ = self._mv_storage_range(entry.name)
         new: dict[bytes, bytes] = {}
         for row in self._mv_rows(entry):
             key = lo + b"".join(
                 _mc_encode_value(row[i], schema[i]) for i in pk
             )
             new[key] = _pickle.dumps(tuple(row), protocol=4)
+        return new
+
+    def _publish_mv_schema(self, store, entry: CatalogEntry) -> None:
+        """Publish the MV's shape next to its data so an engine-free
+        serving replica can encode pk probes and project columns
+        without the binder (serve/reader.MvSchema loads this)."""
+        import json as _json
+
+        from risingwave_tpu.serve.reader import schema_key
+
+        schema = entry.mv_executor.in_schema
+        pk = getattr(entry.mv_executor, "pk_indices",
+                     tuple(range(len(schema))))
+        cols = []
+        for f in schema:
+            if f.data_type.is_string:
+                kind = "string"
+            elif f.data_type == DataType.DECIMAL:
+                kind = "decimal"
+            elif f.data_type in (DataType.FLOAT32, DataType.FLOAT64):
+                kind = "float"
+            else:
+                kind = "int"
+            cols.append({
+                "name": f.name, "kind": kind,
+                "scale": int(getattr(f, "decimal_scale", 0) or 0),
+                "hidden": f.name.startswith("_hidden_"),
+            })
+        doc = {"mv": entry.name, "columns": cols, "pk": list(pk)}
+        store.put(schema_key(entry.name),
+                  _json.dumps(doc).encode())
+
+    def storage_export_mv(self, name: str) -> dict:
+        """Export an MV's current rows into the storage service as an
+        epoch-stamped changelog batch (upserts + tombstones for rows
+        gone since the last export) — ONE new L0 SST, no merge I/O;
+        the compactor folds it down in the background."""
+        if self.hummock is None:
+            raise PlanError("storage export needs a durable data_dir")
+        entry = self.catalog.get(name)
+        if entry.kind != "mview" or entry.job is None:
+            raise PlanError(f"{name!r} is not a materialized view")
+        epoch = entry.job.committed_epoch
+        lo, hi = self._mv_storage_range(name)
+        new = self._mv_export_items(entry)
         stale = [k for k, _ in self.hummock.scan(lo, hi)
                  if k not in new]
         from risingwave_tpu.storage.sst import TOMBSTONE
         batch = sorted(new.items()) + [(k, TOMBSTONE) for k in stale]
         self.hummock.write_batch(batch, epoch=epoch)
+        self._publish_mv_schema(self.hummock.store, entry)
         self.metrics.inc("storage_mv_export_rows_total", len(new),
                          job=name)
         return {"mv": name, "epoch": epoch, "rows": len(new),
                 "deletes": len(stale)}
+
+    def _seed_exported(self, store, name: str) -> dict:
+        """Rebuild the export diff base of one MV from the SHARED
+        manifest (a fresh/adopting worker has no export memory; the
+        committed storage state IS the base the next delta must diff
+        against)."""
+        from risingwave_tpu.serve.reader import (
+            ManifestFollower,
+            mv_key_range,
+        )
+        from risingwave_tpu.storage.sst import SstReader, merge_scan
+
+        v = ManifestFollower(store).refresh(None)
+        readers = [SstReader(store=store, key=s.key)
+                   for lv in v.levels for s in lv]
+        try:
+            lo, hi = mv_key_range(name)
+            return dict(merge_scan(readers, lo, hi))
+        finally:
+            for r in readers:
+                r.close()
+
+    def export_mv_deltas(self, job_name: str, epoch: int) -> list:
+        """Cluster-mode per-barrier MV export: diff every MV riding
+        ``job_name`` against its last export, seal the changes
+        (upserts + tombstones) as ONE new SST uploaded to the shared
+        store, and return its descriptor(s) for the meta to commit
+        into the shared manifest with the round's cluster epoch — the
+        meta stays the manifest's single writer; workers only upload
+        objects under meta-allocated (vacuum-protected) keys."""
+        from risingwave_tpu.storage.sst import (
+            TOMBSTONE,
+            build_sst_bytes,
+        )
+
+        store = self.shared_store if self.shared_store is not None \
+            else (self.hummock.store if self.hummock is not None
+                  else None)
+        if store is None or self.sst_key_allocator is None:
+            return []
+        batch: list[tuple[bytes, bytes]] = []
+        for entry in self.catalog.list("mview"):
+            if entry.job is None or entry.job.name != job_name \
+                    or entry.mv_executor is None:
+                continue
+            new = self._mv_export_items(entry)
+            prev = self._exported.get(entry.name)
+            if prev is None:
+                prev = self._seed_exported(store, entry.name)
+                self._publish_mv_schema(store, entry)
+            ups = [(k, v) for k, v in new.items()
+                   if prev.get(k) != v]
+            dels = [(k, TOMBSTONE) for k in prev if k not in new]
+            batch += ups + dels
+            self._exported[entry.name] = new
+            if ups:
+                self.metrics.inc("storage_mv_export_rows_total",
+                                 len(ups), job=entry.name)
+        if not batch:
+            return []
+        batch.sort()
+        key = self.sst_key_allocator()
+        data, meta = build_sst_bytes(
+            [k for k, _ in batch], [v for _, v in batch]
+        )
+        store.put(key, data)
+        return [{
+            "key": key,
+            "first_key": meta.first_key.hex(),
+            "last_key": meta.last_key.hex(),
+            "n_records": meta.n_records,
+            "size": meta.size,
+            "epoch": epoch,
+        }]
 
     def storage_serve_mv(self, name: str) -> list:
         """Serve an exported MV from the storage service through a

@@ -15,24 +15,39 @@ Device state stays dense in HBM; the storage layer owns the host-side
 durability path (checkpoint upload, serving from closed epochs,
 restart recovery), exactly the split the reference draws between
 executor caches and Hummock.
+
+Exports resolve lazily (PEP 562): ``checkpoint_store`` imports jax, but
+the engine-free serving tier reads SSTs through ``sst``/``hummock``
+from a process that must never load jax.
 """
 
-from risingwave_tpu.storage.checkpoint_store import CheckpointStore
-from risingwave_tpu.storage.hummock import (
-    CompactorService,
-    HummockStorage,
-    InMemObjectStore,
-    LocalFsObjectStore,
-    ObjectStore,
-    StoreFaults,
-)
+_LAZY = {
+    "CheckpointStore": ("risingwave_tpu.storage.checkpoint_store",
+                        "CheckpointStore"),
+    "CompactorService": ("risingwave_tpu.storage.hummock",
+                         "CompactorService"),
+    "HummockStorage": ("risingwave_tpu.storage.hummock",
+                       "HummockStorage"),
+    "InMemObjectStore": ("risingwave_tpu.storage.hummock",
+                         "InMemObjectStore"),
+    "LocalFsObjectStore": ("risingwave_tpu.storage.hummock",
+                           "LocalFsObjectStore"),
+    "ObjectStore": ("risingwave_tpu.storage.hummock", "ObjectStore"),
+    "StoreFaults": ("risingwave_tpu.storage.hummock", "StoreFaults"),
+}
 
-__all__ = [
-    "CheckpointStore",
-    "CompactorService",
-    "HummockStorage",
-    "InMemObjectStore",
-    "LocalFsObjectStore",
-    "ObjectStore",
-    "StoreFaults",
-]
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name):
+    try:
+        mod_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        ) from None
+    import importlib
+
+    value = getattr(importlib.import_module(mod_name), attr)
+    globals()[name] = value
+    return value

@@ -223,6 +223,38 @@ class HummockStorage:
     def delete_batch(self, keys: list[bytes], epoch: int = 0) -> None:
         self.write_batch([(k, TOMBSTONE) for k in keys], epoch)
 
+    # -- externally-uploaded SSTs (cluster MV exports) -------------------
+    def alloc_external_sst_key(self) -> str:
+        """Allocate (and vacuum-protect) an SST key for an EXTERNAL
+        uploader — a cluster compute worker exporting MV rows over the
+        shared store.  The single allocator keeps keys collision-free
+        across processes; the key stays protected until its delta
+        commits (``commit_external``) or the allocation is abandoned
+        (``release_external_sst_key``)."""
+        return self._alloc_sst_key()
+
+    def release_external_sst_key(self, key: str) -> None:
+        """Abandon an allocated-but-never-committed external key (its
+        uploader died or its round was re-sealed elsewhere); whatever
+        landed under it becomes a vacuumable orphan."""
+        with self._lock:
+            self._protected.discard(key)
+
+    def commit_external(self, epoch: int,
+                        ssts: list[SstInfo]) -> None:
+        """Commit externally-uploaded SSTs plus the cluster-epoch stamp
+        as ONE version delta.  ``ssts`` list order is newest-first
+        within the new L0 prefix (the delta prepends in order).  With
+        an empty list this is exactly the old cluster-epoch commit: an
+        empty delta advancing ``max_committed_epoch``."""
+        with self._commit_cv:
+            adds = {0: list(ssts)} if ssts else {}
+            self.versions.commit(epoch, adds=adds, removes={})
+            for s in ssts:
+                self._protected.discard(s.key)
+            self._update_gauges()
+            self._commit_cv.notify_all()
+
     # -- reads ----------------------------------------------------------
     def pin(self) -> PinnedVersion:
         pin_id, version = self.versions.pin()

@@ -174,6 +174,9 @@ class BlockCache:
         self.capacity = capacity_blocks
         self.hits = 0
         self.misses = 0
+        #: decoded bytes inserted on misses — the serving tier's
+        #: cache-fill I/O gauge (approximate: key+value payload)
+        self.miss_bytes = 0
 
     def get(self, key: tuple):
         v = self._d.get(key)
@@ -187,8 +190,13 @@ class BlockCache:
     def put(self, key: tuple, value) -> None:
         self._d[key] = value
         self._d.move_to_end(key)
+        self.miss_bytes += sum(len(k) + len(v) for k, v in value)
         while len(self._d) > self.capacity:
             self._d.popitem(last=False)
+
+    def hit_ratio(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
 
 
 class SstReader:

@@ -244,7 +244,19 @@ class DagJob(CheckpointPipelineMixin):
         states = list(self.states)
         for n in nodes:
             self.nodes.append(n)
-            states.append(n.init_state())
+            if self.mesh is None:
+                states.append(n.init_state())
+            else:
+                # sharded job: the new node's state gets the same
+                # stacked-and-sharded layout as _init_states
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                stacked = jax.vmap(lambda _: n.init_state())(
+                    jnp.arange(self.n_shards)
+                )
+                states.append(jax.device_put(
+                    stacked, NamedSharding(self.mesh, P(self.AXIS))
+                ))
             ids.append(len(self.nodes) - 1)
         self.states = tuple(states)
         self._rebuild()
@@ -1366,11 +1378,31 @@ class DagJob(CheckpointPipelineMixin):
         NOT donated: the snapshot chunk aliases the upstream MV's state
         buffers (it is built zero-copy from them), so donating the state
         tree would donate the chunk's own storage."""
-        prog = jax.jit(
-            lambda states, chunk: self._backfill_impl(
-                states, chunk, node_id, side
-            ),
-        )
+        if self.mesh is None:
+            prog = jax.jit(
+                lambda states, chunk: self._backfill_impl(
+                    states, chunk, node_id, side
+                ),
+            )
+        else:
+            # sharded job: the snapshot chunk arrives stacked
+            # [n_shards, ...]; each shard replays its own MV partition
+            # through the attached subtree inside shard_map (same
+            # calling convention as _make_step's per-shard body)
+            spec = self._sharding_spec()
+
+            def body(states, chunk):
+                local_s = jax.tree.map(lambda x: x[0], states)
+                local_c = jax.tree.map(lambda x: x[0], chunk)
+                out = self._backfill_impl(
+                    tuple(local_s), local_c, node_id, side
+                )
+                return jax.tree.map(lambda x: x[None], out)
+
+            prog = jax.jit(shard_map_nocheck(
+                body, mesh=self.mesh, in_specs=(spec, spec),
+                out_specs=spec,
+            ))
         for chunk in chunks:
             self.states = prog(self.states, chunk)
 

@@ -1,0 +1,197 @@
+"""Serving-tier bench: sustained concurrent reads DURING ingest.
+
+The ISSUE 5 regression gate for Serve-lite: a 1-meta + 1-compute +
+1-serving cluster (in-process) runs global barrier rounds (ingest +
+per-barrier MV export + compaction + periodic vacuum) while reader
+threads hammer the serving tier through the meta's router.  Asserted
+floors (``--assert``):
+
+- ZERO read errors across the whole window (reads pinned at committed
+  epochs, replica leases vacuum-safe);
+- sustained read throughput >= ``--min-reads-per-s``;
+- block-cache hit ratio after warmup >= ``--min-hit-ratio`` (the
+  serving tier serves from cache, not per-read SST I/O);
+- the REPLICA carried the bulk of the reads (the owning worker left
+  the read path — the point of the tier).
+
+Usage:
+    python scripts/serve_bench.py [--seconds 6] [--readers 4] [--assert]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def run(seconds: float = 6.0, readers: int = 4,
+        vacuum_interval_s: float = 0.25,
+        cache_blocks: int = 1024) -> dict:
+    from risingwave_tpu.cluster import ComputeWorker, MetaService
+    from risingwave_tpu.common.config import RwConfig
+    from risingwave_tpu.serve import ServingWorker
+
+    cfg = RwConfig.from_dict({
+        "streaming": {"chunk_size": 256},
+        "state": {"agg_table_size": 1 << 10, "agg_emit_capacity": 256,
+                  "mv_table_size": 1 << 10, "mv_ring_size": 1 << 12},
+        "storage": {"checkpoint_keep_epochs": 4},
+    })
+    tmp = tempfile.mkdtemp(prefix="serve_bench_")
+    meta = MetaService(tmp, heartbeat_timeout_s=10.0)
+    meta.start(port=0, monitor=False)  # compactor ON, monitor manual
+    addr = f"127.0.0.1:{meta.rpc_port}"
+    worker = ComputeWorker(addr, tmp, config=cfg,
+                           heartbeat_interval_s=0.5).start()
+    meta.execute_ddl(
+        "CREATE SOURCE t (k BIGINT, v BIGINT) "
+        "WITH (connector='datagen')"
+    )
+    meta.execute_ddl(
+        "CREATE MATERIALIZED VIEW bm AS "
+        "SELECT k % 32 AS g, count(*) AS n, sum(v) AS s "
+        "FROM t GROUP BY k % 32"
+    )
+    # warm the pipeline (first barrier pays jit compiles) and land the
+    # first exports before the replica joins
+    for _ in range(2):
+        assert meta.tick(1)["committed"]
+    replica = ServingWorker(addr, tmp, heartbeat_interval_s=0.1,
+                            cache_blocks=cache_blocks).start()
+
+    stop = threading.Event()
+    errors: list = []
+    reads = [0] * readers
+    rounds = [0]
+    last_vacuum = [time.monotonic()]
+
+    def ingest_loop():
+        while not stop.is_set():
+            try:
+                if meta.tick(1)["committed"]:
+                    rounds[0] += 1
+                if time.monotonic() - last_vacuum[0] \
+                        > vacuum_interval_s:
+                    meta.storage_vacuum()
+                    last_vacuum[0] = time.monotonic()
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"ingest: {e!r}")
+
+    def read_loop(i: int):
+        queries = [
+            "SELECT g, n, s FROM bm",
+            f"SELECT n FROM bm WHERE g = {i % 32}",
+            "SELECT g, n FROM bm WHERE g >= 8 AND g < 24",
+        ]
+        while not stop.is_set():
+            for sql in queries:
+                try:
+                    cols, rows = meta.serve(sql)
+                    assert rows, "empty serving read"
+                except Exception as e:  # noqa: BLE001
+                    errors.append(repr(e))
+            reads[i] += len(queries)
+
+    threads = [threading.Thread(target=ingest_loop, daemon=True)]
+    threads += [threading.Thread(target=read_loop, args=(i,),
+                                 daemon=True) for i in range(readers)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    # warmup half, then reset cache counters so the hit-ratio floor
+    # measures steady state, not cold fills
+    time.sleep(seconds / 2)
+    replica.view.cache.hits = 0
+    replica.view.cache.misses = 0
+    time.sleep(seconds / 2)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    elapsed = time.monotonic() - t0
+
+    total_reads = sum(reads)
+    summary = {
+        "seconds": round(elapsed, 2),
+        "readers": readers,
+        "rounds_committed": rounds[0],
+        "reads_total": total_reads,
+        "reads_per_s": round(total_reads / elapsed, 1),
+        "read_errors": len(errors),
+        "errors_sample": errors[:3],
+        "replica_reads": replica.reads_total,
+        "replica_read_errors": replica.read_errors,
+        "replica_share": round(
+            replica.reads_total / max(total_reads, 1), 3),
+        "cache_hit_ratio": round(replica.view.cache.hit_ratio(), 3),
+        "gc_objects": int(meta.metrics.get("storage_gc_objects_total"))
+        if _metric_exists(meta.metrics, "storage_gc_objects_total")
+        else 0,
+        "pinned_versions": meta.versions.pinned_count(),
+    }
+    replica.stop()
+    worker.stop()
+    meta.stop()
+    return summary
+
+
+def _metric_exists(m, name) -> bool:
+    try:
+        m.get(name)
+        return True
+    except KeyError:
+        return False
+
+
+def check(summary: dict, min_reads_per_s: float,
+          min_hit_ratio: float, min_replica_share: float) -> list[str]:
+    """The --assert floors; returns a list of violations (empty=pass)."""
+    bad = []
+    if summary["read_errors"] != 0:
+        bad.append(f"read_errors={summary['read_errors']} != 0 "
+                   f"({summary['errors_sample']})")
+    if summary["replica_read_errors"] != 0:
+        bad.append("replica_read_errors="
+                   f"{summary['replica_read_errors']} != 0")
+    if summary["reads_per_s"] < min_reads_per_s:
+        bad.append(f"reads_per_s={summary['reads_per_s']} "
+                   f"< {min_reads_per_s}")
+    if summary["cache_hit_ratio"] < min_hit_ratio:
+        bad.append(f"cache_hit_ratio={summary['cache_hit_ratio']} "
+                   f"< {min_hit_ratio}")
+    if summary["replica_share"] < min_replica_share:
+        bad.append(f"replica_share={summary['replica_share']} "
+                   f"< {min_replica_share}")
+    if summary["rounds_committed"] < 1:
+        bad.append("no rounds committed during the window")
+    return bad
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--seconds", type=float, default=6.0)
+    p.add_argument("--readers", type=int, default=4)
+    p.add_argument("--assert", dest="do_assert", action="store_true")
+    p.add_argument("--min-reads-per-s", type=float, default=20.0)
+    p.add_argument("--min-hit-ratio", type=float, default=0.5)
+    p.add_argument("--min-replica-share", type=float, default=0.5)
+    args = p.parse_args()
+
+    summary = run(seconds=args.seconds, readers=args.readers)
+    print(json.dumps(summary, indent=1))
+    if args.do_assert:
+        bad = check(summary, args.min_reads_per_s,
+                    args.min_hit_ratio, args.min_replica_share)
+        if bad:
+            raise SystemExit("serve_bench FAILED:\n  " + "\n  ".join(bad))
+        print("serve_bench: all floors PASSED")
+
+
+if __name__ == "__main__":
+    main()

@@ -123,27 +123,51 @@ def _drive_rounds(meta, n: int, deadline_s: float = 240.0) -> None:
             time.sleep(0.2)
 
 
+def _spawn_serving(meta_port: int, data_dir: str, log_path: str):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.Popen(
+        [sys.executable, "-m", "risingwave_tpu.server",
+         "--role", "serving", "--meta", f"127.0.0.1:{meta_port}",
+         "--data-dir", data_dir,
+         "--heartbeat-interval", "0.1"],
+        stdout=subprocess.PIPE,
+        stderr=open(log_path, "wb"),
+        env=env,
+    )
+
+
 def test_cluster_sigkill_failover_converges(tmp_path):
-    """The ISSUE 3 acceptance run: a 1-meta + 2-compute cluster with 2
+    """The ISSUE 3 acceptance run, extended with the ISSUE 5 serving
+    tier: a 1-meta + 2-compute + 1-SERVING-REPLICA cluster with 2
     nexmark MVs survives a SIGKILL of one worker — the dead worker's
     job is reassigned and replayed from the last committed cluster
-    epoch, serving reads issued THROUGHOUT the failover observe only
-    committed epochs (zero errors), and the final MV contents are
-    byte-identical to an undisturbed single-node run."""
+    epoch; serving reads issued THROUGHOUT the failover (routed to
+    the replica — an engine-free process whose handshake proves jax
+    never loaded — with engine-only shapes falling back to the owning
+    worker) observe only committed epochs with ZERO errors while
+    vacuum + the meta's compactor churn the shared store underneath;
+    and the final MV contents are byte-identical to an undisturbed
+    single-node run."""
     from risingwave_tpu.cluster import MetaService
     from risingwave_tpu.common.config import RwConfig
 
     rounds_before, rounds_after = 3, 3
     meta = MetaService(str(tmp_path), heartbeat_timeout_s=4.0)
-    meta.start(port=0)
+    meta.start(port=0)  # heartbeat monitor AND compactor both live
     procs = [
         _spawn_worker(meta.rpc_port, str(tmp_path),
                       str(tmp_path / f"worker{i}.log"))
         for i in range(2)
     ]
+    serving = _spawn_serving(meta.rpc_port, str(tmp_path),
+                             str(tmp_path / "serving.log"))
     stop_reads = threading.Event()
     read_errors: list = []
     try:
+        # the engine-free contract, asserted at the process boundary
+        handshake = json.loads(serving.stdout.readline().decode())
+        assert handshake["jax_loaded"] is False, handshake
+
         deadline = time.monotonic() + 120
         while len(meta.live_workers()) < 2:
             assert time.monotonic() < deadline, "workers never registered"
@@ -151,24 +175,39 @@ def test_cluster_sigkill_failover_converges(tmp_path):
                 assert p.poll() is None, \
                     f"worker died at startup (see {tmp_path})"
             time.sleep(0.25)
+        assert serving.poll() is None, \
+            f"serving replica died at startup (see {tmp_path})"
 
         for sql in _CLUSTER_DDL:
             meta.execute_ddl(sql)
         _drive_rounds(meta, rounds_before)
 
         # the serving loop runs ACROSS the kill: every read must come
-        # back from a committed epoch with no error
+        # back from a committed epoch with no error.  The aggregate
+        # shape exercises the OWNER fallback path through the same
+        # window (replicas refuse it); vacuum churns concurrently.
         def read_loop():
             while not stop_reads.is_set():
-                for sql in _CLUSTER_READS:
+                for sql in _CLUSTER_READS + [
+                        "SELECT count(*) FROM qcnt"]:
                     try:
                         meta.serve(sql)
                     except Exception as e:  # noqa: BLE001
                         read_errors.append(repr(e))
                 time.sleep(0.05)
 
-        reader = threading.Thread(target=read_loop, daemon=True)
-        reader.start()
+        def vacuum_loop():
+            while not stop_reads.is_set():
+                try:
+                    meta.storage_vacuum()
+                except Exception as e:  # noqa: BLE001
+                    read_errors.append(f"vacuum: {e!r}")
+                time.sleep(0.1)
+
+        threads = [threading.Thread(target=read_loop, daemon=True),
+                   threading.Thread(target=vacuum_loop, daemon=True)]
+        for t in threads:
+            t.start()
 
         # SIGKILL the worker owning qcnt (pid registered at handshake)
         st = meta.state()
@@ -179,10 +218,14 @@ def test_cluster_sigkill_failover_converges(tmp_path):
 
         _drive_rounds(meta, rounds_after)
         stop_reads.set()
-        reader.join(timeout=10)
+        for t in threads:
+            t.join(timeout=10)
         assert read_errors == [], read_errors[:3]
         assert meta.failovers == 1
         assert meta.cluster_epoch == rounds_before + rounds_after
+        # the replica actually carried reads (not just owner fallback)
+        assert meta.metrics.get("cluster_serving_reads_total") > 0
+        assert meta.state()["serving"], "replica lost its registration"
 
         got = [sorted(tuple(r) for r in meta.serve(sql)[1])
                for sql in _CLUSTER_READS]
@@ -198,7 +241,7 @@ def test_cluster_sigkill_failover_converges(tmp_path):
         assert got == want
     finally:
         stop_reads.set()
-        for p in procs:
+        for p in procs + [serving]:
             if p.poll() is None:
                 p.kill()
             p.wait(timeout=10)

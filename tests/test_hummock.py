@@ -393,6 +393,63 @@ def test_engine_storage_service_background(tmp_path):
     assert eng.hummock.write_path_merges == 0
 
 
+# -- serving pin leases vs vacuum (ISSUE 5 satellite) -------------------
+def test_stale_serving_lease_reaped_unblocks_gc(tmp_path):
+    """A serving replica's epoch pin lease holds its SST set in the
+    vacuum keep-set; a STALE lease (dead replica, expired heartbeat)
+    is reaped by the meta so it can never block GC forever."""
+    import time
+
+    from risingwave_tpu.cluster import MetaService
+    from risingwave_tpu.serve import ServingWorker
+
+    meta = MetaService(str(tmp_path), heartbeat_timeout_s=0.2)
+    meta.start(port=0, monitor=False, compactor=False)
+    sv = None
+    try:
+        # seed data so the replica's first lease pins a real SST set
+        meta.hummock.write_batch(
+            [(_k(i), b"v0") for i in range(32)], epoch=1
+        )
+        addr = f"127.0.0.1:{meta.rpc_port}"
+        # NO heartbeat thread: the lease goes stale on its own
+        sv = ServingWorker(addr, str(tmp_path))
+        sv.start(heartbeat=False)
+        assert meta.versions.pinned_count() >= 1
+        pinned_keys = set(sv.view.version.all_keys())
+        assert pinned_keys
+
+        # churn: the pinned SSTs leave the current version...
+        for step in range(4):
+            meta.hummock.write_batch(
+                [(_k(i), f"v{step + 1}".encode())
+                 for i in range(32)], epoch=step + 2,
+            )
+        while meta.hummock.compact_once():
+            pass
+        assert not pinned_keys <= meta.versions.current.all_keys()
+        # ...but the live lease keeps them on disk
+        meta.storage_vacuum()
+        for key in pinned_keys:
+            assert meta.hummock.store.exists(key), key
+        # and the pinned read still answers
+        assert sv.view.point_get(_k(3)) == b"v0"
+
+        # lease expires (no heartbeats) → meta reaps it → GC proceeds
+        time.sleep(0.3)
+        meta.check_heartbeats()
+        assert meta.state()["serving"] == []
+        assert meta.versions.pinned_count() == 0
+        res = meta.storage_vacuum()
+        assert res["deleted_objects"] >= 1
+        assert not any(meta.hummock.store.exists(k)
+                       for k in pinned_keys)
+    finally:
+        if sv is not None:
+            sv.stop()
+        meta.stop()
+
+
 # -- stress (short version of scripts/compaction_stress.py) -------------
 @pytest.mark.slow
 def test_compaction_stress_short():

@@ -1,0 +1,23 @@
+"""Slow pytest wrapper for scripts/serve_bench.py (ISSUE 5 satellite):
+sustained concurrent serving reads during ingest — throughput floor,
+post-warmup block-cache hit-ratio floor, replica carries the reads,
+and ZERO errors while compaction + vacuum churn underneath."""
+
+import importlib
+import sys
+
+import pytest
+
+
+@pytest.mark.slow
+def test_serve_bench_short():
+    sys.path.insert(0, "scripts")
+    try:
+        bench = importlib.import_module("serve_bench")
+    finally:
+        sys.path.pop(0)
+    summary = bench.run(seconds=4.0, readers=2)
+    bad = bench.check(summary, min_reads_per_s=10.0,
+                      min_hit_ratio=0.5, min_replica_share=0.5)
+    assert bad == [], (bad, summary)
+    assert summary["rounds_committed"] >= 1

@@ -1,0 +1,308 @@
+"""Serve-lite: the engine-free serving tier (ISSUE 5 tentpole).
+
+A ServingWorker reads MV rows straight from shared Hummock SSTs at a
+meta-pinned epoch — no Engine on the read path (the subprocess
+jax-free contract is asserted in test_chaos.py; here in-process
+replicas cover routing, leases, churn, and byte-identity vs the
+owning worker's ``storage_serve_mv``)."""
+
+import pickle
+import threading
+import time
+
+import pytest
+
+from risingwave_tpu.cluster import ComputeWorker, MetaService
+from risingwave_tpu.common.config import RwConfig
+from risingwave_tpu.serve import ServingWorker
+from risingwave_tpu.serve.worker import ServeUnsupported, plan_read
+
+
+def _cfg():
+    return RwConfig.from_dict({
+        "streaming": {"chunk_size": 128},
+        "state": {"agg_table_size": 512, "agg_emit_capacity": 128,
+                  "mv_table_size": 512, "mv_ring_size": 1024},
+        "storage": {"checkpoint_keep_epochs": 4},
+    })
+
+
+def _rows(served):
+    return sorted(tuple(r) for r in served[1])
+
+
+_DDL = [
+    "CREATE SOURCE t (k BIGINT, v BIGINT) "
+    "WITH (connector='datagen')",
+    "CREATE MATERIALIZED VIEW m1 AS "
+    "SELECT k % 8 AS g, count(*) AS n FROM t GROUP BY k % 8",
+]
+
+
+def _mk_cluster(tmp_path, ddl=_DDL, rounds=3):
+    meta = MetaService(str(tmp_path), heartbeat_timeout_s=5.0)
+    meta.start(port=0, monitor=False, compactor=False)
+    addr = f"127.0.0.1:{meta.rpc_port}"
+    w = ComputeWorker(addr, str(tmp_path), config=_cfg(),
+                      heartbeat_interval_s=0.5).start()
+    for sql in ddl:
+        meta.execute_ddl(sql)
+    for _ in range(rounds):
+        assert meta.tick(1)["committed"]
+    return meta, addr, w
+
+
+# -- byte identity vs the owning engine's storage read -------------------
+def test_sst_view_byte_identical_to_storage_serve_mv(tmp_path):
+    """A standalone SstView over the same data_dir returns the EXACT
+    payload bytes Engine.storage_serve_mv decodes — the acceptance
+    byte-identity surface."""
+    from risingwave_tpu.sql import Engine
+
+    eng = Engine(_cfg(), data_dir=str(tmp_path))
+    for sql in _DDL:
+        eng.execute(sql)
+    eng.tick(barriers=2, chunks_per_barrier=1)
+    eng.storage_export_mv("m1")
+    want_rows = eng.storage_serve_mv("m1")
+    assert len(want_rows) == 8
+
+    sv = ServingWorker(None, str(tmp_path))
+    sv.start()  # standalone: follows the manifest, no meta lease
+    try:
+        raw = sv.view.scan_mv("m1")
+        assert [pickle.loads(v) for v in raw] \
+            == [tuple(r) for r in want_rows]
+        assert raw == [pickle.dumps(tuple(r), protocol=4)
+                       for r in want_rows]
+        # the SELECT surface agrees with the raw payloads
+        cols, rows, _ = sv.read("SELECT g, n FROM m1")
+        assert cols == ["g", "n"]
+        assert sorted(rows) == sorted(
+            (r[0], r[1]) for r in want_rows
+        )
+        # point get goes through the bloom/key-range pruned path
+        _, rows, _ = sv.read("SELECT n FROM m1 WHERE g = 5")
+        assert rows == [(want_rows[5][1],)] or len(rows) == 1
+    finally:
+        sv.stop()
+
+
+# -- read planning (unit) ------------------------------------------------
+def test_plan_read_shapes():
+    from risingwave_tpu.serve.reader import MvSchema
+    from risingwave_tpu.sql import ast
+    from risingwave_tpu.sql.parser import parse
+
+    schema = MvSchema({
+        "mv": "m",
+        "columns": [
+            {"name": "a", "kind": "int", "scale": 0, "hidden": False},
+            {"name": "b", "kind": "int", "scale": 0, "hidden": False},
+            {"name": "_hidden_sk", "kind": "int", "scale": 0,
+             "hidden": True},
+        ],
+        "pk": [0, 1],
+    })
+
+    def plan(sql):
+        (sel,) = parse(sql)
+        assert isinstance(sel, ast.Select)
+        return plan_read(sel, schema)
+
+    p = plan("SELECT * FROM m")
+    assert p.mode == "scan" and p.cols == [0, 1]  # hidden excluded
+
+    p = plan("SELECT b, a FROM m WHERE a = 3 AND b = 4")
+    assert p.mode == "get" and p.cols == [1, 0]
+
+    p = plan("SELECT a FROM m WHERE a >= 2 AND a < 7 LIMIT 5")
+    assert p.mode == "scan" and p.limit == 5
+    assert p.lo > b"m:m\x00" and p.hi is not None
+
+    # flipped literal-first comparison normalizes
+    p2 = plan("SELECT a FROM m WHERE 2 <= a AND 7 > a LIMIT 5")
+    assert (p2.lo, p2.hi) == (p.lo, p.hi)
+
+    for bad in [
+        "SELECT count(*) FROM m",                  # aggregate
+        "SELECT a FROM m GROUP BY a",              # group by
+        "SELECT a FROM m ORDER BY a",              # order by
+        "SELECT a FROM m WHERE b = 1",             # non-leading pk range
+        "SELECT a + 1 FROM m",                     # expression
+        "SELECT a FROM m WHERE a + 1 = 2",         # computed predicate
+    ]:
+        with pytest.raises(ServeUnsupported):
+            plan(bad)
+
+    # unknown column is a FINAL error, not a fallback
+    with pytest.raises(ValueError, match="does not exist"):
+        plan("SELECT nope FROM m")
+
+
+# -- cluster routing -----------------------------------------------------
+def test_cluster_serving_routes_point_range_and_fallback(tmp_path):
+    """SELECTs route to the replica (round-robin of one), pinned at
+    the last cluster-committed epoch; engine-only shapes fall back to
+    the owning worker; the replica follows commits forward."""
+    meta, addr, w = _mk_cluster(tmp_path)
+    sv = ServingWorker(addr, str(tmp_path),
+                       heartbeat_interval_s=0.2).start()
+    try:
+        assert _rows(meta.serve("SELECT g, n FROM m1")) == [
+            (g, 48) for g in range(8)
+        ]
+        assert sv.reads_total == 1  # the read came from the replica
+        assert _rows(meta.serve("SELECT g, n FROM m1 WHERE g = 3")) \
+            == [(3, 48)]
+        assert _rows(meta.serve(
+            "SELECT g, n FROM m1 WHERE g >= 2 AND g < 5"
+        )) == [(g, 48) for g in (2, 3, 4)]
+        # engine-only shape: replica refuses, owner serves
+        assert _rows(meta.serve("SELECT count(*) FROM m1"))[0][0] == 8
+        assert sv.read_errors == 0
+        # commits advance; the next routed read sees the new epoch
+        for _ in range(2):
+            assert meta.tick(1)["committed"]
+        assert _rows(meta.serve("SELECT g, n FROM m1")) == [
+            (g, 80) for g in range(8)
+        ]
+        assert meta.metrics.get("cluster_serving_reads_total") >= 4
+        assert meta.state()["serving"][0]["granted_vid"] \
+            >= sv.view.version.vid
+    finally:
+        sv.stop()
+        w.stop()
+        meta.stop()
+
+
+def test_serving_replica_death_zero_errors(tmp_path):
+    """Reads keep answering while the only replica dies mid-stream
+    (fallback to the owning worker), and the dead replica's pin lease
+    is reaped so vacuum is never blocked forever."""
+    meta, addr, w = _mk_cluster(tmp_path)
+    meta.heartbeat_timeout_s = 0.5
+    sv = ServingWorker(addr, str(tmp_path),
+                       heartbeat_interval_s=0.1).start()
+    stop = threading.Event()
+    errors: list = []
+
+    def read_loop():
+        while not stop.is_set():
+            try:
+                got = _rows(meta.serve("SELECT g, n FROM m1"))
+                assert got and all(len(r) == 2 for r in got)
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+            time.sleep(0.01)
+
+    reader = threading.Thread(target=read_loop, daemon=True)
+    reader.start()
+    try:
+        time.sleep(0.3)
+        # hard-kill the replica: no unregister, sockets just die
+        sv._stop.set()
+        sv._server.stop()
+        sv._server = None
+        time.sleep(0.3)
+        assert _rows(meta.serve("SELECT g, n FROM m1")) == [
+            (g, 48) for g in range(8)
+        ]
+        # the stale lease is reaped once heartbeats expire
+        deadline = time.monotonic() + 10
+        while meta.state()["serving"]:
+            meta.check_heartbeats()
+            assert time.monotonic() < deadline, "lease never reaped"
+            time.sleep(0.1)
+        assert meta.versions.pinned_count() == 0
+    finally:
+        stop.set()
+        reader.join(timeout=5)
+        sv.stop()
+        w.stop()
+        meta.stop()
+    assert errors == [], errors[:3]
+
+
+def test_serving_reads_under_compaction_and_vacuum(tmp_path):
+    """Churn: reads concurrent with ingest rounds, compaction, and
+    vacuum — 0 read errors, results always a committed-round multiple,
+    final rows byte-identical to the owning worker's, and vacuum never
+    deletes an SST under the replica's lease (errors would surface as
+    ObjectError reads)."""
+    meta, addr, w = _mk_cluster(tmp_path)
+    sv = ServingWorker(addr, str(tmp_path),
+                       heartbeat_interval_s=0.05).start()
+    stop = threading.Event()
+    errors: list = []
+    served = [0]
+
+    def read_loop():
+        while not stop.is_set():
+            try:
+                got = _rows(meta.serve("SELECT g, n FROM m1"))
+                assert len(got) == 8
+                # every read is one committed round's worth of rows
+                counts = {n for _, n in got}
+                assert len(counts) == 1 and next(iter(counts)) % 16 == 0
+                served[0] += 1
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+            time.sleep(0.005)
+
+    reader = threading.Thread(target=read_loop, daemon=True)
+    reader.start()
+    try:
+        for _ in range(6):
+            assert meta.tick(1)["committed"]
+            meta.hummock.compact_once()
+            meta.storage_vacuum()
+        stop.set()
+        reader.join(timeout=10)
+        assert errors == [], errors[:3]
+        assert served[0] > 0
+        assert sv.read_errors == 0
+        # quiesced: replica rows byte-identical to the owning worker
+        want = _rows(meta.serve("SELECT count(*) FROM m1"))  # owner
+        assert want[0][0] == 8
+        cols, rows, _ = sv.read(
+            "SELECT g, n FROM m1",
+            min_epoch=meta.versions.max_committed_epoch,
+        )
+        owner_rows = _rows(meta.serve("SELECT g, n FROM m1"))
+        assert sorted(rows) == owner_rows == [
+            (g, 144) for g in range(8)
+        ]
+        # GC actually ran under the churn
+        assert meta.metrics.get("storage_gc_objects_total") >= 1
+    finally:
+        stop.set()
+        sv.stop()
+        w.stop()
+        meta.stop()
+
+
+def test_serving_mv_on_mv_and_multiple_replicas(tmp_path):
+    """Every MV riding a job exports (MV-on-MV included); two replicas
+    split the round-robin."""
+    ddl = _DDL + [
+        "CREATE MATERIALIZED VIEW top1 AS "
+        "SELECT g, n FROM m1 WHERE g < 2",
+    ]
+    meta, addr, w = _mk_cluster(tmp_path, ddl=ddl, rounds=2)
+    sv1 = ServingWorker(addr, str(tmp_path),
+                        heartbeat_interval_s=0.2).start()
+    sv2 = ServingWorker(addr, str(tmp_path),
+                        heartbeat_interval_s=0.2).start()
+    try:
+        for _ in range(4):
+            assert _rows(meta.serve("SELECT g, n FROM top1")) == [
+                (0, 32), (1, 32)
+            ]
+        assert sv1.reads_total + sv2.reads_total == 4
+        assert sv1.reads_total > 0 and sv2.reads_total > 0
+    finally:
+        sv1.stop()
+        sv2.stop()
+        w.stop()
+        meta.stop()

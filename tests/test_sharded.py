@@ -378,6 +378,66 @@ def test_sharded_join_q8_matches_linear():
     assert rows_a == rows_b and len(rows_a) > 1000
 
 
+def test_mv_on_mv_over_sharded_join_matches_linear():
+    """ROADMAP carry from round 6 (ISSUE 5 satellite): MV-on-MV over a
+    sharded join job no longer raises in ``_ensure_dag`` — a
+    per-key-safe chain (project/filter/materialize) attaches PER-SHARD
+    inside the upstream's shard_map, backfills the existing rows, and
+    matches the linear run; shapes that would merge rows across shards
+    still raise the explicit 'next round' error."""
+    import pytest
+
+    from risingwave_tpu.sql import Engine
+    from risingwave_tpu.sql.planner import PlannerConfig
+    from risingwave_tpu.stream.dag import DagJob
+
+    def build(par):
+        eng = Engine(PlannerConfig(
+            chunk_capacity=128,
+            join_left_table_size=1 << 12, join_left_bucket_cap=4,
+            join_right_table_size=1 << 10, join_right_bucket_cap=512,
+            join_out_capacity=1 << 12,
+            mv_table_size=4096, mv_ring_size=1 << 15,
+        ))
+        eng.execute(NEXMARK_WM_SOURCES)
+        if par:
+            eng.execute(f"SET streaming_parallelism = {par}")
+        eng.execute(Q8_MV)
+        return eng
+
+    b = build(8)
+    assert isinstance(b.jobs[0], DagJob) and b.jobs[0].mesh is not None
+    for _ in range(2):
+        b.jobs[0].chunk_round()
+        b.jobs[0].inject_barrier()
+    # attach mid-stream: existing rows backfill, new rows stream in
+    b.execute("CREATE MATERIALIZED VIEW v2 AS "
+              "SELECT id, name FROM v WHERE id % 2 = 0")
+    assert len(b.jobs) == 1  # attached to the mesh job, not a new one
+    for _ in range(2):
+        b.jobs[0].chunk_round()
+        b.jobs[0].inject_barrier()
+    rows_b = sorted(b.execute("SELECT id, name FROM v2"))
+
+    a = build(0)
+    for _ in range(2 * 8):
+        a.jobs[0].chunk_round()
+        a.jobs[0].inject_barrier()
+    a.execute("CREATE MATERIALIZED VIEW v2 AS "
+              "SELECT id, name FROM v WHERE id % 2 = 0")
+    for _ in range(2 * 8):
+        a.jobs[0].chunk_round()
+        a.jobs[0].inject_barrier()
+    rows_a = sorted(a.execute("SELECT id, name FROM v2"))
+    assert rows_a == rows_b and len(rows_a) > 500
+
+    # cross-shard shapes keep the explicit error
+    from risingwave_tpu.sql.engine import PlanError
+    with pytest.raises(PlanError, match="next round"):
+        b.execute("CREATE MATERIALIZED VIEW vagg AS "
+                  "SELECT count(*) AS n FROM v")
+
+
 def test_sharded_join_recovers_from_checkpoint(tmp_path):
     """Kill-and-recover a sharded join job from the durable store."""
     from risingwave_tpu.sql import Engine
