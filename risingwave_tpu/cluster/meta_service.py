@@ -42,6 +42,7 @@ from risingwave_tpu.cluster.rpc import (
     RpcError,
     RpcServer,
 )
+from risingwave_tpu.common.faults import RetryPolicy, get_fabric
 from risingwave_tpu.common.metrics import MetricsRegistry
 from risingwave_tpu.meta.store import MetaStore
 
@@ -131,7 +132,10 @@ class MetaService:
                  metrics: MetricsRegistry | None = None,
                  serve_retry_timeout_s: float = 60.0,
                  rpc_timeout_s: float = 180.0,
-                 durable_wait_s: float = 15.0):
+                 durable_wait_s: float = 15.0,
+                 retry_max_attempts: int = 4,
+                 retry_base_delay_s: float = 0.05,
+                 retry_max_delay_s: float = 0.5):
         from risingwave_tpu.storage.hummock import (
             CompactorService,
             HummockStorage,
@@ -191,10 +195,56 @@ class MetaService:
         #: committed cluster epoch (round number, 0 = nothing committed)
         self.cluster_epoch = 0
         self.failovers = 0
+        #: unified backoff for every retry-safe control RPC the meta
+        #: issues (barrier/job_epochs/adopt are idempotent or
+        #: round-guarded; RpcError — the peer REFUSED — never retries)
+        self.retry = RetryPolicy(
+            max_attempts=retry_max_attempts,
+            base_delay_s=retry_base_delay_s,
+            max_delay_s=retry_max_delay_s,
+            metrics=self.metrics, op="meta",
+        )
         self._server: RpcServer | None = None
         self._monitor: threading.Thread | None = None
         self._stop = threading.Event()
+        #: True when this meta rebuilt jobs from a durable catalog (a
+        #: restart) — introspection for operators and chaos asserts
+        self.recovered = False
+        self._recover_from_store()
         self._set_worker_gauges()
+
+    # -- crash recovery ---------------------------------------------------
+    def _recover_from_store(self) -> None:
+        """Meta restart: rebuild the cluster catalog (jobs, MV→job map,
+        prelude) by replaying the durable DDL log, then restore the
+        round position from the last committed-round record.  Every
+        job comes back UNASSIGNED — workers detect the dead meta
+        through heartbeat errors, re-register with backoff, and
+        ``_assign_pending`` re-adopts their jobs from the last durable
+        checkpoint; ``_rewind_job`` translates each recovered epoch
+        back into a round (crediting a round the old meta sealed but
+        never committed — the in-flight round re-seals, it never
+        re-runs).  No operator action anywhere on this path."""
+        ddl = self.store.ddl_log()
+        if not ddl:
+            return
+        self.recovered = True
+        for sql in ddl:
+            self.execute_ddl(sql, replay=True)
+        rec = self.store.last_cluster_commit()
+        if rec is None:
+            return
+        self.cluster_epoch = int(rec["round"])
+        for job in self.jobs.values():
+            seal = rec["seals"].get(job.name)
+            job.rounds = self.cluster_epoch
+            if seal is not None:
+                job.seal_log = [(self.cluster_epoch, int(seal))]
+                job.pinned_epoch = int(seal)
+        self.metrics.set_gauge("cluster_epoch_committed",
+                               self.cluster_epoch)
+        self.metrics.set_gauge("cluster_manifest_epoch",
+                               self.versions.max_committed_epoch)
 
     # -- lifecycle ------------------------------------------------------
     @property
@@ -244,7 +294,8 @@ class MetaService:
             self._next_worker += 1
             w = WorkerInfo(wid, host, int(port), pid)
             w.client = RpcClient(host, int(port),
-                                 timeout=self.rpc_timeout_s)
+                                 timeout=self.rpc_timeout_s,
+                                 src="meta", dst=f"worker{wid}")
             self.workers[wid] = w
             self._set_worker_gauges()
         # a fresh worker can pick up any stranded jobs immediately
@@ -377,7 +428,8 @@ class MetaService:
             self._next_replica += 1
             r = ServingReplicaInfo(rid, host, int(port), pid)
             r.client = RpcClient(host, int(port),
-                                 timeout=self.rpc_timeout_s)
+                                 timeout=self.rpc_timeout_s,
+                                 src="meta", dst=f"serving{rid}")
             pin_id, version = self.versions.pin()
             r.pins[version.vid] = pin_id
             r.granted_vid = version.vid
@@ -463,10 +515,13 @@ class MetaService:
     def rpc_execute_ddl(self, sql: str) -> dict:
         return self.execute_ddl(sql)
 
-    def execute_ddl(self, sql: str) -> dict:
+    def execute_ddl(self, sql: str, replay: bool = False) -> dict:
         """Apply one or more statements at the cluster level: job DDL
         places a streaming job, everything else joins the prelude all
-        future jobs replay."""
+        future jobs replay.  ``replay=True`` (meta crash recovery)
+        rebuilds the in-memory catalog from the already-durable log:
+        nothing is re-appended, no worker is called, no job assigned
+        (workers re-register and re-adopt on their own schedule)."""
         from risingwave_tpu.sql import ast
         from risingwave_tpu.sql.parser import parse_with_text
 
@@ -474,12 +529,16 @@ class MetaService:
         for text, stmt in parse_with_text(sql):
             if isinstance(stmt, (ast.CreateMaterializedView,
                                  ast.CreateSink)):
-                self._place_job(text, stmt.name)
+                self._place_job(text, stmt.name, replay=replay)
                 placed.append(stmt.name)
             elif isinstance(stmt, ast.Insert):
-                self._forward_dml(text, stmt.table)
+                # never reaches the DDL log; forwarded rows live in the
+                # workers' durable table history + checkpoints
+                if not replay:
+                    self._forward_dml(text, stmt.table)
             else:
-                self.store.append_ddl(text)
+                if not replay:
+                    self.store.append_ddl(text)
                 self.prelude.append(text)
         return {"ok": True, "placed": placed,
                 "cluster_epoch": self.cluster_epoch}
@@ -495,10 +554,12 @@ class MetaService:
                 return self.jobs[jname]
         return None
 
-    def _place_job(self, text: str, name: str) -> None:
+    def _place_job(self, text: str, name: str,
+                   replay: bool = False) -> None:
         if name in self._mv_to_job:
             raise ValueError(f"{name!r} already exists")
-        self.store.append_ddl(text)
+        if not replay:
+            self.store.append_ddl(text)
         upstream = self._co_located_job(text)
         if upstream is not None:
             # ship only the prelude delta the job hasn't seen yet plus
@@ -509,10 +570,14 @@ class MetaService:
             upstream.mvs.append(name)
             with self._lock:
                 self._mv_to_job[name] = upstream.name
-            if upstream.worker_id is not None:
+            if not replay and upstream.worker_id is not None:
                 w = self.workers[upstream.worker_id]
-                w.client.call("adopt", ddl=delta, name=upstream.name,
-                              recover=False)
+                self.retry.run(
+                    lambda: w.client.call("adopt", ddl=delta,
+                                          name=upstream.name,
+                                          recover=False),
+                    label="adopt",
+                )
             return
         job = JobInfo(name=name, ddl=list(self.prelude) + [text],
                       mvs=[name])
@@ -523,7 +588,8 @@ class MetaService:
             self.jobs[name] = job
             self._mv_to_job[name] = name
             self._set_worker_gauges()
-        self._assign_pending()
+        if not replay:
+            self._assign_pending()
 
     def _forward_dml(self, text: str, table: str) -> None:
         """INSERTs fan out to every worker whose catalog has the table
@@ -565,8 +631,15 @@ class MetaService:
                 target = min(live,
                              key=lambda w: (len(w.jobs), w.worker_id))
             try:
-                res = target.client.call(
-                    "adopt", ddl=job.ddl, name=job.name, recover=True
+                # adopt is idempotent (already-present DDL is skipped,
+                # recovery rewinds to the same durable epoch) — safe to
+                # retry through transient drops
+                res = self.retry.run(
+                    lambda: target.client.call(
+                        "adopt", ddl=job.ddl, name=job.name,
+                        recover=True,
+                    ),
+                    label="adopt",
                 )
             except (RpcError, ConnectionError, OSError):
                 # adoption failed: leave unassigned; the monitor loop
@@ -647,9 +720,17 @@ class MetaService:
                 if w is None or not w.alive:
                     continue
                 try:
-                    res = w.client.call(
-                        "barrier", job=job.name,
-                        chunks=int(chunks_per_barrier),
+                    # round-tagged: the worker caches each job's last
+                    # (round, seal) and answers a replay from the
+                    # cache, so retrying after a lost RESPONSE cannot
+                    # run the round twice (epoch-guarded idempotence)
+                    res = self.retry.run(
+                        lambda: w.client.call(
+                            "barrier", job=job.name,
+                            chunks=int(chunks_per_barrier),
+                            round=target,
+                        ),
+                        label="barrier",
                     )
                 except (RpcError, ConnectionError, OSError):
                     continue  # monitor expires the worker; round stalls
@@ -681,6 +762,7 @@ class MetaService:
                     "cluster_barrier_commit_seconds",
                     time.perf_counter() - t0,
                 )
+            self._export_fault_gauges()
             return {"round": target, "committed": committed,
                     "jobs": len(jobs), "sealed": sealed,
                     "cluster_epoch": self.cluster_epoch}
@@ -707,7 +789,12 @@ class MetaService:
                 return False
             while True:
                 try:
-                    res = w.client.call("job_epochs", job=job.name)
+                    # read-only poll: always retry-safe
+                    res = self.retry.run(
+                        lambda: w.client.call("job_epochs",
+                                              job=job.name),
+                        label="job_epochs",
+                    )
                 except (RpcError, ConnectionError, OSError):
                     return False
                 with self._lock:
@@ -752,6 +839,13 @@ class MetaService:
             for k in due:
                 del self._pending_ssts[k]
         self.hummock.commit_external(epoch_val, adds)
+        # durable round record AFTER the manifest commit: a crash in
+        # between re-commits the round idempotently at restart (empty
+        # delta, same epoch stamp) — never a lost or double round
+        self.store.append_cluster_commit(
+            round_, epoch_val,
+            {j.name: j.seal_log[-1][1] for j in jobs},
+        )
         with self._lock:
             self.cluster_epoch = round_
             for j in jobs:
@@ -822,6 +916,11 @@ class MetaService:
                             # of this read)
                             try_replicas = False
                             break
+                        if "ServeUnavailable" in str(e):
+                            # replica transiently stuck (lease refresh
+                            # lost, behind the pin): route around it —
+                            # next replica or the owner, never an error
+                            continue
                         raise  # replica answered with a real failure
                     except (ConnectionError, OSError):
                         continue  # replica died mid-read: next one
@@ -848,6 +947,52 @@ class MetaService:
     def rpc_metrics(self) -> dict:
         return {"prometheus": self.metrics.render_prometheus()}
 
+    def rpc_cluster_faults(self) -> dict:
+        return self.cluster_faults()
+
+    def cluster_faults(self) -> dict:
+        """The chaos observability surface (``ctl cluster faults``):
+        this process' injected-fault counters plus the meta's retry
+        budget, and the same two numbers from every live worker and
+        serving replica (best-effort — an unreachable peer reports
+        null rather than failing the whole view)."""
+        self._export_fault_gauges()
+        fabric = get_fabric()
+        out = {
+            "meta": {
+                "fabric": fabric.stats() if fabric is not None else None,
+                "rpc_retries_total": self.retry.retries,
+                "rpc_retry_gave_up_total": self.retry.gave_up,
+            },
+            "workers": {},
+            "serving": {},
+        }
+        with self._lock:
+            workers = [w for w in self.workers.values() if w.alive]
+            serving = [r for r in self.serving.values() if r.alive]
+        for w in workers:
+            try:
+                out["workers"][w.worker_id] = w.client.call("faults")
+            except (RpcError, ConnectionError, OSError):
+                out["workers"][w.worker_id] = None
+        for r in serving:
+            try:
+                out["serving"][r.replica_id] = r.client.call("faults")
+            except (RpcError, ConnectionError, OSError):
+                out["serving"][r.replica_id] = None
+        return out
+
+    def _export_fault_gauges(self) -> None:
+        fabric = get_fabric()
+        self.metrics.set_gauge(
+            "faults_injected_total",
+            fabric.injected_total() if fabric is not None else 0,
+        )
+        self.metrics.set_gauge("rpc_retries_spent_total",
+                               self.retry.retries)
+        self.metrics.set_gauge("rpc_retry_gave_up_spent_total",
+                               self.retry.gave_up)
+
     def state(self) -> dict:
         """The ctl/dashboard surface (risectl cluster-info analog)."""
         now = time.monotonic()
@@ -857,6 +1002,7 @@ class MetaService:
                 "manifest_epoch":
                     self.versions.current.max_committed_epoch,
                 "failovers": self.failovers,
+                "recovered": self.recovered,
                 "workers": [
                     {"id": w.worker_id, "addr": w.addr,
                      "alive": w.alive, "pid": w.pid,

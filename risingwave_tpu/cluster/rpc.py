@@ -23,6 +23,18 @@ the PEER ANSWERED with a failure — the application decision is final
 ``OSError`` means the peer is unreachable — the caller may retry
 against a reassigned owner.  MetaService.serve leans on exactly this
 split to keep serving reads error-free across a worker kill.
+
+Robustness contracts added for the chaos fabric (common/faults.py):
+
+- The client consults the process-global ``FaultFabric`` once per
+  logical call under the label ``src>dst/method`` — deterministic
+  drops, delays, lost responses and one-way partitions inject at this
+  seam, surfacing as ``ConnectionError`` exactly like real ones.
+- The server answers malformed frames (junk bytes, truncated JSON,
+  non-object requests, oversized payloads) with an error frame — the
+  CLIENT gets ``RpcError`` — and keeps serving the connection (line
+  framing resyncs at the next newline); garbage can never take down
+  the accept loop.
 """
 
 from __future__ import annotations
@@ -31,6 +43,12 @@ import json
 import socket
 import socketserver
 import threading
+
+from risingwave_tpu.common.faults import get_fabric
+
+#: hard cap per frame; a peer streaming an unbounded line would pin
+#: server memory (serve results stay far below this)
+MAX_FRAME_BYTES = 64 * 1024 * 1024
 
 
 class RpcError(RuntimeError):
@@ -54,32 +72,67 @@ def _dumps(obj) -> bytes:
 
 
 class _RpcHandler(socketserver.StreamRequestHandler):
+    def _respond(self, resp: dict) -> bool:
+        try:
+            self.wfile.write(_dumps(resp))
+            self.wfile.flush()
+            return True
+        except (BrokenPipeError, ConnectionError, OSError, ValueError):
+            return False
+
     def handle(self):
         target = self.server.target
         while True:
-            line = self.rfile.readline()
+            line = self.rfile.readline(MAX_FRAME_BYTES)
             if not line:
                 return
+            if len(line) >= MAX_FRAME_BYTES and not line.endswith(b"\n"):
+                # oversized frame: discard through the next newline so
+                # the connection resyncs, then answer the error
+                while True:
+                    rest = self.rfile.readline(MAX_FRAME_BYTES)
+                    if not rest:
+                        return
+                    if rest.endswith(b"\n"):
+                        break
+                if not self._respond({"id": None,
+                                      "error": "oversized rpc frame"}):
+                    return
+                continue
             try:
                 req = json.loads(line)
-            except json.JSONDecodeError:
-                return  # garbage on the control socket: drop the peer
+            except (json.JSONDecodeError, UnicodeDecodeError) as e:
+                # junk/torn frame: the CLIENT gets the error; line
+                # framing resyncs at the newline we just consumed
+                if not self._respond({"id": None,
+                                      "error": f"malformed frame: {e}"}):
+                    return
+                continue
+            if not isinstance(req, dict):
+                if not self._respond({
+                        "id": None,
+                        "error": "malformed frame: request must be an "
+                                 "object"}):
+                    return
+                continue
             rid = req.get("id")
             method = req.get("method", "")
-            fn = getattr(target, f"rpc_{method}", None)
+            params = req.get("params") or {}
+            fn = getattr(target, f"rpc_{method}", None) \
+                if isinstance(method, str) else None
             if fn is None:
                 resp = {"id": rid, "error": f"unknown method {method!r}"}
+            elif not isinstance(params, dict):
+                resp = {"id": rid,
+                        "error": "malformed frame: params must be an "
+                                 "object"}
             else:
                 try:
-                    resp = {"id": rid,
-                            "result": fn(**(req.get("params") or {}))}
+                    resp = {"id": rid, "result": fn(**params)}
                 except Exception as e:  # handler errors travel back
                     resp = {"id": rid,
                             "error": f"{type(e).__name__}: {e}"}
-            try:
-                self.wfile.write(_dumps(resp))
-                self.wfile.flush()
-            except (BrokenPipeError, ConnectionError, ValueError):
+            if not self._respond(resp):
                 return
 
 
@@ -115,12 +168,20 @@ class RpcServer(socketserver.ThreadingTCPServer):
 
 class RpcClient:
     """One persistent connection to a peer; calls serialize on a lock
-    (the meta→worker control channel is low-rate by design)."""
+    (the meta→worker control channel is low-rate by design).
 
-    def __init__(self, host: str, port: int, timeout: float = 120.0):
+    ``src``/``dst`` name the two endpoints for the fault fabric: every
+    call is matched under the label ``src>dst/method``, which is what
+    makes one-way partitions expressible (meta>worker1 dark while
+    worker1>meta flows)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 120.0,
+                 src: str = "", dst: str = ""):
         self.host = host
         self.port = port
         self.timeout = timeout
+        self.src = src or "client"
+        self.dst = dst or f"{host}:{port}"
         self._lock = threading.Lock()
         self._sock: socket.socket | None = None
         self._file = None
@@ -146,13 +207,33 @@ class RpcClient:
         """Invoke one remote method.  Raises ``RpcError`` for remote
         handler failures, ``ConnectionError``/``OSError`` when the
         peer is unreachable (one silent reconnect is attempted for
-        idle-dropped sockets)."""
+        idle-dropped sockets).  The fault fabric injects ONCE per
+        logical call (never again on the internal reconnect resend)."""
         with self._lock:
+            fabric = get_fabric()
+            sever_after = None
+            if fabric is not None:
+                sever_after = fabric.rpc_before_send(
+                    f"{self.src}>{self.dst}/{method}"
+                )  # raises FaultInjected for drops
             rid = self._next_id
             self._next_id += 1
             payload = _dumps(
                 {"id": rid, "method": method, "params": params}
             )
+            if sever_after is not None:
+                # error_after_send: the request IS delivered and
+                # executed, but the response is lost with the socket —
+                # the probe for non-idempotent handlers
+                if self._sock is None:
+                    self._connect()
+                self._file.write(payload)
+                self._file.flush()
+                self._close_locked()
+                raise ConnectionError(
+                    f"injected rpc error-after-send: "
+                    f"{self.src}>{self.dst}/{method}"
+                )
             try:
                 resp = self._roundtrip(payload)
             except (ConnectionError, OSError, json.JSONDecodeError):

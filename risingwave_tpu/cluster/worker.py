@@ -14,13 +14,21 @@ hummock manifest — meta owns both), driven ENTIRELY by meta RPCs:
   placement AND the failover path are the same call;
 - ``barrier`` — process N chunks + inject one barrier for ONE job
   (the meta drives rounds job-by-job, so the shared checkpoint
-  manifest has a single writer at any instant);
+  manifest has a single writer at any instant).  Barriers are
+  ROUND-TAGGED: the worker caches each job's last (round, seal)
+  answer and replays it verbatim when the meta retries a round whose
+  response was lost — a retried barrier can never run chunks twice;
 - ``serve``  — a batch read, optionally pinned at ``query_epoch``
   (the meta passes its last cluster-committed epoch);
 - ``execute`` — generic statement forwarding (INSERT fan-out).
 
 A worker has no self-ticker: if the meta dies, the cluster freezes
-consistently instead of diverging.
+consistently instead of diverging.  The heartbeat thread, however,
+never dies with the meta: transient unreachability backs off and
+keeps beating, and a meta that answers "unknown worker" (it restarted
+and lost the registry, or expired us across a partition) triggers
+RE-REGISTRATION — the meta then re-adopts our jobs from the durable
+checkpoint chain, with no operator in the loop.
 """
 
 from __future__ import annotations
@@ -29,7 +37,13 @@ import os
 import threading
 import time
 
-from risingwave_tpu.cluster.rpc import RpcClient, RpcServer, parse_addr
+from risingwave_tpu.cluster.rpc import (
+    RpcClient,
+    RpcError,
+    RpcServer,
+    parse_addr,
+)
+from risingwave_tpu.common.faults import RetryPolicy, get_fabric
 
 
 class ComputeWorker:
@@ -49,8 +63,27 @@ class ComputeWorker:
         self._meta_client: RpcClient | None = None
         self._hb_thread: threading.Thread | None = None
         self._stop = threading.Event()
+        cl = getattr(config, "cluster", None)
+        self.retry = RetryPolicy(
+            max_attempts=cl.rpc_retry_max_attempts if cl else 4,
+            base_delay_s=cl.rpc_retry_base_delay_s if cl else 0.05,
+            max_delay_s=cl.rpc_retry_max_delay_s if cl else 0.5,
+            op="worker",
+        )
+        #: per-job idempotence cache of the last ROUND-TAGGED barrier:
+        #: {"round", "sealed", "result"} — ``result`` is the full
+        #: answer a meta retry replays; ``sealed`` alone survives a
+        #: mid-handler failure (e.g. the export upload died AFTER the
+        #: chunks ran), so the retry redoes only the export, never the
+        #: chunks.  Cleared on adopt (an ownership change must never
+        #: answer from a stale seal).
+        self._round_cache: dict[str, dict] = {}
         #: heartbeats delivered (introspection/tests)
         self.heartbeats_sent = 0
+        #: heartbeats that failed transiently (meta down / partition)
+        self.heartbeat_failures = 0
+        #: times this worker (re-)registered with a meta
+        self.registrations = 0
 
     @property
     def port(self) -> int:
@@ -61,17 +94,27 @@ class ComputeWorker:
         self._stop.clear()
         self._server = RpcServer(self, self.host, self._port_req).start()
         self._meta_client = RpcClient(self.meta_host, self.meta_port,
-                                      timeout=30.0)
-        res = self._meta_client.call(
-            "register_worker", host=self.host, port=self.port,
-            pid=os.getpid(),
-        )
-        self.worker_id = int(res["worker_id"])
+                                      timeout=30.0, src="worker",
+                                      dst="meta")
+        # the FIRST registration is patient beyond the retry budget: a
+        # worker booting alongside its meta (deployment races, chaos
+        # restarts) waits for the meta to listen instead of dying
+        deadline = time.monotonic() + 60.0
+        while True:
+            try:
+                self._register()
+                break
+            except (ConnectionError, OSError):
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.25)
         # MV export SST keys come from the meta (single allocator:
         # collision-free across workers, vacuum-protected until the
-        # round's cluster epoch commits them into the manifest)
-        self.engine.sst_key_allocator = lambda: self._meta_client.call(
-            "alloc_sst", worker_id=self.worker_id
+        # round's cluster epoch commits them into the manifest).
+        # worker_id is read at CALL time, so re-registration after a
+        # meta restart transparently re-points the allocator.
+        self.engine.sst_key_allocator = lambda: self.retry.call(
+            self._meta_client, "alloc_sst", worker_id=self.worker_id,
         )["key"]
         if heartbeat:
             self._hb_thread = threading.Thread(
@@ -81,6 +124,19 @@ class ComputeWorker:
             self._hb_thread.start()
         return self
 
+    def _register(self) -> None:
+        """(Re-)register with the meta.  A fresh meta hands out a new
+        worker id; the old id's entry (if any) stays dead on its side.
+        Retried with backoff — registration is idempotent from the
+        worker's view (only the NEWEST id is ever used again)."""
+        res = self.retry.call(
+            self._meta_client, "register_worker",
+            host=self.host, port=self.port, pid=os.getpid(),
+        )
+        self.worker_id = int(res["worker_id"])
+        self._meta_client.src = f"worker{self.worker_id}"
+        self.registrations += 1
+
     def _heartbeat_loop(self) -> None:
         # independent of the engine lock: a worker busy compiling or
         # crossing a barrier still beats (liveness != idleness)
@@ -89,11 +145,23 @@ class ComputeWorker:
                 self._meta_client.call("heartbeat",
                                        worker_id=self.worker_id)
                 self.heartbeats_sent += 1
-            except Exception:
-                # meta unreachable or expired us; keep trying — a
-                # revived meta needs re-registration, which operators
-                # do by restarting the worker
-                time.sleep(self.heartbeat_interval_s)
+            except (ConnectionError, OSError):
+                # meta unreachable (restarting / partitioned): the
+                # thread SURVIVES and keeps beating — the loop cadence
+                # is the backoff
+                self.heartbeat_failures += 1
+            except RpcError:
+                # the meta answered but doesn't know us: it restarted
+                # (lost registry) or expired us — re-register so it
+                # can re-adopt our jobs; on failure the next beat
+                # retries
+                self.heartbeat_failures += 1
+                try:
+                    self._register()
+                except (RpcError, ConnectionError, OSError):
+                    pass
+            except Exception:  # noqa: BLE001 — never kill the thread
+                self.heartbeat_failures += 1
 
     def stop(self) -> None:
         try:
@@ -124,28 +192,49 @@ class ComputeWorker:
         recover from the last durable checkpoint (exact replay: the
         checkpoint holds state + source cursors of the same commit)."""
         with self._lock:
+            # a (re-)adoption invalidates any cached seal: the next
+            # round must run against the recovered state
+            self._round_cache.pop(name, None)
             epoch = self.engine.adopt_job(list(ddl), name,
                                           recover=recover)
         return {"ok": True, "committed_epoch": epoch}
 
-    def rpc_barrier(self, job: str, chunks: int = 1) -> dict:
+    def rpc_barrier(self, job: str, chunks: int = 1,
+                    round: int = 0) -> dict:
         """Process ``chunks`` chunks + one barrier for one job — the
         meta's global round, applied locally.  Returns the SEALED
         epoch immediately (the checkpoint upload runs in the job's
-        background uploader) plus the round's MV export SSTs (row
-        diffs uploaded to the shared store under meta-allocated keys;
-        the META commits them into the manifest with the cluster
-        epoch, so the serving tier reads every MV at the same round);
-        meta polls ``job_epochs`` for the durable ack before
-        committing the cluster epoch."""
+        background uploader) plus the round's MV export SSTs; meta
+        polls ``job_epochs`` for the durable ack before committing the
+        cluster epoch.  ``round`` tags the call for idempotence: a
+        replay of the round we last sealed answers from the cache
+        without touching the engine (the meta retries barriers whose
+        response was lost in flight)."""
+        rnd = int(round or 0)
         with self._lock:
-            sealed = self.engine.tick_job(job, int(chunks))
+            cached = self._round_cache.get(job) if rnd else None
+            if cached is not None and cached["round"] == rnd \
+                    and cached["result"] is not None:
+                return cached["result"]
+            if cached is not None and cached["round"] == rnd:
+                # chunks already ran for this round; only the export/
+                # response was lost — redo the cheap tail
+                sealed = cached["sealed"]
+            else:
+                sealed = self.engine.tick_job(job, int(chunks))
+                if rnd:
+                    self._round_cache[job] = {"round": rnd,
+                                              "sealed": sealed,
+                                              "result": None}
             ssts = self.engine.export_mv_deltas(job, sealed)
             positions = self.engine.job_epochs(job)
-        return {"ok": True, "committed_epoch": sealed,
-                "sealed_epoch": sealed,
-                "durable_epoch": positions["durable"],
-                "ssts": ssts}
+            res = {"ok": True, "committed_epoch": sealed,
+                   "sealed_epoch": sealed,
+                   "durable_epoch": positions["durable"],
+                   "ssts": ssts}
+            if rnd:
+                self._round_cache[job]["result"] = res
+        return res
 
     def rpc_job_epochs(self, job: str) -> dict:
         """Seal-vs-durable positions of one job (also services its
@@ -172,3 +261,21 @@ class ComputeWorker:
         with self._lock:
             self.engine.execute(sql)
         return {"ok": True}
+
+    def rpc_faults(self) -> dict:
+        """This process' chaos counters (aggregated by the meta's
+        ``cluster_faults`` for the ctl surface)."""
+        fabric = get_fabric()
+        upload_retries = 0
+        for j in self.engine.jobs:
+            up = getattr(j, "_uploader", None)
+            if up is not None:
+                upload_retries += getattr(up, "retries_total", 0)
+        return {
+            "fabric": fabric.stats() if fabric is not None else None,
+            "rpc_retries_total": self.retry.retries,
+            "rpc_retry_gave_up_total": self.retry.gave_up,
+            "heartbeat_failures": self.heartbeat_failures,
+            "registrations": self.registrations,
+            "checkpoint_upload_retries_total": upload_retries,
+        }

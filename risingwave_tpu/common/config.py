@@ -72,6 +72,14 @@ class ClusterConfig:
     serving_heartbeat_interval_s: float = 0.5
     #: serving replica block-cache capacity (decoded SST blocks)
     serving_cache_blocks: int = 1024
+    #: unified control-RPC retry budget (common/faults.RetryPolicy):
+    #: total attempts per idempotent/epoch-guarded call before the
+    #: failure surfaces (1 = no retries, the pre-chaos behavior)
+    rpc_retry_max_attempts: int = 4
+    #: first backoff delay; doubles per retry (deterministic jitter)
+    rpc_retry_base_delay_s: float = 0.05
+    #: backoff cap
+    rpc_retry_max_delay_s: float = 0.5
 
 
 @dataclass

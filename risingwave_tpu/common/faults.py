@@ -1,0 +1,332 @@
+"""Deterministic cluster-wide fault fabric + unified retry policy.
+
+Reference counterpart: the madsim deterministic simulation
+(src/tests/simulation) — every network partition, dropped packet and
+crashed node in a reference chaos run is produced by a SEEDED
+deterministic scheduler, so a failing run replays exactly.  This repo
+cannot intercept the OS scheduler, but it owns every cross-process
+seam (the JSON-RPC transport in ``cluster/rpc.py``, the object store
+under storage/checkpoints), so the same property holds where it
+matters: **identical seed ⇒ identical injected-fault sequence**.
+
+The fabric generalizes the counter-addressed ``StoreFaults`` pattern
+(storage/hummock/object_store.py): a rule fires on the Nth matching
+operation — never on a random draw — and deterministic "randomness"
+(schedule expansion, retry jitter) comes from splitmix64 over
+``(seed, counter)``, a pure function with no hidden state.
+
+Injection points:
+
+- ``rpc`` ops at the CLIENT transport (cluster/rpc.py):
+  ``drop``             the request never leaves (ConnectionError);
+  ``delay``            sleep ``delay_s`` before sending;
+  ``error_after_send`` the peer receives AND executes the call but the
+                       response is lost (ConnectionError) — the probe
+                       for non-idempotent handlers;
+  one-way partitions select on the ``src>dst`` peer label, so meta→A
+  can be dark while A→meta flows.
+- ``put``/``get``/``delete`` at every ObjectStore (the global fabric
+  is consulted next to each store's own ``StoreFaults``), with the
+  same before/after (lost vs durable-then-error) split.
+
+Processes: the fabric is process-global (``install``/``get_fabric``)
+and boots from the ``RWT_FAULTS`` env var — a JSON schedule — so a
+chaos harness arms identical deterministic schedules inside spawned
+worker/serving/meta subprocesses without any code in between.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+def splitmix64(x: int) -> int:
+    """Pure 64-bit mix (the digest scheme's position mixer): the
+    fabric's only source of "randomness" — a function, not a stream."""
+    x = (x + 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF
+    z = x
+    z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
+    z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
+    return z ^ (z >> 31)
+
+
+class FaultInjected(ConnectionError):
+    """An injected transport fault (subclasses ConnectionError so every
+    peer-unreachable code path handles it identically)."""
+
+
+@dataclass
+class FabricRule:
+    """One counter-addressed fault: fires on matching ops number
+    ``after`` .. ``after + times - 1`` (0-based), then retires."""
+
+    op: str                 # "rpc" | "put" | "get" | "delete"
+    substr: str = ""        # matches "src>dst/method" (rpc) or the key
+    after: int = 0
+    mode: str = "drop"      # rpc: drop|delay|error_after_send
+    #                       # store: before|after (StoreFaults split)
+    times: int = 1
+    delay_s: float = 0.0
+    hits: int = 0
+    seen: int = 0
+
+    def to_json(self) -> dict:
+        return {"op": self.op, "substr": self.substr,
+                "after": self.after, "mode": self.mode,
+                "times": self.times, "delay_s": self.delay_s}
+
+    @staticmethod
+    def from_json(d: dict) -> "FabricRule":
+        return FabricRule(
+            op=d["op"], substr=d.get("substr", ""),
+            after=int(d.get("after", 0)), mode=d.get("mode", "drop"),
+            times=int(d.get("times", 1)),
+            delay_s=float(d.get("delay_s", 0.0)),
+        )
+
+
+class FaultFabric:
+    """A deterministic fault schedule shared by every seam in one
+    process.  Thread-safe: rule matching mutates per-rule counters
+    under a lock, so concurrent RPC clients observe one global op
+    order per rule (the order itself is the caller's schedule — tests
+    that need total determinism drive ops single-threaded)."""
+
+    def __init__(self, seed: int = 0,
+                 rules: "list[FabricRule] | None" = None):
+        self.seed = int(seed)
+        self.rules: list[FabricRule] = list(rules or [])
+        self._lock = threading.Lock()
+        #: totals for assertions/metrics ({op: count})
+        self.injected: dict[str, int] = {}
+        self.delays: int = 0
+
+    # -- arming -----------------------------------------------------------
+    def fail_rpc(self, substr: str = "", after: int = 0,
+                 mode: str = "drop", times: int = 1,
+                 delay_s: float = 0.0) -> None:
+        assert mode in ("drop", "delay", "error_after_send"), mode
+        self.rules.append(FabricRule("rpc", substr, after, mode, times,
+                                     delay_s))
+
+    def fail_store(self, op: str, substr: str = "", after: int = 0,
+                   mode: str = "before", times: int = 1) -> None:
+        assert op in ("put", "get", "delete") and mode in ("before",
+                                                           "after")
+        self.rules.append(FabricRule(op, substr, after, mode, times))
+
+    def partition(self, src: str, dst: str, times: int = 1 << 30,
+                  after: int = 0) -> FabricRule:
+        """One-way partition: every RPC labeled ``src>dst`` drops until
+        ``heal()`` (the label carries direction — the reverse path
+        stays up).  Returns the rule so the caller can heal it."""
+        rule = FabricRule("rpc", f"{src}>{dst}/", after, "drop", times)
+        self.rules.append(rule)
+        return rule
+
+    @staticmethod
+    def heal(rule: FabricRule) -> None:
+        rule.times = rule.hits  # retires without rewriting history
+
+    # -- matching (called by the seams) -----------------------------------
+    def _match(self, op: str, label: str) -> "FabricRule | None":
+        with self._lock:
+            for r in self.rules:
+                if r.op != op or r.substr not in label \
+                        or r.hits >= r.times:
+                    continue
+                r.seen += 1
+                if r.seen > r.after:
+                    r.hits += 1
+                    self.injected[op] = self.injected.get(op, 0) + 1
+                    return r
+            return None
+
+    def rpc_before_send(self, label: str) -> "FabricRule | None":
+        """Consulted by RpcClient before writing the request.  Raises
+        ``FaultInjected`` for drops; sleeps for delays; returns the
+        rule for ``error_after_send`` so the client can lose the
+        response after delivery."""
+        r = self._match("rpc", label)
+        if r is None:
+            return None
+        if r.mode == "delay":
+            with self._lock:
+                self.delays += 1
+                self.injected[r.op] -= 1  # a delay is not an error
+            time.sleep(r.delay_s)
+            return None
+        if r.mode == "drop":
+            raise FaultInjected(f"injected rpc drop: {label}")
+        return r  # error_after_send: caller delivers, then severs
+
+    def store_before(self, op: str, key: str) -> "FabricRule | None":
+        r = self._match(op, key)
+        if r is not None and r.mode == "before":
+            from risingwave_tpu.storage.hummock.object_store import (
+                ObjectError,
+            )
+            raise ObjectError(f"injected {op} fault (lost): {key}")
+        return r
+
+    def store_after(self, rule: "FabricRule | None", op: str,
+                    key: str) -> None:
+        if rule is not None and rule.mode == "after":
+            from risingwave_tpu.storage.hummock.object_store import (
+                ObjectError,
+            )
+            raise ObjectError(f"injected {op} fault (durable): {key}")
+
+    # -- introspection -----------------------------------------------------
+    def injected_total(self) -> int:
+        with self._lock:
+            return sum(self.injected.values())
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "seed": self.seed,
+                "rules": len(self.rules),
+                "armed": sum(1 for r in self.rules if r.hits < r.times),
+                "injected": dict(self.injected),
+                "injected_total": sum(self.injected.values()),
+                "delays": self.delays,
+            }
+
+    # -- (de)serialization -------------------------------------------------
+    def to_json(self) -> dict:
+        return {"seed": self.seed,
+                "rules": [r.to_json() for r in self.rules]}
+
+    @staticmethod
+    def from_json(d: dict) -> "FaultFabric":
+        return FaultFabric(
+            seed=int(d.get("seed", 0)),
+            rules=[FabricRule.from_json(r) for r in d.get("rules", [])],
+        )
+
+    # -- seeded schedule expansion ----------------------------------------
+    @staticmethod
+    def storm(seed: int, op: str = "rpc", substr: str = "",
+              n: int = 8, span: int = 64, modes: tuple = (),
+              ) -> "FaultFabric":
+        """Expand ``seed`` into ``n`` single-shot faults whose trigger
+        offsets (0..span) and modes are pure functions of the seed —
+        the deterministic storm generator every chaos schedule uses.
+        Same seed, same storm; there is no RNG to drift."""
+        if not modes:
+            modes = ("drop",) if op == "rpc" else ("before",)
+        fab = FaultFabric(seed=seed)
+        for i in range(n):
+            h = splitmix64((seed << 16) ^ i)
+            after = h % max(span, 1)
+            mode = modes[(h >> 32) % len(modes)]
+            if op == "rpc":
+                fab.fail_rpc(substr=substr, after=after, mode=mode)
+            else:
+                fab.fail_store(op, substr=substr, after=after,
+                               mode=mode)
+        return fab
+
+
+# ---------------------------------------------------------------------------
+# process-global fabric (the seam every transport/store consults)
+
+_FABRIC: FaultFabric | None = None
+_ENV_CHECKED = False
+ENV_VAR = "RWT_FAULTS"
+
+
+def install(fabric: "FaultFabric | None") -> "FaultFabric | None":
+    """Install (or clear, with None) the process-global fabric."""
+    global _FABRIC, _ENV_CHECKED
+    _FABRIC = fabric
+    _ENV_CHECKED = True
+    return fabric
+
+
+def get_fabric() -> "FaultFabric | None":
+    """The process-global fabric; on first call, boots from the
+    ``RWT_FAULTS`` env var (JSON — see FaultFabric.to_json) so
+    subprocesses inherit the harness' schedule."""
+    global _FABRIC, _ENV_CHECKED
+    if not _ENV_CHECKED:
+        _ENV_CHECKED = True
+        spec = os.environ.get(ENV_VAR)
+        if spec:
+            _FABRIC = FaultFabric.from_json(json.loads(spec))
+    return _FABRIC
+
+
+# ---------------------------------------------------------------------------
+# unified retry policy (capped exponential backoff, deterministic jitter)
+
+
+@dataclass
+class RetryPolicy:
+    """Retry transient failures with capped exponential backoff.
+
+    Jitter is DETERMINISTIC — ``splitmix64(seed, attempt)`` scales the
+    delay within ``[1 - jitter_frac, 1]`` — so a seeded chaos run
+    replays its exact retry timeline.  Retries are only safe for
+    idempotent or epoch-guarded calls; the caller picks the exception
+    set (``ConnectionError``/``OSError`` by default: the peer never
+    answered — ``RpcError`` means the peer REFUSED, which no retry
+    fixes, so it is never retried here).
+    """
+
+    max_attempts: int = 5
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+    jitter_frac: float = 0.25
+    seed: int = 0
+    #: metrics label + registry (counters: rpc_retries_total,
+    #: rpc_retry_gave_up_total)
+    metrics: object = None
+    op: str = "rpc"
+    #: cumulative counters (introspection without a registry)
+    retries: int = 0
+    gave_up: int = 0
+    sleeper: object = field(default=time.sleep, repr=False)
+
+    def delay(self, attempt: int) -> float:
+        """Backoff before retry number ``attempt`` (1-based)."""
+        d = min(self.base_delay_s * (2 ** (attempt - 1)),
+                self.max_delay_s)
+        if self.jitter_frac > 0.0:
+            h = splitmix64((self.seed << 20) ^ attempt)
+            frac = (h & 0xFFFFFFFF) / 0xFFFFFFFF
+            d *= 1.0 - self.jitter_frac * frac
+        return d
+
+    def run(self, fn, retry_on: tuple = (ConnectionError, OSError),
+            label: str = ""):
+        """Call ``fn()``; on a retryable exception back off and retry
+        up to ``max_attempts`` total calls, then re-raise."""
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except retry_on as e:
+                attempt += 1
+                if attempt >= self.max_attempts:
+                    self.gave_up += 1
+                    if self.metrics is not None:
+                        self.metrics.inc("rpc_retry_gave_up_total",
+                                         op=label or self.op)
+                    raise
+                self.retries += 1
+                if self.metrics is not None:
+                    self.metrics.inc("rpc_retries_total",
+                                     op=label or self.op)
+                self.sleeper(self.delay(attempt))
+
+    def call(self, client, method: str, **params):
+        """Retrying ``RpcClient.call`` (the one-liner every control
+        loop uses for idempotent/epoch-guarded calls)."""
+        return self.run(lambda: client.call(method, **params),
+                        label=method)

@@ -218,6 +218,21 @@ def cluster_serving(meta_addr: str) -> list[dict]:
     return _meta_state(meta_addr).get("serving", [])
 
 
+def cluster_faults(meta_addr: str) -> dict:
+    """``ctl cluster faults``: the chaos observability surface — the
+    meta's (and every live worker's/replica's) injected-fault
+    counters, retry budget spend, and gave-up totals from the
+    deterministic fault fabric (common/faults.py)."""
+    from risingwave_tpu.cluster.rpc import RpcClient, parse_addr
+
+    host, port = parse_addr(meta_addr)
+    client = RpcClient(host, port, timeout=30.0)
+    try:
+        return client.call("cluster_faults")
+    finally:
+        client.close()
+
+
 def cluster_epochs(meta_addr: str) -> dict:
     """``ctl cluster epochs``: the global checkpoint positions — the
     committed cluster epoch (round), the manifest's epoch stamp, each
@@ -243,16 +258,17 @@ def cluster_epochs(meta_addr: str) -> dict:
 
 
 def _cluster_main(argv: list[str]) -> None:
-    """``python -m risingwave_tpu.ctl cluster {workers|jobs|epochs}
-    <meta_host:rpc_port>`` — online introspection of a running meta
-    (mirrors the offline ``ctl storage`` pattern, but against the live
-    control plane)."""
+    """``python -m risingwave_tpu.ctl cluster
+    {workers|jobs|epochs|serving|faults} <meta_host:rpc_port>`` —
+    online introspection of a running meta (mirrors the offline
+    ``ctl storage`` pattern, but against the live control plane)."""
     import json
 
     sub, addr = argv[0], argv[1]
     fn = {"workers": cluster_workers, "jobs": cluster_jobs,
           "epochs": cluster_epochs,
-          "serving": cluster_serving}.get(sub)
+          "serving": cluster_serving,
+          "faults": cluster_faults}.get(sub)
     if fn is None:
         raise SystemExit(f"unknown cluster subcommand: {sub}")
     print(json.dumps(fn(addr), indent=1))

@@ -79,6 +79,28 @@ class MetaStore:
             os.path.join(self.root, "dml_sql.jsonl")
         )]
 
+    def append_cluster_commit(self, round_: int, epoch: int,
+                              seals: dict) -> None:
+        """Cluster mode: one line per COMMITTED global round — the
+        round number, the manifest epoch stamp, and every job's sealed
+        epoch value.  A restarted meta replays the tail entry to
+        recover its round position and per-job seal log (the manifest
+        alone records epoch VALUES, not round indices).  Appended
+        AFTER the manifest delta commits: a crash in between leaves
+        the manifest one round ahead, which recovery re-commits
+        idempotently (empty delta, same epoch stamp)."""
+        self._append(os.path.join(self.root, "cluster_log.jsonl"),
+                     {"round": int(round_), "epoch": int(epoch),
+                      "seals": {k: int(v) for k, v in seals.items()}})
+
+    def last_cluster_commit(self) -> dict | None:
+        """The newest committed-round record (None = nothing durable).
+        Only the tail matters for recovery; earlier lines are history
+        the log keeps for operators (lines are tiny)."""
+        entries = self._lines(os.path.join(self.root,
+                                           "cluster_log.jsonl"))
+        return entries[-1] if entries else None
+
     # -- read -----------------------------------------------------------
     @staticmethod
     def _lines(path: str) -> list[dict]:

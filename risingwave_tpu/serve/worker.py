@@ -34,7 +34,13 @@ import threading
 import time
 from dataclasses import dataclass
 
-from risingwave_tpu.cluster.rpc import RpcClient, RpcServer, parse_addr
+from risingwave_tpu.cluster.rpc import (
+    RpcClient,
+    RpcError,
+    RpcServer,
+    parse_addr,
+)
+from risingwave_tpu.common.faults import RetryPolicy, get_fabric
 from risingwave_tpu.common.metrics import MetricsRegistry
 from risingwave_tpu.serve.reader import (
     MvSchema,
@@ -48,6 +54,13 @@ from risingwave_tpu.storage.hummock.object_store import ObjectError
 
 class ServeUnsupported(ValueError):
     """The statement needs the engine — route to the owning worker."""
+
+
+class ServeUnavailable(RuntimeError):
+    """This replica transiently cannot serve (meta unreachable during
+    a lease refresh, or stuck behind the pinned epoch) — the meta
+    should route the read to another replica or the owning worker,
+    NOT surface an error.  A routing signal, never a failed read."""
 
 
 _CMP_OPS = ("equal", "less_than", "less_than_or_equal",
@@ -209,6 +222,13 @@ class ServingWorker:
         self.replica_id: int | None = None
         self.reads_total = 0
         self.read_errors = 0
+        self.retry = RetryPolicy(max_attempts=4, base_delay_s=0.05,
+                                 max_delay_s=0.5, metrics=self.metrics,
+                                 op="serving")
+        #: lease heartbeats that failed transiently (meta restarting)
+        self.heartbeat_failures = 0
+        #: times this replica (re-)registered with a meta
+        self.registrations = 0
         #: meta's manifest epoch from the last heartbeat (lag gauge)
         self._meta_manifest_epoch = 0
         self._server: RpcServer | None = None
@@ -227,16 +247,19 @@ class ServingWorker:
         self._server = RpcServer(self, self.host, self._port_req).start()
         if self.meta_addr is not None:
             mh, mp = parse_addr(self.meta_addr)
-            self._meta_client = RpcClient(mh, mp, timeout=30.0)
-            res = self._meta_client.call(
-                "register_serving", host=self.host, port=self.port,
-                pid=os.getpid(),
-            )
-            self.replica_id = int(res["replica_id"])
-            self._meta_manifest_epoch = int(
-                res.get("manifest_epoch", 0)
-            )
-            self._refresh_to(int(res["granted_vid"]))
+            self._meta_client = RpcClient(mh, mp, timeout=30.0,
+                                          src="serving", dst="meta")
+            # first registration waits out a meta that is still
+            # booting (same patience as the compute worker)
+            deadline = time.monotonic() + 60.0
+            while True:
+                try:
+                    self._register()
+                    break
+                except (ConnectionError, OSError):
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.25)
             if heartbeat:
                 self._hb_thread = threading.Thread(
                     target=self._heartbeat_loop,
@@ -268,6 +291,22 @@ class ServingWorker:
         self.view.close()
 
     # -- lease / refresh -------------------------------------------------
+    def _register(self) -> None:
+        """(Re-)register with the meta and take the first epoch-pin
+        grant.  A restarted meta lost our lease wholesale; the fresh
+        registration pins the CURRENT version before the grant leaves,
+        so the read path is vacuum-safe again the moment this
+        returns."""
+        res = self.retry.call(
+            self._meta_client, "register_serving",
+            host=self.host, port=self.port, pid=os.getpid(),
+        )
+        self.replica_id = int(res["replica_id"])
+        self._meta_client.src = f"serving{self.replica_id}"
+        self._meta_manifest_epoch = int(res.get("manifest_epoch", 0))
+        self.registrations += 1
+        self._refresh_to(int(res["granted_vid"]))
+
     def _refresh_to(self, granted_vid: int) -> None:
         try:
             self.view.refresh(granted_vid)
@@ -284,8 +323,10 @@ class ServingWorker:
             return
         with self._hb_lock:
             for _ in range(8):
-                res = self._meta_client.call(
-                    "serving_heartbeat", replica_id=self.replica_id,
+                # idempotent lease round-trip: transient drops retry
+                res = self.retry.call(
+                    self._meta_client, "serving_heartbeat",
+                    replica_id=self.replica_id,
                     vid=self.view.version.vid,
                 )
                 self._meta_manifest_epoch = int(
@@ -309,7 +350,21 @@ class ServingWorker:
         while not self._stop.wait(self.heartbeat_interval_s):
             try:
                 self._grant_refresh()
-            except Exception:  # noqa: BLE001 — meta restart/unreachable
+            except (ConnectionError, OSError):
+                # meta unreachable (restarting / partitioned): keep
+                # the lease loop alive — the cadence is the backoff
+                self.heartbeat_failures += 1
+            except RpcError:
+                # the meta answered but doesn't know this replica: a
+                # restarted meta lost the serving registry — take a
+                # fresh registration (and a fresh pin lease)
+                self.heartbeat_failures += 1
+                try:
+                    self._register()
+                except (RpcError, ConnectionError, OSError):
+                    pass
+            except Exception:  # noqa: BLE001 — never kill the thread
+                self.heartbeat_failures += 1
                 time.sleep(self.heartbeat_interval_s)
 
     # -- the read path ---------------------------------------------------
@@ -378,7 +433,15 @@ class ServingWorker:
         t0 = time.perf_counter()
         plan = self._plan(sql)  # ServeUnsupported propagates un-counted
         try:
+            # catching up may need the meta; a replica that can't is
+            # UNAVAILABLE for this read (routing signal, un-counted —
+            # the meta serves it elsewhere), not a read error
             self._ensure_epoch(int(min_epoch or 0))
+        except (ConnectionError, OSError, RpcError, RuntimeError) as e:
+            raise ServeUnavailable(
+                f"replica cannot reach the pinned epoch: {e!r}"
+            ) from e
+        try:
             version = self.view.version
             try:
                 cols, rows = self._execute(plan, version)
@@ -431,3 +494,15 @@ class ServingWorker:
 
     def rpc_metrics(self) -> dict:
         return {"prometheus": self.metrics.render_prometheus()}
+
+    def rpc_faults(self) -> dict:
+        """This process' chaos counters (aggregated by the meta's
+        ``cluster_faults`` for the ctl surface)."""
+        fabric = get_fabric()
+        return {
+            "fabric": fabric.stats() if fabric is not None else None,
+            "rpc_retries_total": self.retry.retries,
+            "rpc_retry_gave_up_total": self.retry.gave_up,
+            "heartbeat_failures": self.heartbeat_failures,
+            "registrations": self.registrations,
+        }

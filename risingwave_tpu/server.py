@@ -134,7 +134,11 @@ def _run_meta(args) -> None:
             stop.wait(max(args.barrier_interval_ms / 1000.0 - elapsed,
                           0.0))
 
-    threading.Thread(target=tick_loop, daemon=True).start()
+    # --barrier-interval-ms 0: NO self-ticker — an external driver
+    # owns the round cadence through ``rpc_tick`` (the deterministic
+    # mode the chaos campaign uses to count committed rounds exactly)
+    if args.barrier_interval_ms > 0:
+        threading.Thread(target=tick_loop, daemon=True).start()
     try:
         while True:
             time.sleep(3600)

@@ -2053,6 +2053,7 @@ class Engine:
         if store is None or self.sst_key_allocator is None:
             return []
         batch: list[tuple[bytes, bytes]] = []
+        staged: list[tuple[str, dict, int]] = []
         for entry in self.catalog.list("mview"):
             if entry.job is None or entry.job.name != job_name \
                     or entry.mv_executor is None:
@@ -2066,11 +2067,10 @@ class Engine:
                    if prev.get(k) != v]
             dels = [(k, TOMBSTONE) for k in prev if k not in new]
             batch += ups + dels
-            self._exported[entry.name] = new
-            if ups:
-                self.metrics.inc("storage_mv_export_rows_total",
-                                 len(ups), job=entry.name)
+            staged.append((entry.name, new, len(ups)))
         if not batch:
+            for name, new, _ in staged:
+                self._exported[name] = new
             return []
         batch.sort()
         key = self.sst_key_allocator()
@@ -2078,6 +2078,14 @@ class Engine:
             [k for k, _ in batch], [v for _, v in batch]
         )
         store.put(key, data)
+        # the diff base moves ONLY after the object landed: an export
+        # whose upload dies keeps its rows in the next attempt's diff
+        # instead of silently dropping them from the serving tier
+        for name, new, n_ups in staged:
+            self._exported[name] = new
+            if n_ups:
+                self.metrics.inc("storage_mv_export_rows_total",
+                                 n_ups, job=name)
         return [{
             "key": key,
             "first_key": meta.first_key.hex(),

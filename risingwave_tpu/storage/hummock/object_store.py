@@ -115,12 +115,31 @@ class ObjectStore:
         raise NotImplementedError
 
     # -- shared fault plumbing ------------------------------------------
+    # Two layers consult here: the store's OWN StoreFaults (armed by
+    # unit tests against one store instance) and the process-global
+    # FaultFabric (common/faults.py — armed by chaos schedules, also
+    # via the RWT_FAULTS env in spawned workers).  Either may raise.
     def _pre(self, op: str, key: str):
-        return self.faults.before(op, key) if self.faults else None
+        local = self.faults.before(op, key) if self.faults else None
+        from risingwave_tpu.common.faults import get_fabric
+
+        fabric = get_fabric()
+        global_rule = None
+        if fabric is not None:
+            global_rule = fabric.store_before(op, key)
+        return local, global_rule
 
     def _post(self, rule, op: str, key: str) -> None:
+        local, global_rule = rule if isinstance(rule, tuple) \
+            else (rule, None)
         if self.faults:
-            self.faults.after(rule, op, key)
+            self.faults.after(local, op, key)
+        if global_rule is not None:
+            from risingwave_tpu.common.faults import get_fabric
+
+            fabric = get_fabric()
+            if fabric is not None:
+                fabric.store_after(global_rule, op, key)
 
 
 class InMemObjectStore(ObjectStore):

@@ -25,9 +25,13 @@ are unacked, mirroring the storage service's L0-depth write stall.
 Recovery and orderly-stop paths call ``drain()`` first, so nothing
 sealed is silently dropped by a clean exit.
 
-A failed upload is LOUD: the error is re-raised on the barrier loop at
-the next window wait / drain — a job cannot keep sealing epochs that
-will never become durable.
+A failed upload retries FIRST (the unified ``RetryPolicy`` — capped
+exponential backoff, deterministic jitter; store blips and injected
+chaos faults are transient by construction), and only after the
+budget is exhausted turns LOUD: the partial objects are vacuumed and
+the error is re-raised on the barrier loop at the next window wait /
+drain — a job cannot keep sealing epochs that will never become
+durable.
 """
 
 from __future__ import annotations
@@ -37,6 +41,8 @@ import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any
+
+from risingwave_tpu.common.faults import RetryPolicy
 
 
 @dataclass
@@ -65,10 +71,17 @@ class UploadTask:
 class CheckpointUploader:
     """Background uploader for one job's checkpoint chain."""
 
-    def __init__(self, store, job_name: str, metrics=None):
+    def __init__(self, store, job_name: str, metrics=None,
+                 retry: "RetryPolicy | None" = None):
         self.store = store
         self.job_name = job_name
         self.metrics = metrics
+        #: transient store failures (incl. injected chaos faults)
+        #: retry here, OFF the barrier loop, before anything surfaces
+        self.retry = retry if retry is not None else RetryPolicy(
+            max_attempts=4, base_delay_s=0.05, max_delay_s=1.0,
+            metrics=metrics, op="upload",
+        )
         self._q: deque[UploadTask] = deque()
         self._cv = threading.Condition()
         self._pending: list[UploadTask] = []
@@ -81,6 +94,10 @@ class CheckpointUploader:
         self.upload_seconds_total = 0.0
         self.stall_seconds_total = 0.0
         self.max_queue_depth = 0
+
+    @property
+    def retries_total(self) -> int:
+        return self.retry.retries
 
     # -- producer side (the barrier loop) --------------------------------
     def enqueue(self, task: UploadTask) -> None:
@@ -211,9 +228,17 @@ class CheckpointUploader:
             idle_since = time.monotonic()
             t0 = time.perf_counter()
             try:
-                # tier saves FIRST (see UploadTask.spill)
+                # tier saves FIRST (see UploadTask.spill).  Every
+                # store write retries through the policy: re-putting
+                # the same key is idempotent (atomic object replace),
+                # so a commit that died between the npz and the
+                # manifest just rewrites both.
                 for key, host_state in task.spill:
-                    self.store.save(key, task.epoch, host_state, {})
+                    self.retry.run(
+                        lambda k=key, hs=host_state: self.store.save(
+                            k, task.epoch, hs, {}),
+                        retry_on=(OSError,), label="spill_save",
+                    )
                 digests = np.asarray(task.digests) \
                     if task.digests is not None else None
                 prep = self.store.prepare(
@@ -222,7 +247,8 @@ class CheckpointUploader:
                 )
                 # host payload materialized: the shadow may be donated
                 task.fetched.set()
-                self.store.commit(prep)
+                self.retry.run(lambda: self.store.commit(prep),
+                               retry_on=(OSError,), label="commit")
                 dt = time.perf_counter() - t0
                 with self._cv:
                     self._acked.append(task.epoch)
@@ -237,6 +263,13 @@ class CheckpointUploader:
                     )
                 task.done.set()
             except Exception as e:  # noqa: BLE001 — surfaced on the loop
+                # retry budget exhausted (or a non-transient failure):
+                # reap the partial epoch objects so nothing un-durable
+                # lingers in the store, then go loud on the loop
+                try:
+                    self.store.vacuum_orphans(self.job_name)
+                except Exception:  # noqa: BLE001 — best-effort reap
+                    pass
                 task.error = e
                 task.fetched.set()
                 task.done.set()

@@ -1,0 +1,443 @@
+"""Chaos-lite campaign: seeded deterministic fault schedules against a
+real multi-process cluster.
+
+The acceptance harness for ISSUE 6 (the madsim-campaign analog): a
+1-meta + 2-compute + 1-serving cluster (ALL four roles are real
+processes) maintains two nexmark MVs through a seeded fault schedule
+while concurrent serving reads run end-to-end.  Every schedule must
+finish with
+
+- ZERO read errors (reads retry through transient windows and must
+  eventually answer from committed state only),
+- ZERO stuck rounds (every requested global round commits),
+- byte-identical final MV contents vs an undisturbed single-node run
+  of the same config and round count.
+
+Schedules (all deterministic: the fabric is counter-addressed and the
+schedule expands from the seed via splitmix64 — same seed, same
+faults, same replay):
+
+- ``rpc_drop_storm``   drop + error-after-send storms on the meta's
+                       control RPCs and the workers' meta-bound RPCs
+                       (heartbeats included); retry/backoff and
+                       round-tagged barriers must absorb everything;
+- ``meta_kill``        SIGKILL the meta MID-ROUND, restart it on the
+                       same RPC port over the same data_dir: it must
+                       rebuild jobs + round position from the durable
+                       MetaStore/manifest, workers and the serving
+                       replica must re-register via backoff, the
+                       interrupted round re-seals, and committing
+                       resumes with no operator action;
+- ``store_faults``     object-store put faults on the workers'
+                       checkpoint uploads (lost AND durable-then-error
+                       modes) during the pipelined async upload; the
+                       uploader's RetryPolicy absorbs them off the
+                       barrier path.
+
+Run standalone (prints one JSON summary line per schedule)::
+
+    python scripts/chaos_campaign.py --assert            # all three
+    python scripts/chaos_campaign.py --schedule meta_kill --seed 11
+
+or the short ``slow``-marked pytest wrapper
+(tests/test_chaos_campaign.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, ".")  # repo root
+
+CONFIG = {
+    "streaming": {"chunk_size": 256},
+    "state": {"agg_table_size": 1 << 10, "agg_emit_capacity": 256,
+              "mv_table_size": 1 << 10, "mv_ring_size": 1 << 12},
+    "storage": {"checkpoint_keep_epochs": 4},
+}
+
+DDL = [
+    """CREATE SOURCE bid (
+        auction BIGINT, bidder BIGINT, price BIGINT,
+        channel VARCHAR, url VARCHAR, date_time TIMESTAMP
+    ) WITH (connector = 'nexmark', nexmark.table = 'bid')""",
+    """CREATE MATERIALIZED VIEW q7 AS
+    SELECT window_start, max(price) AS max_price, count(*) AS bids
+    FROM TUMBLE(bid, date_time, INTERVAL '1' SECOND)
+    GROUP BY window_start""",
+    """CREATE MATERIALIZED VIEW qcnt AS
+    SELECT auction % 16 AS a, count(*) AS n, sum(price) AS vol
+    FROM bid GROUP BY auction % 16""",
+]
+
+READS = [
+    "SELECT window_start, max_price, bids FROM q7",
+    "SELECT a, n, vol FROM qcnt",
+]
+
+SCHEDULES = ("rpc_drop_storm", "meta_kill", "store_faults")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait_port(port: int, deadline_s: float = 120.0) -> None:
+    """Block until something LISTENS on the port (a freshly spawned
+    meta takes seconds to boot before peers can register)."""
+    deadline = time.monotonic() + deadline_s
+    while True:
+        try:
+            socket.create_connection(("127.0.0.1", port),
+                                     timeout=1.0).close()
+            return
+        except OSError:
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"port {port} never listened")
+            time.sleep(0.2)
+
+
+def _env(fault_env: dict | None) -> dict:
+    env = dict(os.environ, JAX_PLATFORMS=os.environ.get(
+        "JAX_PLATFORMS", "cpu"))
+    env.pop("RWT_FAULTS", None)
+    if fault_env:
+        env["RWT_FAULTS"] = json.dumps(fault_env)
+    return env
+
+
+def _spawn_meta(data_dir: str, rpc_port: int, tag: str,
+                fault_env: dict | None = None):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "risingwave_tpu.server",
+         "--role", "meta", "--port", str(_free_port()),
+         "--rpc-port", str(rpc_port), "--data-dir", data_dir,
+         "--heartbeat-timeout", "3.0",
+         "--barrier-interval-ms", "0"],  # the driver owns the cadence
+        stdout=subprocess.DEVNULL,
+        stderr=open(os.path.join(data_dir, f"meta_{tag}.log"), "wb"),
+        env=_env(fault_env),
+    )
+    return proc
+
+
+def _spawn_worker(rpc_port: int, data_dir: str, idx: int,
+                  fault_env: dict | None = None):
+    return subprocess.Popen(
+        [sys.executable, "-m", "risingwave_tpu.server",
+         "--role", "compute", "--meta", f"127.0.0.1:{rpc_port}",
+         "--data-dir", data_dir, "--config-json", json.dumps(CONFIG),
+         "--heartbeat-interval", "0.25"],
+        stdout=subprocess.DEVNULL,
+        stderr=open(os.path.join(data_dir, f"worker{idx}.log"), "wb"),
+        env=_env(fault_env),
+    )
+
+
+def _spawn_serving(rpc_port: int, data_dir: str,
+                   fault_env: dict | None = None):
+    return subprocess.Popen(
+        [sys.executable, "-m", "risingwave_tpu.server",
+         "--role", "serving", "--meta", f"127.0.0.1:{rpc_port}",
+         "--data-dir", data_dir, "--heartbeat-interval", "0.25"],
+        stdout=subprocess.DEVNULL,
+        stderr=open(os.path.join(data_dir, "serving.log"), "wb"),
+        env=_env(fault_env),
+    )
+
+
+class MetaDriver:
+    """Patient RPC driver: survives the meta being down/restarting
+    (the client reconnects to whatever process owns the port)."""
+
+    def __init__(self, rpc_port: int):
+        from risingwave_tpu.cluster.rpc import RpcClient
+
+        self.client = RpcClient("127.0.0.1", rpc_port, timeout=120.0,
+                                src="driver", dst="meta")
+
+    def call(self, method: str, deadline_s: float = 120.0, **params):
+        from risingwave_tpu.cluster.rpc import RpcError
+
+        deadline = time.monotonic() + deadline_s
+        while True:
+            try:
+                return self.client.call(method, **params)
+            except RpcError:
+                raise  # the meta answered: final
+            except (ConnectionError, OSError):
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.1)
+
+    def close(self) -> None:
+        self.client.close()
+
+
+def _fault_envs(schedule: str, seed: int) -> dict:
+    """Expand one (schedule, seed) into per-role ``RWT_FAULTS`` JSON.
+    Pure function of the inputs — the determinism contract."""
+    from risingwave_tpu.common.faults import FaultFabric
+
+    if schedule == "rpc_drop_storm":
+        meta_fab = FaultFabric.storm(
+            seed, op="rpc", n=10, span=60,
+            modes=("drop", "error_after_send"),
+        )
+        peer_fab = FaultFabric.storm(
+            seed ^ 0x5A5A, op="rpc", substr=">meta/", n=5, span=80,
+            modes=("drop",),
+        )
+        return {"meta": meta_fab.to_json(),
+                "worker": peer_fab.to_json(),
+                "serving": peer_fab.to_json()}
+    if schedule == "store_faults":
+        worker_fab = FaultFabric.storm(
+            seed, op="put", substr="epoch_", n=6, span=50,
+            modes=("before", "after"),
+        )
+        return {"worker": worker_fab.to_json()}
+    return {}
+
+
+def run_schedule(schedule: str, seed: int = 7, rounds: int = 10,
+                 kill_at_round: int = 4, readers: int = 2,
+                 data_dir: str | None = None) -> dict:
+    assert schedule in SCHEDULES, schedule
+    data_dir = data_dir or tempfile.mkdtemp(
+        prefix=f"chaos_{schedule}_")
+    envs = _fault_envs(schedule, seed)
+    # determinism spot-check: the same (schedule, seed) must expand to
+    # the byte-identical fault schedule (no RNG anywhere in the path)
+    deterministic = envs == _fault_envs(schedule, seed)
+
+    rpc_port = _free_port()
+    meta_proc = _spawn_meta(data_dir, rpc_port, "a",
+                            fault_env=envs.get("meta"))
+    _wait_port(rpc_port)  # peers register against a LIVE meta
+    procs = [_spawn_worker(rpc_port, data_dir, i,
+                           fault_env=envs.get("worker"))
+             for i in range(2)]
+    serving_proc = _spawn_serving(rpc_port, data_dir,
+                                  fault_env=envs.get("serving"))
+    driver = MetaDriver(rpc_port)
+    state = {"reads": 0, "read_errors": [], "tick_retries": 0,
+             "meta_restarts": 0}
+    stop = threading.Event()
+
+    def read_loop():
+        while not stop.is_set():
+            for sql in READS:
+                try:
+                    driver.call("serve", sql=sql, deadline_s=180.0)
+                    state["reads"] += 1
+                except Exception as e:  # noqa: BLE001
+                    state["read_errors"].append(repr(e))
+            time.sleep(0.05)
+
+    def drive_round(deadline_s: float = 240.0) -> None:
+        deadline = time.monotonic() + deadline_s
+        while True:
+            try:
+                res = driver.call("tick", chunks_per_barrier=1)
+                if res["committed"]:
+                    return
+            except Exception:  # noqa: BLE001 — meta mid-restart
+                pass
+            state["tick_retries"] += 1
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"round never committed "
+                                   f"({schedule}, seed {seed})")
+            time.sleep(0.2)
+
+    try:
+        deadline = time.monotonic() + 180
+        while True:
+            st = driver.call("cluster_state", deadline_s=120.0)
+            if sum(w["alive"] for w in st["workers"]) >= 2 \
+                    and st["serving"]:
+                break
+            for p in procs:
+                if p.poll() is not None:
+                    raise RuntimeError(
+                        f"worker died at startup (logs in {data_dir})")
+            if time.monotonic() > deadline:
+                raise TimeoutError("cluster never assembled")
+            time.sleep(0.25)
+
+        for sql in DDL:
+            driver.call("execute_ddl", sql=sql)
+
+        threads = [threading.Thread(target=read_loop, daemon=True)
+                   for _ in range(readers)]
+        for t in threads:
+            t.start()
+
+        committed = 0
+        while committed < rounds:
+            drive_round()
+            committed = int(driver.call(
+                "cluster_state")["cluster_epoch"])
+            if schedule == "meta_kill" and committed == kill_at_round \
+                    and state["meta_restarts"] == 0:
+                # SIGKILL MID-ROUND: launch the next round, give the
+                # barriers a moment to be in flight, then kill
+                t = threading.Thread(
+                    target=lambda: _swallow(
+                        lambda: driver.call("tick",
+                                            chunks_per_barrier=1)),
+                    daemon=True)
+                t.start()
+                time.sleep(0.3)
+                meta_proc.send_signal(signal.SIGKILL)
+                meta_proc.wait(timeout=10)
+                t.join(timeout=30)
+                meta_proc = _spawn_meta(data_dir, rpc_port, "b",
+                                        fault_env=envs.get("meta"))
+                state["meta_restarts"] += 1
+
+        stop.set()
+        for t in threads:
+            t.join(timeout=15)
+
+        final_state = driver.call("cluster_state")
+        faults = driver.call("cluster_faults")
+        cluster_rows = [
+            sorted(tuple(v) for v in driver.call(
+                "serve", sql=sql)["rows"])
+            for sql in READS
+        ]
+    finally:
+        stop.set()
+        for p in procs + [serving_proc, meta_proc]:
+            if p.poll() is None:
+                p.kill()
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+        driver.close()
+
+    # undisturbed single-node reference (same config + rounds)
+    from risingwave_tpu.common.config import RwConfig
+    from risingwave_tpu.sql.engine import Engine
+
+    eng = Engine(RwConfig.from_dict(CONFIG))
+    for sql in DDL:
+        eng.execute(sql)
+    eng.tick(barriers=rounds, chunks_per_barrier=1)
+    single_rows = [
+        sorted(tuple(int(x) for x in r) for r in eng.execute(sql))
+        for sql in READS
+    ]
+    mismatches = sum(c != s for c, s in zip(cluster_rows, single_rows))
+
+    worker_faults = [v for v in faults["workers"].values() if v]
+    injected = sum((v["fabric"] or {}).get("injected_total", 0)
+                   for v in worker_faults + [faults["meta"]]
+                   + [v for v in faults["serving"].values() if v])
+    peer_retries = sum(v["rpc_retries_total"] for v in worker_faults)
+    upload_retries = sum(v.get("checkpoint_upload_retries_total", 0)
+                         for v in worker_faults)
+    summary = {
+        "schedule": schedule,
+        "seed": seed,
+        "deterministic_expansion": deterministic,
+        "rounds": rounds,
+        "rounds_committed": int(final_state["cluster_epoch"]),
+        "meta_recovered": bool(final_state.get("recovered")),
+        "meta_restarts": state["meta_restarts"],
+        "live_workers": sum(w["alive"]
+                            for w in final_state["workers"]),
+        "serving_replicas": len(final_state["serving"]),
+        "worker_registrations": sum(
+            v.get("registrations", 0) for v in worker_faults),
+        "reads": state["reads"],
+        "read_errors": len(state["read_errors"]),
+        "read_error_samples": state["read_errors"][:3],
+        "tick_retries": state["tick_retries"],
+        "faults_injected": injected,
+        "meta_rpc_retries": faults["meta"]["rpc_retries_total"],
+        "peer_rpc_retries": peer_retries,
+        "upload_retries": upload_retries,
+        "mv_mismatches": mismatches,
+        "mv_rows": [len(r) for r in cluster_rows],
+        "data_dir": data_dir,
+    }
+    summary["ok"] = bool(
+        summary["deterministic_expansion"]
+        and summary["read_errors"] == 0
+        and summary["rounds_committed"] >= rounds
+        and summary["mv_mismatches"] == 0
+        and summary["live_workers"] == 2
+        and _schedule_ok(schedule, summary)
+    )
+    return summary
+
+
+def _schedule_ok(schedule: str, s: dict) -> bool:
+    if schedule == "rpc_drop_storm":
+        # the storm actually fired and the retry budget absorbed it
+        return s["faults_injected"] > 0 \
+            and (s["meta_rpc_retries"] + s["peer_rpc_retries"]
+                 + s["tick_retries"]) > 0
+    if schedule == "meta_kill":
+        # the restarted meta REBUILT its state from the durable logs
+        # and every peer re-registered without operator action
+        return s["meta_restarts"] == 1 and s["meta_recovered"] \
+            and s["worker_registrations"] >= 4 \
+            and s["serving_replicas"] >= 1
+    if schedule == "store_faults":
+        # faults hit the async upload path and were retried there
+        return s["faults_injected"] > 0 and s["upload_retries"] > 0
+    return True
+
+
+def _swallow(fn) -> None:
+    try:
+        fn()
+    except Exception:  # noqa: BLE001 — the kill window eats the call
+        pass
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--schedule", choices=SCHEDULES + ("all",),
+                   default="all")
+    p.add_argument("--seed", type=int, default=7)
+    p.add_argument("--rounds", type=int, default=10)
+    p.add_argument("--kill-at-round", type=int, default=4)
+    p.add_argument("--readers", type=int, default=2)
+    p.add_argument("--assert", dest="check", action="store_true",
+                   help="exit nonzero unless every schedule converged "
+                        "with 0 read errors and 0 stuck rounds")
+    args = p.parse_args()
+
+    names = SCHEDULES if args.schedule == "all" else (args.schedule,)
+    ok = True
+    for name in names:
+        summary = run_schedule(
+            name, seed=args.seed, rounds=args.rounds,
+            kill_at_round=args.kill_at_round, readers=args.readers,
+        )
+        print(json.dumps(summary), flush=True)
+        ok = ok and summary["ok"]
+    if args.check:
+        raise SystemExit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -248,11 +248,35 @@ def test_cluster_sigkill_failover_converges(tmp_path):
         meta.stop()
 
 
+def test_transient_upload_fault_retries_invisibly(tmp_path):
+    """ISSUE 6 satellite: a TRANSIENT store failure mid-upload (one
+    lost manifest put) is absorbed by the uploader's RetryPolicy —
+    the barrier loop never sees it, durable progress continues, and
+    the retry is visible on the budget counter."""
+    from risingwave_tpu.storage.hummock.object_store import StoreFaults
+
+    b = Engine(_cfg(), data_dir=str(tmp_path))
+    b.execute(DDL)
+    b.tick(barriers=2, chunks_per_barrier=1)
+    store = b.checkpoint_store
+    faults = StoreFaults()
+    faults.fail("put", substr="MANIFEST", mode="before")  # once
+    store.store.faults = faults
+    b.tick(barriers=1, chunks_per_barrier=1)  # must NOT raise
+    store.store.faults = None
+    job = b.jobs[0]
+    assert store.committed_epoch(job.name) == job.sealed_epoch
+    assert job._uploader.retries_total >= 1
+    assert faults.injected_errors == 1
+
+
 def test_crash_mid_upload_rewinds_to_durable_epoch(tmp_path):
-    """ISSUE 4 satellite: kill the process between the checkpoint
-    object write and the manifest commit (fault-injected) — a cold
-    restart must rewind to the previous DURABLE epoch, vacuum the
-    orphan files, and converge to the undisturbed result."""
+    """ISSUE 4 satellite (reworked for the ISSUE 6 retry budget): a
+    PERSISTENT failure between the checkpoint object write and the
+    manifest commit exhausts the uploader's retries, vacuums the
+    partial epoch objects, and surfaces on the barrier loop; a cold
+    restart rewinds to the previous DURABLE epoch and converges to
+    the undisturbed result."""
     import pytest
 
     from risingwave_tpu.storage.hummock.object_store import StoreFaults
@@ -268,22 +292,25 @@ def test_crash_mid_upload_rewinds_to_durable_epoch(tmp_path):
     b.tick(barriers=2, chunks_per_barrier=1)
     store = b.checkpoint_store
     durable = store.committed_epoch(b.jobs[0].name)
-    # arm: the NEXT manifest write is lost (the npz landed already)
+    # arm: EVERY manifest write is lost (the npz lands each attempt)
+    # until the retry budget (4 attempts) exhausts
     faults = StoreFaults()
-    faults.fail("put", substr="MANIFEST", mode="before")
+    faults.fail("put", substr="MANIFEST", mode="before", times=16)
     store.store.faults = faults
     with pytest.raises(RuntimeError, match="upload failed"):
         b.tick(barriers=1, chunks_per_barrier=1)
     store.store.faults = None
     assert store.committed_epoch(b.jobs[0].name) == durable
+    # the retry budget was spent before surfacing...
+    assert b.jobs[0]._uploader.retries_total >= 3
+    # ...and the partial epoch objects were vacuumed with the failure
     orphan = f"{b.jobs[0].name}/epoch_{b.jobs[0].sealed_epoch}.npz"
-    assert store.store.exists(orphan)
+    assert not store.store.exists(orphan)
 
     # "SIGKILL": a cold engine bootstraps from the durable chain only
     b2 = Engine(_cfg(), data_dir=str(tmp_path))
     job2 = b2.jobs[0]
     assert job2.committed_epoch == durable
-    # recovery vacuumed the orphan epoch files
     assert not b2.checkpoint_store.store.exists(orphan)
     # the crashed barrier replays; convergence is exact
     b2.tick(barriers=4, chunks_per_barrier=1)

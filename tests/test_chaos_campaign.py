@@ -1,0 +1,35 @@
+"""Slow wrapper over scripts/chaos_campaign.py (the ISSUE 6 acceptance
+harness): one seeded schedule end-to-end against a real 4-role
+multi-process cluster.  The full ≥3-schedule campaign runs standalone:
+
+    python scripts/chaos_campaign.py --assert
+"""
+
+import pytest
+
+
+def _run(schedule: str, data_dir: str, **kw) -> dict:
+    import importlib
+    import sys
+
+    sys.path.insert(0, "scripts")
+    try:
+        cc = importlib.import_module("chaos_campaign")
+    finally:
+        sys.path.pop(0)
+    return cc.run_schedule(schedule, data_dir=data_dir, **kw)
+
+
+@pytest.mark.slow
+def test_chaos_campaign_meta_kill(tmp_path):
+    """Meta SIGKILL + restart mid-round: recovery from the durable
+    MetaStore/manifest, worker + serving re-registration via backoff,
+    0 read errors, 0 stuck rounds, byte-identical convergence."""
+    summary = _run("meta_kill", str(tmp_path), rounds=8,
+                   kill_at_round=3)
+    assert summary["ok"], summary
+    assert summary["meta_recovered"] is True
+    assert summary["read_errors"] == 0, summary["read_error_samples"]
+    assert summary["rounds_committed"] >= summary["rounds"]
+    assert summary["mv_mismatches"] == 0
+    assert summary["worker_registrations"] >= 4  # 2 workers × 2

@@ -305,6 +305,69 @@ def test_cluster_metrics_exported(tmp_path):
         meta.stop()
 
 
+def test_fault_and_retry_gauges_exported(tmp_path):
+    """ISSUE 6 satellite: the chaos fabric's injected counters and the
+    unified RetryPolicy's budget spend are first-class metrics — per-op
+    retry counters plus process gauges on the meta's scrape surface
+    (the ``ctl cluster faults`` backing data)."""
+    from risingwave_tpu.cluster import MetaService
+    from risingwave_tpu.common import faults as faults_mod
+    from risingwave_tpu.common.faults import (
+        FaultFabric,
+        FaultInjected,
+        RetryPolicy,
+    )
+
+    meta = MetaService(str(tmp_path))
+    fab = faults_mod.install(FaultFabric(seed=3))
+    try:
+        fab.fail_rpc(substr="a>b/", mode="drop", times=2)
+        for _ in range(2):
+            with pytest.raises(FaultInjected):
+                fab.rpc_before_send("a>b/barrier")
+
+        # spend the meta's retry budget against a dead endpoint
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise ConnectionError("transient")
+            return "ok"
+
+        meta.retry.sleeper = lambda _: None
+        assert meta.retry.run(flaky, label="barrier") == "ok"
+
+        fl = meta.cluster_faults()
+        assert fl["meta"]["fabric"]["injected_total"] == 2
+        assert fl["meta"]["rpc_retries_total"] == 2
+
+        m = meta.metrics
+        assert m.get("faults_injected_total") == 2
+        assert m.get("rpc_retries_spent_total") == 2
+        assert m.get("rpc_retry_gave_up_spent_total") == 0
+        assert m.get("rpc_retries_total", op="barrier") == 2
+        text = m.render_prometheus()
+        for name in ("faults_injected_total",
+                     "rpc_retries_spent_total",
+                     "rpc_retry_gave_up_spent_total",
+                     "rpc_retries_total"):
+            assert name in text, name
+
+        # a per-policy budget exhaustion lands on the gave-up counter
+        p = RetryPolicy(max_attempts=2, base_delay_s=0.001,
+                        metrics=m, sleeper=lambda _: None)
+
+        def dead():
+            raise ConnectionError("down")
+
+        with pytest.raises(ConnectionError):
+            p.run(dead, label="upload")
+        assert m.get("rpc_retry_gave_up_total", op="upload") == 1
+    finally:
+        faults_mod.install(None)
+
+
 def test_meta_store_crash_safe_append_and_torn_tail(tmp_path):
     """ISSUE 3 satellite: a worker killed mid-append leaves a torn
     trailing JSONL line — replay drops it (with a warning) instead of

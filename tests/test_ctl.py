@@ -45,6 +45,7 @@ def test_ctl_cluster_subcommands(tmp_path):
     from risingwave_tpu.common.config import RwConfig
     from risingwave_tpu.ctl import (
         cluster_epochs,
+        cluster_faults,
         cluster_jobs,
         cluster_workers,
     )
@@ -95,6 +96,19 @@ def test_ctl_cluster_subcommands(tmp_path):
         # the async-checkpoint split is visible in the ctl surface
         assert ep["jobs"]["cv"]["sealed_epoch"] > 0
         assert ep["jobs"]["cv"]["upload_lag_epochs"] == 0
+
+        # ``ctl cluster faults``: the chaos observability surface —
+        # injected/retried/gave-up counters per node (no fabric armed
+        # here, so everything reads zero/None but the SHAPE is live)
+        fl = cluster_faults(addr)
+        assert fl["meta"]["fabric"] is None
+        assert fl["meta"]["rpc_retries_total"] == 0
+        assert fl["meta"]["rpc_retry_gave_up_total"] == 0
+        wf = fl["workers"][str(w.worker_id)] \
+            if str(w.worker_id) in fl["workers"] \
+            else fl["workers"][w.worker_id]
+        assert wf["registrations"] == 1
+        assert wf["checkpoint_upload_retries_total"] == 0
     finally:
         w.stop()
         meta.stop()

@@ -1,0 +1,309 @@
+"""The deterministic fault fabric + unified retry policy (ISSUE 6):
+seeded determinism, RPC transport injection, retry/backoff behavior,
+and malformed-frame hardening of the JSON-RPC server."""
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from risingwave_tpu.common import faults as faults_mod
+from risingwave_tpu.common.faults import (
+    FaultFabric,
+    FaultInjected,
+    RetryPolicy,
+    splitmix64,
+)
+from risingwave_tpu.cluster.rpc import RpcClient, RpcError, RpcServer
+
+
+@pytest.fixture(autouse=True)
+def _no_global_fabric():
+    """Every test starts and ends with NO process-global fabric (a
+    leaked fabric would inject into unrelated suites)."""
+    faults_mod.install(None)
+    yield
+    faults_mod.install(None)
+
+
+# -- determinism ---------------------------------------------------------
+def test_storm_expansion_is_deterministic():
+    a = FaultFabric.storm(42, op="rpc", n=16, span=100,
+                          modes=("drop", "error_after_send"))
+    b = FaultFabric.storm(42, op="rpc", n=16, span=100,
+                          modes=("drop", "error_after_send"))
+    assert a.to_json() == b.to_json()
+    # a different seed yields a different schedule
+    c = FaultFabric.storm(43, op="rpc", n=16, span=100,
+                          modes=("drop", "error_after_send"))
+    assert a.to_json() != c.to_json()
+
+
+def test_identical_seed_identical_injection_sequence():
+    """The acceptance criterion verbatim: drive the same op sequence
+    through two fabrics built from the same seed — the injected-fault
+    positions must match exactly (counter-addressed, no RNG)."""
+    def drive(fab):
+        hits = []
+        for i in range(200):
+            try:
+                fab.rpc_before_send(f"meta>worker1/barrier#{i}")
+            except FaultInjected:
+                hits.append(i)
+        return hits
+
+    seq1 = drive(FaultFabric.storm(7, op="rpc", n=8, span=150))
+    seq2 = drive(FaultFabric.storm(7, op="rpc", n=8, span=150))
+    assert seq1 == seq2 and len(seq1) > 0
+
+
+def test_retry_policy_jitter_is_deterministic():
+    p1 = RetryPolicy(seed=5)
+    p2 = RetryPolicy(seed=5)
+    assert [p1.delay(a) for a in range(1, 8)] \
+        == [p2.delay(a) for a in range(1, 8)]
+    # capped: never above max_delay_s
+    assert all(p1.delay(a) <= p1.max_delay_s for a in range(1, 20))
+    # splitmix64 is a pure function
+    assert splitmix64(123) == splitmix64(123)
+
+
+# -- retry policy behavior ----------------------------------------------
+def test_retry_policy_retries_then_succeeds():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("transient")
+        return "ok"
+
+    p = RetryPolicy(max_attempts=5, base_delay_s=0.001, sleeper=lambda _: None)
+    assert p.run(flaky) == "ok"
+    assert len(calls) == 3 and p.retries == 2 and p.gave_up == 0
+
+
+def test_retry_policy_exhausts_budget_and_raises():
+    from risingwave_tpu.common.metrics import MetricsRegistry
+
+    m = MetricsRegistry()
+    p = RetryPolicy(max_attempts=3, base_delay_s=0.001, metrics=m,
+                    sleeper=lambda _: None)
+
+    def dead():
+        raise ConnectionError("down")
+
+    with pytest.raises(ConnectionError):
+        p.run(dead, label="barrier")
+    assert p.retries == 2 and p.gave_up == 1
+    assert m.get("rpc_retries_total", op="barrier") == 2
+    assert m.get("rpc_retry_gave_up_total", op="barrier") == 1
+
+
+def test_retry_policy_never_retries_rpc_error():
+    calls = []
+
+    def refused():
+        calls.append(1)
+        raise RpcError("no")
+
+    p = RetryPolicy(max_attempts=5, base_delay_s=0.001)
+    with pytest.raises(RpcError):
+        p.run(refused)
+    assert len(calls) == 1  # RpcError is FINAL, never retried
+
+
+# -- RPC transport injection --------------------------------------------
+class _Counter:
+    def __init__(self):
+        self.calls = 0
+
+    def rpc_bump(self):
+        self.calls += 1
+        return {"calls": self.calls}
+
+
+def test_rpc_drop_and_delay_injection():
+    target = _Counter()
+    server = RpcServer(target).start()
+    fab = faults_mod.install(FaultFabric(seed=1))
+    fab.fail_rpc(substr="a>b/bump", after=1, mode="drop")
+    # NB: a firing rule short-circuits later rules' counters for that
+    # op, so this arms "the next matching op after the drop fires"
+    fab.fail_rpc(substr="a>b/bump", after=1, mode="delay",
+                 delay_s=0.2)
+    try:
+        c = RpcClient("127.0.0.1", server.port, timeout=5,
+                      src="a", dst="b")
+        assert c.call("bump")["calls"] == 1
+        with pytest.raises(ConnectionError):
+            c.call("bump")  # dropped before send
+        assert target.calls == 1  # the peer never saw it
+        t0 = time.monotonic()
+        assert c.call("bump")["calls"] == 2  # delayed, not errored
+        assert time.monotonic() - t0 >= 0.2
+        assert fab.injected_total() == 1  # a delay is not an error
+        assert fab.delays == 1
+        c.close()
+    finally:
+        server.stop()
+
+
+def test_rpc_error_after_send_executes_but_loses_response():
+    target = _Counter()
+    server = RpcServer(target).start()
+    fab = faults_mod.install(FaultFabric(seed=1))
+    fab.fail_rpc(substr="/bump", mode="error_after_send")
+    try:
+        c = RpcClient("127.0.0.1", server.port, timeout=5)
+        with pytest.raises(ConnectionError, match="error-after-send"):
+            c.call("bump")
+        deadline = time.monotonic() + 5
+        while target.calls == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert target.calls == 1  # delivered AND executed
+        assert c.call("bump")["calls"] == 2  # client reconnects
+        c.close()
+    finally:
+        server.stop()
+
+
+def test_rpc_one_way_partition_and_heal():
+    t1, t2 = _Counter(), _Counter()
+    s1, s2 = RpcServer(t1).start(), RpcServer(t2).start()
+    fab = faults_mod.install(FaultFabric())
+    rule = fab.partition("meta", "w1")
+    try:
+        a_to_b = RpcClient("127.0.0.1", s1.port, timeout=5,
+                           src="meta", dst="w1")
+        b_to_a = RpcClient("127.0.0.1", s2.port, timeout=5,
+                           src="w1", dst="meta")
+        with pytest.raises(ConnectionError):
+            a_to_b.call("bump")
+        # one-way: the reverse direction flows
+        assert b_to_a.call("bump")["calls"] == 1
+        FaultFabric.heal(rule)
+        assert a_to_b.call("bump")["calls"] == 1
+        a_to_b.close()
+        b_to_a.close()
+    finally:
+        s1.stop()
+        s2.stop()
+
+
+def test_env_var_boots_the_fabric(monkeypatch):
+    spec = FaultFabric.storm(9, op="put", substr="epoch_", n=3)
+    monkeypatch.setenv(faults_mod.ENV_VAR, json.dumps(spec.to_json()))
+    faults_mod._ENV_CHECKED = False
+    faults_mod._FABRIC = None
+    fab = faults_mod.get_fabric()
+    assert fab is not None and fab.seed == 9 and len(fab.rules) == 3
+    assert fab.to_json() == spec.to_json()
+
+
+# -- malformed / torn frames never crash the server ----------------------
+def _raw_roundtrip(sock_file, payload: bytes) -> dict:
+    sock_file.write(payload)
+    sock_file.flush()
+    return json.loads(sock_file.readline())
+
+
+def test_malformed_frames_yield_rpc_error_not_crash():
+    target = _Counter()
+    server = RpcServer(target).start()
+    try:
+        s = socket.create_connection(("127.0.0.1", server.port),
+                                     timeout=5)
+        f = s.makefile("rwb")
+        # junk bytes
+        resp = _raw_roundtrip(f, b"\x00\xffnot json at all\n")
+        assert "malformed" in resp["error"]
+        # truncated JSON (torn frame, newline landed)
+        resp = _raw_roundtrip(f, b'{"id": 1, "method": "bu\n')
+        assert "malformed" in resp["error"]
+        # non-object request
+        resp = _raw_roundtrip(f, b"42\n")
+        assert "malformed" in resp["error"]
+        # params of the wrong shape
+        resp = _raw_roundtrip(
+            f, b'{"id": 2, "method": "bump", "params": [1, 2]}\n')
+        assert "params" in resp["error"]
+        # the SAME connection still serves valid calls (resynced)
+        resp = _raw_roundtrip(
+            f, b'{"id": 3, "method": "bump", "params": {}}\n')
+        assert resp["result"] == {"calls": 1}
+        f.close()
+        s.close()
+
+        # a fresh RpcClient sees the handler errors as RpcError
+        c = RpcClient("127.0.0.1", server.port, timeout=5)
+        with pytest.raises(RpcError, match="unknown method"):
+            c.call("nope")
+        assert c.call("bump")["calls"] == 2
+        c.close()
+    finally:
+        server.stop()
+
+
+def test_oversized_frame_is_rejected_and_connection_survives():
+    import risingwave_tpu.cluster.rpc as rpc_mod
+
+    target = _Counter()
+    server = RpcServer(target).start()
+    old = rpc_mod.MAX_FRAME_BYTES
+    rpc_mod.MAX_FRAME_BYTES = 4096  # keep the test cheap
+    try:
+        s = socket.create_connection(("127.0.0.1", server.port),
+                                     timeout=5)
+        f = s.makefile("rwb")
+        resp = _raw_roundtrip(f, b"x" * 20000 + b"\n")
+        assert "oversized" in resp["error"]
+        # resynced: the next valid frame answers
+        resp = _raw_roundtrip(
+            f, b'{"id": 1, "method": "bump", "params": {}}\n')
+        assert resp["result"] == {"calls": 1}
+        f.close()
+        s.close()
+    finally:
+        rpc_mod.MAX_FRAME_BYTES = old
+        server.stop()
+
+
+def test_torn_frame_client_death_leaves_server_serving():
+    """A client dying mid-frame (no newline ever arrives) must not
+    wedge the accept loop for other clients."""
+    target = _Counter()
+    server = RpcServer(target).start()
+    try:
+        s = socket.create_connection(("127.0.0.1", server.port),
+                                     timeout=5)
+        s.sendall(b'{"id": 1, "method": "bu')  # torn: no newline
+        s.close()  # peer dies mid-frame
+        c = RpcClient("127.0.0.1", server.port, timeout=5)
+        assert c.call("bump")["calls"] == 1
+        c.close()
+    finally:
+        server.stop()
+
+
+# -- store fabric hook ---------------------------------------------------
+def test_global_fabric_injects_into_object_store():
+    from risingwave_tpu.storage.hummock.object_store import (
+        InMemObjectStore,
+        ObjectError,
+    )
+
+    fab = faults_mod.install(FaultFabric())
+    fab.fail_store("put", substr="epoch_", mode="before")
+    fab.fail_store("put", substr="epoch_", mode="after")
+    store = InMemObjectStore()
+    with pytest.raises(ObjectError, match="lost"):
+        store.put("job/epoch_3.npz", b"x")
+    assert not store.exists("job/epoch_3.npz")  # lost BEFORE landing
+    with pytest.raises(ObjectError, match="durable"):
+        store.put("job/epoch_4.npz", b"y")
+    assert store.exists("job/epoch_4.npz")  # landed, caller died
+    store.put("job/epoch_5.npz", b"z")  # rules retired
+    assert fab.injected_total() == 2

@@ -197,14 +197,20 @@ def test_upload_failure_is_loud_and_recover_rewinds(tmp_path):
     durable = job.committed_epoch
     assert durable > 0
 
-    faults.fail("put", substr="MANIFEST", mode="before")
+    # persistent fault: the uploader's RetryPolicy (4 attempts) must
+    # exhaust before the failure surfaces (ISSUE 6: transient faults
+    # retry invisibly; only a dead store goes loud).  times=4 == the
+    # budget, so the post-recovery save below succeeds again.
+    faults.fail("put", substr="MANIFEST", mode="before", times=4)
     with pytest.raises(RuntimeError, match="upload failed"):
         job.run(barriers=1, chunks_per_barrier=1)
     sealed = job.sealed_epoch
     assert sealed > durable
     assert store.committed_epoch("sj") == durable
-    # the npz of the failed epoch is an orphan on disk right now
-    assert store.store.exists(f"sj/epoch_{sealed}.npz")
+    assert job._uploader.retries_total >= 3
+    # the failed epoch's npz was vacuumed WITH the failure (no orphan
+    # lingers while the operator decides what to do)
+    assert not store.store.exists(f"sj/epoch_{sealed}.npz")
 
     job.recover()
     assert job.committed_epoch == durable
