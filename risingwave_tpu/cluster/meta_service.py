@@ -99,6 +99,40 @@ class ServingReplicaInfo:
 
 
 @dataclass
+class PartitionInfo:
+    """One vnode partition of a partitioned job (the scale plane's
+    barrier unit).  ``lineage`` is the partition's checkpoint key in
+    the SHARED store — it survives worker moves (failover re-adopts
+    the lineage on a new worker; scale-in slices it into recipients).
+    Field names mirror ``JobInfo`` so the round protocol drives jobs
+    and partitions through one code path."""
+
+    lineage: str
+    worker_id: int | None = None
+    #: owned vnode ids (current map)
+    vnodes: list = field(default_factory=list)
+    #: lost its last vnode in a handover: no longer a barrier unit,
+    #: but keeps serving reads pinned at PRE-handover rounds until the
+    #: first post-handover commit publishes a new serve plan — then it
+    #: is dropped and released
+    retiring: bool = False
+    #: cluster round this partition has sealed up to
+    rounds: int = 0
+    #: (round, epoch_value) per sealed barrier, round-ascending
+    seal_log: list = field(default_factory=list)
+    pinned_epoch: int = 0
+    #: vnode set at the last cluster commit — reads pinned at that
+    #: round route with THIS set, so a mid-handover read still sees
+    #: every row exactly once
+    pinned_vnodes: list = field(default_factory=list)
+    durable_epoch: int = 0
+
+    @property
+    def name(self) -> str:  # unit key in seal records / pending SSTs
+        return self.lineage
+
+
+@dataclass
 class JobInfo:
     """One placed streaming job (ref TableFragments / StreamingJob).
 
@@ -122,6 +156,49 @@ class JobInfo:
     #: last durable (upload-acked) epoch the worker reported — the
     #: cluster epoch commits only when this catches the round's seal
     durable_epoch: int = 0
+    #: vnode partitions (scale plane) — None = whole-job placement;
+    #: keyed by checkpoint lineage, ONE partition per owning worker
+    partitions: "dict[str, PartitionInfo] | None" = None
+    #: DML tables the job's source reads (replicated worker↔worker)
+    dml_tables: list = field(default_factory=list)
+    #: read-routing plan published ATOMICALLY at each cluster commit:
+    #: [(worker_id, pinned_epoch, vnodes)] — all entries from the SAME
+    #: round, so a fan-out read sees every vnode exactly once even
+    #: while a handover is reshaping the live partition set
+    serve_plan: list | None = None
+
+
+#: SQL aggregate names (the serve router refuses to union these
+#: across partitions — per-partition partials are not the answer)
+_AGG_FUNCS = frozenset({
+    "count", "sum", "sum0", "min", "max", "avg", "stddev_pop",
+    "stddev_samp", "var_pop", "var_samp", "bool_and", "bool_or",
+    "string_agg", "approx_count_distinct",
+})
+
+
+def _select_needs_engine_merge(sel) -> bool:
+    """True when a SELECT over a partitioned MV cannot be answered by
+    unioning per-partition rows (aggregates / GROUP BY / DISTINCT
+    merge rows ACROSS partitions)."""
+    from risingwave_tpu.sql import ast
+
+    if sel.group_by or sel.having is not None \
+            or getattr(sel, "distinct", False):
+        return True
+
+    def has_agg(e) -> bool:
+        if isinstance(e, ast.FuncCall) and e.name in _AGG_FUNCS:
+            return True
+        for a in ("left", "right", "operand", "expr"):
+            v = getattr(e, a, None)
+            if v is not None and has_agg(v):
+                return True
+        return any(has_agg(x) for x in getattr(e, "args", ())
+                   if not isinstance(x, ast.Star))
+
+    return any(has_agg(item.expr) for item in sel.items
+               if not isinstance(item.expr, ast.Star))
 
 
 class MetaService:
@@ -135,7 +212,9 @@ class MetaService:
                  durable_wait_s: float = 15.0,
                  retry_max_attempts: int = 4,
                  retry_base_delay_s: float = 0.05,
-                 retry_max_delay_s: float = 0.5):
+                 retry_max_delay_s: float = 0.5,
+                 n_vnodes: int = 64,
+                 scale_partitioning: bool = False):
         from risingwave_tpu.storage.hummock import (
             CompactorService,
             HummockStorage,
@@ -207,6 +286,25 @@ class MetaService:
         self._server: RpcServer | None = None
         self._monitor: threading.Thread | None = None
         self._stop = threading.Event()
+        # -- elastic scale plane (cluster/scale) -----------------------
+        #: ring size of the global vnode keyspace
+        self.n_vnodes = int(n_vnodes)
+        #: opt-in: place ELIGIBLE jobs as vnode partitions over the
+        #: active worker set (``ctl cluster scale N`` then moves only
+        #: vnodes).  Off = whole-job placement (the pre-scale plane).
+        self.scale_partitioning = bool(scale_partitioning)
+        #: vnode → worker_id (None until the first map is cut)
+        self.vnode_map: list[int] | None = None
+        #: the ACTIVE worker set (capacity follows ``scale N``, not
+        #: registration — spare workers idle until scaled in)
+        self.active_workers: list[int] = []
+        self._next_lineage = 1
+        self._routing_version = 0
+        self.scale_ops = 0
+        #: per-round DML fence cache (a retried round reuses the fence
+        #: its survivors sealed with — cursor alignment across retries)
+        self._fence_round = 0
+        self._fence_cache: dict[str, int] = {}
         #: True when this meta rebuilt jobs from a durable catalog (a
         #: restart) — introspection for operators and chaos asserts
         self.recovered = False
@@ -231,16 +329,48 @@ class MetaService:
         self.recovered = True
         for sql in ddl:
             self.execute_ddl(sql, replay=True)
+        # scale plane: the last scale event restores the vnode map and
+        # each partitioned job's lineage layout.  Worker ids in the map
+        # are STALE (a restarted meta hands out fresh ids) — every
+        # partition comes back unassigned and ``_assign_pending``
+        # re-adopts its lineage (recover=True) on re-registered
+        # workers, re-pointing the map as it goes.
+        ev = self.store.last_scale_event()
+        if ev is not None:
+            self.scale_partitioning = True
+            self.n_vnodes = int(ev.get("n_vnodes", self.n_vnodes))
+            self.vnode_map = [int(w) for w in ev["map"]] \
+                if ev.get("map") else None
+            self._next_lineage = int(ev.get("next_lineage", 1))
+            for jname, parts in (ev.get("partitions") or {}).items():
+                job = self.jobs.get(jname)
+                if job is None:
+                    continue
+                job.partitions = {
+                    p["lineage"]: PartitionInfo(
+                        lineage=p["lineage"],
+                        worker_id=None,
+                        vnodes=[int(v) for v in p["vnodes"]],
+                    )
+                    for p in parts
+                }
+                job.dml_tables = list(ev.get("dml_tables", {})
+                                      .get(jname, []))
         rec = self.store.last_cluster_commit()
         if rec is None:
             return
         self.cluster_epoch = int(rec["round"])
         for job in self.jobs.values():
-            seal = rec["seals"].get(job.name)
             job.rounds = self.cluster_epoch
-            if seal is not None:
-                job.seal_log = [(self.cluster_epoch, int(seal))]
-                job.pinned_epoch = int(seal)
+            for unit in (job.partitions.values() if job.partitions
+                         else [job]):
+                seal = rec["seals"].get(unit.name)
+                unit.rounds = self.cluster_epoch
+                if seal is not None:
+                    unit.seal_log = [(self.cluster_epoch, int(seal))]
+                    unit.pinned_epoch = int(seal)
+                    if unit is not job:
+                        unit.pinned_vnodes = list(unit.vnodes)
         self.metrics.set_gauge("cluster_epoch_committed",
                                self.cluster_epoch)
         self.metrics.set_gauge("cluster_manifest_epoch",
@@ -313,6 +443,32 @@ class MetaService:
             w.last_seen = time.monotonic()
         return {"ok": True, "cluster_epoch": self.cluster_epoch}
 
+    def rpc_unregister_worker(self, worker_id: int) -> dict:
+        """Graceful deregistration (scale-in decommission, orderly
+        shutdown): the worker leaves the registry ENTIRELY — jobs
+        reassign exactly like a death, and every per-worker metric
+        series is retired so the scrape surface reflects the live
+        membership, not tombstones."""
+        with self._lock:
+            w = self.workers.get(int(worker_id))
+        if w is None:
+            return {"ok": True, "known": False}
+        self._on_worker_dead(w)
+        with self._lock:
+            self.workers.pop(w.worker_id, None)
+            self._remove_worker_series(w.worker_id)
+            self._set_worker_gauges()
+        self._push_routing()
+        return {"ok": True, "known": True}
+
+    def _remove_worker_series(self, worker_id: int) -> None:
+        """Retire EVERY per-worker labeled series of one worker (death
+        or deregistration) — stale gauges must not linger forever on
+        the scrape surface."""
+        for name in ("cluster_worker_heartbeat_age_seconds",
+                     "cluster_worker_vnodes"):
+            self.metrics.remove_series(name, worker=str(worker_id))
+
     def live_workers(self) -> list[WorkerInfo]:
         with self._lock:
             return [w for w in self.workers.values() if w.alive]
@@ -365,8 +521,13 @@ class MetaService:
             self._on_worker_dead(w)
         for r in stale_serving:
             self._on_serving_dead(r)
-        if expired or any(j.worker_id is None
-                          for j in self.jobs.values()):
+        pending = any(
+            (j.worker_id is None if j.partitions is None
+             else any(p.worker_id is None
+                      for p in j.partitions.values()))
+            for j in self.jobs.values()
+        )
+        if expired or pending:
             self._assign_pending()
 
     def _on_serving_dead(self, r: ServingReplicaInfo) -> None:
@@ -397,12 +558,18 @@ class MetaService:
                 w.alive = False
                 self.failovers += 1
                 self.metrics.inc("cluster_failovers_total")
-                self.metrics.remove_series(
-                    "cluster_worker_heartbeat_age_seconds",
-                    worker=str(w.worker_id),
-                )
+                self._remove_worker_series(w.worker_id)
                 for name in list(w.jobs):
-                    self.jobs[name].worker_id = None
+                    job = self.jobs[name]
+                    if job.partitions:
+                        # the partition's LINEAGE survives in the
+                        # shared store; _assign_pending re-adopts it
+                        # (state + vnodes) on a free worker
+                        for p in job.partitions.values():
+                            if p.worker_id == w.worker_id:
+                                p.worker_id = None
+                    else:
+                        job.worker_id = None
                 w.jobs.clear()
                 # allocated-but-never-sealed export keys become
                 # vacuumable orphans; keys already riding a sealed
@@ -551,7 +718,14 @@ class MetaService:
 
         for mv, jname in self._mv_to_job.items():
             if re.search(rf"\b{re.escape(mv)}\b", text):
-                return self.jobs[jname]
+                job = self.jobs[jname]
+                if job.partitions:
+                    raise ValueError(
+                        f"MV-on-MV over partitioned job {jname!r}: "
+                        "next round (attach would need a cross-"
+                        "partition exchange)"
+                    )
+                return job
         return None
 
     def _place_job(self, text: str, name: str,
@@ -594,7 +768,23 @@ class MetaService:
     def _forward_dml(self, text: str, table: str) -> None:
         """INSERTs fan out to every worker whose catalog has the table
         (each job's private reader consumes its worker-local history —
-        the same per-job readers a single node plans)."""
+        the same per-job readers a single node plans).  A table a
+        PARTITIONED job reads routes to its ingest LEADER instead —
+        the leader fans the position-stamped batch out worker↔worker,
+        so the meta stays one control hop, never the data path."""
+        self.metrics.inc("cluster_dml_forward_total")
+        leader = self._table_leader(table)
+        if leader is not None:
+            with self._lock:
+                w = self.workers.get(leader)
+            if w is None or not w.alive:
+                raise ValueError(
+                    f"INSERT into {table!r}: ingest leader "
+                    f"{leader} is not live"
+                )
+            w.client.call("execute", sql=text)
+            self.store.append_dml_sql(text)
+            return
         delivered = 0
         for w in self.live_workers():
             try:
@@ -618,16 +808,46 @@ class MetaService:
         self.store.append_dml_sql(text)
 
     def _assign_pending(self) -> None:
-        """Place every unassigned job on the least-loaded live worker;
-        adoption recovers the job from its last durable checkpoint."""
+        """Place pending barrier units: unassigned vnode PARTITIONS
+        re-adopt their checkpoint lineage on a free worker (failover /
+        meta restart — state AND vnode ownership follow the lineage),
+        fresh jobs take partitioned placement over the vnode map when
+        the scale plane is on and the plan is eligible, and everything
+        else lands whole on the least-loaded live worker."""
         while True:
             with self._lock:
-                pending = [j for j in self.jobs.values()
-                           if j.worker_id is None]
                 live = [w for w in self.workers.values() if w.alive]
-                if not pending or not live:
+                part_pending = [
+                    (j, p) for j in self.jobs.values() if j.partitions
+                    for p in j.partitions.values()
+                    if p.worker_id is None and not p.retiring
+                ]
+                job_pending = [j for j in self.jobs.values()
+                               if j.partitions is None
+                               and j.worker_id is None]
+                if not live or not (part_pending or job_pending):
                     return
-                job = pending[0]
+            if part_pending:
+                if not self._assign_partition(*part_pending[0]):
+                    return
+                continue
+            job = job_pending[0]
+            if self.scale_partitioning:
+                placed = self._try_partition_place(job)
+                if placed:
+                    continue
+                with self._lock:
+                    if job.worker_id is not None or job.partitions:
+                        continue
+            with self._lock:
+                live = [w for w in self.workers.values() if w.alive]
+                if not live:
+                    return
+                # capacity follows the ACTIVE set once a map was cut
+                if self.active_workers:
+                    active = [w for w in live
+                              if w.worker_id in self.active_workers]
+                    live = active or live
                 target = min(live,
                              key=lambda w: (len(w.jobs), w.worker_id))
             try:
@@ -652,6 +872,136 @@ class MetaService:
                 job.worker_id = target.worker_id
                 target.jobs.add(job.name)
                 self._rewind_job(job, recovered)
+
+    def _assign_partition(self, job: JobInfo,
+                          p: "PartitionInfo") -> bool:
+        """Re-adopt one unassigned partition's LINEAGE on a live
+        worker not already hosting this job: the worker recovers the
+        partition's state + cursors from the shared checkpoint store
+        and the vnode map re-points — failover is lineage migration,
+        no state is recomputed."""
+        with self._lock:
+            taken = {q.worker_id for q in job.partitions.values()
+                     if q.worker_id is not None}
+            cands = [w for w in self.workers.values()
+                     if w.alive and w.worker_id not in taken]
+            if not cands:
+                return False  # every live worker already hosts one
+            target = min(cands, key=lambda w: (len(w.jobs),
+                                               w.worker_id))
+        try:
+            res = self.retry.run(
+                lambda: target.client.call(
+                    "adopt", ddl=job.ddl, name=job.name,
+                    recover=True, vnodes=sorted(p.vnodes),
+                    n_vnodes=self.n_vnodes, ckpt_key=p.lineage,
+                ),
+                label="adopt",
+            )
+        except (RpcError, ConnectionError, OSError):
+            return False
+        if not res.get("partitioned"):
+            return False  # deterministic plans: should not happen
+        with self._lock:
+            if p.worker_id is not None:
+                return True  # raced
+            p.worker_id = target.worker_id
+            target.jobs.add(job.name)
+            if self.vnode_map is not None:
+                for v in p.vnodes:
+                    self.vnode_map[v] = target.worker_id
+            if res.get("dml_tables"):
+                job.dml_tables = list(res["dml_tables"])
+            self._rewind_job(p, int(res.get("committed_epoch", 0)))
+        self._push_routing()
+        self._set_vnode_gauges()
+        return True
+
+    def _try_partition_place(self, job: JobInfo) -> bool:
+        """Fresh partitioned placement: adopt one partition per vnode
+        map owner.  The FIRST owner probes plan eligibility — a
+        refusal falls back to whole-job placement on that worker (the
+        job is already adopted there)."""
+        from risingwave_tpu.cluster.scale.vnode import (
+            initial_map,
+            owned_vnodes,
+        )
+
+        with self._lock:
+            live = {w.worker_id: w for w in self.workers.values()
+                    if w.alive}
+            if not live:
+                return False
+            if self.vnode_map is None:
+                self.active_workers = sorted(live)
+                self.vnode_map = initial_map(self.active_workers,
+                                             self.n_vnodes)
+            owners = sorted(set(self.vnode_map))
+            if any(o not in live for o in owners):
+                return False  # owner mid-failover: retry later
+            vmap = list(self.vnode_map)
+        placements = []
+        for wid in owners:
+            with self._lock:
+                lineage = f"{job.name}::p{self._next_lineage}"
+                self._next_lineage += 1
+            placements.append((wid, lineage, owned_vnodes(vmap, wid)))
+        first_wid, first_lineage, first_vns = placements[0]
+        first_w = live[first_wid]
+        try:
+            res = self.retry.run(
+                lambda: first_w.client.call(
+                    "adopt", ddl=job.ddl, name=job.name,
+                    recover=False, vnodes=first_vns,
+                    n_vnodes=self.n_vnodes, ckpt_key=first_lineage,
+                ),
+                label="adopt",
+            )
+        except (RpcError, ConnectionError, OSError):
+            return False
+        if not res.get("partitioned"):
+            # plan not scale-eligible: the probe adoption IS a valid
+            # whole-job placement — keep it
+            with self._lock:
+                job.worker_id = first_wid
+                first_w.jobs.add(job.name)
+            return True
+        with self._lock:
+            job.partitions = {
+                first_lineage: PartitionInfo(
+                    lineage=first_lineage, worker_id=first_wid,
+                    vnodes=list(first_vns), rounds=self.cluster_epoch,
+                )
+            }
+            job.dml_tables = list(res.get("dml_tables") or [])
+            first_w.jobs.add(job.name)
+        for wid, lineage, vns in placements[1:]:
+            w = live[wid]
+            with self._lock:
+                job.partitions[lineage] = PartitionInfo(
+                    lineage=lineage, worker_id=None,
+                    vnodes=list(vns), rounds=self.cluster_epoch,
+                )
+            try:
+                self.retry.run(
+                    lambda w=w, vns=vns, lineage=lineage:
+                    w.client.call(
+                        "adopt", ddl=job.ddl, name=job.name,
+                        recover=False, vnodes=vns,
+                        n_vnodes=self.n_vnodes, ckpt_key=lineage,
+                    ),
+                    label="adopt",
+                )
+            except (RpcError, ConnectionError, OSError):
+                continue  # stays unassigned; _assign_pending retries
+            with self._lock:
+                job.partitions[lineage].worker_id = wid
+                job.partitions[lineage].rounds = self.cluster_epoch
+                w.jobs.add(job.name)
+        self._log_scale_event()
+        self._push_routing()
+        self._set_vnode_gauges()
+        return True
 
     def _rewind_job(self, job: JobInfo, epoch: int) -> None:
         """Translate a recovered committed epoch back into the round
@@ -686,103 +1036,468 @@ class MetaService:
             job.seal_log = job.seal_log[:i]
             job.rounds = job.seal_log[-1][0] if job.seal_log else 0
 
+    # -- the elastic scale plane ------------------------------------------
+    def rpc_cluster_scale(self, n: int) -> dict:
+        return self.scale(int(n))
+
+    def scale(self, n: int) -> dict:
+        """``ctl cluster scale N``: resize the ACTIVE worker set to the
+        N lowest-id live workers and rebalance the vnode map minimally
+        (only moved vnodes — and the state behind them — transfer).
+
+        Protocol, under the tick lock (no rounds in flight):
+
+        1. drive one COMMITTED round — every partition is sealed AND
+           durable at the handover epoch, and since nothing runs
+           between that commit and the handover, live state == the
+           checkpoint at that epoch everywhere;
+        2. compute the new map (``scale.vnode.rebalance``: ±1
+           balanced, minimal movement, deterministic);
+        3. per partitioned job: recipients transplant each donor's
+           checkpoint SLICE (only moved vnodes leave disk), donors
+           narrow their gate mask, empty donors are released;
+        4. durably log the scale event, re-push peer routing;
+        5. drive one more committed round so serving pins (and their
+           pinned vnode sets) move past the handover — reads stay
+           zero-error throughout.
+
+        Retry-safe: a failed handover leaves the map uncut; re-running
+        ``scale`` re-applies the same transfers against the same
+        checkpoints."""
+        with self._tick_lock:
+            return self._scale_locked(int(n))
+
+    def _scale_locked(self, n: int) -> dict:
+        from risingwave_tpu.cluster.scale.vnode import (
+            initial_map,
+            moved_vnodes,
+            rebalance,
+        )
+
+        with self._lock:
+            live = sorted(w.worker_id for w in self.workers.values()
+                          if w.alive)
+        if n < 1 or n > len(live):
+            raise ValueError(
+                f"scale {n}: cluster has {len(live)} live workers "
+                "(register more first)"
+            )
+        active = live[:n]
+        if self.vnode_map is None:
+            # first scale cuts the initial map; jobs placed afterwards
+            # partition over it
+            self.scale_partitioning = True
+            self.vnode_map = initial_map(active, self.n_vnodes)
+            self.active_workers = active
+            self._log_scale_event()
+            self._push_routing()
+            self._set_vnode_gauges()
+            return {"active": active, "moved_vnodes": 0,
+                    "map_initialized": True}
+        # 1. the handover anchor round
+        self._drive_committed_round()
+        handover_round = self.cluster_epoch
+        old_map = list(self.vnode_map)
+        new_map = rebalance(old_map, active, self.n_vnodes)
+        moved = moved_vnodes(old_map, new_map)
+        transfers = []
+        with self._lock:
+            part_jobs = [j for j in self.jobs.values() if j.partitions]
+        for job in part_jobs:
+            transfers += self._handover_job(job, new_map, moved,
+                                            handover_round)
+        self.vnode_map = new_map
+        self.active_workers = active
+        self.scale_ops += 1
+        moved_count = sum(len(v) for v in moved.values())
+        self.metrics.inc("cluster_scale_ops_total")
+        self.metrics.inc("cluster_scale_moved_vnodes_total",
+                         moved_count)
+        self._log_scale_event()
+        self._push_routing()
+        self._set_vnode_gauges()
+        # 2. move whole (non-partitioned) jobs off inactive workers
+        self._evacuate_inactive(set(active))
+        # 3. serving pins move past the handover
+        post = self._drive_committed_round()
+        return {
+            "active": active,
+            "handover_round": handover_round,
+            "committed_round": post["cluster_epoch"],
+            "moved_vnodes": moved_count,
+            "moved": {f"{s}>{d}": len(v)
+                      for (s, d), v in moved.items()},
+            "transfers": transfers,
+        }
+
+    def _drive_committed_round(self, timeout_s: float = 120.0) -> dict:
+        deadline = time.monotonic() + timeout_s
+        while True:
+            res = self._tick_locked(1)
+            if res["committed"] or res.get("units", res["jobs"]) == 0:
+                return res
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"scale: round {res['round']} never committed "
+                    f"({res['sealed']}/{res.get('units')} sealed)"
+                )
+            time.sleep(0.05)
+
+    def _handover_job(self, job: JobInfo, new_map: list[int],
+                      moved: dict, handover_round: int) -> list[dict]:
+        """Apply one scale step to a partitioned job: transplant moved
+        slices into recipients (existing partitions merge in place;
+        fresh workers adopt a NEW lineage built purely from
+        transfers), then narrow/release donors."""
+        from risingwave_tpu.cluster.scale.vnode import owned_vnodes
+
+        with self._lock:
+            by_worker = {p.worker_id: p
+                         for p in job.partitions.values()
+                         if not p.retiring}
+            seal_at = {}
+            for p in by_worker.values():
+                if not p.seal_log \
+                        or p.seal_log[-1][0] != handover_round:
+                    raise RuntimeError(
+                        f"scale: partition {p.lineage} not sealed at "
+                        f"round {handover_round}"
+                    )
+                seal_at[p.worker_id] = p.seal_log[-1][1]
+        gains: dict[int, list] = {}
+        for (src, dst), vns in moved.items():
+            if src not in by_worker:
+                continue  # vnode owned by a worker without this job
+            gains.setdefault(dst, []).append((src, vns))
+        stats = []
+        for dst, srcs in gains.items():
+            new_set = owned_vnodes(new_map, dst)
+            xfers = [{"ckpt": by_worker[src].lineage,
+                      "epoch": seal_at[src], "vnodes": vns}
+                     for src, vns in srcs]
+            with self._lock:
+                w = self.workers.get(dst)
+            if w is None or not w.alive:
+                raise RuntimeError(f"scale: recipient {dst} is dead")
+            p = by_worker.get(dst)
+            if p is None:
+                with self._lock:
+                    lineage = f"{job.name}::p{self._next_lineage}"
+                    self._next_lineage += 1
+                self.retry.run(
+                    lambda: w.client.call(
+                        "adopt", ddl=job.ddl, name=job.name,
+                        recover=False, vnodes=[],
+                        n_vnodes=self.n_vnodes, ckpt_key=lineage,
+                    ),
+                    label="adopt",
+                )
+                p = PartitionInfo(lineage=lineage, worker_id=dst,
+                                  rounds=handover_round)
+                with self._lock:
+                    job.partitions[lineage] = p
+                    w.jobs.add(job.name)
+            res = self.retry.run(
+                lambda: w.client.call(
+                    "repartition", job=job.name, vnodes=new_set,
+                    transfers=xfers,
+                ),
+                label="repartition",
+            )
+            with self._lock:
+                p.vnodes = list(new_set)
+            stats.append({"job": job.name, "worker": dst,
+                          "gained": sum(len(v) for _, v in srcs),
+                          "entries": sum(t["entries"]
+                                         for t in res["transfers"]),
+                          "transfers": res["transfers"]})
+        # donors narrow (or RETIRE: keep serving pre-handover pins
+        # until the post-handover commit publishes the new serve plan)
+        donor_ids = {src for (src, _dst) in moved if src in by_worker}
+        for src in sorted(donor_ids):
+            p = by_worker[src]
+            new_set = owned_vnodes(new_map, src)
+            with self._lock:
+                w = self.workers.get(src)
+            if not new_set:
+                with self._lock:
+                    p.retiring = True
+                continue
+            if w is None or not w.alive:
+                raise RuntimeError(f"scale: donor {src} is dead")
+            self.retry.run(
+                lambda: w.client.call(
+                    "repartition", job=job.name, vnodes=new_set,
+                    transfers=[],
+                ),
+                label="repartition",
+            )
+            with self._lock:
+                p.vnodes = list(new_set)
+        return stats
+
+    def _evacuate_inactive(self, active: set[int]) -> None:
+        """Whole-job placements follow capacity too: jobs on workers
+        outside the active set go back to pending and re-adopt (from
+        their durable checkpoint) on an active worker."""
+        with self._lock:
+            for job in self.jobs.values():
+                if job.partitions is not None \
+                        or job.worker_id is None \
+                        or job.worker_id in active:
+                    continue
+                w = self.workers.get(job.worker_id)
+                if w is not None:
+                    w.jobs.discard(job.name)
+                job.worker_id = None
+        self._assign_pending()
+
+    def _log_scale_event(self) -> None:
+        """Durably record the scale plane's layout (map + partition
+        lineages) — a restarted meta replays the tail event and
+        re-adopts every lineage (see ``_recover_from_store``)."""
+        with self._lock:
+            ev = {
+                "round": self.cluster_epoch,
+                "n_vnodes": self.n_vnodes,
+                "map": list(self.vnode_map or []),
+                "active": list(self.active_workers),
+                "next_lineage": self._next_lineage,
+                "partitions": {
+                    j.name: [{"lineage": p.lineage,
+                              "vnodes": list(p.vnodes)}
+                             for p in j.partitions.values()
+                             if not p.retiring]
+                    for j in self.jobs.values() if j.partitions
+                },
+                "dml_tables": {
+                    j.name: list(j.dml_tables)
+                    for j in self.jobs.values() if j.partitions
+                },
+            }
+        self.store.append_scale_event(ev)
+
+    def _push_routing(self) -> None:
+        """Push the placement choreography to every live worker: peer
+        addresses + per-replicated-table hosts and ingest leader.  The
+        per-chunk exchange then flows worker↔worker — the meta's only
+        involvement with the data path is this control push."""
+        with self._lock:
+            self._routing_version += 1
+            version = self._routing_version
+            peers = {w.worker_id: [w.host, w.port]
+                     for w in self.workers.values() if w.alive}
+            tables: dict[str, dict] = {}
+            for j in self.jobs.values():
+                if not j.partitions:
+                    continue
+                hosts = sorted({p.worker_id
+                                for p in j.partitions.values()
+                                if p.worker_id is not None})
+                if not hosts:
+                    continue
+                for t in j.dml_tables:
+                    cur = tables.setdefault(
+                        t, {"leader": hosts[0], "hosts": []}
+                    )
+                    cur["hosts"] = sorted(set(cur["hosts"]) | set(hosts))
+                    cur["leader"] = min(cur["hosts"])
+            targets = [w for w in self.workers.values() if w.alive]
+        for w in targets:
+            try:
+                w.client.call("update_routing", version=version,
+                              peers=peers, tables=tables)
+            except (RpcError, ConnectionError, OSError):
+                pass  # it pulls fresh routing at re-registration
+
+    def _set_vnode_gauges(self) -> None:
+        with self._lock:
+            vmap = self.vnode_map or []
+            counts: dict[int, int] = {}
+            for wid in vmap:
+                counts[wid] = counts.get(wid, 0) + 1
+            for w in self.workers.values():
+                if w.alive:
+                    self.metrics.set_gauge(
+                        "cluster_worker_vnodes",
+                        counts.get(w.worker_id, 0),
+                        worker=str(w.worker_id),
+                    )
+
     # -- the global checkpoint protocol ---------------------------------
     def rpc_tick(self, chunks_per_barrier: int = 1) -> dict:
         return self.tick(chunks_per_barrier)
 
-    def tick(self, chunks_per_barrier: int = 1) -> dict:
-        """Drive ONE global barrier round: every job SEALS round
-        ``cluster_epoch + 1`` (the barrier RPC returns as soon as the
-        epoch is sealed — its checkpoint upload runs in the worker's
-        background uploader); the cluster epoch commits through the
-        versioned manifest only when every job's upload has ACKED the
-        sealed epoch.  Incomplete rounds (dead/unassigned workers,
-        uploads still in flight) commit nothing — the cluster epoch
-        never moves past a hole, and survivors run at most one round
-        ahead."""
-        t0 = time.perf_counter()
-        with self._tick_lock:
-            target = self.cluster_epoch + 1
-            with self._lock:
-                jobs = list(self.jobs.values())
-            if not jobs:
-                return {"round": target, "committed": False,
-                        "jobs": 0, "sealed": 0}
-            self.metrics.set_gauge("cluster_epoch_in_flight", target)
-            sealed = 0
-            for job in jobs:
-                if job.rounds >= target:
-                    sealed += 1
+    def _barrier_units(self, jobs: list[JobInfo]):
+        """The round's barrier units: (job, unit) pairs where ``unit``
+        is the JobInfo itself (whole-job placement) or each of its
+        vnode partitions — both carry the same round-protocol fields,
+        so the seal/durable/commit path below drives either."""
+        units = []
+        for job in jobs:
+            if job.partitions:
+                units += [(job, p) for p in job.partitions.values()
+                          if not p.retiring]
+            else:
+                units.append((job, job))
+        return units
+
+    def _round_fences(self, jobs: list[JobInfo]) -> dict:
+        """Per-table consumption fences for this round: the ingest
+        leader's current history position.  Every partition of a job
+        consumes the IDENTICAL prefix up to the fence, so source
+        cursors stay aligned across workers (what makes
+        checkpoint-slice handover exact).  One control RPC per
+        replicated table per round — the per-chunk data path stays
+        worker↔worker."""
+        fences: dict[str, int] = {}
+        for job in jobs:
+            if not job.partitions:
+                continue
+            for t in job.dml_tables:
+                if t in fences:
                     continue
-                with self._lock:
-                    w = self.workers.get(job.worker_id) \
-                        if job.worker_id is not None else None
+                cached = self._fence_cache.get(t)
+                if cached is not None:
+                    fences[t] = cached
+                    continue
+                leader = self._table_leader(t)
+                w = self.workers.get(leader) \
+                    if leader is not None else None
                 if w is None or not w.alive:
                     continue
                 try:
-                    # round-tagged: the worker caches each job's last
-                    # (round, seal) and answers a replay from the
-                    # cache, so retrying after a lost RESPONSE cannot
-                    # run the round twice (epoch-guarded idempotence)
                     res = self.retry.run(
-                        lambda: w.client.call(
-                            "barrier", job=job.name,
-                            chunks=int(chunks_per_barrier),
-                            round=target,
-                        ),
-                        label="barrier",
+                        lambda: w.client.call("table_len", table=t),
+                        label="table_len",
                     )
+                    fences[t] = int(res["len"])
                 except (RpcError, ConnectionError, OSError):
-                    continue  # monitor expires the worker; round stalls
-                epoch = int(res.get("sealed_epoch",
-                                    res["committed_epoch"]))
-                ssts = res.get("ssts") or []
-                with self._lock:
-                    job.rounds = target
-                    job.seal_log.append((target, epoch))
-                    job.durable_epoch = int(
-                        res.get("durable_epoch", epoch)
-                    )
-                    # a failover re-seal replaces the dead attempt's
-                    # pending export (same round, recomputed bytes)
-                    for s in self._pending_ssts.pop((job.name, target),
-                                                    []):
-                        self.hummock.release_external_sst_key(s["key"])
-                    if ssts:
-                        self._pending_ssts[(job.name, target)] = ssts
-                        w.sst_keys.difference_update(
-                            {s["key"] for s in ssts}
-                        )
-                sealed += 1
-            committed = sealed == len(jobs) \
-                and self._await_durable(jobs, target)
-            if committed:
-                self._commit_cluster_epoch(target, jobs)
-                self.metrics.observe(
-                    "cluster_barrier_commit_seconds",
-                    time.perf_counter() - t0,
-                )
-            self._export_fault_gauges()
-            return {"round": target, "committed": committed,
-                    "jobs": len(jobs), "sealed": sealed,
-                    "cluster_epoch": self.cluster_epoch}
+                    continue  # round stalls for this job's partitions
+        return fences
 
-    def _await_durable(self, jobs: list[JobInfo], target: int) -> bool:
-        """The seal-vs-ack split: poll each sealed job's worker until
+    def _table_leader(self, table: str) -> int | None:
+        with self._lock:
+            hosts = sorted({
+                p.worker_id
+                for j in self.jobs.values() if j.partitions
+                and table in j.dml_tables
+                for p in j.partitions.values()
+                if p.worker_id is not None
+            })
+        return hosts[0] if hosts else None
+
+    def tick(self, chunks_per_barrier: int = 1) -> dict:
+        with self._tick_lock:
+            return self._tick_locked(chunks_per_barrier)
+
+    def _tick_locked(self, chunks_per_barrier: int = 1) -> dict:
+        """Drive ONE global barrier round: every barrier unit (job or
+        vnode partition) SEALS round ``cluster_epoch + 1`` (the
+        barrier RPC returns as soon as the epoch is sealed — its
+        checkpoint upload runs in the worker's background uploader);
+        the cluster epoch commits through the versioned manifest only
+        when every unit's upload has ACKED the sealed epoch.
+        Incomplete rounds (dead/unassigned workers, uploads still in
+        flight) commit nothing — the cluster epoch never moves past a
+        hole, and survivors run at most one round ahead."""
+        t0 = time.perf_counter()
+        target = self.cluster_epoch + 1
+        with self._lock:
+            jobs = list(self.jobs.values())
+        units = self._barrier_units(jobs)
+        if not units:
+            return {"round": target, "committed": False,
+                    "jobs": 0, "sealed": 0}
+        self.metrics.set_gauge("cluster_epoch_in_flight", target)
+        # consumption fences are PER ROUND: a retried round (worker
+        # failure mid-round) reuses the fence its survivors already
+        # sealed with, so a re-adopted partition consumes the same
+        # prefix and cursors stay aligned
+        if self._fence_round != target:
+            self._fence_round = target
+            self._fence_cache = {}
+        fences = self._round_fences(jobs)
+        self._fence_cache.update(fences)
+        sealed = 0
+        for job, unit in units:
+            if unit.rounds >= target:
+                sealed += 1
+                continue
+            with self._lock:
+                w = self.workers.get(unit.worker_id) \
+                    if unit.worker_id is not None else None
+            if w is None or not w.alive:
+                continue
+            limits = {t: fences[t] for t in job.dml_tables
+                      if t in fences} if job.partitions else None
+            if job.partitions and job.dml_tables and not limits:
+                continue  # fence unavailable: stall, never diverge
+            try:
+                # round-tagged: the worker caches each job's last
+                # (round, seal) and answers a replay from the
+                # cache, so retrying after a lost RESPONSE cannot
+                # run the round twice (epoch-guarded idempotence)
+                res = self.retry.run(
+                    lambda: w.client.call(
+                        "barrier", job=job.name,
+                        chunks=int(chunks_per_barrier),
+                        round=target, limits=limits,
+                    ),
+                    label="barrier",
+                )
+            except (RpcError, ConnectionError, OSError):
+                continue  # monitor expires the worker; round stalls
+            epoch = int(res.get("sealed_epoch",
+                                res["committed_epoch"]))
+            ssts = res.get("ssts") or []
+            with self._lock:
+                unit.rounds = target
+                unit.seal_log.append((target, epoch))
+                unit.durable_epoch = int(
+                    res.get("durable_epoch", epoch)
+                )
+                # a failover re-seal replaces the dead attempt's
+                # pending export (same round, recomputed bytes)
+                for s in self._pending_ssts.pop((unit.name, target),
+                                                []):
+                    self.hummock.release_external_sst_key(s["key"])
+                if ssts:
+                    self._pending_ssts[(unit.name, target)] = ssts
+                    w.sst_keys.difference_update(
+                        {s["key"] for s in ssts}
+                    )
+            sealed += 1
+        committed = sealed == len(units) \
+            and self._await_durable(units, target)
+        if committed:
+            self._commit_cluster_epoch(target, units)
+            self.metrics.observe(
+                "cluster_barrier_commit_seconds",
+                time.perf_counter() - t0,
+            )
+        self._export_fault_gauges()
+        return {"round": target, "committed": committed,
+                "jobs": len(jobs), "units": len(units),
+                "sealed": sealed,
+                "cluster_epoch": self.cluster_epoch}
+
+    def _await_durable(self, units, target: int) -> bool:
+        """The seal-vs-ack split: poll each sealed unit's worker until
         its durable (upload-acked) epoch reaches the round's seal, or
         the bounded wait expires (round retried by the next tick)."""
         deadline = time.monotonic() + self.durable_wait_s
-        for job in jobs:
+        for job, unit in units:
             with self._lock:
-                if not job.seal_log:
+                if not unit.seal_log:
                     return False
-                want = job.seal_log[-1][1]
-                w = self.workers.get(job.worker_id) \
-                    if job.worker_id is not None else None
+                want = unit.seal_log[-1][1]
+                w = self.workers.get(unit.worker_id) \
+                    if unit.worker_id is not None else None
             lag_gauge = lambda v: self.metrics.set_gauge(  # noqa: E731
-                "cluster_job_durable_lag_epochs", v, job=job.name,
+                "cluster_job_durable_lag_epochs", v, job=unit.name,
             )
-            if job.durable_epoch >= want:
+            if unit.durable_epoch >= want:
                 lag_gauge(0)
                 continue
             if w is None or not w.alive:
@@ -798,29 +1513,28 @@ class MetaService:
                 except (RpcError, ConnectionError, OSError):
                     return False
                 with self._lock:
-                    job.durable_epoch = int(res.get("durable", 0))
-                lag_gauge(max(0, want - job.durable_epoch))
+                    unit.durable_epoch = int(res.get("durable", 0))
+                lag_gauge(max(0, want - unit.durable_epoch))
                 self.metrics.set_gauge(
                     "cluster_job_upload_queue_depth",
-                    int(res.get("upload_queue", 0)), job=job.name,
+                    int(res.get("upload_queue", 0)), job=unit.name,
                 )
-                if job.durable_epoch >= want:
+                if unit.durable_epoch >= want:
                     break
                 if time.monotonic() > deadline:
                     return False
                 time.sleep(0.02)
         return True
 
-    def _commit_cluster_epoch(self, round_: int,
-                              jobs: list[JobInfo]) -> None:
-        """All jobs sealed ``round_``: ONE manifest delta records the
+    def _commit_cluster_epoch(self, round_: int, units) -> None:
+        """All units sealed ``round_``: ONE manifest delta records the
         global consistency point — carrying every MV export SST the
         round's seals uploaded (newest round first, so L0 reader order
         stays newest-first) — then serving pins move forward: a
         snapshot read after this sees every MV at the same round."""
         from risingwave_tpu.storage.hummock.version import SstInfo
 
-        epoch_val = min(j.seal_log[-1][1] for j in jobs)
+        epoch_val = min(u.seal_log[-1][1] for _, u in units)
         with self._lock:
             due = sorted(
                 [k for k in self._pending_ssts if k[1] <= round_],
@@ -844,16 +1558,48 @@ class MetaService:
         # delta, same epoch stamp) — never a lost or double round
         self.store.append_cluster_commit(
             round_, epoch_val,
-            {j.name: j.seal_log[-1][1] for j in jobs},
+            {u.name: u.seal_log[-1][1] for _, u in units},
         )
+        retired: list[tuple[int, str]] = []
         with self._lock:
             self.cluster_epoch = round_
-            for j in jobs:
-                j.pinned_epoch = j.seal_log[-1][1]
+            plans: dict[str, list] = {}
+            for job, u in units:
+                job.rounds = round_
+                u.pinned_epoch = u.seal_log[-1][1]
+                if u is not job:
+                    # reads pinned at this round route with the vnode
+                    # set of this round — consistent through handover
+                    u.pinned_vnodes = list(u.vnodes)
+                    plans.setdefault(job.name, []).append(
+                        (u.worker_id, u.pinned_epoch, list(u.vnodes))
+                    )
                 # seal_log only needs entries recovery can rewind to;
                 # everything at/before the global commit is final
-                if len(j.seal_log) > 64:
-                    j.seal_log = j.seal_log[-64:]
+                if len(u.seal_log) > 64:
+                    u.seal_log = u.seal_log[-64:]
+            for job, _ in units:
+                if job.name in plans:
+                    # the ATOMIC routing switch: fan-out reads now see
+                    # this round's owners/vnodes — never a mixed-round
+                    # union; retiring donors are safe to drop
+                    job.serve_plan = plans[job.name]
+                    for p in [p for p in job.partitions.values()
+                              if p.retiring]:
+                        job.partitions.pop(p.lineage, None)
+                        if p.worker_id is not None:
+                            retired.append((p.worker_id, job.name))
+                            w = self.workers.get(p.worker_id)
+                            if w is not None:
+                                w.jobs.discard(job.name)
+        for wid, jname in retired:
+            with self._lock:
+                w = self.workers.get(wid)
+            if w is not None and w.alive:
+                try:
+                    w.client.call("release", job=jname)
+                except (RpcError, ConnectionError, OSError):
+                    pass  # best-effort; the idle partition is inert
         self.metrics.set_gauge("cluster_epoch_committed", round_)
         self.metrics.set_gauge("cluster_manifest_epoch", epoch_val)
 
@@ -893,6 +1639,8 @@ class MetaService:
                 if jname is None:
                     raise ValueError(f"{mv!r} is not a placed MV")
                 job = self.jobs[jname]
+                parts = list(job.partitions.values()) \
+                    if job.partitions else None
                 w = self.workers.get(job.worker_id) \
                     if job.worker_id is not None else None
                 pin = job.pinned_epoch
@@ -900,6 +1648,13 @@ class MetaService:
                 replicas = [r for r in self.serving.values() if r.alive]
                 self._serve_rr += 1
                 start = self._serve_rr
+            if parts is not None and _select_needs_engine_merge(sel):
+                # per-partition results of an aggregate-shaped SELECT
+                # cannot be unioned — a loud refusal, never a wrong row
+                raise ValueError(
+                    "aggregate serving reads over a partitioned MV: "
+                    "create a materialized view for the aggregation"
+                )
             if try_replicas and replicas:
                 for i in range(len(replicas)):
                     r = replicas[(start + i) % len(replicas)]
@@ -924,7 +1679,56 @@ class MetaService:
                         raise  # replica answered with a real failure
                     except (ConnectionError, OSError):
                         continue  # replica died mid-read: next one
-            if w is not None and w.alive:
+            if parts is not None:
+                # partitioned MV: fan out per the serve PLAN (the
+                # atomically-published routing of the last commit — a
+                # consistent single-round view through handovers) and
+                # union the disjoint slices; any owner mid-failover ⇒
+                # wait and retry the whole read (never a partial
+                # answer)
+                with self._lock:
+                    plan = list(job.serve_plan) if job.serve_plan \
+                        else [(p.worker_id, p.pinned_epoch,
+                               list(p.pinned_vnodes)
+                               or list(p.vnodes))
+                              for p in job.partitions.values()
+                              if not p.retiring]
+                    owners = [
+                        (self.workers.get(wid)
+                         if wid is not None else None, pe, pv)
+                        for wid, pe, pv in plan
+                    ]
+                if all(w2 is not None and w2.alive
+                       for w2, _, _ in owners):
+                    rows: list[tuple] = []
+                    cols: list = []
+                    complete = True
+                    for w2, pe, pv in owners:
+                        try:
+                            res = w2.client.call(
+                                "serve", sql=sql, query_epoch=pe,
+                                vnodes=pv,
+                            )
+                        except RpcError as e:
+                            if "does not exist" in str(e):
+                                # released donor hit through a plan
+                                # snapshotted just before the commit
+                                # swapped it: stale routing, not a
+                                # failed read — retry the fresh plan
+                                complete = False
+                                break
+                            raise  # the engine refused: final
+                        except (ConnectionError, OSError):
+                            complete = False
+                            break
+                        cols = res["cols"]
+                        rows += [tuple(r) for r in res["rows"]]
+                    if complete:
+                        self.metrics.inc(
+                            "cluster_partitioned_reads_total"
+                        )
+                        return cols, rows
+            elif w is not None and w.alive:
                 try:
                     res = w.client.call("serve", sql=sql,
                                         query_epoch=pin)
@@ -1026,9 +1830,28 @@ class MetaService:
                          j.seal_log[-1][1] if j.seal_log else 0,
                      "durable_epoch": j.durable_epoch,
                      "committed_epoch":
-                         j.seal_log[-1][1] if j.seal_log else 0}
+                         j.seal_log[-1][1] if j.seal_log else 0,
+                     "partitions": [
+                         {"lineage": p.lineage,
+                          "worker": p.worker_id,
+                          "vnodes": len(p.vnodes),
+                          "rounds": p.rounds,
+                          "pinned_epoch": p.pinned_epoch}
+                         for p in j.partitions.values()
+                     ] if j.partitions else None}
                     for j in self.jobs.values()
                 ],
+                "scale": {
+                    "partitioning": self.scale_partitioning,
+                    "n_vnodes": self.n_vnodes,
+                    "active_workers": list(self.active_workers),
+                    "scale_ops": self.scale_ops,
+                    "vnode_map": {
+                        str(w): sum(1 for x in self.vnode_map
+                                    if x == w)
+                        for w in sorted(set(self.vnode_map))
+                    } if self.vnode_map else None,
+                },
             }
 
 

@@ -1,0 +1,30 @@
+"""Scale-lite: the elastic vnode scale plane.
+
+Reference counterpart: the meta's scale/recovery plane (PAPER.md §1)
+— a consistent-hash virtual-node keyspace owned by the meta, with
+``risectl`` rescheduling moving vnodes (and the state behind them)
+between compute nodes through a checkpoint epoch
+(src/meta/src/stream/scale.rs).  *Suki* (PAPERS.md) is the exemplar
+for the choreographed data path: once the meta has placed the
+partitions, per-chunk data flows worker↔worker over peer channels and
+the meta keeps only control traffic.
+
+Modules:
+
+- ``vnode``    — the vnode keyspace: deterministic hashing, the
+  vnode→worker map, and the minimal-movement rebalance;
+- ``gate``     — the traceable per-partition row filter (each
+  partition of a job masks source rows to its owned vnodes);
+- ``handover`` — per-vnode checkpoint slices + live-state transplant
+  (the state that follows moved vnodes across workers).
+"""
+
+from risingwave_tpu.cluster.scale.vnode import (  # noqa: F401
+    N_VNODES_DEFAULT,
+    initial_map,
+    moved_vnodes,
+    owned_vnodes,
+    rebalance,
+    vnode_member_mask,
+    vnodes_of_ints,
+)

@@ -1,0 +1,86 @@
+"""VnodeGateExecutor: the per-partition row filter.
+
+Reference counterpart: the vnode bitmap every stateful actor holds
+(``ActorMapping``/vnode bitmaps, src/common/src/hash/consistent_hash/
+mapping.rs) — an actor only processes the keys whose vnodes it owns.
+Here a *partition* of a streaming job is a full replica of the job's
+fragment on one worker, fed by the same deterministic source stream;
+this gate sits directly before the keyed (agg) executor and narrows
+the validity mask to rows whose distribution-key vnode the partition
+owns.
+
+TPU-first shape: the owned-vnode set is the executor's STATE (a
+``bool [n_vnodes]`` membership mask), not a captured constant — a
+scale operation updates the mask array in place and the compiled
+fragment programs never retrace.  The gate itself is one hash + one
+gather per chunk and fuses into the fragment step program, so the
+traceable fused multi-chunk dispatch path survives partitioning.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from risingwave_tpu.common.chunk import (
+    Chunk,
+    OP_DELETE,
+    OP_INSERT,
+    OP_UPDATE_DELETE,
+    OP_UPDATE_INSERT,
+    split_col,
+)
+from risingwave_tpu.common.types import Schema
+from risingwave_tpu.cluster.scale.vnode import (
+    vnode_member_mask,
+    vnodes_of_ints,
+)
+from risingwave_tpu.expr.node import Expr
+from risingwave_tpu.stream.executor import Executor
+
+
+class VnodeGateExecutor(Executor):
+    """Mask rows to the partition's owned vnodes (state = the mask)."""
+
+    emits_on_apply = True
+    emits_on_flush = False
+
+    def __init__(self, in_schema: Schema, key_expr: Expr,
+                 n_vnodes: int):
+        super().__init__(in_schema)
+        self.key_expr = key_expr
+        self.n_vnodes = n_vnodes
+
+    def init_state(self):
+        # owns everything until the control plane narrows it — a
+        # single-partition job behaves exactly like an unpartitioned one
+        return jnp.ones((self.n_vnodes,), jnp.bool_)
+
+    def make_mask(self, vnodes):
+        """Device membership mask for ``set_job_vnodes`` state swaps."""
+        return vnode_member_mask(vnodes, self.n_vnodes)
+
+    def apply(self, mask, chunk: Chunk):
+        key, null = split_col(self.key_expr.eval(chunk))
+        vn = vnodes_of_ints(key, self.n_vnodes)
+        keep = mask[vn] & chunk.valid
+        if null is not None:
+            # eligibility requires a NOT NULL dist key; a runtime NULL
+            # (never expected) routes to vnode-of-zero-payload, which
+            # the zeroed split_col payload already produces
+            pass
+        # Update-pair degradation, exactly like FilterExecutor: a U-/U+
+        # pair whose sides land in different vnodes degrades to the
+        # surviving side's plain Insert/Delete
+        is_ud = chunk.ops == OP_UPDATE_DELETE
+        is_ui = chunk.ops == OP_UPDATE_INSERT
+        partner_keep_for_ud = jnp.roll(keep, -1)
+        partner_keep_for_ui = jnp.roll(keep, 1)
+        ops = chunk.ops
+        ops = jnp.where(is_ud & keep & ~partner_keep_for_ud,
+                        OP_DELETE, ops)
+        ops = jnp.where(is_ui & keep & ~partner_keep_for_ui,
+                        OP_INSERT, ops)
+        return mask, Chunk(chunk.columns, ops, keep, chunk.schema)
+
+    def __repr__(self) -> str:
+        return f"VnodeGateExecutor(n={self.n_vnodes})"

@@ -1,0 +1,237 @@
+"""Online state handover: per-vnode checkpoint slices + transplant.
+
+Reference counterpart: the reschedule plan of ``scale.rs`` — when a
+vnode moves, the state *behind* it (agg groups, MV rows keyed in that
+vnode) moves with it, anchored at a checkpoint epoch so the transfer
+is exact.
+
+Mechanics here (cluster/meta_service drives the protocol):
+
+1. the meta seals a round whose checkpoints are DURABLE on every
+   partition (the handover epoch);
+2. the recipient loads each donor partition's checkpoint *at that
+   epoch* from the SHARED checkpoint store — state never crosses an
+   RPC, only the moved keys' slices leave disk;
+3. ``slice_partition_states`` extracts exactly the moved vnodes'
+   entries (group keys + every per-slot state array) — the "only
+   moved vnodes transfer" contract is structural, not best-effort;
+4. ``clear_vnodes`` tombstones any stale entries the recipient still
+   holds for the gained vnodes (a worker regaining vnodes it donated
+   earlier refreshes, never resurrects);
+5. ``transplant`` bulk find-or-claims the moved keys in the live
+   tables (``HashTable.lookup_or_insert`` over the whole slice) and
+   scatters the donor's per-slot arrays at the claimed slots.
+
+Eligible state shapes: ``HashAggExecutor`` (prims / row_count / prev
+snapshot / emitted / dirty / minput buckets — everything slot-aligned)
+and ``MaterializeExecutor`` (pk table + dense value columns).  The
+engine's eligibility gate guarantees no DISTINCT dedup tables and an
+empty spill ring; both are asserted loudly here anyway.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from risingwave_tpu.cluster.scale.vnode import (
+    vnode_member_mask,
+    vnodes_of_ints,
+)
+from risingwave_tpu.common.chunk import NCol, StrCol
+from risingwave_tpu.state.hash_table import gather_key
+from risingwave_tpu.stream.hash_agg import HashAggExecutor
+from risingwave_tpu.stream.materialize import (
+    MaterializeExecutor,
+    MvState,
+    _scatter_col,
+)
+
+
+def _to_dev(col):
+    """Host slice column → device (NCol/StrCol aware)."""
+    if isinstance(col, NCol):
+        return NCol(_to_dev(col.data), jnp.asarray(col.null))
+    if isinstance(col, StrCol):
+        return StrCol(jnp.asarray(col.data), jnp.asarray(col.lens))
+    return jnp.asarray(col)
+
+
+def _dist_payload(col):
+    """Raw integer payload of the distribution key column (the
+    eligibility gate guarantees NOT NULL integer family)."""
+    if isinstance(col, NCol):
+        return col.data
+    return col
+
+
+def _entry_mask(table, vnodes, n_vnodes) -> np.ndarray:
+    """Host ``bool [size]``: occupied slots whose key falls in the
+    vnode set."""
+    occ = np.asarray(table.occupied)
+    vn = np.asarray(vnodes_of_ints(
+        _dist_payload(table.key_cols[0]), n_vnodes
+    ))
+    member = np.zeros((n_vnodes,), bool)
+    member[[int(v) for v in vnodes]] = True
+    return occ & member[vn]
+
+
+def _assert_plain_agg(ex: HashAggExecutor, state) -> None:
+    if state.distinct_tables:
+        raise RuntimeError(
+            "vnode handover over a DISTINCT aggregation (dedup tables "
+            "are not sliceable): not scale-eligible"
+        )
+    spill = getattr(state, "spill_count", ())
+    if not isinstance(spill, tuple) and int(np.asarray(spill)) != 0:
+        raise RuntimeError(
+            "vnode handover with rows in the spill ring — drain first"
+        )
+
+
+# -- slice (donor checkpoint → moved entries) ---------------------------
+def slice_partition_states(executors, states, vnodes,
+                           n_vnodes: int) -> dict[int, dict]:
+    """Extract the moved vnodes' entries from a (host) checkpoint
+    state tree: ``{executor_idx: slice}`` for every keyed executor.
+
+    Works on the numpy trees ``CheckpointStore.load`` returns (and on
+    device trees — gathers go through numpy either way)."""
+    out: dict[int, dict] = {}
+    for i, ex in enumerate(executors):
+        st = states[i]
+        if isinstance(ex, HashAggExecutor):
+            _assert_plain_agg(ex, st)
+            take = _entry_mask(st.table, vnodes, n_vnodes)
+            idx = np.nonzero(take)[0]
+            out[i] = {
+                "kind": "agg",
+                "n": int(idx.shape[0]),
+                "keys": [gather_key(np.asarray(c) if not isinstance(
+                    c, (NCol, StrCol)) else c, idx)
+                    for c in st.table.key_cols],
+                "prims": [np.asarray(p)[idx] for p in st.prims],
+                "prev_prims": [np.asarray(p)[idx]
+                               for p in st.prev_prims],
+                "row_count": np.asarray(st.row_count)[idx],
+                "prev_row_count": np.asarray(st.prev_row_count)[idx],
+                "dirty": np.asarray(st.dirty)[idx],
+                "emitted": np.asarray(st.emitted)[idx],
+                "minput_vals": [np.asarray(v)[idx]
+                                for v in st.minput_vals],
+                "minput_occ": [np.asarray(o)[idx]
+                               for o in st.minput_occ],
+            }
+        elif isinstance(ex, MaterializeExecutor):
+            take = _entry_mask(st.table, vnodes, n_vnodes)
+            idx = np.nonzero(take)[0]
+            out[i] = {
+                "kind": "mv",
+                "n": int(idx.shape[0]),
+                "keys": [gather_key(np.asarray(c) if not isinstance(
+                    c, (NCol, StrCol)) else c, idx)
+                    for c in st.table.key_cols],
+                "values": [gather_key(v if isinstance(v, (NCol, StrCol))
+                                      else np.asarray(v), idx)
+                           for v in st.values],
+            }
+    return out
+
+
+# -- clear (recipient live state: evict stale entries in gained set) ----
+def clear_vnodes(executors, states, vnodes, n_vnodes: int):
+    """Tombstone every live entry in the given vnode set (stale state
+    from an earlier ownership must never shadow the donor's current
+    slice).  Returns (states', cleared_entries)."""
+    new_states = list(states)
+    cleared = 0
+    member = vnode_member_mask(vnodes, n_vnodes)
+    for i, ex in enumerate(executors):
+        st = states[i]
+        if isinstance(ex, HashAggExecutor):
+            vn = vnodes_of_ints(
+                _dist_payload(st.table.key_cols[0]), n_vnodes
+            )
+            stale = st.table.occupied & member[vn]
+            cleared += int(jnp.sum(stale))
+            new_states[i] = st._replace(
+                table=st.table.clear_where(stale),
+                row_count=jnp.where(stale, 0, st.row_count),
+                prev_row_count=jnp.where(stale, 0, st.prev_row_count),
+                dirty=st.dirty & ~stale,
+                emitted=st.emitted & ~stale,
+                minput_occ=tuple(o & ~stale[:, None]
+                                 for o in st.minput_occ),
+            )
+        elif isinstance(ex, MaterializeExecutor):
+            vn = vnodes_of_ints(
+                _dist_payload(st.table.key_cols[0]), n_vnodes
+            )
+            stale = st.table.occupied & member[vn]
+            cleared += int(jnp.sum(stale))
+            new_states[i] = MvState(
+                st.table.clear_where(stale), st.values, st.overflow
+            )
+    return tuple(new_states), cleared
+
+
+# -- transplant (moved entries → recipient live state) ------------------
+def transplant(executors, states, slices: dict[int, dict]):
+    """Merge donor slices into the live state tree; returns
+    ``(states', entries_moved)``.  Raises loudly when the recipient
+    table cannot claim a slot (undersized table — the overflow analog
+    of the streaming path's loud counters)."""
+    new_states = list(states)
+    moved = 0
+    for i, sl in slices.items():
+        st = states[i]
+        n = sl["n"]
+        if n == 0:
+            continue
+        keys = [_to_dev(c) for c in sl["keys"]]
+        valid = jnp.ones((n,), jnp.bool_)
+        table, slots, _, overflow = st.table.lookup_or_insert(
+            keys, valid
+        )
+        if bool(jnp.any(overflow & valid)):
+            raise RuntimeError(
+                f"vnode transplant overflowed executor {i}'s table "
+                f"({n} entries) — increase table capacity"
+            )
+        if sl["kind"] == "agg":
+            new_states[i] = st._replace(
+                table=table,
+                prims=tuple(
+                    p.at[slots].set(_to_dev(v), mode="drop")
+                    for p, v in zip(st.prims, sl["prims"])
+                ),
+                prev_prims=tuple(
+                    p.at[slots].set(_to_dev(v), mode="drop")
+                    for p, v in zip(st.prev_prims, sl["prev_prims"])
+                ),
+                row_count=st.row_count.at[slots].set(
+                    _to_dev(sl["row_count"]), mode="drop"),
+                prev_row_count=st.prev_row_count.at[slots].set(
+                    _to_dev(sl["prev_row_count"]), mode="drop"),
+                dirty=st.dirty.at[slots].set(
+                    _to_dev(sl["dirty"]), mode="drop"),
+                emitted=st.emitted.at[slots].set(
+                    _to_dev(sl["emitted"]), mode="drop"),
+                minput_vals=tuple(
+                    mv.at[slots].set(_to_dev(v), mode="drop")
+                    for mv, v in zip(st.minput_vals, sl["minput_vals"])
+                ),
+                minput_occ=tuple(
+                    mo.at[slots].set(_to_dev(o), mode="drop")
+                    for mo, o in zip(st.minput_occ, sl["minput_occ"])
+                ),
+            )
+        else:
+            values = tuple(
+                _scatter_col(store, slots, _to_dev(col))
+                for store, col in zip(st.values, sl["values"])
+            )
+            new_states[i] = MvState(table, values, st.overflow)
+        moved += n
+    return tuple(new_states), moved

@@ -1,0 +1,125 @@
+"""The vnode keyspace: consistent hashing + the minimal rebalance.
+
+Reference counterpart: ``VirtualNode`` (src/common/src/hash/consistent_
+hash/vnode.rs) and the meta's ``WorkerMapping`` rebalance
+(src/meta/src/stream/scale.rs:224) — a job's keyed state is
+partitioned over a fixed ring of N virtual nodes; capacity changes
+remap *vnodes to workers*, never keys to vnodes, so scaling N→M moves
+only ``|delta targets|`` vnodes and the state behind them.
+
+Every hash here routes through ``common.hash.hash64_columns`` — the
+SAME mix the device state tables use — so a row's vnode computed at
+the chunk gate, a group's vnode computed from a checkpoint slice, and
+an MV row's vnode computed at serving-read time can never disagree.
+The map itself is a plain ``list[int]`` of length ``n_vnodes`` whose
+entries are worker ids; all functions are pure and deterministic
+(sorted-worker order, index order), so every process derives the same
+map from the same inputs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+#: the default ring size (ref VirtualNode::COUNT is 256; 64 keeps the
+#: per-vnode slices chunky on small test tables)
+N_VNODES_DEFAULT = 64
+
+
+def vnodes_of_ints(col, n_vnodes: int):
+    """``int32 [cap]`` vnode of each value of an integer key column.
+
+    Accepts host numpy or device jnp arrays; the hash is
+    ``hash64_columns`` — identical to the state tables' slot hashing —
+    so chunk-gate routing, checkpoint slicing, and read filtering all
+    agree bit-for-bit.  Distribution keys are restricted to
+    NOT NULL integer-family columns (engine eligibility), which keeps
+    host row values and raw stored values in the same hash domain.
+    """
+    import jax.numpy as jnp
+
+    from risingwave_tpu.common.hash import hash64_columns
+
+    h = hash64_columns([jnp.asarray(col).astype(jnp.int64)])
+    return (h % np.uint64(n_vnodes)).astype(jnp.int32)
+
+
+def vnode_member_mask(vnodes, n_vnodes: int):
+    """``bool [n_vnodes]`` membership mask of a vnode set (device)."""
+    import jax.numpy as jnp
+
+    mask = jnp.zeros((n_vnodes,), jnp.bool_)
+    vn = sorted(int(v) for v in vnodes)
+    if not vn:
+        return mask
+    return mask.at[jnp.asarray(vn, jnp.int32)].set(True)
+
+
+def _targets(workers: list[int], n_vnodes: int) -> dict[int, int]:
+    """Per-worker vnode quota: ⌊n/W⌋ (+1 for the first ``n mod W``
+    workers in ascending id order) — balanced within ±1 by
+    construction, deterministic across processes."""
+    ws = sorted(workers)
+    base, extra = divmod(n_vnodes, len(ws))
+    return {w: base + (1 if i < extra else 0) for i, w in enumerate(ws)}
+
+
+def initial_map(workers: list[int], n_vnodes: int) -> list[int]:
+    """First assignment: round-robin over sorted workers (every worker
+    lands within ±1 of its quota)."""
+    ws = sorted(workers)
+    return [ws[v % len(ws)] for v in range(n_vnodes)]
+
+
+def rebalance(old: list[int] | None, workers: list[int],
+              n_vnodes: int) -> list[int]:
+    """Remap the ring onto ``workers`` moving the MINIMAL vnode set.
+
+    Each surviving worker keeps its current vnodes up to its new quota
+    (in vnode-index order); only the excess — plus every vnode whose
+    owner left — is reassigned, in index order, to the first
+    under-quota worker in ascending id order.  Minimal by construction:
+    a worker over quota must shed exactly ``count - quota`` vnodes and
+    an under-quota worker must gain exactly ``quota - count``; nothing
+    else moves.  Pure function of (old, workers): every process
+    computes the same map."""
+    if not workers:
+        raise ValueError("rebalance needs at least one worker")
+    if old is None:
+        return initial_map(workers, n_vnodes)
+    if len(old) != n_vnodes:
+        raise ValueError(
+            f"map has {len(old)} vnodes, expected {n_vnodes}"
+        )
+    quota = _targets(workers, n_vnodes)
+    kept: dict[int, int] = {w: 0 for w in quota}
+    new = list(old)
+    pending: list[int] = []
+    for v, w in enumerate(old):
+        if w in quota and kept[w] < quota[w]:
+            kept[w] += 1
+        else:
+            pending.append(v)
+    order = sorted(quota)
+    for v in pending:
+        for w in order:
+            if kept[w] < quota[w]:
+                new[v] = w
+                kept[w] += 1
+                break
+    return new
+
+
+def moved_vnodes(old: list[int],
+                 new: list[int]) -> dict[tuple[int, int], list[int]]:
+    """``{(src_worker, dst_worker): [vnode, ...]}`` of every vnode that
+    changed owner (the handover work list)."""
+    out: dict[tuple[int, int], list[int]] = {}
+    for v, (a, b) in enumerate(zip(old, new)):
+        if a != b:
+            out.setdefault((a, b), []).append(v)
+    return out
+
+
+def owned_vnodes(vmap: list[int], worker_id: int) -> list[int]:
+    return [v for v, w in enumerate(vmap) if w == worker_id]

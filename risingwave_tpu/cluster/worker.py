@@ -84,6 +84,22 @@ class ComputeWorker:
         self.heartbeat_failures = 0
         #: times this worker (re-)registered with a meta
         self.registrations = 0
+        # -- worker↔worker exchange (the scale plane's data path) -------
+        #: meta-pushed routing: peer addresses + replicated-table hosts
+        #: (the choreography — per-chunk data then flows peer-to-peer,
+        #: the meta keeps only control traffic)
+        self._routing: dict = {"version": -1, "peers": {}, "tables": {}}
+        self._routing_lock = threading.Lock()
+        #: lazily-opened peer channels, labeled worker{i}>worker{j} so
+        #: the fault fabric can storm the exchange seam
+        self._peers: dict[int, RpcClient] = {}
+        #: exchange counters (stress/chaos observability)
+        self.exchange_rows_out = 0
+        self.exchange_rows_in = 0
+        self.exchange_batches_out = 0
+        self.exchange_batches_in = 0
+        self.exchange_fetches = 0
+        self.exchange_send_failures = 0
 
     @property
     def port(self) -> int:
@@ -177,30 +193,258 @@ class ComputeWorker:
         if self._server is not None:
             self._server.stop()
             self._server = None
+        with self._routing_lock:
+            for c in self._peers.values():
+                c.close()
+            self._peers.clear()
         if self._meta_client is not None:
             self._meta_client.close()
             self._meta_client = None
+
+    # -- worker↔worker exchange (scale plane data path) -----------------
+    def rpc_update_routing(self, version: int, peers: dict,
+                           tables: dict) -> dict:
+        """Meta-pushed placement choreography: peer worker addresses
+        and, per replicated DML table, its hosts + ingest leader.  The
+        per-chunk fan-out below never touches the meta again."""
+        with self._routing_lock:
+            if int(version) >= self._routing["version"]:
+                self._routing = {
+                    "version": int(version),
+                    "peers": {int(k): tuple(v)
+                              for k, v in peers.items()},
+                    "tables": {t: {"leader": int(i["leader"]),
+                                   "hosts": [int(h)
+                                             for h in i["hosts"]]}
+                               for t, i in tables.items()},
+                }
+                # drop channels to peers that left the ring
+                for wid in [w for w in self._peers
+                            if w not in self._routing["peers"]]:
+                    self._peers.pop(wid).close()
+        return {"ok": True}
+
+    def _peer(self, wid: int) -> RpcClient:
+        with self._routing_lock:
+            c = self._peers.get(wid)
+            if c is None:
+                host, port = self._routing["peers"][wid]
+                c = RpcClient(host, int(port), timeout=30.0,
+                              src=f"worker{self.worker_id}",
+                              dst=f"worker{wid}")
+                self._peers[wid] = c
+            return c
+
+    def _table_route(self, table: str) -> dict | None:
+        with self._routing_lock:
+            return self._routing["tables"].get(table)
+
+    def _dml_manager(self, table: str):
+        entry = self.engine.catalog.get(table)
+        if entry.dml is None:
+            raise ValueError(f"{table!r} is not a DML table")
+        return entry.dml
+
+    def rpc_execute(self, sql: str) -> dict:
+        """Generic statement execution.  INSERTs into a replicated
+        table take the choreographed path: a non-leader forwards to
+        the table's ingest leader (worker↔worker); the leader applies
+        locally and fans the position-stamped batch out to every other
+        host over peer channels — the meta never sees a data chunk."""
+        from risingwave_tpu.sql import ast
+        from risingwave_tpu.sql.parser import parse
+
+        stmts = parse(sql)
+        route = None
+        if len(stmts) == 1 and isinstance(stmts[0], ast.Insert):
+            route = self._table_route(stmts[0].table)
+        if route is None:
+            with self._lock:
+                self.engine.execute(sql)
+            return {"ok": True}
+        table = stmts[0].table
+        if route["leader"] != self.worker_id:
+            # worker↔worker forward; the leader's answer is ours
+            return self.retry.run(
+                lambda: self._peer(route["leader"]).call(
+                    "execute", sql=sql),
+                label="execute_forward",
+            )
+        with self._lock:
+            mgr = self._dml_manager(table)
+            seq = mgr.history_len()
+            self.engine.execute(sql)
+            rows = mgr.history_slice(seq)
+        # fan out OUTSIDE the engine lock (peers may be forwarding to
+        # us concurrently); a dropped delivery self-heals at the next
+        # barrier's catch-up fetch
+        for wid in route["hosts"]:
+            if wid == self.worker_id:
+                continue
+            try:
+                self.retry.run(
+                    lambda w=wid: self._peer(w).call(
+                        "exchange", table=table, seq=seq, rows=rows),
+                    label="exchange",
+                )
+                self.exchange_rows_out += len(rows)
+                self.exchange_batches_out += 1
+            except (RpcError, ConnectionError, OSError, KeyError):
+                self.exchange_send_failures += 1
+        return {"ok": True, "seq": seq, "rows": len(rows)}
+
+    def rpc_exchange(self, table: str, seq: int, rows: list) -> dict:
+        """Receive one position-stamped batch from a peer.  Duplicate
+        positions are skipped; a batch beyond the local tail is
+        refused (the barrier-time catch-up fetch fills the gap from
+        the leader — ordered, idempotent delivery without a broker)."""
+        with self._lock:
+            mgr = self._dml_manager(table)
+            try:
+                applied = mgr.insert_at(
+                    int(seq), [tuple(r) for r in rows]
+                )
+            except ValueError:
+                return {"ok": False, "have": mgr.history_len()}
+        self.exchange_rows_in += applied
+        self.exchange_batches_in += 1
+        return {"ok": True, "applied": applied}
+
+    def rpc_fetch_table(self, table: str, from_seq: int = 0) -> dict:
+        """Peer catch-up: the table's history from a position (the
+        handover/new-host backfill and the gap repair path)."""
+        with self._lock:
+            mgr = self._dml_manager(table)
+            return {"seq": int(from_seq),
+                    "rows": mgr.history_slice(int(from_seq))}
+
+    def rpc_table_len(self, table: str) -> dict:
+        with self._lock:
+            return {"len": self._dml_manager(table).history_len()}
+
+    def _ensure_table_len(self, table: str, want: int) -> None:
+        """Catch the local replica up to the round's consumption fence
+        before the barrier runs — exchange drops (chaos) repair here."""
+        with self._lock:
+            have = self._dml_manager(table).history_len()
+        if have >= want:
+            return
+        route = self._table_route(table)
+        if route is None or route["leader"] == self.worker_id:
+            raise RuntimeError(
+                f"{table!r} behind its fence ({have} < {want}) with "
+                "no leader to fetch from"
+            )
+        res = self.retry.run(
+            lambda: self._peer(route["leader"]).call(
+                "fetch_table", table=table, from_seq=have),
+            label="fetch_table",
+        )
+        self.exchange_fetches += 1
+        with self._lock:
+            mgr = self._dml_manager(table)
+            applied = mgr.insert_at(
+                int(res["seq"]), [tuple(r) for r in res["rows"]]
+            )
+        self.exchange_rows_in += applied
+        if applied:
+            self.exchange_batches_in += 1
 
     # -- RPC surface ----------------------------------------------------
     def rpc_ping(self) -> dict:
         return {"ok": True, "worker_id": self.worker_id,
                 "jobs": [j.name for j in self.engine.jobs]}
 
-    def rpc_adopt(self, ddl: list, name: str,
-                  recover: bool = True) -> dict:
+    def rpc_scale_stats(self) -> dict:
+        """Exchange/partition observability (scale_stress asserts the
+        per-chunk path flows worker↔worker)."""
+        return {
+            "exchange_rows_out": self.exchange_rows_out,
+            "exchange_rows_in": self.exchange_rows_in,
+            "exchange_batches_out": self.exchange_batches_out,
+            "exchange_batches_in": self.exchange_batches_in,
+            "exchange_fetches": self.exchange_fetches,
+            "exchange_send_failures": self.exchange_send_failures,
+            "routing_version": self._routing["version"],
+            "partitions": {
+                j.name: sorted(j.vnodes)
+                for j in self.engine.jobs
+                if hasattr(j, "vnodes")
+            },
+        }
+
+    def rpc_adopt(self, ddl: list, name: str, recover: bool = True,
+                  vnodes: list | None = None, n_vnodes: int = 0,
+                  ckpt_key: str | None = None) -> dict:
         """Adopt (or extend) a streaming job: replay its DDL, then
         recover from the last durable checkpoint (exact replay: the
-        checkpoint holds state + source cursors of the same commit)."""
+        checkpoint holds state + source cursors of the same commit).
+
+        With ``vnodes`` the meta asks for a PARTITIONED adoption: the
+        job is rebuilt as one vnode partition (gate before the agg,
+        checkpoint lineage ``ckpt_key``) owning the given set.  An
+        ineligible plan answers ``partitioned: false`` and stays a
+        whole job — the meta falls back to job-level placement."""
+        from risingwave_tpu.sql.planner import PlanError
+
         with self._lock:
             # a (re-)adoption invalidates any cached seal: the next
             # round must run against the recovered state
             self._round_cache.pop(name, None)
-            epoch = self.engine.adopt_job(list(ddl), name,
-                                          recover=recover)
-        return {"ok": True, "committed_epoch": epoch}
+            if vnodes is None:
+                epoch = self.engine.adopt_job(list(ddl), name,
+                                              recover=recover)
+                return {"ok": True, "committed_epoch": epoch,
+                        "partitioned": False}
+            self.engine.adopt_job(list(ddl), name, recover=False)
+            try:
+                spec = self.engine.partition_job(
+                    name, int(n_vnodes), ckpt_key or name
+                )
+            except PlanError as e:
+                # not scale-eligible: finish as a plain adoption
+                entry = self.engine.catalog.get(name)
+                if recover:
+                    entry.job.recover()
+                return {"ok": True, "partitioned": False,
+                        "reason": str(e),
+                        "committed_epoch": entry.job.committed_epoch}
+            entry = self.engine.catalog.get(name)
+            if recover:
+                # the partition's OWN lineage (failover / meta restart)
+                entry.job.recover()
+            self.engine.set_job_vnodes(name, vnodes)
+            return {"ok": True, "partitioned": True,
+                    "committed_epoch": entry.job.committed_epoch,
+                    **spec}
+
+    def rpc_repartition(self, job: str, vnodes: list, transfers: list,
+                        rewind_epoch: int | None = None) -> dict:
+        """One handover step on this worker's partition (see
+        Engine.repartition_job).  Clears the round cache — ownership
+        changed, a cached seal must never answer for the new set."""
+        with self._lock:
+            self._round_cache.pop(job, None)
+            res = self.engine.repartition_job(
+                job, vnodes, list(transfers or ()),
+                rewind_epoch=rewind_epoch,
+            )
+        return {"ok": True, **res}
+
+    def rpc_release(self, job: str) -> dict:
+        """Drop a partition that lost its last vnode (scale-in): the
+        MV leaves this engine; sources (and their histories) stay for
+        a future re-adoption."""
+        with self._lock:
+            self._round_cache.pop(job, None)
+            if job in self.engine.catalog:
+                self.engine.execute(
+                    f"DROP MATERIALIZED VIEW {job}"
+                )
+        return {"ok": True}
 
     def rpc_barrier(self, job: str, chunks: int = 1,
-                    round: int = 0) -> dict:
+                    round: int = 0, limits: dict | None = None) -> dict:
         """Process ``chunks`` chunks + one barrier for one job — the
         meta's global round, applied locally.  Returns the SEALED
         epoch immediately (the checkpoint upload runs in the job's
@@ -209,8 +453,18 @@ class ComputeWorker:
         cluster epoch.  ``round`` tags the call for idempotence: a
         replay of the round we last sealed answers from the cache
         without touching the engine (the meta retries barriers whose
-        response was lost in flight)."""
+        response was lost in flight).  ``limits`` is the round's
+        consumption fence per replicated DML table (scale plane): the
+        local replica first catches up to the fence over the peer
+        exchange, then consumes exactly up to it — every partition of
+        a job sees the identical prefix per round."""
         rnd = int(round or 0)
+        if limits:
+            for table, want in limits.items():
+                try:
+                    self._ensure_table_len(table, int(want))
+                except (ValueError, KeyError):
+                    pass  # not a hosted DML table on this worker
         with self._lock:
             cached = self._round_cache.get(job) if rnd else None
             if cached is not None and cached["round"] == rnd \
@@ -221,7 +475,8 @@ class ComputeWorker:
                 # response was lost — redo the cheap tail
                 sealed = cached["sealed"]
             else:
-                sealed = self.engine.tick_job(job, int(chunks))
+                sealed = self.engine.tick_job(job, int(chunks),
+                                              source_limits=limits)
                 if rnd:
                     self._round_cache[job] = {"round": rnd,
                                               "sealed": sealed,
@@ -242,25 +497,29 @@ class ComputeWorker:
         with self._lock:
             return self.engine.job_epochs(job)
 
-    def rpc_serve(self, sql: str, query_epoch: int = 0) -> dict:
+    def rpc_serve(self, sql: str, query_epoch: int = 0,
+                  vnodes: list | None = None) -> dict:
         """Batch read; ``query_epoch`` pins the retained checkpoint of
         the meta's last cluster commit (reads never see state a global
-        commit hasn't covered)."""
+        commit hasn't covered).  ``vnodes`` narrows a partitioned MV
+        read to the vnode set this partition owned AT THE PINNED ROUND
+        (the meta fans a partitioned read across owners and unions the
+        disjoint slices)."""
         qe = int(query_epoch or 0)
         with self._lock:
             if qe:
                 self.engine.session_config.set("query_epoch", qe)
+            if vnodes is not None:
+                self.engine._serve_vnodes = frozenset(
+                    int(v) for v in vnodes
+                )
             try:
                 cols, rows = self.engine.query(sql)
             finally:
                 if qe:
                     self.engine.session_config.set("query_epoch", 0)
+                self.engine._serve_vnodes = None
         return {"cols": cols, "rows": [list(r) for r in rows]}
-
-    def rpc_execute(self, sql: str) -> dict:
-        with self._lock:
-            self.engine.execute(sql)
-        return {"ok": True}
 
     def rpc_faults(self) -> dict:
         """This process' chaos counters (aggregated by the meta's
@@ -278,4 +537,10 @@ class ComputeWorker:
             "heartbeat_failures": self.heartbeat_failures,
             "registrations": self.registrations,
             "checkpoint_upload_retries_total": upload_retries,
+            # the worker↔worker exchange seam (scale_storm asserts the
+            # fabric's faults here were absorbed/repaired)
+            "exchange_rows_out": self.exchange_rows_out,
+            "exchange_rows_in": self.exchange_rows_in,
+            "exchange_fetches": self.exchange_fetches,
+            "exchange_send_failures": self.exchange_send_failures,
         }

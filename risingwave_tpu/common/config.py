@@ -72,6 +72,13 @@ class ClusterConfig:
     serving_heartbeat_interval_s: float = 0.5
     #: serving replica block-cache capacity (decoded SST blocks)
     serving_cache_blocks: int = 1024
+    #: scale plane: vnode ring size (the consistent-hash keyspace
+    #: jobs partition over; ref VirtualNode::COUNT)
+    n_vnodes: int = 64
+    #: scale plane: place ELIGIBLE jobs as vnode partitions over the
+    #: active worker set (``ctl cluster scale N`` then moves only
+    #: vnodes + the state behind them).  Off = whole-job placement.
+    scale_partitioning: bool = False
     #: unified control-RPC retry budget (common/faults.RetryPolicy):
     #: total attempts per idempotent/epoch-guarded call before the
     #: failure surfaces (1 = no retries, the pre-chaos behavior)

@@ -49,6 +49,33 @@ class TableDmlManager:
         self._readers.append(r)
         return r
 
+    # -- cluster replication (worker↔worker exchange) -------------------
+    def history_len(self) -> int:
+        """Current history position (the exchange sequence number)."""
+        return len(self._history)
+
+    def history_slice(self, lo: int, hi: int | None = None) -> list:
+        """Rows [lo, hi) of the history — the peer catch-up payload."""
+        return [list(r) for r in
+                (self._history[lo:] if hi is None
+                 else self._history[lo:hi])]
+
+    def insert_at(self, seq: int, rows: Sequence[tuple]) -> int:
+        """Position-stamped idempotent append (exchange delivery): the
+        batch claims positions [seq, seq+len).  Rows already present
+        are skipped (duplicate delivery); a batch starting beyond the
+        current length is REFUSED (the caller fills the gap from the
+        leader first).  Returns rows actually appended."""
+        here = len(self._history)
+        if seq > here:
+            raise ValueError(
+                f"exchange gap: batch at seq {seq}, history at {here}"
+            )
+        fresh = [tuple(r) for r in rows[here - seq:]]
+        if fresh:
+            self.insert(fresh)
+        return len(fresh)
+
     def insert(self, rows: Sequence[tuple]) -> int:
         rows = list(rows)
         # one pass: per-string-column max encoded length of this batch
@@ -120,12 +147,20 @@ class TableSourceReader:
         self._rows = history
         #: consumed-row cursor into the table history (checkpointable)
         self.offset = 0
+        #: consumption fence (cluster lockstep rounds): rows at or
+        #: beyond this history position are invisible until the meta
+        #: raises it — every partition of a job consumes the IDENTICAL
+        #: prefix per round, so cursors stay aligned across workers
+        self.limit: int | None = None
 
     def pending(self) -> int:
         # a restored offset may exceed the in-process history (fresh
         # process, history not yet replayed): never negative — the
         # cursor simply has nothing to read until history catches up
-        return max(0, len(self._rows) - self.offset)
+        end = len(self._rows)
+        if self.limit is not None:
+            end = min(end, self.limit)
+        return max(0, end - self.offset)
 
     def next_chunk(self) -> Chunk:
         n = min(self.pending(), self.cap)

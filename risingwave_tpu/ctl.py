@@ -233,6 +233,35 @@ def cluster_faults(meta_addr: str) -> dict:
         client.close()
 
 
+def cluster_scale(meta_addr: str, n: int) -> dict:
+    """``ctl cluster scale N <meta_addr>``: resize the active worker
+    set online — the meta rebalances the vnode map minimally and
+    hands the moved vnodes' state over through a checkpoint epoch
+    (reads stay zero-error throughout)."""
+    from risingwave_tpu.cluster.rpc import RpcClient, parse_addr
+
+    host, port = parse_addr(meta_addr)
+    client = RpcClient(host, port, timeout=600.0)
+    try:
+        return client.call("cluster_scale", n=int(n))
+    finally:
+        client.close()
+
+
+def cluster_vnodes(meta_addr: str) -> dict:
+    """``ctl cluster vnodes``: the scale plane's view — active worker
+    set, per-worker vnode counts, and each partitioned job's
+    partition layout."""
+    s = _meta_state(meta_addr)
+    return {
+        "scale": s.get("scale"),
+        "partitions": {
+            j["name"]: j["partitions"]
+            for j in s["jobs"] if j.get("partitions")
+        },
+    }
+
+
 def cluster_epochs(meta_addr: str) -> dict:
     """``ctl cluster epochs``: the global checkpoint positions — the
     committed cluster epoch (round), the manifest's epoch stamp, each
@@ -264,10 +293,17 @@ def _cluster_main(argv: list[str]) -> None:
     ``ctl storage`` pattern, but against the live control plane)."""
     import json
 
-    sub, addr = argv[0], argv[1]
+    sub = argv[0]
+    if sub == "scale":
+        # ctl cluster scale <N> <meta_addr>
+        print(json.dumps(cluster_scale(argv[2], int(argv[1])),
+                         indent=1))
+        return
+    addr = argv[1]
     fn = {"workers": cluster_workers, "jobs": cluster_jobs,
           "epochs": cluster_epochs,
           "serving": cluster_serving,
+          "vnodes": cluster_vnodes,
           "faults": cluster_faults}.get(sub)
     if fn is None:
         raise SystemExit(f"unknown cluster subcommand: {sub}")

@@ -93,6 +93,18 @@ class MetaStore:
                      {"round": int(round_), "epoch": int(epoch),
                       "seals": {k: int(v) for k, v in seals.items()}})
 
+    def append_scale_event(self, event: dict) -> None:
+        """Scale plane: one line per layout change — the vnode map,
+        the active worker set, and every partitioned job's checkpoint
+        lineages.  A restarted meta replays the TAIL event and
+        re-adopts each lineage from the shared store."""
+        self._append(os.path.join(self.root, "scale_log.jsonl"), event)
+
+    def last_scale_event(self) -> dict | None:
+        entries = self._lines(os.path.join(self.root,
+                                           "scale_log.jsonl"))
+        return entries[-1] if entries else None
+
     def last_cluster_commit(self) -> dict | None:
         """The newest committed-round record (None = nothing durable).
         Only the tail matters for recovery; earlier lines are history

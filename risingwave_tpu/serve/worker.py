@@ -103,7 +103,7 @@ def plan_read(select, schema: MvSchema) -> ReadPlan:
     ``ServeUnsupported`` (the meta falls back to the owning worker)."""
     from risingwave_tpu.sql import ast
 
-    if select.group_by or select.having is not None or select.order_by:
+    if select.group_by or select.having is not None:
         raise ServeUnsupported(
             "serving replicas handle projection/point/range reads only"
         )
@@ -111,6 +111,22 @@ def plan_read(select, schema: MvSchema) -> ReadPlan:
             or select.from_.temporal:
         raise ServeUnsupported("serving reads are SELECT ... FROM <mv>")
     mv = select.from_.name
+    if select.order_by:
+        # ORDER BY pushdown: the scan already yields memcomparable-pk
+        # order, so an ASCENDING prefix of the pk columns is a no-op —
+        # accept it (typically ORDER BY pk LIMIT k) instead of falling
+        # back to the owning worker.  Anything else still needs the
+        # engine's sort.
+        for pos, oi in enumerate(select.order_by):
+            if oi.descending or not isinstance(oi.expr, ast.ColumnRef):
+                raise ServeUnsupported(
+                    "serving ORDER BY supports an ascending pk prefix"
+                )
+            idx = schema.index_of(oi.expr.name)
+            if pos >= len(schema.pk) or idx != schema.pk[pos]:
+                raise ServeUnsupported(
+                    "serving ORDER BY supports an ascending pk prefix"
+                )
 
     # projection
     cols: list[int] = []

@@ -113,6 +113,8 @@ def _run_meta(args) -> None:
     meta = MetaService(
         args.data_dir or "./data",
         heartbeat_timeout_s=args.heartbeat_timeout,
+        n_vnodes=args.n_vnodes,
+        scale_partitioning=args.scale_partitioning,
     ).start(args.host, args.rpc_port)
     front = MetaFrontend(meta)
     server = pg_serve(front, args.host, args.port)
@@ -215,6 +217,12 @@ def main() -> None:
     p.add_argument("--barrier-interval-ms", type=int, default=1000)
     p.add_argument("--serving-cache-blocks", type=int, default=1024,
                    help="serving block-cache capacity (serving role)")
+    p.add_argument("--n-vnodes", type=int, default=64,
+                   help="scale plane: vnode ring size (meta role)")
+    p.add_argument("--scale-partitioning", action="store_true",
+                   help="scale plane: partition eligible jobs over "
+                        "the vnode map (meta role); `ctl cluster "
+                        "scale N` then moves only vnodes")
     args = p.parse_args()
 
     if args.role == "meta":

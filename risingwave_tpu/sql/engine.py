@@ -209,6 +209,9 @@ class Engine:
         #: last exported (key → pickled row) per MV — the incremental
         #: export diff base; seeded from the shared manifest on adopt
         self._exported: dict[str, dict] = {}
+        #: per-read vnode override for partitioned MV serving (the
+        #: cluster worker pins reads to the map at the pinned round)
+        self._serve_vnodes = None
         if data_dir is not None and role == "compute":
             import os as _os
 
@@ -945,6 +948,11 @@ class Engine:
         if isinstance(ex, MaterializeExecutor):
             valid = st.table.occupied
             cap = ex.table_size
+            vn_set, n_vn = self._mv_vnode_set(entry)
+            if vn_set is not None:
+                valid = self._vnode_filtered_mv_state(
+                    st, vn_set, n_vn
+                ).table.occupied
         elif isinstance(ex, AppendOnlyMaterialize):
             valid = jnp.arange(ex.ring_size, dtype=jnp.int64) < st.cursor
             cap = ex.ring_size
@@ -1632,12 +1640,21 @@ class Engine:
                 job.drain_uploads()
             self._export_checkpoint_gauges(job)
 
-    def tick_job(self, name: str, chunks_per_barrier: int = 1) -> int:
+    def tick_job(self, name: str, chunks_per_barrier: int = 1,
+                 source_limits: dict | None = None) -> int:
         """Advance ONE job a single barrier round (the cluster worker's
         barrier RPC — meta drives each job's rounds individually so a
         reassigned job can catch up while the rest hold).  Returns the
-        job's committed epoch after the barrier."""
+        job's committed epoch after the barrier.
+
+        ``source_limits`` (cluster scale plane) fences DML-table
+        consumption at a meta-chosen history position so every
+        partition of the job consumes the identical prefix this round
+        — source cursors stay aligned across workers, which is what
+        makes checkpoint-slice handover exact."""
         job = self._job_by_name(name)
+        if source_limits:
+            self._apply_source_limits(job, source_limits)
         ckpt_freq = int(self.system_params.get("checkpoint_frequency"))
         job.checkpoint_frequency = ckpt_freq
         job.maintenance_interval = int(self.system_params.get(
@@ -1798,6 +1815,278 @@ class Engine:
         # manifest — re-seed from storage on the next export
         self._exported.clear()
         return entry.job.committed_epoch
+
+    # -- elastic scale plane (cluster/scale) -----------------------------
+    def _dml_tables_of(self, job) -> list[str]:
+        """Names of the DML tables this job's source reads (the tables
+        the cluster must replicate worker↔worker for partitions to see
+        identical streams)."""
+        rows = getattr(getattr(job, "source", None), "_rows", None)
+        if rows is None:
+            return []
+        return [e.name for e in self.catalog.list("source")
+                if e.dml is not None and rows is e.dml._history]
+
+    def _apply_source_limits(self, job, limits: dict) -> None:
+        src = getattr(job, "source", None)
+        if src is None or not hasattr(src, "limit"):
+            return
+        for tbl in self._dml_tables_of(job):
+            if tbl in limits:
+                src.limit = int(limits[tbl])
+
+    def partition_job(self, name: str, n_vnodes: int,
+                      ckpt_key: str) -> dict:
+        """Rebuild a freshly-adopted job as ONE partition of a
+        vnode-partitioned cluster job (the scale plane's unit): a
+        ``VnodeGateExecutor`` lands directly before the aggregation and
+        masks source rows to the owned vnode set; the checkpoint
+        lineage moves to ``ckpt_key`` so every partition checkpoints
+        independently in the SHARED store.
+
+        Eligibility (raises ``PlanError`` otherwise — the worker falls
+        back to whole-job placement):
+
+        - a linear ``StreamingJob`` carrying exactly one MV:
+          stateless prefix → one ``HashAggExecutor`` → Materialize;
+        - no DISTINCT / retractable-min-max buckets / EOWC /
+          watermark-driven cleaning (their state is not sliceable or
+          their emission depends on the global stream);
+        - the leading GROUP BY expression (the distribution key) is a
+          NOT NULL integer-family value — host row values and raw
+          stored values then share one hash domain, so chunk routing,
+          checkpoint slicing, and read filtering agree exactly.
+        """
+        from risingwave_tpu.cluster.scale.gate import VnodeGateExecutor
+        from risingwave_tpu.stream.executor import (
+            FilterExecutor,
+            HopWindowExecutor,
+            ProjectExecutor,
+        )
+        from risingwave_tpu.stream.fragment import Fragment
+        from risingwave_tpu.stream.hash_agg import HashAggExecutor
+        from risingwave_tpu.stream.materialize import MaterializeExecutor
+
+        entry = self.catalog.get(name)
+        job = entry.job
+        if hasattr(job, "vnode_gate_idx"):
+            # already a partition on this engine (a restarted meta
+            # re-adopting lineages): re-point the checkpoint lineage —
+            # the caller's recover() then loads it
+            if job.n_vnodes != n_vnodes:
+                raise PlanError(
+                    f"{name!r}: vnode ring mismatch "
+                    f"({job.n_vnodes} vs {n_vnodes})"
+                )
+            job.ckpt_key = ckpt_key
+            agg = job.fragment.executors[job.vnode_gate_idx + 1]
+            return {
+                "partitioned": True,
+                "dist": agg.group_by[0][0],
+                "dml_tables": self._dml_tables_of(job),
+            }
+        if entry.kind != "mview" or not isinstance(job, StreamingJob):
+            raise PlanError(
+                f"{name!r} is not a linear streaming MV: not "
+                "scale-eligible"
+            )
+        riders = [e for e in self.catalog.list() if e.job is job]
+        if riders != [entry]:
+            raise PlanError(
+                f"{name!r} shares its job with other MVs/sinks: not "
+                "scale-eligible"
+            )
+        if job.barriers_seen:
+            raise PlanError(
+                f"{name!r} already ran unpartitioned barriers: "
+                "partitioning happens at adoption"
+            )
+        execs = list(job.fragment.executors)
+        aggs = [i for i, ex in enumerate(execs)
+                if isinstance(ex, HashAggExecutor)]
+        if len(aggs) != 1 or not isinstance(execs[-1],
+                                            MaterializeExecutor):
+            raise PlanError(
+                f"{name!r}: scale-eligible jobs are "
+                "source → agg → materialize"
+            )
+        agg_idx = aggs[0]
+        agg = execs[agg_idx]
+        for ex in execs[:agg_idx]:
+            if not isinstance(ex, (FilterExecutor, ProjectExecutor,
+                                   HopWindowExecutor)):
+                raise PlanError(
+                    f"{name!r}: stateful/watermark prefix executor "
+                    f"{type(ex).__name__}: not scale-eligible"
+                )
+        for ex in execs[agg_idx + 1:-1]:
+            if not isinstance(ex, (FilterExecutor, ProjectExecutor)):
+                raise PlanError(
+                    f"{name!r}: post-agg executor {type(ex).__name__}: "
+                    "not scale-eligible"
+                )
+        if (agg.emit_on_window_close or agg._distinct_aggs
+                or agg._minput_aggs
+                or agg.watermark_group_idx is not None):
+            raise PlanError(
+                f"{name!r}: DISTINCT/minput/EOWC/watermark "
+                "aggregations are not scale-eligible"
+            )
+        dist_expr = agg.group_by[0][1]
+        f = dist_expr.return_field(agg.in_schema)
+        if f.nullable or not np.issubdtype(
+                np.dtype(f.data_type.physical_dtype), np.integer):
+            raise PlanError(
+                f"{name!r}: distribution key {agg.group_by[0][0]!r} "
+                "must be a NOT NULL integer-family column"
+            )
+        # spill-to-host draining is not wired for partitioned state
+        # handover: overflow stays a loud error (the sharded mesh path
+        # makes the same call)
+        for ex in execs:
+            if getattr(ex, "spill_ring", 0):
+                ex.spill_ring = 0
+        gate = VnodeGateExecutor(agg.in_schema, dist_expr, n_vnodes)
+        frag = Fragment(execs[:agg_idx] + [gate] + execs[agg_idx:],
+                        name=f"{name}_part")
+        part = StreamingJob(
+            job.source, frag, name,
+            checkpoint_frequency=job.checkpoint_frequency,
+            checkpoint_store=job.checkpoint_store,
+        )
+        part.maintenance_interval = job.maintenance_interval
+        part.snapshot_interval = job.snapshot_interval
+        part.metrics = job.metrics
+        part.ckpt_key = ckpt_key
+        part.vnode_gate_idx = agg_idx
+        part.n_vnodes = n_vnodes
+        part.vnodes = frozenset(range(n_vnodes))
+        self.jobs[self.jobs.index(job)] = part
+        entry.job = part
+        entry.mv_state_index = (entry.mv_state_index[0] + 1,) \
+            + tuple(entry.mv_state_index[1:])
+        self._serving_cache = {}
+        return {
+            "partitioned": True,
+            "dist": agg.group_by[0][0],
+            "dml_tables": self._dml_tables_of(part),
+        }
+
+    def set_job_vnodes(self, name: str, vnodes) -> None:
+        """Swap the partition's owned-vnode mask (STATE, not code: the
+        compiled fragment programs never retrace)."""
+        entry = self.catalog.get(name)
+        job = entry.job
+        gi = job.vnode_gate_idx
+        gate = job.fragment.executors[gi]
+        job.vnodes = frozenset(int(v) for v in vnodes)
+        states = list(job.states)
+        states[gi] = gate.make_mask(job.vnodes)
+        job.states = tuple(states)
+
+    def repartition_job(self, name: str, vnodes, transfers: list,
+                        rewind_epoch: int | None = None) -> dict:
+        """Apply one handover step to this worker's partition: rewind
+        to the handover epoch if the partition ran ahead (uncommitted
+        round), evict stale entries in the gained vnodes, transplant
+        each donor's checkpoint slice, then swap the owned mask.
+
+        ``transfers``: ``[{"ckpt": donor_lineage, "epoch": e,
+        "vnodes": [...]}]`` — the slices are read from the SHARED
+        checkpoint store; only moved vnodes' entries leave disk."""
+        from risingwave_tpu.cluster.scale.handover import (
+            clear_vnodes,
+            slice_partition_states,
+            transplant,
+        )
+        from risingwave_tpu.stream.runtime import restore_source
+
+        entry = self.catalog.get(name)
+        job = entry.job
+        if not hasattr(job, "vnode_gate_idx"):
+            raise PlanError(f"{name!r} is not a partitioned job")
+        if rewind_epoch is not None and (
+                job.committed_epoch != rewind_epoch
+                or job.sealed_epoch != rewind_epoch):
+            job.recover(rewind_epoch)
+        stats = []
+        cleared = 0
+        if transfers:
+            executors = job.fragment.executors
+            gained = sorted(
+                set(int(v) for t in transfers for v in t["vnodes"])
+            )
+            job.states, cleared = clear_vnodes(
+                executors, job.states, gained, job.n_vnodes
+            )
+            fresh = job.barriers_seen == 0 and job.committed_epoch == 0
+            for t in transfers:
+                loaded = self.checkpoint_store.load(
+                    t["ckpt"], int(t["epoch"])
+                )
+                if loaded is None:
+                    raise RuntimeError(
+                        f"donor checkpoint {t['ckpt']}@{t['epoch']} "
+                        "not found in the shared store"
+                    )
+                _, d_states, d_src = loaded
+                sl = slice_partition_states(
+                    executors, d_states, t["vnodes"], job.n_vnodes
+                )
+                job.states, moved = transplant(
+                    executors, job.states, sl
+                )
+                if fresh:
+                    # all donors sealed the same round over the same
+                    # replicated stream: any donor's cursor is THE
+                    # cursor of the handover epoch
+                    restore_source(job.source, d_src)
+                    fresh = False
+                else:
+                    ours = job.source.state() \
+                        if hasattr(job.source, "state") else {}
+                    if ("offset" in ours and "offset" in d_src
+                            and ours["offset"] != d_src["offset"]):
+                        raise RuntimeError(
+                            f"handover cursor mismatch for {name!r}: "
+                            f"local {ours['offset']} vs donor "
+                            f"{d_src['offset']}"
+                        )
+                stats.append({
+                    "ckpt": t["ckpt"],
+                    "vnodes": len(t["vnodes"]),
+                    "entries": moved,
+                })
+        self.set_job_vnodes(name, vnodes)
+        # the export diff base is vnode-filtered: ownership changed, so
+        # it re-seeds from the shared manifest on the next export
+        self._exported.clear()
+        return {"vnodes": len(job.vnodes), "cleared": cleared,
+                "transfers": stats}
+
+    def _vnode_filtered_mv_state(self, st, vn_set, n_vn):
+        """A materialize state narrowed to one vnode set: occupancy is
+        masked by the stored leading-pk vnode, so stale slots (state a
+        handover left behind) and co-owned rows never surface in reads
+        or exports."""
+        import jax.numpy as jnp
+
+        from risingwave_tpu.cluster.scale.vnode import (
+            vnode_member_mask,
+            vnodes_of_ints,
+        )
+        from risingwave_tpu.state.hash_table import HashTable
+        from risingwave_tpu.stream.materialize import MvState
+
+        member = vnode_member_mask(vn_set, n_vn)
+        key0 = st.table.key_cols[0]
+        payload = key0.data if hasattr(key0, "null") else key0
+        vn = vnodes_of_ints(payload, n_vn)
+        occ = jnp.asarray(st.table.occupied) & member[vn]
+        table = HashTable(st.table.key_cols, occ,
+                          jnp.asarray(st.table.tombstone),
+                          st.table.size)
+        return MvState(table, st.values, st.overflow)
 
     def collect_join_metrics(self) -> None:
         """Export join-path observability into the Prometheus registry.
@@ -2029,10 +2318,31 @@ class Engine:
                    for lv in v.levels for s in lv]
         try:
             lo, hi = mv_key_range(name)
-            return dict(merge_scan(readers, lo, hi))
+            base = dict(merge_scan(readers, lo, hi))
         finally:
             for r in readers:
                 r.close()
+        entry = self.catalog.get(name) if name in self.catalog else None
+        if entry is None or getattr(entry.job, "n_vnodes", None) is None:
+            return base
+        # partitioned MV: the manifest holds EVERY partition's rows;
+        # the diff base keeps only this partition's vnodes, so narrowed
+        # ownership never emits tombstones for rows another partition
+        # now owns (and gained rows never re-upload unchanged)
+        import pickle as _pickle
+
+        from risingwave_tpu.cluster.scale.vnode import vnodes_of_ints
+
+        if not base:
+            return base
+        pk0 = entry.mv_executor.pk_indices[0]
+        keys = list(base)
+        vals = np.asarray(
+            [int(_pickle.loads(base[k])[pk0]) for k in keys], np.int64
+        )
+        vn = np.asarray(vnodes_of_ints(vals, entry.job.n_vnodes))
+        own = {int(v) for v in entry.job.vnodes}
+        return {k: base[k] for k, v in zip(keys, vn) if int(v) in own}
 
     def export_mv_deltas(self, job_name: str, epoch: int) -> list:
         """Cluster-mode per-barrier MV export: diff every MV riding
@@ -2136,9 +2446,22 @@ class Engine:
             vals = vals.astype(np.float64) / 10**f.decimal_scale
         return vals.tolist(), False
 
+    def _mv_vnode_set(self, entry: CatalogEntry):
+        """(vnode_set, n_vnodes) a read of this MV must narrow to, or
+        (None, None).  An explicit per-read override (the meta passes
+        the map AT THE PINNED ROUND) wins over the partition's current
+        ownership."""
+        n_vn = getattr(entry.job, "n_vnodes", None)
+        if n_vn is None:
+            return None, None
+        override = getattr(self, "_serve_vnodes", None)
+        return (override if override is not None
+                else entry.job.vnodes), n_vn
+
     def _mv_rows(self, entry: CatalogEntry):
         from risingwave_tpu.stream.sharded import ShardedStreamingJob
 
+        vn_set, n_vn = self._mv_vnode_set(entry)
         # time travel: SET query_epoch reads a retained historical
         # checkpoint (ref FOR SYSTEM_TIME AS OF over Hummock versions,
         # time_travel_version_cache.rs)
@@ -2148,9 +2471,10 @@ class Engine:
                 raise PlanError(
                     "query_epoch needs a durable data_dir"
                 )
-            # checkpoints live under the JOB's name — an MV attached
-            # to a shared DagJob (MV-on-MV) reads its job's snapshot
-            ckpt_name = entry.job.name
+            # checkpoints live under the JOB's lineage key — an MV
+            # attached to a shared DagJob (MV-on-MV) reads its job's
+            # snapshot; a partitioned job reads its own partition's
+            ckpt_name = getattr(entry.job, "ckpt_key", entry.job.name)
             epochs = self.checkpoint_store.epochs(ckpt_name)
             if qe not in epochs:
                 raise PlanError(
@@ -2169,6 +2493,8 @@ class Engine:
                         _jax.tree.map(lambda x: x[shard], st)
                     ))
                 return rows
+            if vn_set is not None:
+                st = self._vnode_filtered_mv_state(st, vn_set, n_vn)
             return entry.mv_executor.to_host(st)
 
         idx = entry.mv_state_index
@@ -2179,6 +2505,8 @@ class Engine:
         state = entry.job.states
         for i in idx:
             state = state[i]
+        if vn_set is not None:
+            state = self._vnode_filtered_mv_state(state, vn_set, n_vn)
         return entry.mv_executor.to_host(state)
 
     @staticmethod

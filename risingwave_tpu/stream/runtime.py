@@ -101,6 +101,18 @@ class CheckpointPipelineMixin:
         self._uploader = None
         self._sinks_due = False
 
+    @property
+    def ckpt_key(self) -> str:
+        """Durable-store key of this job's checkpoint lineage.  A
+        partitioned job (cluster scale plane) runs one replica per
+        worker over ONE shared store — each partition checkpoints
+        under its own lineage key instead of the job name."""
+        return getattr(self, "_ckpt_key", None) or self.name
+
+    @ckpt_key.setter
+    def ckpt_key(self, value: str) -> None:
+        self._ckpt_key = value
+
     # -- uploader plumbing ----------------------------------------------
     def _ensure_uploader(self):
         if self._uploader is None and self.checkpoint_store is not None:
@@ -108,7 +120,8 @@ class CheckpointPipelineMixin:
                 CheckpointUploader,
             )
             self._uploader = CheckpointUploader(
-                self.checkpoint_store, self.name, metrics=self.metrics
+                self.checkpoint_store, self.ckpt_key,
+                metrics=self.metrics,
             )
         return self._uploader
 
@@ -165,7 +178,7 @@ class CheckpointPipelineMixin:
                 up.drain()
                 self._process_upload_acks()
             if store is not None:
-                store.invalidate(self.name)
+                store.invalidate(self.ckpt_key)
             self._shadow = None
         if self._shadow is None:
             self._shadow = ShadowSnapshot(
@@ -562,7 +575,7 @@ class StreamingJob(CheckpointPipelineMixin):
         # snapshot and the durable save
         spill_host = {i: tier.snapshot() for i, _, _, tier in self._spill
                       if tier.rows_absorbed}
-        spill_items = [(f"{self.name}@spill{i}", spill_host[i])
+        spill_items = [(f"{self.ckpt_key}@spill{i}", spill_host[i])
                        for i in spill_host]
         self._snapshot_commit(epoch_val, src_state, spill_host,
                               spill_items)
@@ -576,13 +589,15 @@ class StreamingJob(CheckpointPipelineMixin):
             self.paused = True
 
     # -- recovery -------------------------------------------------------
-    def recover(self) -> None:
+    def recover(self, epoch: int | None = None) -> None:
         """Reset to the last committed checkpoint (ref §3.5 recovery:
         rebuild actors + resume from last committed epoch).  Drains the
         upload queue first (sealed epochs finish becoming durable, a
         failed upload is swallowed — the rewind IS its resolution),
         then prefers the durable store (survives process restarts) over
-        the in-memory shadow."""
+        the in-memory shadow.  ``epoch`` pins the rewind to a specific
+        retained checkpoint (the scale plane rewinds survivors to the
+        handover round before transplanting moved-vnode slices)."""
         self._counters = None
         if self._uploader is not None:
             self._uploader.drain(raise_error=False)
@@ -596,19 +611,19 @@ class StreamingJob(CheckpointPipelineMixin):
             # overwrite a valid chain entry with a wrong-base delta
             # (invalidate also vacuums orphan files a crashed upload
             # left between object write and manifest commit)
-            self.checkpoint_store.invalidate(self.name)
-            loaded = self.checkpoint_store.load(self.name)
+            self.checkpoint_store.invalidate(self.ckpt_key)
+            loaded = self.checkpoint_store.load(self.ckpt_key, epoch)
             if loaded is not None:
-                epoch, states, src_state = loaded
+                epoch_v, states, src_state = loaded
                 self.states = jax.device_put(states)
-                self.committed_epoch = epoch
-                self.sealed_epoch = epoch
+                self.committed_epoch = epoch_v
+                self.sealed_epoch = epoch_v
                 restore_source(self.source, src_state)
                 for i, _, _, tier in self._spill:
-                    key = f"{self.name}@spill{i}"
+                    key = f"{self.ckpt_key}@spill{i}"
                     self.checkpoint_store.invalidate(key)
                     rewind_spill_tier(
-                        self.checkpoint_store, key, epoch, tier
+                        self.checkpoint_store, key, epoch_v, tier
                     )
                 return
         if not self.checkpoints:

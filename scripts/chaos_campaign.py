@@ -32,7 +32,15 @@ faults, same replay):
                        checkpoint uploads (lost AND durable-then-error
                        modes) during the pipelined async upload; the
                        uploader's RetryPolicy absorbs them off the
-                       barrier path.
+                       barrier path;
+- ``scale_storm``      RPC drops on the worker↔worker EXCHANGE seam
+                       (fan-out + catch-up fetch of a vnode-
+                       partitioned job's replicated table) while the
+                       cluster SCALES OUT mid-stream: retries plus
+                       the barrier-fence repair fetch must absorb
+                       every drop, the handover must move exactly the
+                       minimal vnode set, and the MV must converge
+                       byte-identically.
 
 Run standalone (prints one JSON summary line per schedule)::
 
@@ -84,7 +92,18 @@ READS = [
     "SELECT a, n, vol FROM qcnt",
 ]
 
-SCHEDULES = ("rpc_drop_storm", "meta_kill", "store_faults")
+SCHEDULES = ("rpc_drop_storm", "meta_kill", "store_faults",
+             "scale_storm")
+
+#: scale_storm topology: a vnode-partitioned aggregation over a
+#: replicated DML table (the worker↔worker exchange seam under test)
+SCALE_DDL = [
+    "CREATE TABLE t (k BIGINT, v BIGINT)",
+    """CREATE MATERIALIZED VIEW agg AS
+    SELECT k, count(*) AS n, sum(v) AS s, max(v) AS mx
+    FROM t GROUP BY k""",
+]
+SCALE_READ = "SELECT k, n, s, mx FROM agg"
 
 
 def _free_port() -> int:
@@ -120,13 +139,17 @@ def _env(fault_env: dict | None) -> dict:
 
 
 def _spawn_meta(data_dir: str, rpc_port: int, tag: str,
-                fault_env: dict | None = None):
+                fault_env: dict | None = None,
+                scale_partitioning: bool = False):
+    argv = [sys.executable, "-m", "risingwave_tpu.server",
+            "--role", "meta", "--port", str(_free_port()),
+            "--rpc-port", str(rpc_port), "--data-dir", data_dir,
+            "--heartbeat-timeout", "3.0",
+            "--barrier-interval-ms", "0"]  # the driver owns the cadence
+    if scale_partitioning:
+        argv.append("--scale-partitioning")
     proc = subprocess.Popen(
-        [sys.executable, "-m", "risingwave_tpu.server",
-         "--role", "meta", "--port", str(_free_port()),
-         "--rpc-port", str(rpc_port), "--data-dir", data_dir,
-         "--heartbeat-timeout", "3.0",
-         "--barrier-interval-ms", "0"],  # the driver owns the cadence
+        argv,
         stdout=subprocess.DEVNULL,
         stderr=open(os.path.join(data_dir, f"meta_{tag}.log"), "wb"),
         env=_env(fault_env),
@@ -210,6 +233,16 @@ def _fault_envs(schedule: str, seed: int) -> dict:
             modes=("before", "after"),
         )
         return {"worker": worker_fab.to_json()}
+    if schedule == "scale_storm":
+        # drops on the worker↔worker peer seam only: exchange fan-out,
+        # catch-up fetch_table, repartition-era forwards — the labels
+        # are ``worker{i}>worker{j}/<method>``, so ``>worker`` never
+        # matches a worker's meta-bound RPCs
+        peer_fab = FaultFabric.storm(
+            seed, op="rpc", substr=">worker", n=8, span=8,
+            modes=("drop",),
+        )
+        return {"worker": peer_fab.to_json()}
     return {}
 
 
@@ -217,6 +250,10 @@ def run_schedule(schedule: str, seed: int = 7, rounds: int = 10,
                  kill_at_round: int = 4, readers: int = 2,
                  data_dir: str | None = None) -> dict:
     assert schedule in SCHEDULES, schedule
+    if schedule == "scale_storm":
+        return run_scale_storm(seed=seed, rounds=rounds,
+                               scale_at_round=kill_at_round,
+                               readers=readers, data_dir=data_dir)
     data_dir = data_dir or tempfile.mkdtemp(
         prefix=f"chaos_{schedule}_")
     envs = _fault_envs(schedule, seed)
@@ -404,6 +441,188 @@ def _schedule_ok(schedule: str, s: dict) -> bool:
         # faults hit the async upload path and were retried there
         return s["faults_injected"] > 0 and s["upload_retries"] > 0
     return True
+
+
+def run_scale_storm(seed: int = 7, rounds: int = 10,
+                    scale_at_round: int = 4, readers: int = 2,
+                    data_dir: str | None = None) -> dict:
+    """Seeded drops on the worker↔worker exchange seam while the
+    cluster scales out mid-stream (see module docstring)."""
+    data_dir = data_dir or tempfile.mkdtemp(prefix="chaos_scale_")
+    envs = _fault_envs("scale_storm", seed)
+    deterministic = envs == _fault_envs("scale_storm", seed)
+
+    rpc_port = _free_port()
+    meta_proc = _spawn_meta(data_dir, rpc_port, "a",
+                            scale_partitioning=True)
+    _wait_port(rpc_port)
+    procs = [_spawn_worker(rpc_port, data_dir, i,
+                           fault_env=envs.get("worker"))
+             for i in range(2)]
+    driver = MetaDriver(rpc_port)
+    state = {"reads": 0, "read_errors": [], "tick_retries": 0,
+             "rows": []}
+    stop = threading.Event()
+
+    def read_loop():
+        while not stop.is_set():
+            try:
+                driver.call("serve", sql=SCALE_READ, deadline_s=180.0)
+                state["reads"] += 1
+            except Exception as e:  # noqa: BLE001
+                state["read_errors"].append(repr(e))
+            time.sleep(0.05)
+
+    def drive_round(deadline_s: float = 240.0) -> None:
+        deadline = time.monotonic() + deadline_s
+        while True:
+            res = driver.call("tick", chunks_per_barrier=2)
+            if res["committed"]:
+                return
+            state["tick_retries"] += 1
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"round never committed (scale_storm, seed {seed})")
+            time.sleep(0.2)
+
+    try:
+        deadline = time.monotonic() + 180
+        while True:
+            st = driver.call("cluster_state", deadline_s=120.0)
+            if sum(w["alive"] for w in st["workers"]) >= 2:
+                break
+            for p in procs:
+                if p.poll() is not None:
+                    raise RuntimeError(
+                        f"worker died at startup (logs in {data_dir})")
+            if time.monotonic() > deadline:
+                raise TimeoutError("cluster never assembled")
+            time.sleep(0.25)
+
+        driver.call("cluster_scale", n=1)  # capacity starts at ONE
+        for sql in SCALE_DDL:
+            driver.call("execute_ddl", sql=sql)
+
+        def ingest(i0: int, n: int) -> None:
+            rows = [((i0 + j) % 97, 3 * (i0 + j) + 1) for j in range(n)]
+            vals = ",".join(f"({k},{v})" for k, v in rows)
+            # the meta forwards ONE statement to the ingest leader;
+            # the leader's fan-out (the seam under storm) is peer RPC
+            driver.call("execute_ddl",
+                        sql=f"INSERT INTO t VALUES {vals}")
+            state["rows"].extend(rows)
+
+        threads = [threading.Thread(target=read_loop, daemon=True)
+                   for _ in range(readers)]
+        for t in threads:
+            t.start()
+
+        scale_out = None
+        i0 = 0
+        committed = 0
+        while committed < rounds:
+            # several small batches per round: each fan-out is one
+            # peer RPC, so the storm has real traffic to hit
+            for _ in range(4):
+                ingest(i0, 24)
+                i0 += 24
+            drive_round()
+            committed = int(driver.call(
+                "cluster_state")["cluster_epoch"])
+            if scale_out is None and committed >= scale_at_round:
+                # DOUBLE mid-stream, exchange storm active
+                scale_out = driver.call("cluster_scale", n=2,
+                                        deadline_s=600.0)
+        total = len(state["rows"])
+        drain_deadline = time.monotonic() + 300
+        while True:
+            drive_round()
+            rows = driver.call("serve", sql=SCALE_READ)["rows"]
+            if sum(int(r[1]) for r in rows) == total:
+                break
+            if time.monotonic() > drain_deadline:
+                raise TimeoutError("scale_storm never drained")
+
+        stop.set()
+        for t in threads:
+            t.join(timeout=15)
+        faults = driver.call("cluster_faults")
+        final_state = driver.call("cluster_state")
+        cluster_rows = sorted(
+            tuple(int(x) for x in r)
+            for r in driver.call("serve", sql=SCALE_READ)["rows"]
+        )
+    finally:
+        stop.set()
+        for p in procs + [meta_proc]:
+            if p.poll() is None:
+                p.kill()
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+        driver.close()
+
+    from risingwave_tpu.common.config import RwConfig
+    from risingwave_tpu.sql.engine import Engine
+
+    eng = Engine(RwConfig.from_dict(CONFIG))
+    for sql in SCALE_DDL:
+        eng.execute(sql)
+    sent = state["rows"]
+    for i in range(0, len(sent), 1024):
+        vals = ",".join(f"({k},{v})" for k, v in sent[i:i + 1024])
+        eng.execute(f"INSERT INTO t VALUES {vals}")
+    for _ in range(4096):
+        eng.tick(barriers=1, chunks_per_barrier=2)
+        if sum(int(r[1]) for r in eng.execute(SCALE_READ)) \
+                == len(sent):
+            break
+    single_rows = sorted(
+        tuple(int(x) for x in r) for r in eng.execute(SCALE_READ)
+    )
+
+    worker_faults = [v for v in faults["workers"].values() if v]
+    injected = sum((v["fabric"] or {}).get("injected_total", 0)
+                   for v in worker_faults)
+    absorbed = sum(v["rpc_retries_total"]
+                   + v.get("exchange_fetches", 0)
+                   + v.get("exchange_send_failures", 0)
+                   for v in worker_faults)
+    summary = {
+        "schedule": "scale_storm",
+        "seed": seed,
+        "deterministic_expansion": deterministic,
+        "rounds": rounds,
+        "rounds_committed": int(final_state["cluster_epoch"]),
+        "rows_ingested": len(sent),
+        "reads": state["reads"],
+        "read_errors": len(state["read_errors"]),
+        "read_error_samples": state["read_errors"][:3],
+        "tick_retries": state["tick_retries"],
+        "scale_out_moved_vnodes":
+            scale_out["moved_vnodes"] if scale_out else 0,
+        "active_workers":
+            final_state["scale"]["active_workers"],
+        "faults_injected": injected,
+        "exchange_faults_absorbed": absorbed,
+        "exchange_rows_in": sum(v.get("exchange_rows_in", 0)
+                                for v in worker_faults),
+        "mv_mismatches": int(cluster_rows != single_rows),
+        "mv_rows": len(cluster_rows),
+        "data_dir": data_dir,
+    }
+    summary["ok"] = bool(
+        summary["deterministic_expansion"]
+        and summary["read_errors"] == 0
+        and summary["rounds_committed"] >= rounds
+        and summary["mv_mismatches"] == 0
+        and summary["scale_out_moved_vnodes"] == 32
+        and summary["faults_injected"] > 0
+        and summary["exchange_faults_absorbed"] > 0
+        and summary["active_workers"] == [1, 2]
+    )
+    return summary
 
 
 def _swallow(fn) -> None:

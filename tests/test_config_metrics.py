@@ -305,6 +305,63 @@ def test_cluster_metrics_exported(tmp_path):
         meta.stop()
 
 
+def test_worker_removal_retires_per_worker_series(tmp_path):
+    """ISSUE 7 satellite: after a worker is REMOVED — scale-in
+    deregistration or death — every one of its per-worker labeled
+    series (heartbeat age, vnode count) leaves the scrape surface
+    instead of lingering forever."""
+    import time
+
+    from risingwave_tpu.cluster import ComputeWorker, MetaService
+
+    meta = MetaService(str(tmp_path), heartbeat_timeout_s=0.8,
+                       scale_partitioning=True, n_vnodes=16)
+    meta.start(port=0, monitor=False)
+    addr = f"127.0.0.1:{meta.rpc_port}"
+    w1 = ComputeWorker(addr, str(tmp_path),
+                       heartbeat_interval_s=0.2).start()
+    w2 = ComputeWorker(addr, str(tmp_path),
+                       heartbeat_interval_s=0.2).start()
+    try:
+        meta.scale(2)  # cuts the map: per-worker vnode gauges exist
+        meta.check_heartbeats()
+        m = meta.metrics
+        for w in (w1, w2):
+            assert m.get("cluster_worker_vnodes",
+                         worker=str(w.worker_id)) == 8
+            assert m.get("cluster_worker_heartbeat_age_seconds",
+                         worker=str(w.worker_id)) >= 0.0
+
+        # graceful deregistration (the scale-in decommission path);
+        # the process stops FIRST — a live worker would re-register
+        # through its heartbeat loop, which is exactly the point of
+        # that loop
+        w2.stop()
+        meta.rpc_unregister_worker(w2.worker_id)
+        text = m.render_prometheus()
+        assert f'worker="{w2.worker_id}"' not in text
+        assert f'worker="{w1.worker_id}"' in text
+        for name in ("cluster_worker_heartbeat_age_seconds",
+                     "cluster_worker_vnodes"):
+            with pytest.raises(KeyError):
+                m.get(name, worker=str(w2.worker_id))
+        assert w2.worker_id not in meta.workers  # fully removed
+
+        # death path retires the same series
+        w1.stop()
+        deadline = time.monotonic() + 10
+        while meta.metrics.get("cluster_live_workers") > 0:
+            assert time.monotonic() < deadline
+            time.sleep(0.1)
+            meta.check_heartbeats()
+        assert f'worker="{w1.worker_id}"' \
+            not in m.render_prometheus()
+    finally:
+        w1.stop()
+        w2.stop()
+        meta.stop()
+
+
 def test_fault_and_retry_gauges_exported(tmp_path):
     """ISSUE 6 satellite: the chaos fabric's injected counters and the
     unified RetryPolicy's budget spend are first-class metrics — per-op

@@ -82,6 +82,7 @@ def test_ctl_cluster_subcommands(tmp_path):
             "committed_epoch": jobs[0]["committed_epoch"],
             "sealed_epoch": jobs[0]["sealed_epoch"],
             "durable_epoch": jobs[0]["durable_epoch"],
+            "partitions": None,
         }]
         assert jobs[0]["pinned_epoch"] > 0
         assert jobs[0]["pinned_epoch"] == jobs[0]["committed_epoch"]

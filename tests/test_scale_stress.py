@@ -1,0 +1,32 @@
+"""Slow wrapper over scripts/scale_stress.py (the ISSUE 7 acceptance
+harness), matching the cluster_stress pattern: double then halve the
+worker set mid-stream under sustained ingest with concurrent
+epoch-pinned reads."""
+
+import pytest
+
+
+@pytest.mark.slow
+def test_scale_stress_short(tmp_path):
+    import importlib
+    import sys
+
+    sys.path.insert(0, "scripts")
+    try:
+        ss = importlib.import_module("scale_stress")
+    finally:
+        sys.path.pop(0)
+
+    summary = ss.run(rounds_per_phase=4, readers=2,
+                     data_dir=str(tmp_path))
+    assert summary["read_errors"] == 0, summary["read_error_samples"]
+    assert summary["ingest_errors"] == 0
+    assert not summary["mv_mismatch"]
+    # only moved vnodes transferred, both directions minimal
+    assert summary["scale_out_minimal"]
+    assert summary["scale_in_minimal"]
+    # the per-chunk path flowed worker-to-worker, the meta stayed flat
+    assert summary["exchange_rows_out"] > 0
+    assert summary["exchange_rows_in"] > 0
+    assert summary["meta_dml_forwards"] == 0
+    assert summary["reads"] > 0

@@ -124,10 +124,22 @@ def test_plan_read_shapes():
     p2 = plan("SELECT a FROM m WHERE 2 <= a AND 7 > a LIMIT 5")
     assert (p2.lo, p2.hi) == (p.lo, p.hi)
 
+    # ORDER BY pushdown: scan order already IS memcomparable-pk order,
+    # so an ascending pk prefix is a no-op the replica accepts
+    p = plan("SELECT a, b FROM m ORDER BY a LIMIT 3")
+    assert p.mode == "scan" and p.limit == 3
+    p = plan("SELECT a, b FROM m ORDER BY a, b LIMIT 3 OFFSET 1")
+    assert p.mode == "scan" and p.limit == 3 and p.offset == 1
+    p = plan("SELECT b FROM m WHERE a >= 2 ORDER BY a")
+    assert p.mode == "scan" and p.lo > b"m:m\x00"
+
     for bad in [
         "SELECT count(*) FROM m",                  # aggregate
         "SELECT a FROM m GROUP BY a",              # group by
-        "SELECT a FROM m ORDER BY a",              # order by
+        "SELECT a FROM m ORDER BY a DESC",         # descending
+        "SELECT a FROM m ORDER BY b",              # not a pk PREFIX
+        "SELECT a FROM m ORDER BY a, b, a",        # beyond the pk
+        "SELECT a FROM m ORDER BY a + 1",          # expression key
         "SELECT a FROM m WHERE b = 1",             # non-leading pk range
         "SELECT a + 1 FROM m",                     # expression
         "SELECT a FROM m WHERE a + 1 = 2",         # computed predicate
