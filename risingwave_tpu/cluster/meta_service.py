@@ -214,11 +214,13 @@ class MetaService:
                  retry_base_delay_s: float = 0.05,
                  retry_max_delay_s: float = 0.5,
                  n_vnodes: int = 64,
-                 scale_partitioning: bool = False):
+                 scale_partitioning: bool = False,
+                 scrub_interval_s: float = 30.0):
         from risingwave_tpu.storage.hummock import (
             CompactorService,
             HummockStorage,
             LocalFsObjectStore,
+            ScrubberService,
         )
 
         self.data_dir = data_dir
@@ -250,6 +252,29 @@ class MetaService:
         # core with the barrier loop and the RPC server
         self.compactor = CompactorService(self.hummock,
                                           poll_interval_s=0.05)
+        # -- integrity: scrub + quarantine + self-healing repair -------
+        #: corrupt objects currently under repair (dedups concurrent
+        #: detections of the same object)
+        self._repairing: set = set()
+        self._repair_lock = threading.Lock()
+        self.repairs = {"sst": 0, "checkpoint": 0}
+        #: corrupt SST keys workers surfaced through barrier responses
+        #: (repaired after the round, outside the tick lock)
+        self._corrupt_reports: list = []
+        #: every detection point routes here: compaction reads, scrub
+        #: walks, serving-replica reports — quarantine + repair, off
+        #: the latency path
+        self.hummock.on_corruption = self._on_corruption
+        #: the background scrubber (meta-owned, a compactor sibling):
+        #: paced off-barrier verification of every pinned-version SST
+        #: and retained checkpoint lineage over the SHARED data_dir
+        self.scrubber = ScrubberService(
+            self.hummock,
+            ckpt_object_store=LocalFsObjectStore(data_dir),
+            metrics=self.metrics,
+            interval_s=scrub_interval_s,
+            on_corruption=self._on_corruption,
+        )
         self._lock = threading.RLock()
         #: serializes barrier rounds AND failover reassignment: a job
         #: is never adopted while one of its barrier RPCs is in flight
@@ -383,7 +408,7 @@ class MetaService:
 
     def start(self, host: str = "127.0.0.1", port: int = 0,
               monitor: bool = True, compactor: bool = True,
-              ) -> "MetaService":
+              scrubber: bool = True) -> "MetaService":
         self._stop.clear()
         self._server = RpcServer(self, host, port).start()
         if monitor:
@@ -397,10 +422,15 @@ class MetaService:
             # manifest's single writer); in-process tests may pass
             # compactor=False and drive hummock.compact_once directly
             self.compactor.start()
+        if scrubber:
+            # the scrub walk is read-only + paced; repairs go through
+            # the same quarantine pipeline every detection point uses
+            self.scrubber.start()
         return self
 
     def stop(self) -> None:
         self._stop.set()
+        self.scrubber.stop()
         self.compactor.stop()
         if self._monitor is not None:
             self._monitor.join(timeout=5)
@@ -678,6 +708,208 @@ class MetaService:
     def rpc_storage_vacuum(self) -> dict:
         return self.storage_vacuum()
 
+    # -- integrity: corruption reports, quarantine, self-healing repair --
+    def _on_corruption(self, kind: str, key: str,
+                       context: "dict | None" = None) -> None:
+        """Sink for every meta-side detection point (scrub walk,
+        compaction read).  Repairs run synchronously on the calling
+        background thread — both are already off the latency path."""
+        self.report_corruption(key, kind=kind,
+                               reason=(context or {}).get("error", ""),
+                               by="scrubber", sync=True)
+
+    def rpc_report_corruption(self, key: str, kind: str = "sst",
+                              reason: str = "", by: str = "") -> dict:
+        """A peer (serving replica, compute worker) hit corrupt shared
+        bytes: quarantine immediately, repair in the background so the
+        reporter's read path is never blocked on the repair."""
+        return self.report_corruption(key, kind=kind, reason=reason,
+                                      by=by, sync=False)
+
+    def report_corruption(self, key: str, kind: str = "sst",
+                          reason: str = "", by: str = "",
+                          sync: bool = True) -> dict:
+        self.metrics.inc("integrity_errors_total", kind=kind)
+        with self._repair_lock:
+            if key in self._repairing:
+                return {"ok": True, "repair": "in_progress"}
+            self._repairing.add(key)
+
+        def _run() -> dict:
+            try:
+                if kind in ("sst", "sst_block", "sst_footer"):
+                    self.hummock.quarantine_sst(
+                        key, reason or "reported", by=by or "report")
+                    repaired = self._repair_sst(key)
+                    cat = "sst"
+                elif kind == "checkpoint":
+                    repaired = self._repair_checkpoint(key)
+                    cat = "checkpoint"
+                else:
+                    # manifest chain damage has no re-derivable source:
+                    # durable note + loud metric, operator escalation
+                    from risingwave_tpu.storage.integrity import (
+                        quarantine,
+                    )
+                    quarantine(self.hummock.store, key,
+                               reason or "manifest corruption",
+                               by=by or "report",
+                               metrics=self.metrics)
+                    return {"ok": True, "repair": "quarantined"}
+                if repaired is True:
+                    with self._repair_lock:
+                        self.repairs[cat] = self.repairs.get(cat, 0) + 1
+                    self.metrics.inc("integrity_repairs_total",
+                                     kind=cat)
+                return {"ok": True,
+                        "repair": "done" if repaired else "pending"}
+            finally:
+                with self._repair_lock:
+                    self._repairing.discard(key)
+
+        if sync:
+            return _run()
+        threading.Thread(target=_run, name="integrity-repair",
+                         daemon=True).start()
+        return {"ok": True, "repair": "scheduled"}
+
+    def _mvs_overlapping(self, info) -> list[str]:
+        """MV names whose storage key range intersects one SstInfo —
+        the owners whose rows a corrupt export SST may carry."""
+        from risingwave_tpu.serve.reader import mv_key_range
+
+        out = []
+        with self._lock:
+            mvs = list(self._mv_to_job)
+        for mv in mvs:
+            lo, hi = mv_key_range(mv)
+            if info.last_key >= lo and info.first_key < hi:
+                out.append(mv)
+        return out
+
+    def _repair_sst(self, key: str) -> bool:
+        """Self-heal one corrupt MV-export SST: every owning job's live
+        worker re-exports the affected MVs IN FULL (diff base re-seeded
+        from the manifest minus the corrupt object, so shadowed
+        tombstones re-emit), then ONE version delta atomically swaps
+        the corrupt SST for the fresh exports — readers never see a
+        window with the rows missing.  Owners that are dead/unassigned
+        leave the repair pending; the next scrub cycle retries."""
+        with self._tick_lock:
+            v = self.hummock.versions.current
+            info = next((s for lv in v.levels for s in lv
+                         if s.key == key), None)
+            if info is None:
+                # already swapped out (or never committed): nothing to
+                # repair — truthy so the caller stops retrying, but
+                # distinct so it is not COUNTED as a repair
+                return "noop"
+            jobs = sorted({self._mv_to_job[m]
+                           for m in self._mvs_overlapping(info)
+                           if m in self._mv_to_job})
+            targets: list = []
+            with self._lock:
+                for jname in jobs:
+                    job = self.jobs.get(jname)
+                    if job is None:
+                        continue
+                    units = list(job.partitions.values()) \
+                        if job.partitions else [job]
+                    for u in units:
+                        if getattr(u, "retiring", False):
+                            continue
+                        w = self.workers.get(u.worker_id) \
+                            if u.worker_id is not None else None
+                        if w is None or not w.alive:
+                            return False  # owner mid-failover: retry
+                        targets.append((jname, w))
+            from risingwave_tpu.storage.hummock.version import SstInfo
+
+            fresh: list[SstInfo] = []
+            for jname, w in targets:
+                try:
+                    res = self.retry.run(
+                        lambda w=w, jname=jname: w.client.call(
+                            "reexport", job=jname, exclude=[key]),
+                        label="reexport",
+                    )
+                except (RpcError, ConnectionError, OSError):
+                    return False  # keep the corrupt SST until healed
+                for s in res.get("ssts") or []:
+                    fresh.append(SstInfo(
+                        key=s["key"],
+                        first_key=bytes.fromhex(s["first_key"]),
+                        last_key=bytes.fromhex(s["last_key"]),
+                        n_records=int(s["n_records"]),
+                        size=int(s["size"]),
+                    ))
+            self.hummock.replace_sst(key, fresh)
+            return True
+
+    def _repair_checkpoint(self, key: str) -> bool:
+        """Route a corrupt checkpoint epoch object to its OWNING worker
+        for lineage repair (quarantine + truncate to the last verified
+        epoch — the worker holds the manifest lock for its own
+        commits).  An ownerless lineage self-heals at its next
+        adoption: the verified load rewinds past the corruption."""
+        lineage = key.split("/epoch_")[0].split("@spill")[0]
+        with self._lock:
+            target = None
+            for j in self.jobs.values():
+                if j.partitions:
+                    p = j.partitions.get(lineage)
+                    if p is not None and p.worker_id is not None:
+                        target = (self.workers.get(p.worker_id), j.name)
+                elif j.name == lineage and j.worker_id is not None:
+                    target = (self.workers.get(j.worker_id), j.name)
+        if target is None or target[0] is None or not target[0].alive:
+            return False
+        w, _jname = target
+        try:
+            res = self.retry.run(
+                lambda: w.client.call("repair_checkpoint",
+                                      lineage=lineage),
+                label="repair_checkpoint",
+            )
+        except (RpcError, ConnectionError, OSError):
+            return False
+        return bool(res.get("ok"))
+
+    def _drain_corrupt_reports(self) -> None:
+        """Repair corrupt SSTs workers surfaced in barrier responses
+        (collected under the tick lock, repaired outside it)."""
+        with self._lock:
+            due, self._corrupt_reports = self._corrupt_reports, []
+        for key in due:
+            self.report_corruption(key, kind="sst",
+                                   reason="worker export seam",
+                                   by="worker", sync=True)
+
+    def rpc_cluster_scrub(self) -> dict:
+        return self.cluster_scrub()
+
+    def cluster_scrub(self) -> dict:
+        """``ctl cluster scrub``: ONE full synchronous scrub cycle over
+        every pinned-version SST and retained checkpoint lineage, with
+        the quarantine/repair pipeline armed — plus the integrity
+        bookkeeping an operator needs."""
+        from risingwave_tpu.storage.integrity import quarantine_list
+
+        report = self.scrubber.run_once()
+        report["quarantined"] = [
+            n.get("key") for n in quarantine_list(self.hummock.store)
+        ]
+        if self.scrubber.ckpt_store is not None:
+            # checkpoint quarantine notes live in the checkpoint root
+            # (written by the owning worker's lineage repair)
+            report["quarantined"] += [
+                n.get("key")
+                for n in quarantine_list(self.scrubber.ckpt_store)
+            ]
+        with self._repair_lock:
+            report["repairs"] = dict(self.repairs)
+        return report
+
     # -- DDL / placement -------------------------------------------------
     def rpc_execute_ddl(self, sql: str) -> dict:
         return self.execute_ddl(sql)
@@ -828,7 +1060,16 @@ class MetaService:
                 if not live or not (part_pending or job_pending):
                     return
             if part_pending:
-                if not self._assign_partition(*part_pending[0]):
+                res = self._assign_partition(*part_pending[0])
+                if res == "no_host":
+                    # no spare worker can host the dead partition's
+                    # lineage: merge its vnodes into a survivor via
+                    # the scale-in slice-transplant path instead of
+                    # stalling the round forever
+                    if self._merge_dead_partition(*part_pending[0]):
+                        continue
+                    return
+                if not res:
                     return
                 continue
             job = job_pending[0]
@@ -886,7 +1127,7 @@ class MetaService:
             cands = [w for w in self.workers.values()
                      if w.alive and w.worker_id not in taken]
             if not cands:
-                return False  # every live worker already hosts one
+                return "no_host"  # every live worker already hosts one
             target = min(cands, key=lambda w: (len(w.jobs),
                                                w.worker_id))
         try:
@@ -916,6 +1157,80 @@ class MetaService:
         self._push_routing()
         self._set_vnode_gauges()
         return True
+
+    def _merge_dead_partition(self, job: JobInfo,
+                              p: "PartitionInfo") -> bool:
+        """Merge-failover (the ROADMAP remaining item): a partitioned
+        job's worker died and NO spare worker can host its lineage —
+        instead of stalling the round forever, merge the dead
+        partition's vnodes into a surviving partition through the
+        scale-in slice-transplant path: the recipient rewinds to its
+        own checkpoint at the last COMMITTED round, transplants the
+        dead lineage's slice at that same round (all partitions sealed
+        it durably — the commit required the acks), and widens its
+        mask.  Capacity shrinks; correctness doesn't."""
+        # non-blocking tick-lock acquire: a scale op mid-flight calls
+        # _assign_pending with the lock held — defer to the monitor's
+        # next pass rather than deadlocking
+        if not self._tick_lock.acquire(blocking=False):
+            return False
+        try:
+            round_c = self.cluster_epoch
+            if round_c <= 0:
+                return False
+            with self._lock:
+                epoch_p = next((e for r, e in reversed(p.seal_log)
+                                if r == round_c), None)
+                cands = [
+                    q for q in job.partitions.values()
+                    if q is not p and not q.retiring
+                    and q.worker_id is not None
+                    and (w := self.workers.get(q.worker_id)) is not None
+                    and w.alive
+                ]
+                if not cands:
+                    return False
+                q = min(cands, key=lambda x: (len(x.vnodes), x.lineage))
+                epoch_q = next((e for r, e in reversed(q.seal_log)
+                                if r == round_c), None)
+                w = self.workers[q.worker_id]
+            if epoch_q is None or (p.vnodes and epoch_p is None):
+                return False
+            merged = sorted(set(q.vnodes) | set(p.vnodes))
+            transfers = [{"ckpt": p.lineage, "epoch": epoch_p,
+                          "vnodes": sorted(p.vnodes)}] if p.vnodes \
+                else []
+            try:
+                self.retry.run(
+                    lambda: w.client.call(
+                        "repartition", job=job.name, vnodes=merged,
+                        transfers=transfers, rewind_epoch=epoch_q,
+                    ),
+                    label="repartition",
+                )
+            except (RpcError, ConnectionError, OSError):
+                return False
+            with self._lock:
+                q.vnodes = merged
+                # the recipient rewound to the committed round: drop
+                # any later (uncommitted) seal so the next round
+                # re-seals against the merged state
+                q.seal_log = [(r, e) for r, e in q.seal_log
+                              if r <= round_c]
+                q.rounds = round_c
+                q.durable_epoch = epoch_q
+                job.partitions.pop(p.lineage, None)
+                if self.vnode_map is not None:
+                    for v in p.vnodes:
+                        self.vnode_map[v] = q.worker_id
+                    self.active_workers = sorted(set(self.vnode_map))
+                self.metrics.inc("cluster_merge_failovers_total")
+            self._log_scale_event()
+            self._push_routing()
+            self._set_vnode_gauges()
+            return True
+        finally:
+            self._tick_lock.release()
 
     def _try_partition_place(self, job: JobInfo) -> bool:
         """Fresh partitioned placement: adopt one partition per vnode
@@ -1389,7 +1704,11 @@ class MetaService:
 
     def tick(self, chunks_per_barrier: int = 1) -> dict:
         with self._tick_lock:
-            return self._tick_locked(chunks_per_barrier)
+            res = self._tick_locked(chunks_per_barrier)
+        # corrupt SSTs surfaced by worker export seams during the
+        # round repair OUTSIDE the tick lock (repair re-enters it)
+        self._drain_corrupt_reports()
+        return res
 
     def _tick_locked(self, chunks_per_barrier: int = 1) -> dict:
         """Drive ONE global barrier round: every barrier unit (job or
@@ -1451,6 +1770,9 @@ class MetaService:
             epoch = int(res.get("sealed_epoch",
                                 res["committed_epoch"]))
             ssts = res.get("ssts") or []
+            if res.get("corrupt"):
+                with self._lock:
+                    self._corrupt_reports.extend(res["corrupt"])
             with self._lock:
                 unit.rounds = target
                 unit.seal_log.append((target, epoch))
@@ -1710,11 +2032,13 @@ class MetaService:
                                 vnodes=pv,
                             )
                         except RpcError as e:
-                            if "does not exist" in str(e):
-                                # released donor hit through a plan
-                                # snapshotted just before the commit
-                                # swapped it: stale routing, not a
-                                # failed read — retry the fresh plan
+                            if "does not exist" in str(e) \
+                                    or "is not retained" in str(e):
+                                # stale routing (released donor), or a
+                                # checkpoint repair truncated the
+                                # pinned epoch — both transient: the
+                                # next commit republishes plan + pins.
+                                # Retry, never a failed read
                                 complete = False
                                 break
                             raise  # the engine refused: final
@@ -1733,8 +2057,13 @@ class MetaService:
                     res = w.client.call("serve", sql=sql,
                                         query_epoch=pin)
                     return res["cols"], [tuple(r) for r in res["rows"]]
-                except RpcError:
-                    raise  # the engine refused: final
+                except RpcError as e:
+                    if "is not retained" in str(e):
+                        # a checkpoint repair truncated the pinned
+                        # epoch: wait for the next commit to re-pin
+                        pass
+                    else:
+                        raise  # the engine refused: final
                 except (ConnectionError, OSError):
                     pass  # owner died mid-read: wait for reassignment
             if time.monotonic() > deadline:
@@ -1841,6 +2170,13 @@ class MetaService:
                      ] if j.partitions else None}
                     for j in self.jobs.values()
                 ],
+                "integrity": {
+                    "scrub_cycles": self.scrubber.cycles,
+                    "scrub_objects_verified":
+                        self.scrubber.objects_verified,
+                    "scrub_corruptions": self.scrubber.corruptions,
+                    "repairs": dict(self.repairs),
+                },
                 "scale": {
                     "partitioning": self.scale_partitioning,
                     "n_vnodes": self.n_vnodes,

@@ -481,15 +481,51 @@ class ComputeWorker:
                     self._round_cache[job] = {"round": rnd,
                                               "sealed": sealed,
                                               "result": None}
-            ssts = self.engine.export_mv_deltas(job, sealed)
+            from risingwave_tpu.storage.integrity import IntegrityError
+
+            corrupt: list[str] = []
+            try:
+                ssts = self.engine.export_mv_deltas(job, sealed)
+            except IntegrityError as e:
+                # a corrupt shared SST under the export's diff-base
+                # seeding: seal the round anyway (exports retry next
+                # round) and surface the key so the meta repairs it
+                ssts = []
+                if e.key:
+                    corrupt.append(e.key)
             positions = self.engine.job_epochs(job)
             res = {"ok": True, "committed_epoch": sealed,
                    "sealed_epoch": sealed,
                    "durable_epoch": positions["durable"],
-                   "ssts": ssts}
+                   "ssts": ssts, "corrupt": corrupt}
             if rnd:
                 self._round_cache[job]["result"] = res
         return res
+
+    def rpc_reexport(self, job: str, exclude: list | None = None) -> dict:
+        """Integrity repair: re-export the job's MVs IN FULL against a
+        diff base re-seeded from the shared manifest MINUS the
+        quarantined keys in ``exclude`` — upserts for every row the
+        corrupt SST carried, tombstones for rows it shadowed.  The meta
+        commits the returned SSTs atomically with the corrupt object's
+        removal."""
+        with self._lock:
+            ssts = self.engine.reexport_job_mvs(
+                job, exclude=exclude or ())
+        return {"ok": True, "ssts": ssts}
+
+    def rpc_repair_checkpoint(self, lineage: str) -> dict:
+        """Integrity repair: verify + truncate one checkpoint lineage
+        this worker owns (quarantine corrupt epoch objects, rewind the
+        chain to the last verified epoch).  The next save re-bases with
+        a full snapshot, so the lineage converges forward; a recovery
+        in the window rewinds to the verified epoch and the meta's
+        round-credit rewind replays the gap."""
+        with self._lock:
+            if self.engine.checkpoint_store is None:
+                return {"ok": False, "reason": "no durable store"}
+            rep = self.engine.checkpoint_store.repair_lineage(lineage)
+        return {"ok": True, **rep}
 
     def rpc_job_epochs(self, job: str) -> dict:
         """Seal-vs-durable positions of one job (also services its

@@ -79,6 +79,11 @@ class ClusterConfig:
     #: active worker set (``ctl cluster scale N`` then moves only
     #: vnodes + the state behind them).  Off = whole-job placement.
     scale_partitioning: bool = False
+    #: integrity scrubber (meta-owned): seconds between background
+    #: scrub cycles over pinned-version SSTs + checkpoint lineages
+    #: (0 disables the background thread; ``ctl cluster scrub`` still
+    #: drives cycles on demand)
+    scrub_interval_s: float = 30.0
     #: unified control-RPC retry budget (common/faults.RetryPolicy):
     #: total attempts per idempotent/epoch-guarded call before the
     #: failure surfaces (1 = no retries, the pre-chaos behavior)

@@ -27,7 +27,14 @@ Injection points:
   can be dark while A→meta flows.
 - ``put``/``get``/``delete`` at every ObjectStore (the global fabric
   is consulted next to each store's own ``StoreFaults``), with the
-  same before/after (lost vs durable-then-error) split.
+  same before/after (lost vs durable-then-error) split — plus PAYLOAD
+  corruption modes ``bit_flip``/``truncate`` on put/get: the Nth
+  matching operation's bytes are deterministically damaged (one bit
+  chosen by splitmix64, or the tail cut) instead of erroring, the
+  corruption-storm primitive the integrity layer's detect/quarantine/
+  repair pipeline is proven against.  Corrupted keys are recorded
+  (``corrupted_keys``) so a chaos harness can assert every planted
+  corruption was detected.
 
 Processes: the fabric is process-global (``install``/``get_fabric``)
 and boots from the ``RWT_FAULTS`` env var — a JSON schedule — so a
@@ -57,6 +64,26 @@ def splitmix64(x: int) -> int:
 class FaultInjected(ConnectionError):
     """An injected transport fault (subclasses ConnectionError so every
     peer-unreachable code path handles it identically)."""
+
+
+#: store-rule modes that damage payload bytes instead of erroring
+CORRUPT_MODES = ("bit_flip", "truncate")
+
+
+def corrupt_payload(data: bytes, mode: str, seed: int,
+                    counter: int) -> bytes:
+    """Deterministically damage one payload: flip the splitmix64-chosen
+    bit, or cut the object to half its length.  Pure function of
+    (bytes, mode, seed, counter) — the corruption-storm replay
+    contract."""
+    if not data:
+        return data
+    if mode == "truncate":
+        return data[:max(1, len(data) // 2)]
+    pos = splitmix64((seed << 8) ^ counter) % (len(data) * 8)
+    out = bytearray(data)
+    out[pos >> 3] ^= 1 << (pos & 7)
+    return bytes(out)
 
 
 @dataclass
@@ -104,6 +131,10 @@ class FaultFabric:
         #: totals for assertions/metrics ({op: count})
         self.injected: dict[str, int] = {}
         self.delays: int = 0
+        #: object keys whose payloads a corrupt-mode rule damaged —
+        #: the chaos harness' "every planted corruption detected"
+        #: ground truth
+        self.corrupted_keys: list[str] = []
 
     # -- arming -----------------------------------------------------------
     def fail_rpc(self, substr: str = "", after: int = 0,
@@ -115,8 +146,9 @@ class FaultFabric:
 
     def fail_store(self, op: str, substr: str = "", after: int = 0,
                    mode: str = "before", times: int = 1) -> None:
-        assert op in ("put", "get", "delete") and mode in ("before",
-                                                           "after")
+        assert op in ("put", "get", "delete") \
+            and mode in ("before", "after") + CORRUPT_MODES
+        assert not (mode in CORRUPT_MODES and op == "delete")
         self.rules.append(FabricRule(op, substr, after, mode, times))
 
     def partition(self, src: str, dst: str, times: int = 1 << 30,
@@ -181,6 +213,16 @@ class FaultFabric:
             )
             raise ObjectError(f"injected {op} fault (durable): {key}")
 
+    def store_corrupt(self, rule: "FabricRule | None", key: str,
+                      data: bytes) -> bytes:
+        """Apply a matched corrupt-mode rule to one payload (consulted
+        by the stores between ``store_before`` and the actual I/O)."""
+        if rule is None or rule.mode not in CORRUPT_MODES:
+            return data
+        with self._lock:
+            self.corrupted_keys.append(key)
+        return corrupt_payload(data, rule.mode, self.seed, rule.hits)
+
     # -- introspection -----------------------------------------------------
     def injected_total(self) -> int:
         with self._lock:
@@ -195,6 +237,7 @@ class FaultFabric:
                 "injected": dict(self.injected),
                 "injected_total": sum(self.injected.values()),
                 "delays": self.delays,
+                "corrupted_keys": list(self.corrupted_keys),
             }
 
     # -- (de)serialization -------------------------------------------------

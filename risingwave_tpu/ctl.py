@@ -162,13 +162,54 @@ def _open_storage(data_dir: str):
     )
 
 
+def storage_scrub(data_dir: str) -> dict:
+    """``ctl storage scrub <data_dir>`` — OFFLINE integrity scrub of a
+    node's durable state: every SST in the version (footer crc, index,
+    every block's crc32c trailer), the version log's hash chain, and
+    every retained checkpoint epoch object vs its manifest-recorded
+    crc.  Report-only (no node running, nothing to repair FROM): a
+    corrupt object is listed, never silently read."""
+    from risingwave_tpu.storage.hummock import LocalFsObjectStore
+    from risingwave_tpu.storage.hummock.scrubber import ScrubberService
+    from risingwave_tpu.storage.integrity import (
+        ManifestCorruption,
+        quarantine_list,
+    )
+
+    try:
+        storage = _open_storage(data_dir)
+    except ManifestCorruption as e:
+        # the version log itself is damaged: report instead of crashing
+        return {"ssts_verified": 0, "blocks_verified": 0,
+                "checkpoints_verified": 0,
+                "corrupt": [("manifest", e.key)], "ok": False}
+    scrub = ScrubberService(
+        storage,
+        ckpt_object_store=LocalFsObjectStore(data_dir),
+        pace_s=0.0,
+    )
+    report = scrub.run_once()
+    report["quarantined"] = [
+        n.get("key") for n in quarantine_list(storage.store)
+    ]
+    report["ok"] = not report["corrupt"]
+    return report
+
+
 def _storage_main(argv: list[str]) -> None:
-    """``python -m risingwave_tpu.ctl storage {version|gc} <data_dir>``
-    — offline inspection/GC of a node's storage service state (risectl
-    hummock list-version / trigger-full-gc analogs)."""
+    """``python -m risingwave_tpu.ctl storage {version|gc|scrub}
+    <data_dir>`` — offline inspection/GC/integrity-scrub of a node's
+    storage service state (risectl hummock list-version /
+    trigger-full-gc analogs)."""
     import json
 
     sub, data_dir = argv[0], argv[1]
+    if sub == "scrub":
+        report = storage_scrub(data_dir)
+        print(json.dumps(report, indent=1))
+        if not report["ok"]:
+            raise SystemExit(1)
+        return
     storage = _open_storage(data_dir)
     if sub == "version":
         print(json.dumps(storage.stats(), indent=1))
@@ -262,6 +303,22 @@ def cluster_vnodes(meta_addr: str) -> dict:
     }
 
 
+def cluster_scrub(meta_addr: str) -> dict:
+    """``ctl cluster scrub <meta_addr>``: drive ONE full ONLINE scrub
+    cycle on the running meta — every pinned-version SST and retained
+    checkpoint lineage verified, with quarantine + self-healing repair
+    armed (corrupt MV exports re-export from live job state, corrupt
+    checkpoint lineages rewind to the last verified epoch)."""
+    from risingwave_tpu.cluster.rpc import RpcClient, parse_addr
+
+    host, port = parse_addr(meta_addr)
+    client = RpcClient(host, port, timeout=600.0)
+    try:
+        return client.call("cluster_scrub")
+    finally:
+        client.close()
+
+
 def cluster_epochs(meta_addr: str) -> dict:
     """``ctl cluster epochs``: the global checkpoint positions — the
     committed cluster epoch (round), the manifest's epoch stamp, each
@@ -304,6 +361,7 @@ def _cluster_main(argv: list[str]) -> None:
           "epochs": cluster_epochs,
           "serving": cluster_serving,
           "vnodes": cluster_vnodes,
+          "scrub": cluster_scrub,
           "faults": cluster_faults}.get(sub)
     if fn is None:
         raise SystemExit(f"unknown cluster subcommand: {sub}")

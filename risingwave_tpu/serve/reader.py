@@ -35,6 +35,7 @@ from risingwave_tpu.storage.hummock.version import (
     HummockVersion,
     VersionDelta,
     apply_delta,
+    verify_chain_doc,
 )
 from risingwave_tpu.storage.sst import (
     TOMBSTONE,
@@ -135,6 +136,10 @@ class ManifestFollower:
     def __init__(self, store):
         self.store = store
         self.version = HummockVersion.empty()
+        #: hash-chain link of the last verified log entry — the
+        #: follower verifies every delta it replays against the chain
+        #: the writer commits (storage/hummock/version.py)
+        self._chain = 0
         self._lock = threading.Lock()
 
     @property
@@ -164,31 +169,35 @@ class ManifestFollower:
             # re-anchor on a base snapshot when the contiguous delta
             # chain from our vid has been pruned away
             chain_start = v.vid + 1
+            chain = self._chain
             usable = [b for b in base_vids if v.vid < b <= target]
             if usable and (not delta_vids
                            or min(delta_vids) > chain_start):
                 base = max(usable)
-                v = HummockVersion.from_json(json.loads(
-                    self.store.get(_BASE_PREFIX
-                                   + f"{base:012d}.json")
-                ))
+                key = _BASE_PREFIX + f"{base:012d}.json"
+                # a re-anchor cannot know the base's predecessor (its
+                # chain prefix was pruned) — the self-crc still holds
+                body, chain = verify_chain_doc(
+                    self.store.get(key), "version", key, None
+                )
+                v = HummockVersion.from_json(body)
                 chain_start = base + 1
             for vid in range(chain_start, target + 1):
                 key = _DELTA_PREFIX + f"{vid:012d}.json"
                 try:
-                    d = VersionDelta.from_json(
-                        json.loads(self.store.get(key))
-                    )
+                    raw = self.store.get(key)
                 except ObjectError:
                     raise StaleLease(
                         f"delta {vid} pruned before follower reached it"
                     ) from None
-                v = apply_delta(v, d)
+                body, chain = verify_chain_doc(raw, "delta", key, chain)
+                v = apply_delta(v, VersionDelta.from_json(body))
             if limit_vid is not None and v.vid < limit_vid:
                 raise StaleLease(
                     f"cannot reach vid {limit_vid} (log ends at {v.vid})"
                 )
             self.version = v
+            self._chain = chain
             return v
 
 

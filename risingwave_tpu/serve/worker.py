@@ -50,6 +50,10 @@ from risingwave_tpu.serve.reader import (
     mv_key_range,
 )
 from risingwave_tpu.storage.hummock.object_store import ObjectError
+from risingwave_tpu.storage.integrity import (
+    IntegrityError,
+    record_integrity_error,
+)
 
 
 class ServeUnsupported(ValueError):
@@ -383,6 +387,26 @@ class ServingWorker:
                 self.heartbeat_failures += 1
                 time.sleep(self.heartbeat_interval_s)
 
+    def _report_corruption(self, err: IntegrityError) -> None:
+        """Fire-and-forget corruption report (the meta quarantines and
+        repairs in the background) — the read path never blocks on a
+        repair round-trip."""
+        if self._meta_client is None or not err.key:
+            return
+
+        def _send() -> None:
+            try:
+                self._meta_client.call(
+                    "report_corruption", key=err.key, kind=err.kind,
+                    reason=str(err),
+                    by=f"serving{self.replica_id}",
+                )
+            except Exception:  # noqa: BLE001 — scrub re-detects
+                pass
+
+        threading.Thread(target=_send, name="serving-corruption-report",
+                         daemon=True).start()
+
     # -- the read path ---------------------------------------------------
     def _plan(self, sql: str) -> ReadPlan:
         from risingwave_tpu.sql import ast
@@ -453,6 +477,14 @@ class ServingWorker:
             # UNAVAILABLE for this read (routing signal, un-counted —
             # the meta serves it elsewhere), not a read error
             self._ensure_epoch(int(min_epoch or 0))
+        except IntegrityError as e:
+            # the manifest chain broke under the refresh: report for
+            # quarantine and route the read around this replica
+            record_integrity_error(self.metrics, e)
+            self._report_corruption(e)
+            raise ServeUnavailable(
+                f"manifest corruption under refresh: {e!r}"
+            ) from e
         except (ConnectionError, OSError, RpcError, RuntimeError) as e:
             raise ServeUnavailable(
                 f"replica cannot reach the pinned epoch: {e!r}"
@@ -468,6 +500,17 @@ class ServingWorker:
                 self._grant_refresh()
                 version = self.view.version
                 cols, rows = self._execute(plan, version)
+        except IntegrityError as e:
+            # corrupt shared bytes (SST block/footer crc): a DETECTED
+            # corruption is a routing event — report it to the meta
+            # (quarantine + self-healing repair) and answer
+            # ServeUnavailable so the read lands on another replica or
+            # the owner; never an error, never a silently wrong row
+            record_integrity_error(self.metrics, e)
+            self._report_corruption(e)
+            raise ServeUnavailable(
+                f"corrupt object under read: {e!r}"
+            ) from e
         except BaseException:
             self.read_errors += 1
             self.metrics.inc("serving_read_errors_total")

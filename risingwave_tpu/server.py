@@ -115,7 +115,10 @@ def _run_meta(args) -> None:
         heartbeat_timeout_s=args.heartbeat_timeout,
         n_vnodes=args.n_vnodes,
         scale_partitioning=args.scale_partitioning,
-    ).start(args.host, args.rpc_port)
+        scrub_interval_s=args.scrub_interval,
+        serve_retry_timeout_s=args.serve_retry_timeout,
+    ).start(args.host, args.rpc_port,
+            scrubber=args.scrub_interval > 0)
     front = MetaFrontend(meta)
     server = pg_serve(front, args.host, args.port)
     print(json.dumps({
@@ -215,6 +218,12 @@ def main() -> None:
     p.add_argument("--heartbeat-interval", type=float, default=0.5)
     p.add_argument("--heartbeat-timeout", type=float, default=3.0)
     p.add_argument("--barrier-interval-ms", type=int, default=1000)
+    p.add_argument("--scrub-interval", type=float, default=30.0,
+                   help="seconds between background integrity-scrub "
+                        "cycles on the meta (0 = disabled)")
+    p.add_argument("--serve-retry-timeout", type=float, default=60.0,
+                   help="how long a serving read waits through "
+                        "failover/repair windows before erroring")
     p.add_argument("--serving-cache-blocks", type=int, default=1024,
                    help="serving block-cache capacity (serving role)")
     p.add_argument("--n-vnodes", type=int, default=64,

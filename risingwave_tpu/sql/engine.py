@@ -212,6 +212,9 @@ class Engine:
         #: per-read vnode override for partitioned MV serving (the
         #: cluster worker pins reads to the map at the pinned round)
         self._serve_vnodes = None
+        #: SST keys the export diff-base seeding must skip (quarantined
+        #: corrupt objects mid-repair — see reexport_job_mvs)
+        self._seed_exclude: frozenset = frozenset()
         if data_dir is not None and role == "compute":
             import os as _os
 
@@ -222,6 +225,7 @@ class Engine:
             self.checkpoint_store = CheckpointStore(
                 data_dir,
                 keep_epochs=self.rw_config.storage.checkpoint_keep_epochs,
+                metrics=self.metrics,
             )
             self.shared_store = LocalFsObjectStore(
                 _os.path.join(data_dir, "hummock")
@@ -239,6 +243,7 @@ class Engine:
             self.checkpoint_store = CheckpointStore(
                 data_dir,
                 keep_epochs=self.rw_config.storage.checkpoint_keep_epochs,
+                metrics=self.metrics,
             )
             self.meta_store = MetaStore(data_dir)
             self.hummock = HummockStorage(
@@ -2058,11 +2063,28 @@ class Engine:
                     "entries": moved,
                 })
         self.set_job_vnodes(name, vnodes)
+        durable = 0
+        if transfers and self.checkpoint_store is not None:
+            # durably seal the POST-TRANSPLANT state under this
+            # partition's lineage at its committed epoch (0 for a
+            # fresh recipient): a recipient killed between the
+            # transplant and its first post-handover seal would
+            # otherwise re-adopt a lineage MISSING the moved vnodes'
+            # state — the crash-mid-scale hole the scale_kill chaos
+            # schedule proves closed
+            self.checkpoint_store.invalidate(job.ckpt_key)
+            src_state = job.source.state() \
+                if hasattr(job.source, "state") else {}
+            self.checkpoint_store.save(
+                job.ckpt_key, job.committed_epoch, job.states,
+                src_state,
+            )
+            durable = job.committed_epoch
         # the export diff base is vnode-filtered: ownership changed, so
         # it re-seeds from the shared manifest on the next export
         self._exported.clear()
         return {"vnodes": len(job.vnodes), "cleared": cleared,
-                "transfers": stats}
+                "transfers": stats, "durable_epoch": durable}
 
     def _vnode_filtered_mv_state(self, st, vn_set, n_vn):
         """A materialize state narrowed to one vnode set: occupancy is
@@ -2315,7 +2337,8 @@ class Engine:
 
         v = ManifestFollower(store).refresh(None)
         readers = [SstReader(store=store, key=s.key)
-                   for lv in v.levels for s in lv]
+                   for lv in v.levels for s in lv
+                   if s.key not in self._seed_exclude]
         try:
             lo, hi = mv_key_range(name)
             base = dict(merge_scan(readers, lo, hi))
@@ -2404,6 +2427,26 @@ class Engine:
             "size": meta.size,
             "epoch": epoch,
         }]
+
+    def reexport_job_mvs(self, job_name: str, exclude=()) -> list:
+        """Integrity repair export: drop the export diff bases of every
+        MV riding ``job_name`` and re-seed them from the shared
+        manifest EXCLUDING the quarantined keys — the resulting SST
+        carries upserts for every row the corrupt object held and
+        tombstones for rows it shadowed, so swapping it in for the
+        corrupt SST is byte-exact.  Returns the SST descriptors for the
+        meta's atomic replace commit."""
+        job = self._job_by_name(job_name)
+        if job is None:
+            return []
+        for entry in self.catalog.list("mview"):
+            if entry.job is not None and entry.job.name == job_name:
+                self._exported.pop(entry.name, None)
+        self._seed_exclude = frozenset(exclude or ())
+        try:
+            return self.export_mv_deltas(job_name, job.committed_epoch)
+        finally:
+            self._seed_exclude = frozenset()
 
     def storage_serve_mv(self, name: str) -> list:
         """Serve an exported MV from the storage service through a

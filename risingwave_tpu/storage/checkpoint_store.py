@@ -62,6 +62,12 @@ from risingwave_tpu.storage.digest import (
     leaf_block_count,
     normalize_u64,
 )
+from risingwave_tpu.storage.integrity import (
+    CheckpointCorruption,
+    crc32c,
+    quarantine,
+    record_integrity_error,
+)
 
 # back-compat aliases (pre-round-7 internal names)
 _normalize_u64 = normalize_u64
@@ -82,12 +88,14 @@ class CheckpointStore:
     def __init__(self, root: str, keep_epochs: int = 2,
                  full_interval: int = 16,
                  block_elems: int = DEFAULT_BLOCK_ELEMS,
-                 object_store=None):
+                 object_store=None, metrics=None):
         from risingwave_tpu.storage.hummock.object_store import (
             LocalFsObjectStore,
         )
         self.root = root
         self.keep_epochs = keep_epochs
+        #: integrity counters (integrity_errors_total, repairs)
+        self.metrics = metrics
         #: checkpoints between forced fulls (chain-length bound)
         self.full_interval = full_interval
         self.block_elems = block_elems
@@ -227,15 +235,24 @@ class CheckpointStore:
         key = f"{job_name}/epoch_{epoch}"
         buf = io.BytesIO()
         np.savez(buf, **prep["payload"])
+        npz_bytes = buf.getvalue()
+        meta_bytes = pickle.dumps({
+            "treedef": prep["treedef"],
+            "source_state": prep["source_state"],
+            "epoch": epoch, "kind": kind,
+        })
         with self._lock:
-            self.store.put(key + ".npz", buf.getvalue())
-            self.store.put(key + ".meta", pickle.dumps({
-                "treedef": prep["treedef"],
-                "source_state": prep["source_state"],
-                "epoch": epoch, "kind": kind,
-            }))
+            self.store.put(key + ".npz", npz_bytes)
+            self.store.put(key + ".meta", meta_bytes)
             m = self._load_manifest()
             job = m["jobs"].setdefault(job_name, {"epochs": []})
+            # crc32c trailer per epoch object, recorded in the
+            # manifest (computed over the bytes BEFORE the put, so a
+            # put corrupted in flight — or on disk later — mismatches
+            # on read and the typed CheckpointCorruption fires)
+            job.setdefault("crc", {})[str(epoch)] = {
+                "npz": crc32c(npz_bytes), "meta": crc32c(meta_bytes),
+            }
             # idempotent per epoch: a re-save of an already-committed
             # epoch (e.g. ALTER PARALLELISM re-basing state at the
             # current epoch) REPLACES the entry — appending would leave
@@ -257,6 +274,7 @@ class CheckpointStore:
                     idx -= 1
                 for old in epochs_l[:idx]:
                     kinds.pop(str(old), None)
+                    job.get("crc", {}).pop(str(old), None)
                     for suffix in (".npz", ".meta"):
                         self.store.delete(
                             f"{job_name}/epoch_{old}{suffix}"
@@ -347,11 +365,52 @@ class CheckpointStore:
         """Load (epoch, states_host, source_state); latest if epoch None.
 
         Reconstructs delta checkpoints from the nearest full plus the
-        delta chain (the reference's version + version-deltas).  Holds
-        the manifest lock so a concurrent uploader commit's GC cannot
-        delete a chain file between the manifest read and the fetch."""
+        delta chain (the reference's version + version-deltas).  Every
+        object fetched is verified against the crc the manifest
+        recorded at commit.  A latest-epoch load (``epoch=None`` — the
+        recovery path) SELF-HEALS: a corrupt object quarantines its
+        lineage tail (``quarantine_epoch``) and the load rewinds to
+        the last epoch whose whole chain verifies — the round-credit
+        rewind upstream then replays the gap.  An explicit-epoch load
+        (time travel, scale-handover slices) must be exact, so
+        corruption there raises ``CheckpointCorruption``.
+
+        Holds the manifest lock so a concurrent uploader commit's GC
+        cannot delete a chain file between the manifest read and the
+        fetch."""
         with self._lock:
-            return self._load_locked(job_name, epoch)
+            if epoch is not None:
+                return self._load_locked(job_name, epoch)
+            while True:
+                target = self.committed_epoch(job_name)
+                if target is None:
+                    return None
+                try:
+                    return self._load_locked(job_name, target)
+                except CheckpointCorruption as e:
+                    record_integrity_error(self.metrics, e)
+                    dropped = self.quarantine_epoch(
+                        job_name, getattr(e, "epoch", target),
+                        reason=str(e),
+                    )
+                    if not dropped:
+                        raise  # nothing left to rewind past
+                    if self.metrics is not None:
+                        self.metrics.inc("integrity_repairs_total",
+                                         kind="checkpoint_rewind")
+
+    def _get_verified(self, job: dict, job_name: str, epoch: int,
+                      suffix: str) -> bytes:
+        key = f"{job_name}/epoch_{epoch}.{suffix}"
+        data = self.store.get(key)
+        rec = job.get("crc", {}).get(str(epoch))
+        if rec is not None and crc32c(data) != int(rec[suffix]):
+            err = CheckpointCorruption(
+                f"{key}: checkpoint object checksum mismatch", key=key
+            )
+            err.epoch = epoch
+            raise err
+        return data
 
     def _load_locked(self, job_name: str, epoch: int | None):
         if epoch is None:
@@ -372,15 +431,19 @@ class CheckpointStore:
                 break
         chain.reverse()
         base = chain[0]
-        key = f"{job_name}/epoch_{base}"
-        meta = pickle.loads(self.store.get(key + ".meta"))
-        with np.load(io.BytesIO(self.store.get(key + ".npz"))) as z:
+        meta = pickle.loads(
+            self._get_verified(job, job_name, base, "meta")
+        )
+        with np.load(io.BytesIO(
+                self._get_verified(job, job_name, base, "npz"))) as z:
             leaves = [np.array(z[f"leaf_{i}"])
                       for i in range(len(z.files))]
         for e in chain[1:]:
-            dkey = f"{job_name}/epoch_{e}"
-            meta = pickle.loads(self.store.get(dkey + ".meta"))
-            with np.load(io.BytesIO(self.store.get(dkey + ".npz"))) as z:
+            meta = pickle.loads(
+                self._get_verified(job, job_name, e, "meta")
+            )
+            with np.load(io.BytesIO(
+                    self._get_verified(job, job_name, e, "npz"))) as z:
                 for key in z.files:
                     _, li, s_el = key.split("_")
                     li, s_el = int(li), int(s_el)
@@ -389,6 +452,79 @@ class CheckpointStore:
                     flat[s_el:s_el + data.shape[0]] = data
         states = jax.tree.unflatten(meta["treedef"], leaves)
         return epoch, states, meta["source_state"]
+
+    # -- integrity: quarantine + lineage repair --------------------------
+    def quarantine_epoch(self, job_name: str, epoch: int,
+                         reason: str = "checksum mismatch") -> list[int]:
+        """Quarantine one corrupt epoch and drop it — plus every later
+        DELTA chained through it (a full re-bases the chain, so epochs
+        from the next full onward stay) — from the manifest.  Dropped
+        objects become vacuumable orphans; a durable quarantine note
+        records each.  Returns the dropped epochs."""
+        with self._lock:
+            m = self._load_manifest()
+            job = m["jobs"].get(job_name)
+            if job is None or epoch not in job.get("epochs", []):
+                return []
+            epochs = job["epochs"]
+            kinds = job.setdefault("kind", {})
+            i = epochs.index(epoch)
+            j = i + 1
+            while j < len(epochs) \
+                    and kinds.get(str(epochs[j]), "full") != "full":
+                j += 1
+            dropped = epochs[i:j]
+            for e in dropped:
+                quarantine(self.store, f"{job_name}/epoch_{e}.npz",
+                           reason=reason, by="checkpoint_store",
+                           metrics=self.metrics)
+                kinds.pop(str(e), None)
+                job.get("crc", {}).pop(str(e), None)
+            job["epochs"] = epochs[:i] + epochs[j:]
+            job["committed"] = max(job["epochs"]) if job["epochs"] \
+                else 0
+            self._store_manifest(m)
+            # stale digest cache could delta against a dropped base
+            self._last_digests.pop(job_name, None)
+            self._since_full.pop(job_name, None)
+        return dropped
+
+    def verify_job(self, job_name: str) -> dict:
+        """Scrub one job's retained lineage: every epoch object's
+        bytes against the manifest-recorded crc (no decode).  Returns
+        ``{"verified": n, "corrupt": [(epoch, key)]}``."""
+        from risingwave_tpu.storage.integrity import (
+            verify_checkpoint_store,
+        )
+
+        with self._lock:
+            rep = verify_checkpoint_store(self.store, self._MANIFEST,
+                                          jobs=[job_name])
+        return {"verified": rep["verified"],
+                "corrupt": [(e, k) for _, e, k in rep["corrupt"]]}
+
+    def repair_lineage(self, job_name: str) -> dict:
+        """Verify + self-heal one lineage in place: corrupt epochs are
+        quarantined and the chain truncates to verified state (the
+        corrupt-checkpoint repair the scrubber triggers through the
+        owning worker).  The next save after a repair re-bases with a
+        full snapshot (digest cache dropped by ``quarantine_epoch``)."""
+        rep = self.verify_job(job_name)
+        dropped: list[int] = []
+        for e, key in rep["corrupt"]:
+            record_integrity_error(
+                self.metrics,
+                CheckpointCorruption(f"{key}: scrub mismatch", key=key),
+            )
+            dropped += self.quarantine_epoch(
+                job_name, e, reason="scrub checksum mismatch"
+            )
+        if dropped and self.metrics is not None:
+            self.metrics.inc("integrity_repairs_total",
+                             kind="checkpoint_rewind")
+        return {"verified": rep["verified"],
+                "corrupt": [k for _, k in rep["corrupt"]],
+                "dropped_epochs": sorted(set(dropped))}
 
     # -- MV export to SSTs ----------------------------------------------
     def export_mv_sst(self, job_name: str, epoch: int, mv_executor,

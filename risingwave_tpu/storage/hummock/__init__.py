@@ -16,9 +16,14 @@ Four pieces, mirroring the reference's split:
 - ``compactor``     — ``CompactorService``: the background thread that
   takes compaction off the ingest path (compactor_runner.rs:70) and
   whose L0-depth write stall backpressures the barrier loop
+- ``scrubber``      — ``ScrubberService``: paced off-barrier checksum
+  verification of every pinned-version SST and retained checkpoint
+  lineage, feeding the quarantine + self-healing repair pipeline
+  (storage/integrity.py)
 """
 
 from risingwave_tpu.storage.hummock.compactor import CompactorService
+from risingwave_tpu.storage.hummock.scrubber import ScrubberService
 from risingwave_tpu.storage.hummock.object_store import (
     InMemObjectStore,
     LocalFsObjectStore,
@@ -48,6 +53,7 @@ __all__ = [
     "ObjectError",
     "ObjectStore",
     "PinnedVersion",
+    "ScrubberService",
     "SstInfo",
     "StoreFaults",
     "VersionDelta",

@@ -48,14 +48,23 @@ class StoreFaults:
     rules: list[_FaultRule] = field(default_factory=list)
     #: totals for test assertions
     injected_errors: int = 0
+    injected_corruptions: int = 0
+    #: deterministic-corruption seed (splitmix64 bit choice)
+    seed: int = 0
 
     def fail(self, op: str, substr: str = "", after: int = 0,
              mode: str = "before", times: int = 1) -> None:
         """Arm one deterministic failure: the ``after``-th matching op
         (0-based) raises ``ObjectError``; with ``mode='after'`` the
-        store mutation still lands first (crash-after-upload)."""
-        assert op in ("put", "get", "delete") and mode in ("before",
-                                                           "after")
+        store mutation still lands first (crash-after-upload); with
+        ``mode='bit_flip'``/``'truncate'`` the op succeeds but its
+        PAYLOAD is deterministically damaged (the corruption probe the
+        integrity layer must catch)."""
+        from risingwave_tpu.common.faults import CORRUPT_MODES
+
+        assert op in ("put", "get", "delete") \
+            and mode in ("before", "after") + CORRUPT_MODES
+        assert not (mode in CORRUPT_MODES and op == "delete")
         self.rules.append(_FaultRule(op, substr, after, mode, times))
 
     # -- hooks called by the stores -------------------------------------
@@ -84,6 +93,18 @@ class StoreFaults:
         if rule is not None and rule.mode == "after":
             self.injected_errors += 1
             raise ObjectError(f"injected {op} fault (durable): {key}")
+
+    def corrupt(self, rule: "_FaultRule | None", key: str,
+                data: bytes) -> bytes:
+        from risingwave_tpu.common.faults import (
+            CORRUPT_MODES,
+            corrupt_payload,
+        )
+
+        if rule is None or rule.mode not in CORRUPT_MODES:
+            return data
+        self.injected_corruptions += 1
+        return corrupt_payload(data, rule.mode, self.seed, rule.hits)
 
 
 class ObjectStore:
@@ -141,6 +162,22 @@ class ObjectStore:
             if fabric is not None:
                 fabric.store_after(global_rule, op, key)
 
+    def _xform(self, rule, key: str, data: bytes) -> bytes:
+        """Apply matched corrupt-mode rules (local + global fabric) to
+        one payload — put corruption lands DURABLY damaged bytes, get
+        corruption models a bad read of an intact object."""
+        local, global_rule = rule if isinstance(rule, tuple) \
+            else (rule, None)
+        if self.faults:
+            data = self.faults.corrupt(local, key, data)
+        if global_rule is not None:
+            from risingwave_tpu.common.faults import get_fabric
+
+            fabric = get_fabric()
+            if fabric is not None:
+                data = fabric.store_corrupt(global_rule, key, data)
+        return data
+
 
 class InMemObjectStore(ObjectStore):
     """Dict-backed store for tests/chaos (the sim object store)."""
@@ -153,7 +190,7 @@ class InMemObjectStore(ObjectStore):
     def put(self, key: str, data: bytes) -> None:
         rule = self._pre("put", key)
         with self._lock:
-            self._d[key] = bytes(data)
+            self._d[key] = bytes(self._xform(rule, key, data))
         self._post(rule, "put", key)
 
     def get(self, key: str) -> bytes:
@@ -163,7 +200,7 @@ class InMemObjectStore(ObjectStore):
                 raise ObjectError(f"no such object: {key}")
             data = self._d[key]
         self._post(rule, "get", key)
-        return data
+        return self._xform(rule, key, data)
 
     def open(self, key: str):
         return io.BytesIO(self.get(key))
@@ -203,6 +240,7 @@ class LocalFsObjectStore(ObjectStore):
 
     def put(self, key: str, data: bytes) -> None:
         rule = self._pre("put", key)
+        data = self._xform(rule, key, data)
         path = self._path(key)
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         tmp = path + ".tmp"
@@ -219,7 +257,7 @@ class LocalFsObjectStore(ObjectStore):
         except FileNotFoundError as e:
             raise ObjectError(f"no such object: {key}") from e
         self._post(rule, "get", key)
-        return data
+        return self._xform(rule, key, data)
 
     def open(self, key: str):
         rule = self._pre("get", key)
@@ -228,6 +266,15 @@ class LocalFsObjectStore(ObjectStore):
         except FileNotFoundError as e:
             raise ObjectError(f"no such object: {key}") from e
         self._post(rule, "get", key)
+        local, global_rule = rule
+        if (local is not None and local.mode in ("bit_flip", "truncate")) \
+                or (global_rule is not None
+                    and global_rule.mode in ("bit_flip", "truncate")):
+            # a corrupted READ of a seekable object: materialize the
+            # damaged bytes once (footer-first SST reads then see them)
+            data = f.read()
+            f.close()
+            return io.BytesIO(self._xform(rule, key, data))
         return f
 
     def delete(self, key: str) -> None:

@@ -145,6 +145,10 @@ class HummockStorage:
         self._next_task = 1
         #: write-path purity counter: merges performed on ingest (0)
         self.write_path_merges = 0
+        #: corruption sink ``(kind, key, context)`` — the meta points
+        #: this at its quarantine+repair pipeline; None = detection
+        #: only (typed error + quarantine note)
+        self.on_corruption = None
         # next SST id: past the largest object present (orphans from a
         # crashed run included, so a reused id can never alias one)
         ids = [int(k[len(SST_PREFIX):].split(".")[0])
@@ -386,17 +390,89 @@ class HummockStorage:
 
     def compact_once(self) -> bool:
         """Pick + execute + commit one task synchronously (the ctl
-        'trigger compaction' surface and the service's inner step)."""
+        'trigger compaction' surface and the service's inner step).
+
+        Compaction reads every input block, so it is a DETECTION POINT
+        for cold corruption: an ``IntegrityError`` aborts the task,
+        quarantines the corrupt input and hands it to
+        ``on_corruption`` (the meta wires repair) instead of wedging
+        the compactor on a poisoned level."""
+        from risingwave_tpu.storage.integrity import (
+            IntegrityError,
+            record_integrity_error,
+        )
+
         task = self.pick_compaction()
         if task is None:
             return False
         try:
             self.execute_compaction(task)
+        except IntegrityError as e:
+            self.abort_compaction(task)
+            record_integrity_error(self.metrics, e)
+            key = e.key or (task.inputs[0].key if task.inputs else "")
+            self.quarantine_sst(key, reason=str(e), by="compactor")
+            if self.on_corruption is not None:
+                self.on_corruption("sst", key, {"error": str(e)})
+            return False
         except BaseException:
             self.abort_compaction(task)
             raise
         self.commit_compaction(task)
         return True
+
+    # -- integrity: quarantine + corrupt-object removal ------------------
+    def quarantine_sst(self, key: str, reason: str,
+                       by: str = "storage") -> bool:
+        """Durable quarantine note for one corrupt SST (idempotent);
+        returns True on first detection."""
+        from risingwave_tpu.storage.integrity import quarantine
+
+        return quarantine(self.store, key, reason, by=by,
+                          metrics=self.metrics)
+
+    def replace_sst(self, bad_key: str, ssts: "list[SstInfo]") -> bool:
+        """ONE version delta: drop a corrupt SST from its level and
+        prepend fresh repair exports at L0 — atomic, so no read ever
+        sees the rows missing between removal and re-export."""
+        with self._commit_cv:
+            v = self.versions.current
+            lv_hit = next((lv for lv, level in enumerate(v.levels)
+                           if any(s.key == bad_key for s in level)),
+                          None)
+            if lv_hit is None and not ssts:
+                return False
+            adds = {0: list(ssts)} if ssts else {}
+            removes = {lv_hit: [bad_key]} if lv_hit is not None else {}
+            self.versions.commit(v.max_committed_epoch,
+                                 adds=adds, removes=removes)
+            for s in ssts:
+                self._protected.discard(s.key)
+            r = self._readers.pop(bad_key, None)
+            if r is not None:
+                r.close()
+            self._update_gauges()
+            self._commit_cv.notify_all()
+            return lv_hit is not None
+
+    def remove_sst(self, key: str) -> bool:
+        """Commit one delta removing a (corrupt, quarantined) SST from
+        whichever level holds it — the first half of repair; the
+        second half is the owner re-exporting the rows it carried.
+        Returns whether the key was in the current version."""
+        with self._commit_cv:
+            v = self.versions.current
+            for lv, level in enumerate(v.levels):
+                if any(s.key == key for s in level):
+                    self.versions.commit(v.max_committed_epoch,
+                                         adds={}, removes={lv: [key]})
+                    r = self._readers.pop(key, None)
+                    if r is not None:
+                        r.close()
+                    self._update_gauges()
+                    self._commit_cv.notify_all()
+                    return True
+        return False
 
     # -- vacuum / GC ----------------------------------------------------
     def vacuum(self, extra_refs: "set[str] | frozenset[str]" = frozenset(),

@@ -14,6 +14,17 @@ and its delta commit leaves an *orphan object* that no version
 references (vacuum reaps it), never a corrupt version.  Pins hold a
 full immutable ``HummockVersion`` so serving reads keep a consistent
 SST set while the compactor rewrites levels underneath them.
+
+Integrity: the log is a **hash chain**.  Every delta/base object is
+wrapped as ``{"prev": <predecessor link>, "crc": crc32c(prev || body),
+"delta"/"version": body}`` — each entry commits the hash of its
+predecessor, a base snapshot re-anchors the chain, and replay
+(``VersionManager._replay`` on meta recovery, and the serving tier's
+``ManifestFollower``) verifies every link with
+``verify_chain_doc``.  A flipped bit anywhere in the log raises the
+typed ``ManifestCorruption`` (storage/integrity.py) naming the exact
+object — an operational event for the scrubber/ctl surface, never a
+silently wrong SST set.
 """
 
 from __future__ import annotations
@@ -21,6 +32,9 @@ from __future__ import annotations
 import json
 import threading
 from dataclasses import dataclass, field
+
+from risingwave_tpu.storage import codec
+from risingwave_tpu.storage.integrity import ManifestCorruption
 
 _DELTA_FMT = "version/delta_{:012d}.json"
 _BASE_FMT = "version/base_{:012d}.json"
@@ -164,6 +178,57 @@ def apply_delta(v: HummockVersion, d: VersionDelta) -> HummockVersion:
     )
 
 
+def wrap_chain_doc(kind: str, body: dict, prev: int) -> tuple[bytes, int]:
+    """Serialize one log entry (``kind`` = "delta" | "version") with
+    its chain fields; returns (object bytes, this entry's link value).
+    The link is ``crc32c(prev || canonical body)`` — committing the
+    predecessor's link makes the log a hash chain."""
+    body_bytes = json.dumps(body, sort_keys=True).encode()
+    crc = codec.crc32c(("%08x" % (prev & 0xFFFFFFFF)).encode()
+                       + body_bytes)
+    doc = {"prev": int(prev), "crc": crc, kind: body}
+    return json.dumps(doc).encode(), crc
+
+
+def verify_chain_doc(raw: bytes, kind: str, key: str,
+                     prev: "int | None") -> tuple[dict, int]:
+    """Decode + verify one log entry: self-crc always, predecessor
+    link when ``prev`` is known (None = re-anchoring, e.g. a follower
+    landing on a base snapshot).  Returns (body, link).  Legacy bare
+    objects (pre-integrity logs) pass through with the raw bytes' crc
+    as their link so mixed logs keep chaining."""
+    try:
+        doc = json.loads(raw)
+    except (ValueError, UnicodeDecodeError) as e:
+        raise ManifestCorruption(
+            f"{key}: undecodable manifest entry ({e!r})", key=key
+        ) from e
+    if kind not in doc:
+        if "vid" not in doc:
+            raise ManifestCorruption(
+                f"{key}: not a manifest {kind} entry", key=key
+            )
+        return doc, codec.crc32c(bytes(raw))  # legacy bare entry
+    body = doc[kind]
+    body_bytes = json.dumps(body, sort_keys=True).encode()
+    crc = codec.crc32c(
+        ("%08x" % (int(doc.get("prev", 0)) & 0xFFFFFFFF)).encode()
+        + body_bytes
+    )
+    if crc != int(doc.get("crc", -1)):
+        raise ManifestCorruption(
+            f"{key}: manifest entry checksum mismatch", key=key
+        )
+    if prev is not None and int(doc.get("prev", 0)) != int(prev):
+        raise ManifestCorruption(
+            f"{key}: chain break (expected predecessor "
+            f"{int(prev):#010x}, recorded "
+            f"{int(doc.get('prev', 0)):#010x})",
+            key=key,
+        )
+    return body, crc
+
+
 class VersionManager:
     """Owns the version log on the object store + the pin table.
 
@@ -182,22 +247,34 @@ class VersionManager:
         self._pins: dict[int, HummockVersion] = {}
         self._next_pin = 1
         self._deltas_since_base = 0
+        #: hash-chain link of the newest log entry (0 = empty log)
+        self._chain = 0
         self.current = self._replay()
 
     # -- log ------------------------------------------------------------
     def _replay(self) -> HummockVersion:
+        """Rebuild from the log, VERIFYING the hash chain link by link
+        (the meta-recovery verification leg: a corrupt base or delta
+        raises ``ManifestCorruption`` naming the object instead of
+        silently applying a damaged SST set)."""
         base_keys = self.store.list(_BASE_PREFIX)
         v = HummockVersion.empty()
+        self._chain = 0
         if base_keys:
-            v = HummockVersion.from_json(
-                json.loads(self.store.get(base_keys[-1]))
+            key = base_keys[-1]
+            body, self._chain = verify_chain_doc(
+                self.store.get(key), "version", key, None
             )
+            v = HummockVersion.from_json(body)
         n = 0
         for key in self.store.list(_DELTA_PREFIX):
-            d = VersionDelta.from_json(json.loads(self.store.get(key)))
-            if d.vid <= v.vid:
+            vid = int(key[len(_DELTA_PREFIX):-len(".json")])
+            if vid <= v.vid:
                 continue  # pre-base entry not yet pruned
-            v = apply_delta(v, d)
+            body, self._chain = verify_chain_doc(
+                self.store.get(key), "delta", key, self._chain
+            )
+            v = apply_delta(v, VersionDelta.from_json(body))
             n += 1
         self._deltas_since_base = n
         return v
@@ -212,10 +289,10 @@ class VersionManager:
             )
             # the delta object IS the commit point: a crash before this
             # put leaves only orphan SSTs, never a half-applied version
-            self.store.put(
-                _DELTA_FMT.format(delta.vid),
-                json.dumps(delta.to_json()).encode(),
-            )
+            raw, link = wrap_chain_doc("delta", delta.to_json(),
+                                       self._chain)
+            self.store.put(_DELTA_FMT.format(delta.vid), raw)
+            self._chain = link
             self.current = apply_delta(self.current, delta)
             self._deltas_since_base += 1
             if self._deltas_since_base >= self.base_interval:
@@ -241,8 +318,11 @@ class VersionManager:
 
     def _write_base(self) -> None:
         v = self.current
-        self.store.put(_BASE_FMT.format(v.vid),
-                       json.dumps(v.to_json()).encode())
+        raw, link = wrap_chain_doc("version", v.to_json(), self._chain)
+        self.store.put(_BASE_FMT.format(v.vid), raw)
+        # the chain re-anchors on the base: the next delta commits the
+        # base's link, so a follower landing on the base keeps chaining
+        self._chain = link
         self._deltas_since_base = 0
         # prune superseded log entries (safe: replay ignores them)
         for key in self.store.list(_DELTA_PREFIX):

@@ -1,0 +1,179 @@
+"""End-to-end integrity layer: typed corruption errors + quarantine.
+
+Reference counterpart: Hummock's checksum discipline — every SST block
+carries a crc32c (src/storage/src/hummock/sstable/block.rs) and a
+checksum mismatch is an *operational event* (a storage error routed to
+recovery), never a silent wrong read and never a bare process crash.
+This module is the repo-wide vocabulary for that discipline:
+
+- ``IntegrityError`` taxonomy — one typed error per corruption site
+  (SST data block, SST footer/index, checkpoint epoch object, manifest
+  base+delta chain), each carrying the object key so the control plane
+  can quarantine and repair the exact object;
+- durable **quarantine notes** — ``quarantine/<key>.json`` documents in
+  the same object store, written when corruption is detected, so an
+  operator (and ``ctl storage scrub``) can see every corruption event
+  across process restarts;
+- jax-free verifiers for whole objects (an SST end-to-end, a
+  checkpoint store's manifest-recorded crcs) shared by the online
+  ScrubberService (storage/hummock/scrubber.py), the offline
+  ``ctl storage scrub <dir>``, and the serving tier (which must stay
+  jax-free).
+
+Everything here is detection vocabulary; *repair* lives with the
+owners: the meta re-exports corrupt MV SSTs from live job state and
+rewinds corrupt checkpoint lineages to the last verified epoch
+(cluster/meta_service.py), a serving replica answers
+``ServeUnavailable`` so the read routes around the bad replica.
+"""
+
+from __future__ import annotations
+
+import json
+
+from risingwave_tpu.storage import codec
+
+QUARANTINE_PREFIX = "quarantine/"
+
+
+class IntegrityError(Exception):
+    """Base of the corruption taxonomy.  ``key`` names the corrupt
+    object (object-store key or path); ``kind`` labels metric series
+    (``integrity_errors_total{kind=...}``)."""
+
+    kind = "integrity"
+
+    def __init__(self, message: str, *, key: str = ""):
+        super().__init__(message)
+        self.key = key
+
+
+class BlockCorruption(IntegrityError):
+    """An SST data block failed its crc32c trailer."""
+
+    kind = "sst_block"
+
+
+class FooterCorruption(IntegrityError):
+    """An SST footer/index region is unreadable: bad magic, short
+    object, index crc mismatch, or undecodable index."""
+
+    kind = "sst_footer"
+
+
+class CheckpointCorruption(IntegrityError):
+    """A checkpoint epoch object's bytes mismatch the crc recorded in
+    the checkpoint manifest."""
+
+    kind = "checkpoint"
+
+
+class ManifestCorruption(IntegrityError):
+    """The version-manifest base+delta chain broke: a delta's
+    predecessor hash or self-crc does not verify."""
+
+    kind = "manifest"
+
+
+def crc32c(data: bytes) -> int:
+    return codec.crc32c(data)
+
+
+# ---------------------------------------------------------------------------
+# durable quarantine notes
+
+
+def quarantine_key(object_key: str) -> str:
+    return QUARANTINE_PREFIX + object_key.replace("/", "__") + ".json"
+
+
+def quarantine(store, object_key: str, reason: str, by: str = "",
+               metrics=None) -> bool:
+    """Write one durable quarantine note for ``object_key`` (idempotent
+    — re-detections of the same object keep the first note).  Returns
+    True when this call wrote the note (first detection)."""
+    import time
+
+    qk = quarantine_key(object_key)
+    fresh = not store.exists(qk)
+    if fresh:
+        store.put(qk, json.dumps({
+            "key": object_key,
+            "reason": reason,
+            "by": by,
+            "at": time.time(),
+        }).encode())
+    if metrics is not None:
+        metrics.set_gauge("quarantined_objects",
+                          len(store.list(QUARANTINE_PREFIX)))
+    return fresh
+
+
+def quarantine_list(store) -> list[dict]:
+    """Every durable quarantine note in the store (oldest key order)."""
+    out = []
+    for key in store.list(QUARANTINE_PREFIX):
+        try:
+            out.append(json.loads(store.get(key)))
+        except Exception:  # noqa: BLE001 — a torn note is still a note
+            out.append({"key": key, "reason": "unreadable note"})
+    return out
+
+
+def record_integrity_error(metrics, err: IntegrityError) -> None:
+    if metrics is not None:
+        metrics.inc("integrity_errors_total", kind=err.kind)
+
+
+# ---------------------------------------------------------------------------
+# jax-free object verifiers (scrubber / offline ctl / serving tier)
+
+
+def verify_sst_object(store, key: str) -> int:
+    """Read one SST end-to-end — footer, index crc, every data block's
+    crc trailer.  Returns the number of blocks verified; raises the
+    typed ``IntegrityError`` on the first mismatch."""
+    from risingwave_tpu.storage.sst import SstReader
+
+    r = SstReader(store=store, key=key)
+    try:
+        n = 0
+        for bi in range(len(r.index["blocks"])):
+            r._read_block(bi)
+            n += 1
+        return n
+    finally:
+        r.close()
+
+
+def verify_checkpoint_store(store, manifest_key: str = "MANIFEST.json",
+                            jobs: "list[str] | None" = None) -> dict:
+    """Verify every retained checkpoint epoch object against the crcs
+    the checkpoint manifest records (jax-free: bytes + crc only, no
+    npz decode).  Returns ``{"verified": n, "corrupt": [(job, epoch,
+    key), ...], "skipped": n_without_crc}``."""
+    report = {"verified": 0, "corrupt": [], "skipped": 0}
+    if not store.exists(manifest_key):
+        return report
+    m = json.loads(store.get(manifest_key))
+    for job_name, job in m.get("jobs", {}).items():
+        if jobs is not None and job_name not in jobs:
+            continue
+        crcs = job.get("crc", {})
+        for epoch in job.get("epochs", []):
+            rec = crcs.get(str(epoch))
+            if rec is None:
+                report["skipped"] += 1  # pre-integrity checkpoint
+                continue
+            for suffix in ("npz", "meta"):
+                key = f"{job_name}/epoch_{epoch}.{suffix}"
+                try:
+                    data = store.get(key)
+                except Exception:  # noqa: BLE001 — missing = corrupt chain
+                    report["corrupt"].append((job_name, epoch, key))
+                    continue
+                if crc32c(data) != int(rec[suffix]):
+                    report["corrupt"].append((job_name, epoch, key))
+                else:
+                    report["verified"] += 1
+    return report

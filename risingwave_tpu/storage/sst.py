@@ -5,11 +5,18 @@ format, builder, bloom filters, multi-SST iterators — SURVEY.md §2.5).
 Simplified format, one object per SST:
 
     [block 0][block 1]...[block k-1][index json][footer]
-    footer = index_offset (8B LE) + index_len (8B LE) + magic (8B)
+    footer = index_offset (8B LE) + index_len (8B LE)
+           + index_crc32c (4B LE) + magic (8B)
 
 Each block holds varint-framed (key, value) records in key order with a
 crc32c trailer; the index stores each block's first key + offset/len,
-the SST's key range, and a per-SST bloom filter over full keys.  Point
+the SST's key range, and a per-SST bloom filter over full keys; the
+footer crc covers the whole index/bloom region, so EVERY byte of an
+SST is checksummed (ref block.rs crc32c + the sstable meta checksum).
+Corruption raises the typed ``IntegrityError`` taxonomy
+(storage/integrity.py) — ``BlockCorruption`` for a data block,
+``FooterCorruption`` for the footer/index — which the owners turn into
+quarantine + repair instead of a crash.  Point
 gets consult the bloom then binary-search the index and scan one block;
 range scans merge blocks.  ``merge_scan`` merges multiple SSTs
 newest-first with tombstone handling — the LSM read path — skipping
@@ -40,8 +47,15 @@ from dataclasses import dataclass
 import numpy as np
 
 from risingwave_tpu.storage import codec
+from risingwave_tpu.storage.integrity import (
+    BlockCorruption,
+    FooterCorruption,
+)
 
+#: legacy footer magic (24-byte footer, no index crc) — still readable
 MAGIC = b"RWTPUSST"
+#: current footer magic: 28-byte footer whose crc covers the index
+MAGIC2 = b"RWTPUST2"
 TOMBSTONE = b"\xff\xfe__tombstone__"
 DEFAULT_BLOCK_BYTES = 64 * 1024
 DEFAULT_BLOOM_BITS_PER_KEY = 10
@@ -136,8 +150,9 @@ def build_sst_bytes(
         if bloom_bits_per_key else None,
     }).encode()
     out += index_bytes
-    out += struct.pack("<QQ", offset, len(index_bytes))
-    out += MAGIC
+    out += struct.pack("<QQI", offset, len(index_bytes),
+                       codec.crc32c(index_bytes))
+    out += MAGIC2
     meta = SstMeta(
         path="",
         first_key=keys[0] if keys else b"",
@@ -214,13 +229,48 @@ class SstReader:
             self.path = path
             self._f = open(path, "rb")
         self.cache = cache
-        self._f.seek(-24, os.SEEK_END)
-        tail = self._f.read(24)
-        index_offset, index_len = struct.unpack("<QQ", tail[:16])
-        if tail[16:] != MAGIC:
-            raise ValueError(f"{self.path}: bad magic")
-        self._f.seek(index_offset)
-        self.index = json.loads(self._f.read(index_len))
+        try:
+            self._f.seek(0, os.SEEK_END)
+            size = self._f.tell()
+            if size < 24:
+                raise FooterCorruption(
+                    f"{self.path}: truncated ({size} bytes, no footer)",
+                    key=self.path,
+                )
+            tail_len = min(28, size)
+            self._f.seek(-tail_len, os.SEEK_END)
+            tail = self._f.read(tail_len)
+            if tail[-8:] == MAGIC2:
+                index_offset, index_len, index_crc = struct.unpack(
+                    "<QQI", tail[-28:-8]
+                )
+            elif tail[-8:] == MAGIC:
+                index_offset, index_len = struct.unpack(
+                    "<QQ", tail[-24:-8]
+                )
+                index_crc = None  # pre-integrity SST
+            else:
+                raise FooterCorruption(
+                    f"{self.path}: bad magic", key=self.path
+                )
+            self._f.seek(index_offset)
+            index_bytes = self._f.read(index_len)
+            if index_crc is not None \
+                    and codec.crc32c(index_bytes) != index_crc:
+                raise FooterCorruption(
+                    f"{self.path}: index checksum mismatch",
+                    key=self.path,
+                )
+            self.index = json.loads(index_bytes)
+        except FooterCorruption:
+            raise
+        except (ValueError, KeyError, struct.error, OSError) as e:
+            # any garbage between the footer and a decoded index is
+            # the same operational event: a corrupt footer/index
+            raise FooterCorruption(
+                f"{self.path}: unreadable footer/index ({e!r})",
+                key=self.path,
+            ) from e
         self._block_first_keys = [
             bytes.fromhex(b["first_key"]) for b in self.index["blocks"]
         ]
@@ -280,9 +330,16 @@ class SstReader:
         meta = self.index["blocks"][bi]
         self._f.seek(meta["offset"])
         data = self._f.read(meta["len"] + 4)
+        if len(data) < meta["len"] + 4:
+            raise BlockCorruption(
+                f"{self.path}: block {bi} truncated", key=self.path
+            )
         block, crc = data[:-4], struct.unpack("<I", data[-4:])[0]
         if codec.crc32c(block) != crc:
-            raise ValueError(f"{self.path}: block {bi} checksum mismatch")
+            raise BlockCorruption(
+                f"{self.path}: block {bi} checksum mismatch",
+                key=self.path,
+            )
         keys, ko, vals, vo = codec.block_decode(block)
         out = []
         kb = keys.tobytes()

@@ -40,7 +40,26 @@ faults, same replay):
                        the barrier-fence repair fetch must absorb
                        every drop, the handover must move exactly the
                        minimal vnode set, and the MV must converge
-                       byte-identically.
+                       byte-identically;
+- ``corruption_storm`` seeded ``bit_flip``/``truncate`` payload
+                       corruption on the workers' object-store puts
+                       (MV-export SSTs AND checkpoint epoch uploads)
+                       while rounds, serving reads, the compactor and
+                       the meta scrubber all run: EVERY planted
+                       corruption must be detected (typed
+                       IntegrityError → durable quarantine note),
+                       repaired (SST re-export from live job state /
+                       checkpoint lineage rewind), with ZERO client-
+                       visible read errors, zero silent wrong reads,
+                       and byte-identical convergence;
+- ``scale_kill``       SIGKILL the slice-transplant RECIPIENT between
+                       the transplant and the donors' mask swap
+                       during ``ctl cluster scale N`` (a seeded fabric
+                       delay on the donor's repartition RPC holds the
+                       window open): the transplanted state must
+                       survive through the durably-sealed lineage,
+                       the op must roll forward on retry, 0 read
+                       errors, byte-identical convergence.
 
 Run standalone (prints one JSON summary line per schedule)::
 
@@ -93,7 +112,7 @@ READS = [
 ]
 
 SCHEDULES = ("rpc_drop_storm", "meta_kill", "store_faults",
-             "scale_storm")
+             "scale_storm", "corruption_storm", "scale_kill")
 
 #: scale_storm topology: a vnode-partitioned aggregation over a
 #: replicated DML table (the worker↔worker exchange seam under test)
@@ -140,7 +159,9 @@ def _env(fault_env: dict | None) -> dict:
 
 def _spawn_meta(data_dir: str, rpc_port: int, tag: str,
                 fault_env: dict | None = None,
-                scale_partitioning: bool = False):
+                scale_partitioning: bool = False,
+                scrub_interval: float | None = None,
+                serve_retry_timeout: float | None = None):
     argv = [sys.executable, "-m", "risingwave_tpu.server",
             "--role", "meta", "--port", str(_free_port()),
             "--rpc-port", str(rpc_port), "--data-dir", data_dir,
@@ -148,6 +169,10 @@ def _spawn_meta(data_dir: str, rpc_port: int, tag: str,
             "--barrier-interval-ms", "0"]  # the driver owns the cadence
     if scale_partitioning:
         argv.append("--scale-partitioning")
+    if scrub_interval is not None:
+        argv += ["--scrub-interval", str(scrub_interval)]
+    if serve_retry_timeout is not None:
+        argv += ["--serve-retry-timeout", str(serve_retry_timeout)]
     proc = subprocess.Popen(
         argv,
         stdout=subprocess.DEVNULL,
@@ -243,6 +268,32 @@ def _fault_envs(schedule: str, seed: int) -> dict:
             modes=("drop",),
         )
         return {"worker": peer_fab.to_json()}
+    if schedule == "corruption_storm":
+        # payload corruption on the workers' shared-store uploads:
+        # bit_flips on MV-export SSTs, bit_flip+truncate on checkpoint
+        # epoch objects — every byte of both is crc-covered, so every
+        # firing MUST surface as a typed IntegrityError somewhere
+        # (serving read, compaction merge, or the scrub walk)
+        fab = FaultFabric.storm(
+            seed, op="put", substr="sst/", n=3, span=8,
+            modes=("bit_flip",),
+        )
+        ck = FaultFabric.storm(
+            seed ^ 0xC0FF, op="put", substr="/epoch_", n=2, span=20,
+            modes=("bit_flip", "truncate"),
+        )
+        fab.rules += ck.rules
+        return {"worker": fab.to_json()}
+    if schedule == "scale_kill":
+        # ONE seeded delay on the donor's mask-swap RPC during the
+        # handover (meta-side label ``meta>worker1/repartition``): the
+        # recipient's transplant has landed, the donor's narrow is
+        # held open — the deterministic window where the campaign
+        # SIGKILLs the recipient
+        fab = FaultFabric(seed=seed)
+        fab.fail_rpc(substr=">worker1/repartition", after=0,
+                     mode="delay", times=1, delay_s=3.0)
+        return {"meta": fab.to_json()}
     return {}
 
 
@@ -254,6 +305,10 @@ def run_schedule(schedule: str, seed: int = 7, rounds: int = 10,
         return run_scale_storm(seed=seed, rounds=rounds,
                                scale_at_round=kill_at_round,
                                readers=readers, data_dir=data_dir)
+    if schedule == "scale_kill":
+        return run_scale_kill(seed=seed, rounds=rounds,
+                              scale_at_round=kill_at_round,
+                              readers=readers, data_dir=data_dir)
     data_dir = data_dir or tempfile.mkdtemp(
         prefix=f"chaos_{schedule}_")
     envs = _fault_envs(schedule, seed)
@@ -261,9 +316,15 @@ def run_schedule(schedule: str, seed: int = 7, rounds: int = 10,
     # the byte-identical fault schedule (no RNG anywhere in the path)
     deterministic = envs == _fault_envs(schedule, seed)
 
+    storm = schedule == "corruption_storm"
     rpc_port = _free_port()
-    meta_proc = _spawn_meta(data_dir, rpc_port, "a",
-                            fault_env=envs.get("meta"))
+    meta_proc = _spawn_meta(
+        data_dir, rpc_port, "a", fault_env=envs.get("meta"),
+        # corruption_storm: fast background scrub cycles + patient
+        # serving reads (repairs happen inside the read window)
+        scrub_interval=2.0 if storm else None,
+        serve_retry_timeout=180.0 if storm else None,
+    )
     _wait_port(rpc_port)  # peers register against a LIVE meta
     procs = [_spawn_worker(rpc_port, data_dir, i,
                            fault_env=envs.get("worker"))
@@ -328,6 +389,11 @@ def run_schedule(schedule: str, seed: int = 7, rounds: int = 10,
             drive_round()
             committed = int(driver.call(
                 "cluster_state")["cluster_epoch"])
+            if storm:
+                # scrub EVERY round: a corrupt checkpoint epoch must
+                # be caught before retention GC rotates it out —
+                # detection + (synchronous) repair per cycle
+                driver.call("cluster_scrub", deadline_s=300.0)
             if schedule == "meta_kill" and committed == kill_at_round \
                     and state["meta_restarts"] == 0:
                 # SIGKILL MID-ROUND: launch the next round, give the
@@ -350,6 +416,16 @@ def run_schedule(schedule: str, seed: int = 7, rounds: int = 10,
         for t in threads:
             t.join(timeout=15)
 
+        final_scrub = None
+        if storm:
+            # drain: keep scrubbing until nothing corrupt remains in
+            # reach (repairs are synchronous within each cycle)
+            for _ in range(6):
+                final_scrub = driver.call("cluster_scrub",
+                                          deadline_s=300.0)
+                if not final_scrub["corrupt"]:
+                    break
+                time.sleep(0.5)
         final_state = driver.call("cluster_state")
         faults = driver.call("cluster_faults")
         cluster_rows = [
@@ -389,6 +465,11 @@ def run_schedule(schedule: str, seed: int = 7, rounds: int = 10,
     peer_retries = sum(v["rpc_retries_total"] for v in worker_faults)
     upload_retries = sum(v.get("checkpoint_upload_retries_total", 0)
                          for v in worker_faults)
+    planted = sorted({
+        k for v in worker_faults
+        for k in (v["fabric"] or {}).get("corrupted_keys", [])
+    })
+    detected = sorted(set((final_scrub or {}).get("quarantined", [])))
     summary = {
         "schedule": schedule,
         "seed": seed,
@@ -410,6 +491,13 @@ def run_schedule(schedule: str, seed: int = 7, rounds: int = 10,
         "meta_rpc_retries": faults["meta"]["rpc_retries_total"],
         "peer_rpc_retries": peer_retries,
         "upload_retries": upload_retries,
+        "corruptions_planted": planted,
+        "corruptions_detected": detected,
+        "all_corruptions_detected":
+            bool(planted) and set(planted) <= set(detected),
+        "repairs": (final_scrub or {}).get("repairs", {}),
+        "scrub_unrepaired":
+            len((final_scrub or {}).get("corrupt", [])),
         "mv_mismatches": mismatches,
         "mv_rows": [len(r) for r in cluster_rows],
         "data_dir": data_dir,
@@ -440,6 +528,13 @@ def _schedule_ok(schedule: str, s: dict) -> bool:
     if schedule == "store_faults":
         # faults hit the async upload path and were retried there
         return s["faults_injected"] > 0 and s["upload_retries"] > 0
+    if schedule == "corruption_storm":
+        # every planted corruption detected (quarantine note per
+        # corrupted object), every reachable one repaired, and at
+        # least one repair of each class actually ran
+        return s["all_corruptions_detected"] \
+            and s["scrub_unrepaired"] == 0 \
+            and sum(s["repairs"].values()) > 0
     return True
 
 
@@ -621,6 +716,226 @@ def run_scale_storm(seed: int = 7, rounds: int = 10,
         and summary["faults_injected"] > 0
         and summary["exchange_faults_absorbed"] > 0
         and summary["active_workers"] == [1, 2]
+    )
+    return summary
+
+
+def run_scale_kill(seed: int = 7, rounds: int = 8,
+                   scale_at_round: int = 3, readers: int = 2,
+                   data_dir: str | None = None) -> dict:
+    """SIGKILL the slice-transplant recipient mid-``cluster scale``
+    (see module docstring): the seeded fabric delays the DONOR's
+    mask-swap RPC, holding open the window between the recipient's
+    transplant and the donors' narrow; the campaign kills the
+    recipient inside it.  The transplanted state must survive through
+    the durably-sealed lineage (failover re-adopts it on the spare
+    worker), the interrupted scale op must roll forward on retry, and
+    the MV must converge byte-identically with 0 read errors."""
+    data_dir = data_dir or tempfile.mkdtemp(prefix="chaos_scalekill_")
+    envs = _fault_envs("scale_kill", seed)
+    deterministic = envs == _fault_envs("scale_kill", seed)
+
+    rpc_port = _free_port()
+    meta_proc = _spawn_meta(data_dir, rpc_port, "a",
+                            fault_env=envs.get("meta"),
+                            scale_partitioning=True,
+                            serve_retry_timeout=300.0)
+    _wait_port(rpc_port)
+    driver = MetaDriver(rpc_port)
+    scaler = MetaDriver(rpc_port)  # scale blocks for minutes: own conn
+    procs = []
+    state = {"reads": 0, "read_errors": [], "tick_retries": 0,
+             "rows": []}
+    stop = threading.Event()
+
+    def read_loop():
+        while not stop.is_set():
+            try:
+                driver.call("serve", sql=SCALE_READ, deadline_s=420.0)
+                state["reads"] += 1
+            except Exception as e:  # noqa: BLE001
+                state["read_errors"].append(repr(e))
+            time.sleep(0.05)
+
+    def drive_round(deadline_s: float = 420.0) -> None:
+        deadline = time.monotonic() + deadline_s
+        while True:
+            try:
+                res = driver.call("tick", chunks_per_barrier=2)
+                if res["committed"]:
+                    return
+            except Exception:  # noqa: BLE001 — stalled scale window
+                pass
+            state["tick_retries"] += 1
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"round never committed (scale_kill, seed {seed})")
+            time.sleep(0.2)
+
+    def ingest(i0: int, n: int) -> None:
+        rows = [((i0 + j) % 83, 5 * (i0 + j) + 2) for j in range(n)]
+        vals = ",".join(f"({k},{v})" for k, v in rows)
+        driver.call("execute_ddl", sql=f"INSERT INTO t VALUES {vals}")
+        state["rows"].extend(rows)
+
+    scale_res: dict = {}
+    try:
+        # spawn workers ONE AT A TIME: registration order fixes the
+        # worker ids the seeded schedule addresses (worker1 = donor)
+        deadline = time.monotonic() + 240
+        for i in range(3):
+            procs.append(_spawn_worker(rpc_port, data_dir, i))
+            while True:
+                st = driver.call("cluster_state", deadline_s=120.0)
+                if sum(w["alive"] for w in st["workers"]) >= i + 1:
+                    break
+                if procs[i].poll() is not None:
+                    raise RuntimeError(
+                        f"worker died at startup (logs in {data_dir})")
+                if time.monotonic() > deadline:
+                    raise TimeoutError("cluster never assembled")
+                time.sleep(0.25)
+
+        driver.call("cluster_scale", n=1)  # donor owns everything
+        for sql in SCALE_DDL:
+            driver.call("execute_ddl", sql=sql)
+
+        threads = [threading.Thread(target=read_loop, daemon=True)
+                   for _ in range(readers)]
+        for t in threads:
+            t.start()
+
+        i0 = 0
+        committed = 0
+        while committed < scale_at_round:
+            for _ in range(3):
+                ingest(i0, 24)
+                i0 += 24
+            drive_round()
+            committed = int(driver.call(
+                "cluster_state")["cluster_epoch"])
+
+        # scale 1 -> 2 in a thread; the donor's narrow is delayed by
+        # the fabric, so the recipient's transplant is observable
+        # BEFORE the mask swap — the kill window
+        def do_scale():
+            try:
+                scale_res["first"] = scaler.call(
+                    "cluster_scale", n=2, deadline_s=600.0)
+            except Exception as e:  # noqa: BLE001 — expected: stall
+                scale_res["first_error"] = repr(e)
+
+        t_scale = threading.Thread(target=do_scale, daemon=True)
+        t_scale.start()
+        kill_deadline = time.monotonic() + 120
+        while True:
+            st = driver.call("cluster_state", deadline_s=120.0)
+            job = next((j for j in st["jobs"] if j["name"] == "agg"),
+                       None)
+            parts = (job or {}).get("partitions") or []
+            if any(p["worker"] == 2 and p["vnodes"] > 0
+                   for p in parts):
+                break  # transplant landed on the recipient
+            if time.monotonic() > kill_deadline:
+                raise TimeoutError("transplant to recipient never "
+                                   "became visible")
+            time.sleep(0.05)
+        procs[1].send_signal(signal.SIGKILL)  # the recipient dies
+        procs[1].wait(timeout=10)
+        t_scale.join(timeout=600)
+
+        # failover: the dead recipient's lineage (WITH the durably
+        # sealed transplanted slice) re-adopts on the spare worker
+        drive_round(deadline_s=420.0)
+        # the interrupted op rolls forward on retry
+        scale_res["retry"] = scaler.call("cluster_scale", n=2,
+                                         deadline_s=600.0)
+
+        while committed < rounds:
+            for _ in range(3):
+                ingest(i0, 24)
+                i0 += 24
+            drive_round()
+            committed = int(driver.call(
+                "cluster_state")["cluster_epoch"])
+        total = len(state["rows"])
+        drain_deadline = time.monotonic() + 420
+        while True:
+            drive_round()
+            rows = driver.call("serve", sql=SCALE_READ)["rows"]
+            if sum(int(r[1]) for r in rows) == total:
+                break
+            if time.monotonic() > drain_deadline:
+                raise TimeoutError("scale_kill never drained")
+
+        stop.set()
+        for t in threads:
+            t.join(timeout=15)
+        final_state = driver.call("cluster_state")
+        cluster_rows = sorted(
+            tuple(int(x) for x in r)
+            for r in driver.call("serve", sql=SCALE_READ)["rows"]
+        )
+    finally:
+        stop.set()
+        for p in procs + [meta_proc]:
+            if p.poll() is None:
+                p.kill()
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+        driver.close()
+        scaler.close()
+
+    from risingwave_tpu.common.config import RwConfig
+    from risingwave_tpu.sql.engine import Engine
+
+    eng = Engine(RwConfig.from_dict(CONFIG))
+    for sql in SCALE_DDL:
+        eng.execute(sql)
+    sent = state["rows"]
+    for i in range(0, len(sent), 1024):
+        vals = ",".join(f"({k},{v})" for k, v in sent[i:i + 1024])
+        eng.execute(f"INSERT INTO t VALUES {vals}")
+    for _ in range(4096):
+        eng.tick(barriers=1, chunks_per_barrier=2)
+        if sum(int(r[1]) for r in eng.execute(SCALE_READ)) \
+                == len(sent):
+            break
+    single_rows = sorted(
+        tuple(int(x) for x in r) for r in eng.execute(SCALE_READ)
+    )
+
+    summary = {
+        "schedule": "scale_kill",
+        "seed": seed,
+        "deterministic_expansion": deterministic,
+        "rounds": rounds,
+        "rounds_committed": int(final_state["cluster_epoch"]),
+        "rows_ingested": len(sent),
+        "reads": state["reads"],
+        "read_errors": len(state["read_errors"]),
+        "read_error_samples": state["read_errors"][:3],
+        "tick_retries": state["tick_retries"],
+        "first_scale_error": scale_res.get("first_error"),
+        "first_scale": scale_res.get("first"),
+        "retry_scale_ok": "retry" in scale_res,
+        "active_workers": final_state["scale"]["active_workers"],
+        "mv_mismatches": int(cluster_rows != single_rows),
+        "mv_rows": len(cluster_rows),
+        "data_dir": data_dir,
+    }
+    summary["ok"] = bool(
+        summary["deterministic_expansion"]
+        and summary["read_errors"] == 0
+        and summary["rounds_committed"] >= rounds
+        and summary["mv_mismatches"] == 0
+        and summary["retry_scale_ok"]
+        # the kill interrupted the first op OR the op absorbed the
+        # death entirely — either way the retry rolled it forward
+        and (summary["first_scale_error"] is not None
+             or summary["first_scale"] is not None)
     )
     return summary
 

@@ -21,6 +21,24 @@ def _run(schedule: str, data_dir: str, **kw) -> dict:
 
 
 @pytest.mark.slow
+def test_corruption_storm(tmp_path):
+    """Integrity-lite acceptance: seeded bit_flip/truncate corruption
+    on the workers' MV-export and checkpoint uploads, with serving
+    reads, the compactor and the meta scrubber live — every planted
+    corruption detected (quarantine note per corrupted object), every
+    reachable one repaired, 0 client-visible read errors, 0 silent
+    wrong reads (byte-identical convergence vs single node)."""
+    summary = _run("corruption_storm", str(tmp_path), rounds=8)
+    assert summary["ok"], summary
+    assert summary["corruptions_planted"], summary
+    assert summary["all_corruptions_detected"], summary
+    assert summary["scrub_unrepaired"] == 0, summary
+    assert summary["read_errors"] == 0, summary["read_error_samples"]
+    assert summary["mv_mismatches"] == 0
+    assert summary["rounds_committed"] >= summary["rounds"]
+
+
+@pytest.mark.slow
 def test_chaos_campaign_meta_kill(tmp_path):
     """Meta SIGKILL + restart mid-round: recovery from the durable
     MetaStore/manifest, worker + serving re-registration via backoff,

@@ -404,3 +404,70 @@ def test_meta_restart_recovers_partitions(tmp_path):
     finally:
         for w in workers:
             w.stop()
+
+
+def test_merge_failover_when_no_spare_worker(tmp_path):
+    """ROADMAP remaining item: a partitioned job's worker dies and NO
+    spare worker can host its lineage — the dead partition's vnodes
+    MERGE into the survivor via the scale-in slice-transplant path
+    (recipient rewinds to the last committed round, transplants the
+    dead lineage's slice, widens its mask) instead of stalling the
+    round forever.  Rounds resume and the MV converges
+    byte-identically."""
+    from risingwave_tpu.common.config import RwConfig
+    from risingwave_tpu.sql.engine import Engine
+
+    meta, workers = _mk_cluster(tmp_path, n_workers=2)
+    rows_sent: list = []
+    try:
+        meta.scale(2)
+        for sql in DDL:
+            meta.execute_ddl(sql)
+        _ingest(meta, rows_sent, 0, 160)
+        _drive(meta, 3)
+        job = meta.jobs["agg"]
+        assert len(job.partitions) == 2
+
+        # kill one worker (no spare exists: both host a partition)
+        dead = workers[1]
+        dead_id = dead.worker_id
+        dead.stop()
+        meta._on_worker_dead(meta.workers[dead_id])
+        meta._assign_pending()
+
+        # the dead partition MERGED into the survivor
+        assert len(job.partitions) == 1
+        survivor = next(iter(job.partitions.values()))
+        assert sorted(survivor.vnodes) == list(range(meta.n_vnodes))
+        assert survivor.worker_id == workers[0].worker_id
+        assert meta.metrics.get("cluster_merge_failovers_total") == 1
+        assert all(w == workers[0].worker_id for w in meta.vnode_map)
+
+        # rounds resume; ingest keeps flowing; everything drains
+        _ingest(meta, rows_sent, 160, 160)
+        for _ in range(200):
+            meta.tick(2)
+            _, rows = meta.serve(READ)
+            if sum(int(r[1]) for r in rows) == len(rows_sent):
+                break
+        else:
+            raise TimeoutError("merged cluster never drained")
+        cluster = sorted(tuple(int(x) for x in r) for r in rows)
+
+        eng = Engine(RwConfig.from_dict(CONFIG))
+        for sql in DDL:
+            eng.execute(sql)
+        vals = ",".join(f"({k},{v})" for k, v in rows_sent)
+        eng.execute(f"INSERT INTO t VALUES {vals}")
+        for _ in range(200):
+            eng.tick(barriers=1, chunks_per_barrier=2)
+            if sum(int(r[1]) for r in eng.execute(READ)) \
+                    == len(rows_sent):
+                break
+        single = sorted(tuple(int(x) for x in r)
+                        for r in eng.execute(READ))
+        assert cluster == single
+    finally:
+        for w in workers:
+            w.stop()
+        meta.stop()

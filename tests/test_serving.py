@@ -318,3 +318,64 @@ def test_serving_mv_on_mv_and_multiple_replicas(tmp_path):
         sv2.stop()
         w.stop()
         meta.stop()
+
+
+def test_corrupt_replica_block_falls_back_zero_errors(tmp_path):
+    """Integrity satellite: a replica whose LOCAL reads of a shared
+    SST return corrupt bytes (bad disk/cache sector) answers
+    ``ServeUnavailable`` — the meta routes the read to the healthy
+    replica (or owner) with ZERO client errors and ZERO wrong rows,
+    and the corruption is reported for quarantine."""
+    import os
+
+    from risingwave_tpu.storage.hummock import (
+        LocalFsObjectStore,
+        StoreFaults,
+    )
+
+    meta, addr, w = _mk_cluster(tmp_path)
+    # replica A reads every SST through a corrupting store (bit_flip
+    # on get, deterministic); replica B reads the same files clean
+    bad_faults = StoreFaults(seed=3)
+    bad_faults.fail("get", substr="sst/", mode="bit_flip", times=64)
+    bad_store = LocalFsObjectStore(
+        os.path.join(str(tmp_path), "hummock"), faults=bad_faults)
+    sv_bad = ServingWorker(addr, str(tmp_path), store=bad_store,
+                           heartbeat_interval_s=0.2).start()
+    sv_ok = ServingWorker(addr, str(tmp_path),
+                          heartbeat_interval_s=0.2).start()
+    try:
+        # every routed read answers correctly regardless of which
+        # replica round-robin picks first
+        for _ in range(6):
+            assert _rows(meta.serve("SELECT g, n FROM m1")) == [
+                (g, 48) for g in range(8)
+            ]
+        # the corrupt replica detected typed corruption (never served
+        # a wrong row, never surfaced a client error)
+        assert bad_faults.injected_corruptions > 0
+        assert sv_bad.metrics.get("integrity_errors_total",
+                                  kind="sst_footer") >= 1 \
+            or sv_bad.metrics.get("integrity_errors_total",
+                                  kind="sst_block") >= 1
+        assert sv_bad.read_errors == 0
+        # the healthy replica carried reads
+        assert sv_ok.reads_total > 0
+        # the report reached the meta's integrity pipeline
+        deadline = time.monotonic() + 10
+        while True:
+            try:
+                assert meta.metrics.get("integrity_errors_total",
+                                        kind="sst_footer") >= 1 \
+                    or meta.metrics.get("integrity_errors_total",
+                                        kind="sst_block") >= 1
+                break
+            except (KeyError, AssertionError):
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.1)
+    finally:
+        sv_bad.stop()
+        sv_ok.stop()
+        w.stop()
+        meta.stop()
