@@ -30,6 +30,7 @@ from typing import Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from risingwave_tpu.common.chunk import Chunk, NCol, StrCol
 from risingwave_tpu.common.hash import VNODE_COUNT, compute_vnodes
@@ -57,6 +58,35 @@ def shard_map_nocheck(body, *, mesh, in_specs, out_specs):
     return _shard_map_impl(
         body, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
     )
+
+
+#: trace-time exchange audit (profile_q8 --assert --sharded): each
+#: ``shuffle_chunk`` TRACE bumps ``calls`` and adds the per-shard
+#: all_to_all payload bytes.  Programs compile once, so after a warm
+#: run this reflects exactly what the compiled graphs contain — a
+#: per-row or per-window exchange regression shows up as extra traced
+#: calls/bytes, with zero steady-state cost (nothing runs on device).
+EXCHANGE_TRACE = {"calls": 0, "bytes": 0}
+
+
+def reset_exchange_trace() -> None:
+    EXCHANGE_TRACE["calls"] = 0
+    EXCHANGE_TRACE["bytes"] = 0
+
+
+def _trace_bytes(x) -> int:
+    return int(np.prod(x.shape)) * x.dtype.itemsize
+
+
+def single_shard_keys(chunk) -> list:
+    """Constant routing key: every row hashes to ONE owning shard.
+
+    The device analog of the reference's singleton fragments (global
+    aggs / global TopN need a total view): an all_to_all keyed on a
+    constant routes the whole stream to whichever shard owns
+    vnode(hash(0)), and the other shards run the same programs over
+    empty chunks — byte-identical to the linear run at that shard."""
+    return [jnp.zeros((chunk.capacity,), jnp.int64)]
 
 
 def shard_of_vnode(vnodes: jnp.ndarray, n_shards: int,
@@ -155,4 +185,9 @@ def shuffle_chunk(
     cols = tuple(a2a_col(c) for c in cols)
     ops = a2a(ops)
     valid = a2a(valid)
+    EXCHANGE_TRACE["calls"] += 1
+    EXCHANGE_TRACE["bytes"] += sum(
+        _trace_bytes(x)
+        for x in jax.tree.leaves((cols, ops, valid))
+    )
     return Chunk(cols, ops, valid, chunk.schema)

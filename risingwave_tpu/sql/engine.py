@@ -1009,8 +1009,11 @@ class Engine:
             if getattr(self.catalog.get(tap.name).job, "mesh", None)
             is not None
         }
+        exchange_specs: dict[int, list] = {}
         if mesh_jobs:
-            self._validate_mesh_attach(plan, taps, mesh_jobs)
+            exchange_specs = self._plan_mesh_attach(
+                plan, taps, mesh_jobs
+            )
 
         # attach: resolve every tap to its upstream job's MV node
         tap_refs: dict[str, int] = {}
@@ -1061,6 +1064,15 @@ class Engine:
                 ))
         ids = target.add_nodes(rewritten)
 
+        # sharded attach: mark the derived exchange edges BEFORE any
+        # backfill/step program compiles — the snapshot replay and the
+        # live changelog cross the same all_to_all (dag._exchange)
+        for pi, specs in exchange_specs.items():
+            for side, key_fn in specs:
+                target.exchanges[(ids[pi], side)] = key_fn
+        if exchange_specs:
+            target._rebuild()
+
         # backfill: every NEW input slot that consumes a tapped MV
         # replays its current snapshot before going live (device-side,
         # one chunk).  Per input SLOT, not per tap — a self-join of one
@@ -1096,53 +1108,128 @@ class Engine:
         return target, terminal, (ids[plan.mv_node], plan.mv_index), \
             (ids, list(src_rename.values())), False
 
-    def _validate_mesh_attach(self, plan: DagPlan, taps: dict,
-                              mesh_jobs: set) -> None:
-        """MV-on-MV over a SHARDED join job (ROADMAP carry from round
-        6): the attached nodes run per-shard inside the upstream's
-        shard_map, which is correct exactly when every new node is a
-        per-key-safe chain over the tapped MV — a joined row's
-        changelog always lands on the shard owning its join key, so
-        project/filter/materialize over it stay shard-local.  Anything
-        that would merge rows ACROSS shards (aggs over reduced keys,
-        new joins, TopN) or pull a new un-sharded source still raises.
-        """
+    def _plan_mesh_attach(self, plan: DagPlan, taps: dict,
+                          mesh_jobs: set) -> dict[int, list]:
+        """MV-on-MV over SHARDED join jobs: derive the device hash
+        exchange each attached node needs (ROADMAP multi-device item).
+
+        The attached nodes run per-shard inside the upstream's
+        shard_map.  A per-key-safe chain (project/filter/materialize)
+        stays shard-local — a joined row's changelog always lands on
+        the shard owning its join key.  Cross-shard shapes no longer
+        raise; they get an ``all_to_all`` exchange on the attach edge
+        (keyed by the same ``hash64``/crc32 vnode mix as every other
+        exchange) so rows re-route to their new key owners:
+
+        - HashAgg over REDUCED keys → exchange on its group-by keys
+          (every group lands whole on one shard);
+        - global agg / global TopN (no keys) → constant-key exchange
+          to ONE owning shard (the reference's singleton fragment);
+        - grouped TopN → exchange on its partition keys;
+        - a new JoinNode (join of two sharded MVs; their mesh jobs
+          merge first) → exchange per side on its equi keys.
+
+        Still raising: un-sharded/new sources mixed in, temporal
+        joins, shapes whose keys are not evaluable on the attach-edge
+        chunk (a projection ahead of a keyed stateful op), and
+        executors outside the gated set.
+
+        Returns ``{plan_node_id: [(side, key_fn)]}`` (side None for a
+        FragNode input edge)."""
+        from risingwave_tpu.parallel.exchange import single_shard_keys
         from risingwave_tpu.stream.executor import (
             FilterExecutor as _F,
             ProjectExecutor as _P,
+        )
+        from risingwave_tpu.stream.hash_agg import (
+            HashAggExecutor as _A,
         )
         from risingwave_tpu.stream.materialize import (
             AppendOnlyMaterialize as _AOM,
             MaterializeExecutor as _M,
         )
+        from risingwave_tpu.stream.temporal_join import (
+            TemporalJoinExecutor as _TJ,
+        )
+        from risingwave_tpu.stream.top_n import GroupTopNExecutor as _T
 
-        if len(mesh_jobs) > 1 or any(
+        if any(
             getattr(self.catalog.get(t.name).job, "mesh", None) is None
             for t in taps.values()
         ):
             raise PlanError(
-                "MV-on-MV joining a sharded job with another job: "
-                "next round"
+                "MV-on-MV joining a sharded job with an un-sharded "
+                "job: next round"
+            )
+        if len({j.n_shards for j in mesh_jobs}) > 1:
+            raise PlanError(
+                "MV-on-MV joining sharded jobs of different "
+                "parallelism: next round"
             )
         if len(taps) != len(plan.sources):
             raise PlanError(
                 "MV-on-MV over a sharded join job cannot add new "
                 "sources: next round"
             )
-        for n in plan.nodes:
-            if not isinstance(n, FragNode):
-                raise PlanError(
-                    "MV-on-MV over a sharded join job supports "
-                    "project/filter/materialize chains (no new "
-                    "joins): next round"
-                )
-            for ex in n.fragment.executors:
-                if not isinstance(ex, (_F, _P, _M, _AOM)):
+
+        specs: dict[int, list] = {}
+        for i, n in enumerate(plan.nodes):
+            if isinstance(n, JoinNode):
+                if isinstance(n.join, _TJ):
                     raise PlanError(
-                        "MV-on-MV over a sharded join job supports "
-                        "project/filter/materialize chains "
-                        f"(got {type(ex).__name__}): next round"
+                        "temporal join over a sharded job (build side "
+                        "replicates, not partitions): next round"
                     )
+                specs[i] = [
+                    ("left", lambda c, ks=n.join.left_keys:
+                        _join_exchange_keys(ks, c)),
+                    ("right", lambda c, ks=n.join.right_keys:
+                        _join_exchange_keys(ks, c)),
+                ]
+                continue
+            execs = n.fragment.executors
+            stateful = [ex for ex in execs
+                        if not isinstance(ex, (_F, _P, _M, _AOM))]
+            if not stateful:
+                continue  # per-key-safe chain: stays shard-local
+            if len(stateful) > 1:
+                raise PlanError(
+                    "MV-on-MV over a sharded job with more than one "
+                    "keyed operator per fragment: next round"
+                )
+            ex = stateful[0]
+            pos = execs.index(ex)
+            if isinstance(ex, _A):
+                keyed = bool(ex.group_by)
+                key_fn = (
+                    (lambda c, a=ex: [e.eval(c) for _, e in a.group_by])
+                    if keyed else single_shard_keys
+                )
+            elif isinstance(ex, _T):
+                keyed = bool(ex.group_by)
+                key_fn = (
+                    (lambda c, t=ex: [k.eval(c) for k in t.group_by])
+                    if keyed else single_shard_keys
+                )
+            else:
+                raise PlanError(
+                    "MV-on-MV over a sharded job supports project/"
+                    "filter/materialize chains, aggs, TopN, and joins "
+                    f"(got {type(ex).__name__}): next round"
+                )
+            # a KEYED op's keys evaluate on the attach-edge chunk:
+            # only filters may precede it (they preserve the schema);
+            # an unkeyed (constant-route) op tolerates any per-key-
+            # safe prefix — the exchange does not read columns
+            if keyed and any(not isinstance(p, _F)
+                             for p in execs[:pos]):
+                raise PlanError(
+                    "MV-on-MV over a sharded job: a projection ahead "
+                    "of a keyed agg/TopN (keys not evaluable on the "
+                    "attach edge): next round"
+                )
+            specs[i] = [(None, key_fn)]
+        return specs
 
     @staticmethod
     def _agg_shard_safe(agg, node, plan: DagPlan) -> bool:
@@ -1221,7 +1308,20 @@ class Engine:
     def _merge_dag_jobs(self, a: DagJob, b: DagJob) -> DagJob:
         """Fuse job ``b`` into ``a`` (a join of MVs living in different
         jobs): sources and nodes move over with remapped ids; catalog
-        entries follow."""
+        entries follow.  Two SHARDED jobs merge too (a join of two
+        sharded MVs): equal-parallelism meshes span the same devices,
+        so ``b``'s stacked states drop into ``a``'s mesh unchanged and
+        its exchange edges remap with its node ids."""
+        if (a.mesh is None) != (b.mesh is None):
+            raise PlanError(
+                "MV-on-MV joining a sharded job with an un-sharded "
+                "job: next round"
+            )
+        if a.mesh is not None and a.n_shards != b.n_shards:
+            raise PlanError(
+                "MV-on-MV joining sharded jobs of different "
+                "parallelism: next round"
+            )
         offset = len(a.nodes)
         rename: dict[str, str] = {}
         for sname, reader in b.sources.items():
@@ -1253,6 +1353,8 @@ class Engine:
                 ))
         a.nodes.extend(moved)
         a.states = tuple(list(a.states) + list(b.states))
+        for (i, side), fn in b.exchanges.items():
+            a.exchanges[(offset + i, side)] = fn
         a._rebuild()
         for entry in self.catalog.list():
             if entry.job is b:
@@ -2129,7 +2231,17 @@ class Engine:
           drained window capacity (small = oversized out_capacity);
         - ``join_drain_windows_per_chunk``: emission windows per probe
           chunk (1 = no amplification re-dispatch).
+
+        Plus ``dag_fused_fallback_total{reason}``: windows a DagJob
+        could NOT run as one fused dispatch (staged plan, host-chunk
+        source) — a silent degradation to per-chunk host dispatches is
+        a throughput cliff, so it is counted per reason.
+
+        Sharded jobs export the same gauges with counters SUMMED over
+        the shard axis (chunks count per-shard pulls, so per-chunk
+        ratios stay comparable to the linear job's).
         """
+        import jax as _jax
         import numpy as _np
 
         from risingwave_tpu.stream.hash_join import PoolSideState
@@ -2137,6 +2249,12 @@ class Engine:
         for job in self.jobs:
             if not isinstance(job, DagJob):
                 continue
+            for reason, count in job.fused_fallbacks.items():
+                self.metrics.set_gauge(
+                    "dag_fused_fallback_total", count,
+                    job=job.name, reason=reason,
+                )
+            n_shards = job.n_shards
             for idx, node in enumerate(job.nodes):
                 if not isinstance(node, JoinNode):
                     continue
@@ -2144,17 +2262,20 @@ class Engine:
                 if not hasattr(jstate, "chunks"):
                     continue  # non-HashJoin two-input node
                 labels = {"job": job.name, "node": str(idx)}
-                chunks = max(int(_np.asarray(jstate.chunks)), 1)
+                chunks = max(int(_np.asarray(jstate.chunks).sum()), 1)
                 self.metrics.set_gauge(
                     "join_probe_iters_per_chunk",
-                    float(_np.asarray(jstate.probe_iters)) / chunks,
+                    float(_np.asarray(jstate.probe_iters).sum())
+                    / chunks,
                     **labels,
                 )
                 out_cap = node.join.out_capacity
-                windows = max(int(_np.asarray(jstate.emit_windows)), 1)
+                windows = max(
+                    int(_np.asarray(jstate.emit_windows).sum()), 1
+                )
                 self.metrics.set_gauge(
                     "join_emit_window_fill_ratio",
-                    float(_np.asarray(jstate.emit_rows))
+                    float(_np.asarray(jstate.emit_rows).sum())
                     / (windows * out_cap),
                     **labels,
                 )
@@ -2169,10 +2290,12 @@ class Engine:
                     from risingwave_tpu.stream.hash_join import (
                         _pool_capacity,
                     )
+                    rows0 = s.rows if job.mesh is None else \
+                        _jax.tree.map(lambda x: x[0], s.rows)
                     self.metrics.set_gauge(
                         "join_pool_occupancy",
-                        float(_np.asarray(s.pool_len))
-                        / _pool_capacity(s.rows),
+                        float(_np.asarray(s.pool_len).sum())
+                        / (_pool_capacity(rows0) * n_shards),
                         side=side_name, **labels,
                     )
 
@@ -2213,6 +2336,14 @@ class Engine:
                     clean = getattr(join, f"{side}_clean", None)
                     proto = _empty_chunk(schema, 4)
                     sstate = getattr(job.states[idx], side)
+                    if job.mesh is not None:
+                        # audit the per-shard program (drop the shard
+                        # axis — every shard compiles the same body)
+                        sstate = _jax.tree.map(
+                            lambda x: _jax.ShapeDtypeStruct(
+                                x.shape[1:], x.dtype
+                            ), sstate,
+                        )
                     reset_probe_stats()
                     _jax.eval_shape(
                         lambda s, c, keys=keys, clean=clean:

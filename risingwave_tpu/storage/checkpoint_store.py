@@ -155,17 +155,34 @@ class CheckpointStore:
 
     # -- checkpoint save: prepare (fetch) / commit (write) --------------
     def prepare(self, job_name: str, epoch: int, leaves, shapes,
-                treedef, source_state: dict, digests=None) -> dict:
+                treedef, source_state: dict, digests=None,
+                lanes=None) -> dict:
         """Stage one epoch's payload on the host.
 
         ``leaves`` may be device arrays of any shape (they are read as
         flat element streams); ``digests`` (uint64 vector from the
-        shadow snapshot's update program) skips the digest pass.  After
-        this returns, the caller may freely mutate or donate the device
-        buffers — everything needed by ``commit`` is host-resident."""
+        shadow snapshot's update program) skips the digest pass.
+        ``lanes`` (per-leaf ``(rows, row_elems)`` or None, from a
+        per-shard shadow) describes the digest's block grid: lane
+        leaves restart their blocks at every row, so the dirty-run
+        extraction below walks rows and never emits a run crossing a
+        shard boundary.  After this returns, the caller may freely
+        mutate or donate the device buffers — everything needed by
+        ``commit`` is host-resident."""
+        from risingwave_tpu.storage.digest import lane_block_count
+
         block = self.block_elems
-        nblocks = [leaf_block_count(s, block) for s in shapes]
+        if lanes is None:
+            lanes = [None] * len(shapes)
+        nblocks = [
+            lane_block_count(s, ln[0], block) if ln
+            else leaf_block_count(s, block)
+            for s, ln in zip(shapes, lanes)
+        ]
         if digests is None:
+            # the store-side digest pass is flat-only; a lane grid is
+            # meaningful only for shadow-computed digest vectors
+            lanes = [None] * len(shapes)
             digest_jit, nblocks = self._digest_fn(job_name, leaves)
             digests = np.asarray(digest_jit(leaves))
         else:
@@ -197,31 +214,39 @@ class CheckpointStore:
             for i, (h, s) in enumerate(zip(host, shapes)):
                 payload[f"leaf_{i}"] = np.asarray(h).reshape(s)
         else:
-            # fetch only dirty runs, flat per leaf
+            # fetch only dirty runs, flat per leaf; lane leaves walk
+            # per shard row so no run crosses a shard boundary
             off = 0
-            for i, (x, nb, shape) in enumerate(
-                    zip(leaves, nblocks, shapes)):
+            for i, (x, nb, shape, ln) in enumerate(
+                    zip(leaves, nblocks, shapes, lanes)):
                 leaf_dirty = dirty[off:off + nb]
                 off += nb
                 if not leaf_dirty.any():
                     continue
                 flat = jnp.asarray(x).reshape(-1)
                 n = flat.shape[0]
-                # coalesce adjacent dirty blocks into runs
-                b = 0
-                while b < nb:
-                    if not leaf_dirty[b]:
-                        b += 1
+                rows, m = ln if ln else (1, n)
+                nb_row = nb // rows
+                for r in range(rows):
+                    row_dirty = leaf_dirty[r * nb_row:(r + 1) * nb_row]
+                    if ln and not row_dirty.any():
                         continue
-                    e = b
-                    while e + 1 < nb and leaf_dirty[e + 1]:
-                        e += 1
-                    s_el = b * block
-                    e_el = min((e + 1) * block, n)
-                    payload[f"r_{i}_{s_el}"] = np.asarray(
-                        flat[s_el:e_el]
-                    )
-                    b = e + 1
+                    base_el = r * m
+                    # coalesce adjacent dirty blocks into runs
+                    b = 0
+                    while b < nb_row:
+                        if not row_dirty[b]:
+                            b += 1
+                            continue
+                        e = b
+                        while e + 1 < nb_row and row_dirty[e + 1]:
+                            e += 1
+                        s_el = base_el + b * block
+                        e_el = base_el + min((e + 1) * block, m)
+                        payload[f"r_{i}_{s_el}"] = np.asarray(
+                            flat[s_el:e_el]
+                        )
+                        b = e + 1
         return {
             "job": job_name, "epoch": epoch, "kind": kind,
             "payload": payload, "treedef": treedef,
@@ -288,7 +313,7 @@ class CheckpointStore:
                 else self._since_full.get(job_name, 0) + 1
 
     def save(self, job_name: str, epoch: int, states: Any,
-             source_state: dict, digests=None) -> None:
+             source_state: dict, digests=None, lanes=None) -> None:
         """Persist one committed epoch synchronously (prepare+commit —
         the 'SST upload' + commit in one call).
 
@@ -298,7 +323,7 @@ class CheckpointStore:
         shapes = [np.shape(x) for x in leaves]
         self.commit(self.prepare(
             job_name, epoch, leaves, shapes, treedef, source_state,
-            digests=digests,
+            digests=digests, lanes=lanes,
         ))
 
     def invalidate(self, job_name: str) -> None:

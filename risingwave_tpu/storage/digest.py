@@ -117,3 +117,30 @@ def digest_leaves(leaves, nblocks, block: int) -> jnp.ndarray:
     return jnp.concatenate([
         leaf_digest(x, nb, block) for x, nb in zip(leaves, nblocks)
     ])
+
+
+def lane_block_count(shape, rows: int, block: int) -> int:
+    """Block count of a leaf digested as ``rows`` independent lanes
+    (``rows * ceil(row_elems / block)``)."""
+    n = int(np.prod(shape)) if shape else 1
+    m = n // rows
+    return rows * max(1, -(-m // block))
+
+
+def leaf_digest_lanes(x, rows: int, block: int) -> jnp.ndarray:
+    """Per-block digests of one leaf in ``rows`` lanes, ``uint64
+    [lane_block_count]`` (traceable).
+
+    A mesh-stacked leaf (``[n_shards, ...]``) digested flat would let
+    blocks straddle shard rows: two shards writing different halves of
+    one straddling block keep it eternally dirty, and the delta
+    extraction cannot attribute it to either shard.  Lanes restart the
+    block grid at every row — no digest block spans a lane boundary,
+    so the dirty mask (and the dirty-run upload) is exact per shard.
+    Position mixing is row-local, which is fine: a digest is only ever
+    compared against the SAME block's previous digest."""
+    x = jnp.asarray(x).reshape(rows, -1)
+    nb_row = max(1, -(-x.shape[1] // block))
+    return jax.vmap(
+        lambda r: leaf_digest(r, nb_row, block)
+    )(x).reshape(-1)

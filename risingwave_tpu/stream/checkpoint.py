@@ -59,6 +59,10 @@ class UploadTask:
     shapes: list
     treedef: Any
     source_state: dict
+    #: per-leaf (rows, row_elems) digest-lane structure from a
+    #: per-shard shadow (mesh-stacked trees), None entries = flat —
+    #: prepare() must extract dirty runs on the same block grid
+    lanes: Any = None
     #: [(store_key, host_state)] spill-tier saves, persisted FIRST (a
     #: crash between tier and job save leaves the tier ahead, which
     #: recovery rewinds; the reverse order loses absorbed groups)
@@ -244,6 +248,7 @@ class CheckpointUploader:
                 prep = self.store.prepare(
                     self.job_name, task.epoch, task.leaves, task.shapes,
                     task.treedef, task.source_state, digests=digests,
+                    lanes=task.lanes,
                 )
                 # host payload materialized: the shadow may be donated
                 task.fetched.set()

@@ -45,7 +45,6 @@ from risingwave_tpu.stream.fragment import (
 from risingwave_tpu.stream.runtime import (
     CheckpointPipelineMixin,
     CheckpointSnapshot,
-    _snapshot_copy,
     check_counter_values,
     deliver_sinks,
     restore_source,
@@ -134,6 +133,11 @@ class DagJob(CheckpointPipelineMixin):
         #: n-round fused programs (one dispatch per n scheduling rounds;
         #: per-dispatch host overhead amortized n-fold), keyed by n
         self._fused_multi: dict[int, Any] = {}
+        #: windows that could NOT run as one fused dispatch, by reason
+        #: (observability: a silent degradation to per-chunk host
+        #: dispatches is a throughput cliff — exported as
+        #: ``dag_fused_fallback_total{reason}`` by collect_join_metrics)
+        self.fused_fallbacks: dict[str, int] = {}
         self.maintenance_interval = 1
         self._ckpts_since_maintain = 0
         self.snapshot_interval = 1
@@ -283,6 +287,8 @@ class DagJob(CheckpointPipelineMixin):
             self.nodes[i] = None
             states[i] = None
         self.states = tuple(states)
+        for key in [k for k in self.exchanges if k[0] in drop]:
+            del self.exchanges[key]
         self._rebuild()
 
     def reseed_checkpoint(self) -> None:
@@ -296,9 +302,11 @@ class DagJob(CheckpointPipelineMixin):
     def _snapshot_and_save(self, epoch: int) -> None:
         """The shared checkpoint tail: incremental shadow snapshot +
         async durable upload (used by both the barrier commit and
-        topology reseeds).  Sharded meshes keep the full-copy path —
-        the shadow programs are meshless and per-shard snapshot cost is
-        HBM-local."""
+        topology reseeds).  Sharded meshes ride the SAME pipeline with
+        per-shard digest lanes (stream/shadow.py ``shard_rows``): no
+        digest block spans a shard row, so dirty tracking — and the
+        delta upload — is exact per shard, replacing the old full-copy
+        full-upload path."""
         src_state = {
             name: (src.state() if hasattr(src, "state") else {})
             for name, src in self.sources.items()
@@ -312,35 +320,16 @@ class DagJob(CheckpointPipelineMixin):
             for s, tier in enumerate(tiers)
             if tier.rows_absorbed
         }
-        if self.mesh is not None:
-            snap = CheckpointSnapshot(
-                epoch=epoch,
-                states=_snapshot_copy(self.states),
-                source_state=src_state,
-                spill=spill_host,
-            )
-            self.checkpoints = [snap]
-            self.sealed_epoch = epoch
-            self.committed_epoch = epoch
-            if self.checkpoint_store is not None:
-                # tier saves FIRST (see StreamingJob._commit_checkpoint):
-                # a crash between the saves leaves the tier ahead, which
-                # recovery rewinds; the reverse order loses absorbed
-                # groups
-                for (idx, j, s), host_state in spill_host.items():
-                    self.checkpoint_store.save(
-                        self._spill_key(idx, j, s), epoch,
-                        host_state, {},
-                    )
-                self.checkpoint_store.save(
-                    self.name, epoch, snap.states, src_state
-                )
-            return
         spill_items = [
             (self._spill_key(idx, j, s), host_state)
             for (idx, j, s), host_state in spill_host.items()
         ]
         self._snapshot_commit(epoch, src_state, spill_host, spill_items)
+
+    def _shadow_shard_rows(self) -> int | None:
+        """Mesh-stacked trees digest in per-shard lanes (see
+        CheckpointPipelineMixin._snapshot_commit)."""
+        return self.n_shards if self.mesh is not None else None
 
     def downstream_closure(self, ref: Ref,
                            through_joins: bool = True) -> list[int]:
@@ -511,10 +500,12 @@ class DagJob(CheckpointPipelineMixin):
                         lambda x: x[None], tuple(new_states)
                     )
 
+            # donated like the linear path: the mesh-stacked state
+            # updates in place, no per-step allocation churn
             prog = jax.jit(shard_map_nocheck(
                 body, mesh=self.mesh, in_specs=(spec, spec),
                 out_specs=spec,
-            ))
+            ), donate_argnums=(0,))
             return prog, fused
         if fused:
             # traceable source: generation fuses into the step program
@@ -756,21 +747,35 @@ class DagJob(CheckpointPipelineMixin):
         n-fold.  For q8's binary-join DAG that cost was 2n dispatches
         per barrier (one per source chunk); now it is one.
 
-        Falls back to per-chunk dispatch for host-chunk sources,
-        staged plans (whose compile size must stay linear), and
-        sharded meshes (their per-shard base ordinals ride a different
-        calling convention)."""
+        Sharded meshes fuse too (``_run_chunks_mesh``): the whole
+        barrier-to-barrier window runs as ONE ``shard_map`` program,
+        exchanges (all_to_all) inside the loop body, mesh-stacked
+        state donated.  Falls back to per-chunk dispatch only for
+        host-chunk sources and staged plans (whose compile size must
+        stay linear) — each fallback is counted by reason
+        (``fused_fallbacks``) so the degradation is observable."""
         if self.paused or n <= 0:
             return 0
-        fusable = self.mesh is None and not self.staged and all(
+        reason = None
+        if not self.sources:
+            reason = "no_sources"
+        elif self.staged:
+            reason = "staged"
+        elif not all(
             hasattr(src, "impl") and hasattr(src, "next_base")
             for src in self.sources.values()
-        ) and len(self.sources) > 0
-        if n == 1 or not fusable:
+        ):
+            reason = "host_chunk_source"
+        if reason is not None or n == 1:
+            if reason is not None and n > 1:
+                self.fused_fallbacks[reason] = \
+                    self.fused_fallbacks.get(reason, 0) + 1
             rows = 0
             for _ in range(n):
                 rows += self.chunk_round()
             return rows
+        if self.mesh is not None:
+            return self._run_chunks_mesh(n)
         prog = self._fused_multi.get(n)
         if prog is None:
             pulls = list(self._pulls)
@@ -809,6 +814,66 @@ class DagJob(CheckpointPipelineMixin):
             reader.offset += reader.cap * (n * k - 1)
             rows += reader.cap * n * k
         self.states = prog(self.states, k0s)
+        return rows
+
+    def _run_chunks_mesh(self, n: int) -> int:
+        """The sharded fused window: n scheduling rounds — per-shard
+        source generation, every exchange collective, join emission
+        drains — as ONE ``shard_map``-ed ``fori_loop`` program between
+        barriers, with the mesh-stacked state donated.
+
+        Per-shard base ordinals come in as one ``[n_shards, n*k]``
+        int64 column per source, computed host-side by the SAME
+        ``next_base()`` sequence the per-chunk path consumes — the
+        generated streams are ordinal-identical to n per-chunk rounds,
+        so fused and unfused runs stay byte-identical."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        prog = self._fused_multi.get(n)
+        if prog is None:
+            pulls = list(self._pulls)
+            readers = dict(self.sources)
+            spec = self._sharding_spec()
+
+            def body(states, *base_cols):
+                local = jax.tree.map(lambda x: x[0], states)
+
+                def round_body(i, st):
+                    new_states = list(st)
+                    for si, (nm, k) in enumerate(pulls):
+                        for rep in range(k):
+                            b0 = base_cols[si][0, i * k + rep]
+                            chunk = readers[nm].impl(b0, readers[nm].cap)
+                            self._propagate(
+                                new_states, [(("source", nm), chunk)]
+                            )
+                    return tuple(new_states)
+
+                out = jax.lax.fori_loop(0, n, round_body, tuple(local))
+                return jax.tree.map(lambda x: x[None], out)
+
+            prog = jax.jit(shard_map_nocheck(
+                body, mesh=self.mesh,
+                in_specs=(spec,) + (spec,) * len(pulls),
+                out_specs=spec,
+            ), donate_argnums=(0,))
+            if len(self._fused_multi) >= 4:
+                self._fused_multi.pop(next(iter(self._fused_multi)))
+            self._fused_multi[n] = prog
+        rows = 0
+        base_cols = []
+        sharding = NamedSharding(self.mesh, P(self.AXIS))
+        for nm, k in self._pulls:
+            reader = self.sources[nm]
+            arr = np.empty((n * k, self.n_shards), np.int64)
+            for i in range(n * k):
+                for s in range(self.n_shards):
+                    arr[i, s] = reader.next_base()
+            base_cols.append(jax.device_put(
+                jnp.asarray(arr.T), sharding
+            ))
+            rows += reader.cap * n * k * self.n_shards
+        self.states = prog(self.states, *base_cols)
         return rows
 
     # -- barrier program ------------------------------------------------
@@ -1039,7 +1104,7 @@ class DagJob(CheckpointPipelineMixin):
         return jax.jit(shard_map_nocheck(
             body, mesh=self.mesh, in_specs=(spec, spec),
             out_specs=(spec, P()),
-        ))
+        ), donate_argnums=(0,))
 
     def _barrier_epoch_arg(self, sealed):
         if self.mesh is None:
@@ -1102,7 +1167,7 @@ class DagJob(CheckpointPipelineMixin):
                 self._maintain_prog = jax.jit(shard_map_nocheck(
                     body, mesh=self.mesh, in_specs=(spec,),
                     out_specs=spec,
-                ))
+                ), donate_argnums=(0,))
         self.states = self._maintain_prog(self.states)
         if self._counters is None:
             return
@@ -1340,7 +1405,15 @@ class DagJob(CheckpointPipelineMixin):
                     tier.reset()
             return
         snap = self.checkpoints[-1]
-        self.states = self._restore_in_memory(snap)
+        states = self._restore_in_memory(snap)
+        if self.mesh is not None:
+            # shadow restores land on the default device; re-pin the
+            # stacked tree to the mesh layout before programs run
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            states = jax.device_put(
+                states, NamedSharding(self.mesh, P(self.AXIS))
+            )
+        self.states = states
         for name, src in self.sources.items():
             restore_source(src, snap.source_state.get(name, {}))
         for (idx, j), tiers in getattr(self, "_spill_tiers",
@@ -1410,6 +1483,13 @@ class DagJob(CheckpointPipelineMixin):
                        side: str | None):
         new_states = list(states)
         node = self.nodes[node_id]
+        # a marked attach edge routes the snapshot replay through the
+        # SAME exchange live chunks cross (agg-over-reduced-key / join
+        # attach edges): each shard's partition re-routes to its new
+        # key owners before the first executor sees it
+        chunk = self._exchange(
+            node_id, side if isinstance(node, JoinNode) else None, chunk
+        )
         if isinstance(node, FragNode):
             new_states[node_id], out = node.fragment._step_impl(
                 new_states[node_id], chunk

@@ -153,6 +153,12 @@ class CheckpointPipelineMixin:
     def _deliver_all_sinks(self, epoch_val) -> None:
         """Subclass hook: drain sink ring buffers at ``epoch_val``."""
 
+    def _shadow_shard_rows(self) -> int | None:
+        """Subclass hook: leading per-shard axis length of every state
+        leaf (mesh-stacked trees digest in per-shard lanes), None for
+        linear trees."""
+        return None
+
     # -- the shared snapshot-commit tail ---------------------------------
     def _snapshot_commit(self, epoch_val: int, src_state: dict,
                          spill_host: dict, spill_items: list) -> None:
@@ -186,6 +192,7 @@ class CheckpointPipelineMixin:
                 block_elems=store.block_elems if store is not None
                 else DEFAULT_BLOCK_ELEMS,
                 digest=store is not None,
+                shard_rows=self._shadow_shard_rows(),
             )
             digests = self._shadow.digests
         else:
@@ -205,7 +212,7 @@ class CheckpointPipelineMixin:
                 epoch=epoch_val, leaves=self._shadow.leaves,
                 digests=digests, shapes=self._shadow.shapes,
                 treedef=self._shadow.treedef, source_state=src_state,
-                spill=spill_items,
+                spill=spill_items, lanes=self._shadow.lanes,
             ))
             self._process_upload_acks()
         else:

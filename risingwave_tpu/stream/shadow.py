@@ -49,9 +49,10 @@ import numpy as np
 
 from risingwave_tpu.storage.digest import (
     DEFAULT_BLOCK_ELEMS,
-    digest_leaves,
+    lane_block_count,
     leaf_block_count,
     leaf_digest,
+    leaf_digest_lanes,
 )
 
 #: leaves at/below this many blocks skip the ladder and copy whole
@@ -118,17 +119,96 @@ def _copy_leaf(flat, sh, dirty, nb: int, n: int, block: int):
     return new_sh, nd.astype(jnp.int64)
 
 
-def _build_programs(sig, block: int, digest: bool):
+def _copy_leaf_rows(flat, sh, dirty, rows: int, m: int, block: int):
+    """Lane-aware dirty-budget ladder (mesh-stacked leaves): like
+    ``_copy_leaf``, but block starts are computed per (lane, block)
+    pair — ``start = lane*m + b*block`` — so the windowed gather/
+    scatter never crosses a shard row's boundary, and each lane's
+    ragged tail copies unconditionally as ONE static slice update
+    over the shard axis."""
+    nb_row = max(1, -(-m // block))
+    nbf = m // block  # full blocks per lane
+    if rows * nb_row <= _SMALL_NB or rows * nbf < 2:
+        return flat, jnp.int64(0)
+    nd = jnp.sum(dirty)
+    dirty_full = dirty.reshape(rows, nb_row)[:, :nbf].reshape(-1)
+    order = jnp.argsort(jnp.logical_not(dirty_full), stable=True)
+
+    gdims = jax.lax.GatherDimensionNumbers(
+        offset_dims=(1,), collapsed_slice_dims=(),
+        start_index_map=(0,),
+    )
+    sdims = jax.lax.ScatterDimensionNumbers(
+        update_window_dims=(1,), inserted_window_dims=(),
+        scatter_dims_to_operand_dims=(0,),
+    )
+
+    def rung(k: int):
+        def body(operand):
+            flat, sh = operand
+            ids = order[:k]
+            starts = ((ids // nbf) * m + (ids % nbf) * block) \
+                .astype(jnp.int32)[:, None]
+            vals = jax.lax.gather(
+                flat, starts, gdims, slice_sizes=(block,),
+                unique_indices=True,
+                mode=jax.lax.GatherScatterMode.PROMISE_IN_BOUNDS,
+            )
+            return jax.lax.scatter(
+                sh, starts, vals, sdims, unique_indices=True,
+                mode=jax.lax.GatherScatterMode.PROMISE_IN_BOUNDS,
+            )
+
+        return body
+
+    k0 = max(1, rows * nbf // 64)
+    k1 = max(1, rows * nbf // 8)
+    level = (nd > k0).astype(jnp.int32) + (nd > k1).astype(jnp.int32)
+    new_sh = jax.lax.switch(
+        level,
+        [rung(k0), rung(k1), lambda operand: operand[0]],
+        (flat, sh),
+    )
+    tail = m - nbf * block
+    if tail:
+        new_sh = new_sh.reshape(rows, m).at[:, nbf * block:].set(
+            flat.reshape(rows, m)[:, nbf * block:]
+        ).reshape(-1)
+    return new_sh, nd.astype(jnp.int64)
+
+
+def leaf_lanes(shape, shard_rows) -> tuple | None:
+    """Lane structure of one leaf under a per-shard digest scheme:
+    ``(rows, row_elems)`` when the leaf carries the mesh-stacked
+    leading axis, else None (flat digesting)."""
+    if not shard_rows or not shape or shape[0] != shard_rows:
+        return None
+    n = int(np.prod(shape)) if shape else 1
+    return (shard_rows, n // shard_rows)
+
+
+def _build_programs(sig, block: int, digest: bool, shard_rows):
     shapes = [s for _, s in sig]
-    nblocks = [leaf_block_count(s, block) for s in shapes]
+    lanes = [leaf_lanes(s, shard_rows) for s in shapes]
+    nblocks = [
+        lane_block_count(s, ln[0], block) if ln
+        else leaf_block_count(s, block)
+        for s, ln in zip(shapes, lanes)
+    ]
     sizes = [int(np.prod(s)) if s else 1 for s in shapes]
     total = sum(nblocks)
+
+    def digest_one(flat, nb, ln):
+        return leaf_digest_lanes(flat, ln[0], block) if ln \
+            else leaf_digest(flat, nb, block)
 
     def init(leaves):
         flat = tuple(jnp.copy(jnp.asarray(x).reshape(-1))
                      for x in leaves)
-        d = digest_leaves(flat, nblocks, block) if digest \
-            else jnp.zeros((0,), jnp.uint64)
+        d = jnp.concatenate([
+            digest_one(x, nb, ln)
+            for x, nb, ln in zip(flat, nblocks, lanes)
+        ]) if digest else jnp.zeros((0,), jnp.uint64)
         return flat, d
 
     def update(live_leaves, shadow_leaves, old_digests):
@@ -146,15 +226,20 @@ def _build_programs(sig, block: int, digest: bool):
         new_digests = []
         dirty_total = jnp.zeros((), jnp.int64)
         off = 0
-        for x, sh, nb, n in zip(live_leaves, shadow_leaves,
-                                nblocks, sizes):
+        for x, sh, nb, n, ln in zip(live_leaves, shadow_leaves,
+                                    nblocks, sizes, lanes):
             flat = jnp.asarray(x).reshape(-1)
-            d = leaf_digest(flat, nb, block)
+            d = digest_one(flat, nb, ln)
             dirty = d != jax.lax.dynamic_slice(
                 old_digests, (off,), (nb,)
             )
             off += nb
-            new_sh, nd = _copy_leaf(flat, sh, dirty, nb, n, block)
+            if ln:
+                new_sh, nd = _copy_leaf_rows(
+                    flat, sh, dirty, ln[0], ln[1], block
+                )
+            else:
+                new_sh, nd = _copy_leaf(flat, sh, dirty, nb, n, block)
             new_shadow.append(new_sh)
             new_digests.append(d)
             dirty_total = dirty_total + nd
@@ -174,13 +259,13 @@ def _build_programs(sig, block: int, digest: bool):
     )
 
 
-def _programs(sig, block: int, digest: bool):
-    key = (sig, block, digest)
+def _programs(sig, block: int, digest: bool, shard_rows):
+    key = (sig, block, digest, shard_rows)
     hit = _PROG_CACHE.get(key)
     if hit is None:
         if len(_PROG_CACHE) >= _PROG_CACHE_MAX:
             _PROG_CACHE.pop(next(iter(_PROG_CACHE)))
-        hit = _build_programs(sig, block, digest)
+        hit = _build_programs(sig, block, digest, shard_rows)
         _PROG_CACHE[key] = hit
     return hit
 
@@ -192,23 +277,36 @@ class ShadowSnapshot:
     scatter; the digest vector feeds the checkpoint store's delta
     upload.  ``digest=False`` (store-less jobs): nothing consumes the
     digest, so the update is a straight copy into the persistent
-    (donated) shadow buffers — no digest pass, no allocation churn."""
+    (donated) shadow buffers — no digest pass, no allocation churn.
+
+    ``shard_rows=N`` (mesh-stacked trees): every leaf whose leading
+    axis is the shard axis digests in N per-shard LANES — the block
+    grid restarts at each shard row, so no digest block (and no
+    dirty-run copy) ever spans two shards.  ``lanes`` records the
+    per-leaf structure for the checkpoint store's delta extraction."""
 
     def __init__(self, states, block_elems: int = DEFAULT_BLOCK_ELEMS,
-                 digest: bool = True):
+                 digest: bool = True, shard_rows: int | None = None):
         leaves, self.treedef = jax.tree.flatten(states)
         self.block = block_elems
         self.digest_mode = digest
+        self.shard_rows = shard_rows
         self.shapes = [np.shape(x) for x in leaves]
         self.sig = tuple(
             (str(x.dtype), np.shape(x)) for x in leaves
         )
+        #: per-leaf (rows, row_elems) lane structure, None = flat —
+        #: shipped with every UploadTask so the store's dirty-run
+        #: extraction uses the same block grid as the digest
+        self.lanes = [leaf_lanes(s, shard_rows) for s in self.shapes]
         self.nblocks = [
-            leaf_block_count(s, block_elems) for s in self.shapes
+            lane_block_count(s, ln[0], block_elems) if ln
+            else leaf_block_count(s, block_elems)
+            for s, ln in zip(self.shapes, self.lanes)
         ]
         self.total_blocks = int(sum(self.nblocks))
         self._init_prog, self._update_prog, self._restore_prog = \
-            _programs(self.sig, block_elems, digest)
+            _programs(self.sig, block_elems, digest, shard_rows)
         #: flat device copies of every leaf (the shadow contents)
         self.leaves, self.digests = self._init_prog(tuple(leaves))
         #: dirty blocks of the LAST update (device scalar; read only by

@@ -642,3 +642,32 @@ def test_integrity_and_scrub_metrics_exported(tmp_path):
     assert "scrub_cursor_age_s" in rendered
     assert 'scrub_corruptions_total{kind="sst"}' in rendered
     assert "scrub_cycles_total" in rendered
+
+
+def test_dag_fused_fallback_counter_exported():
+    """ISSUE 9 satellite: a DagJob window that cannot run as ONE fused
+    dispatch (host-chunk DML sources here) is counted by reason and
+    exported as ``dag_fused_fallback_total{reason}`` — the silent
+    per-chunk degradation becomes observable."""
+    eng = Engine(PlannerConfig(
+        chunk_capacity=64,
+        join_table_size=512, join_bucket_cap=16,
+        join_out_capacity=1 << 10,
+        mv_table_size=512, mv_ring_size=1 << 12,
+    ))
+    eng.execute("CREATE TABLE lt (k BIGINT, v BIGINT)")
+    eng.execute("CREATE TABLE rt (k BIGINT, w BIGINT)")
+    eng.execute("INSERT INTO lt VALUES (1, 10), (2, 20)")
+    eng.execute("INSERT INTO rt VALUES (1, 100), (2, 200)")
+    eng.execute(
+        "CREATE MATERIALIZED VIEW jm AS SELECT lt.k AS k, lt.v AS v, "
+        "rt.w AS w FROM lt JOIN rt ON lt.k = rt.k"
+    )
+    eng.tick(barriers=1, chunks_per_barrier=4)
+    job = eng.jobs[0]
+    assert job.fused_fallbacks.get("host_chunk_source", 0) >= 1
+    eng.collect_join_metrics()
+    got = eng.metrics.get("dag_fused_fallback_total", job=job.name,
+                          reason="host_chunk_source")
+    assert got >= 1
+    assert "dag_fused_fallback_total" in eng.metrics.render_prometheus()

@@ -27,3 +27,26 @@ def test_profile_q8_assert_small():
         f"profile_q8 --assert failed:\n{out.stdout}\n{out.stderr[-2000:]}"
     )
     assert "profile_q8 --assert: OK" in out.stdout
+
+
+@pytest.mark.slow
+def test_profile_q8_assert_sharded():
+    """ISSUE 9: the sharded q8 gate — one fused shard_map dispatch per
+    barrier window on 8 host-emulated devices, zero per-chunk host
+    dispatches, bounded exchange traffic, per-shard delta snapshots."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "profile_q8.py"),
+         "--assert", "--sharded"],
+        env=env, capture_output=True, text=True, timeout=1800, cwd=ROOT,
+    )
+    assert out.returncode == 0, (
+        f"profile_q8 --assert --sharded failed:\n{out.stdout}\n"
+        f"{out.stderr[-2000:]}"
+    )
+    assert "profile_q8 --assert --sharded: OK" in out.stdout
