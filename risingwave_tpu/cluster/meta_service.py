@@ -177,6 +177,16 @@ _AGG_FUNCS = frozenset({
 })
 
 
+def _sql_literal(v) -> str:
+    """Render one pk value as a SQL literal (the multi-get owner
+    fallback synthesizes per-pk SELECTs)."""
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (int, float)):
+        return repr(v)
+    return "'" + str(v).replace("'", "''") + "'"
+
+
 def _select_needs_engine_merge(sel) -> bool:
     """True when a SELECT over a partitioned MV cannot be answered by
     unioning per-partition rows (aggregates / GROUP BY / DISTINCT
@@ -292,6 +302,9 @@ class MetaService:
         self.jobs: dict[str, JobInfo] = {}
         #: mv/sink name -> owning JobInfo name
         self._mv_to_job: dict[str, str] = {}
+        #: secondary indexes: index name → upstream MV name (an MV
+        #: with live indexes refuses DROP until they are dropped)
+        self._indexes: dict[str, str] = {}
         #: non-job DDL in arrival order (sources/tables/SETs/functions)
         #: — shipped to a worker the first time a job needs them
         self.prelude: list[str] = []
@@ -544,8 +557,13 @@ class MetaService:
                 if age > self.heartbeat_timeout_s:
                     expired.append(w)
             for r in self.serving.values():
-                if r.alive and now - r.last_seen \
-                        > self.heartbeat_timeout_s:
+                if not r.alive:
+                    continue
+                self.metrics.set_gauge(
+                    "cluster_serving_heartbeat_age_seconds",
+                    now - r.last_seen, replica=str(r.replica_id),
+                )
+                if now - r.last_seen > self.heartbeat_timeout_s:
                     stale_serving.append(r)
         for w in expired:
             self._on_worker_dead(w)
@@ -561,9 +579,12 @@ class MetaService:
             self._assign_pending()
 
     def _on_serving_dead(self, r: ServingReplicaInfo) -> None:
-        """Reap one serving replica: drop it from routing and release
+        """Reap one serving replica: drop it from routing, release
         every pin of its lease (stale leases must not hold GC keep-set
-        entries for a process that will never read again)."""
+        entries for a process that will never read again), and RETIRE
+        its per-replica metric series — a reaped replica must not
+        leave frozen gauges on the scrape surface (mirrors the
+        per-worker retirement)."""
         with self._lock:
             if not r.alive:
                 return
@@ -574,7 +595,15 @@ class MetaService:
             if r.client is not None:
                 r.client.close()
             self.serving.pop(r.replica_id, None)
+            self._remove_serving_series(r.replica_id)
             self._set_worker_gauges()
+
+    def _remove_serving_series(self, replica_id: int) -> None:
+        """Retire EVERY per-replica labeled series of one serving
+        replica (lease reaped or deregistered)."""
+        for name in ("cluster_serving_heartbeat_age_seconds",
+                     "cluster_serving_granted_vid"):
+            self.metrics.remove_series(name, replica=str(replica_id))
 
     def _on_worker_dead(self, w: WorkerInfo) -> None:
         # under the tick lock: never declare dead / reassign while one
@@ -624,13 +653,18 @@ class MetaService:
             rid = self._next_replica
             self._next_replica += 1
             r = ServingReplicaInfo(rid, host, int(port), pid)
+            # pooled connections: concurrent serving-read routers must
+            # not serialize behind one in-flight batch frame
             r.client = RpcClient(host, int(port),
                                  timeout=self.rpc_timeout_s,
-                                 src="meta", dst=f"serving{rid}")
+                                 src="meta", dst=f"serving{rid}",
+                                 pool=4)
             pin_id, version = self.versions.pin()
             r.pins[version.vid] = pin_id
             r.granted_vid = version.vid
             self.serving[rid] = r
+            self.metrics.set_gauge("cluster_serving_granted_vid",
+                                   r.granted_vid, replica=str(rid))
             self._set_worker_gauges()
         self.hummock._update_gauges()
         return {
@@ -667,6 +701,10 @@ class MetaService:
             keep = {held, version.vid}
             for pv in [p for p in r.pins if p not in keep]:
                 self.versions.unpin(r.pins.pop(pv))
+            self.metrics.set_gauge(
+                "cluster_serving_granted_vid", r.granted_vid,
+                replica=str(r.replica_id),
+            )
             self._set_worker_gauges()
         return {
             "ok": True,
@@ -930,6 +968,17 @@ class MetaService:
                                  ast.CreateSink)):
                 self._place_job(text, stmt.name, replay=replay)
                 placed.append(stmt.name)
+            elif isinstance(stmt, ast.CreateIndex):
+                # a secondary-index MV rides its upstream's job (the
+                # engine attaches it MV-on-MV and exports it into the
+                # shared serving keyspace like any MV)
+                self._place_job(text, stmt.name, replay=replay,
+                                upstream_mv=stmt.table)
+                self._indexes[stmt.name] = stmt.table
+                placed.append(stmt.name)
+            elif isinstance(stmt, ast.DropStatement) \
+                    and stmt.kind in ("materialized view", "index"):
+                self._drop_mv(text, stmt, replay=replay)
             elif isinstance(stmt, ast.Insert):
                 # never reaches the DDL log; forwarded rows live in the
                 # workers' durable table history + checkpoints
@@ -961,12 +1010,30 @@ class MetaService:
         return None
 
     def _place_job(self, text: str, name: str,
-                   replay: bool = False) -> None:
+                   replay: bool = False,
+                   upstream_mv: str | None = None) -> None:
         if name in self._mv_to_job:
             raise ValueError(f"{name!r} already exists")
+        if upstream_mv is not None:
+            # an index ALWAYS co-locates onto its upstream's job
+            # (validated BEFORE the durable append so a refused
+            # statement can never poison the replay log)
+            if upstream_mv not in self._mv_to_job:
+                raise ValueError(
+                    f"CREATE INDEX on {upstream_mv!r}: "
+                    f"{upstream_mv!r} does not exist"
+                )
+            upstream = self.jobs[self._mv_to_job[upstream_mv]]
+            if upstream.partitions:
+                raise ValueError(
+                    f"CREATE INDEX over partitioned job "
+                    f"{upstream.name!r}: next round (attach would "
+                    "need a cross-partition exchange)"
+                )
+        else:
+            upstream = self._co_located_job(text)
         if not replay:
             self.store.append_ddl(text)
-        upstream = self._co_located_job(text)
         if upstream is not None:
             # ship only the prelude delta the job hasn't seen yet plus
             # the new statement; the worker attaches it to the live job
@@ -996,6 +1063,105 @@ class MetaService:
             self._set_worker_gauges()
         if not replay:
             self._assign_pending()
+
+    def _drop_mv(self, text: str, stmt, replay: bool = False) -> None:
+        """DROP MATERIALIZED VIEW / DROP INDEX at the cluster level:
+        the owning worker drops it from its engine (the DROP also
+        joins ``job.ddl`` so future adopts replay it), the meta
+        unplaces it (last MV ⇒ the job leaves the round protocol),
+        writes TOMBSTONES for every exported row in one delta, and
+        deletes the serve-schema doc — serving answers "does not
+        exist" instead of stale rows (ROADMAP round-8 follow-up).
+
+        Ordering matters for replicas: schema docs are rewritten
+        BEFORE the tombstone delta commits, so a replica pinned at a
+        pre-drop version still sees consistent doc+data, and one that
+        refreshes past the tombstones reloads the rewritten docs
+        (its schema cache clears on every vid advance)."""
+        import json as _json
+
+        from risingwave_tpu.serve.reader import (
+            mv_key_range,
+            schema_key,
+        )
+        from risingwave_tpu.storage.hummock.object_store import (
+            ObjectError,
+        )
+
+        name = stmt.name
+        with self._lock:
+            jname = self._mv_to_job.get(name)
+        if jname is None:
+            if stmt.if_exists:
+                return
+            raise ValueError(f"{name!r} does not exist")
+        if stmt.kind == "index" and name not in self._indexes:
+            raise ValueError(f"{name!r} is not an index")
+        deps = sorted(ix for ix, mv in self._indexes.items()
+                      if mv == name)
+        if deps:
+            raise ValueError(
+                f"cannot drop {name!r}: indexes {deps} depend on it "
+                "(DROP INDEX first)"
+            )
+        if not replay:
+            self.store.append_ddl(text)
+        with self._tick_lock:
+            with self._lock:
+                job = self.jobs[jname]
+                w = self.workers.get(job.worker_id) \
+                    if job.worker_id is not None else None
+            job.ddl.append(text)
+            if not replay and w is not None and w.alive:
+                self.retry.run(
+                    lambda: w.client.call("execute", sql=text),
+                    label="drop",
+                )
+            with self._lock:
+                if name in job.mvs:
+                    job.mvs.remove(name)
+                self._mv_to_job.pop(name, None)
+                upstream_of = self._indexes.pop(name, None)
+                if not job.mvs:
+                    # last MV gone: the job leaves the round protocol
+                    self.jobs.pop(jname, None)
+                    self._pending_ssts.pop(jname, None)
+                    if w is not None:
+                        w.jobs.discard(jname)
+                self._set_worker_gauges()
+            if replay:
+                return  # storage already holds the tombstones
+            if upstream_of is not None:
+                # the upstream's doc must stop advertising the index
+                # BEFORE its rows are tombstoned (a replica reloading
+                # the doc post-tombstone must not plan through it)
+                try:
+                    doc = _json.loads(
+                        self.hummock.store.get(schema_key(upstream_of))
+                    )
+                    doc["indexes"] = [
+                        e for e in doc.get("indexes", [])
+                        if e.get("name") != name
+                    ]
+                    if not doc["indexes"]:
+                        doc.pop("indexes")
+                    self.hummock.store.put(
+                        schema_key(upstream_of),
+                        _json.dumps(doc).encode(),
+                    )
+                except ObjectError:
+                    pass  # upstream never exported
+            try:
+                self.hummock.store.delete(schema_key(name))
+            except ObjectError:
+                pass  # never exported
+            lo, hi = mv_key_range(name)
+            keys = [k for k, _ in self.hummock.scan(lo, hi)]
+            if keys:
+                self.hummock.delete_batch(
+                    keys, epoch=self.versions.max_committed_epoch
+                )
+            self.metrics.inc("cluster_mv_drops_total")
 
     def _forward_dml(self, text: str, table: str) -> None:
         """INSERTs fan out to every worker whose catalog has the table
@@ -1959,7 +2125,9 @@ class MetaService:
             with self._lock:
                 jname = self._mv_to_job.get(mv)
                 if jname is None:
-                    raise ValueError(f"{mv!r} is not a placed MV")
+                    raise ValueError(
+                        f"{mv!r} does not exist (not a placed MV)"
+                    )
                 job = self.jobs[jname]
                 parts = list(job.partitions.values()) \
                     if job.partitions else None
@@ -2072,6 +2240,124 @@ class MetaService:
                     f"{self.serve_retry_timeout_s}s"
                 )
             time.sleep(0.05)
+
+    def rpc_serve_batch(self, sqls: list) -> dict:
+        return {"results": [
+            {"cols": cols, "rows": [list(r) for r in rows]}
+            for cols, rows in self.serve_batch(list(sqls))
+        ]}
+
+    def serve_batch(self, sqls: list) -> list:
+        """Route N SELECTs through ONE replica RPC frame (the batched
+        multi-get protocol).  Items the replica cannot serve
+        (``unsupported``) fall back PER ITEM to the single-read router
+        (owning worker); a final per-item error (unknown column/MV)
+        raises like the single-read path would.  With no live replica
+        every item takes the single-read router."""
+        with self._lock:
+            replicas = [r for r in self.serving.values() if r.alive]
+            manifest_pin = self.versions.max_committed_epoch
+            self._serve_rr += 1
+            start = self._serve_rr
+        for i in range(len(replicas)):
+            r = replicas[(start + i) % len(replicas)]
+            try:
+                res = r.client.call("read_batch", sqls=sqls,
+                                    min_epoch=manifest_pin)
+            except RpcError as e:
+                if "ServeUnavailable" in str(e):
+                    continue  # replica stuck behind the pin: next one
+                raise
+            except (ConnectionError, OSError):
+                continue  # replica died mid-batch: next one
+            out = []
+            for item, sql in zip(res["results"], sqls):
+                if item.get("error") is not None:
+                    raise ValueError(item["error"])
+                if "unsupported" in item:
+                    out.append(self.serve(sql))
+                else:
+                    out.append((item["cols"],
+                                [tuple(row) for row in item["rows"]]))
+            self.metrics.inc("cluster_serving_batch_reads_total",
+                             len(sqls))
+            return out
+        return [self.serve(sql) for sql in sqls]
+
+    def rpc_serve_multi_get(self, mv: str, pks: list,
+                            cols: list | None = None) -> dict:
+        names, rows = self.serve_multi_get(mv, pks, cols)
+        return {"cols": names, "rows": [list(r) for r in rows]}
+
+    def serve_multi_get(self, mv: str, pks: list,
+                        cols: list | None = None):
+        """First-class multi-get: one MV + N full pks in one frame.
+        Routes to a replica (one sorted SstView pass); with none live
+        it falls back to per-pk SELECTs against the single-read
+        router, union sorted by encoded pk — the same row order the
+        replica path answers.  Missing pks are omitted."""
+        from risingwave_tpu.serve.reader import MvSchema, schema_key
+
+        with self._lock:
+            if mv not in self._mv_to_job:
+                raise ValueError(
+                    f"{mv!r} does not exist (not a placed MV)"
+                )
+            replicas = [r for r in self.serving.values() if r.alive]
+            manifest_pin = self.versions.max_committed_epoch
+            self._serve_rr += 1
+            start = self._serve_rr
+        for i in range(len(replicas)):
+            r = replicas[(start + i) % len(replicas)]
+            try:
+                res = r.client.call("multi_get", mv=mv, pks=pks,
+                                    cols=cols, min_epoch=manifest_pin)
+                self.metrics.inc("cluster_serving_batch_reads_total",
+                                 len(pks))
+                return res["cols"], [tuple(row) for row in res["rows"]]
+            except RpcError as e:
+                if "ServeUnavailable" in str(e) \
+                        or "ServeUnsupported" in str(e):
+                    # stuck replica, or the MV's schema doc has not
+                    # landed yet: fall through (next replica / owner)
+                    continue
+                raise
+            except (ConnectionError, OSError):
+                continue
+        # owner fallback: per-pk SELECTs, union in encoded-pk order
+        import json as _json
+
+        try:
+            schema = MvSchema(_json.loads(
+                self.hummock.store.get(schema_key(mv))
+            ))
+        except Exception:  # noqa: BLE001 — never exported yet
+            schema = None
+        if schema is None:
+            raise ValueError(
+                f"multi_get on {mv!r}: no schema published and no "
+                "live serving replica"
+            )
+        pk_names = [schema.columns[i].name for i in schema.pk]
+        keyed = []
+        out_cols: list = []
+        for pk in pks:
+            where = " AND ".join(
+                f"{n} = {_sql_literal(v)}"
+                for n, v in zip(pk_names, pk)
+            )
+            proj = ", ".join(cols) if cols else "*"
+            c, rows = self.serve(
+                f"SELECT {proj} FROM {mv} WHERE {where}"
+            )
+            out_cols = c or out_cols
+            enc = b"".join(
+                schema.encode_pk_value(ci, v)
+                for ci, v in zip(schema.pk, pk)
+            )
+            keyed += [(enc, tuple(row)) for row in rows]
+        keyed.sort(key=lambda kv: kv[0])
+        return out_cols, [row for _, row in keyed]
 
     # -- introspection ----------------------------------------------------
     def rpc_cluster_state(self) -> dict:
@@ -2209,3 +2495,15 @@ class MetaFrontend:
             return self.meta.serve(sql)
         self.meta.execute_ddl(sql)
         return [], []
+
+    def query_batch(self, sqls: list) -> list:
+        """Batched serving reads: N SELECTs through one replica RPC
+        frame (``MetaService.serve_batch``); per-item owner fallback
+        keeps the SQL surface identical to ``query``."""
+        return self.meta.serve_batch(list(sqls))
+
+    def multi_get(self, mv: str, pks: list,
+                  cols: list | None = None):
+        """First-class multi-get: one MV + N pks in one frame, rows
+        back in encoded-pk order (missing pks omitted)."""
+        return self.meta.serve_multi_get(mv, list(pks), cols)

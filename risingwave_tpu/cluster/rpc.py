@@ -166,36 +166,30 @@ class RpcServer(socketserver.ThreadingTCPServer):
             self._thread = None
 
 
-class RpcClient:
-    """One persistent connection to a peer; calls serialize on a lock
-    (the meta→worker control channel is low-rate by design).
+class _RpcChannel:
+    """One pooled connection: its own socket, file, and lock."""
 
-    ``src``/``dst`` name the two endpoints for the fault fabric: every
-    call is matched under the label ``src>dst/method``, which is what
-    makes one-way partitions expressible (meta>worker1 dark while
-    worker1>meta flows)."""
+    __slots__ = ("host", "port", "timeout", "lock", "_sock", "_file",
+                 "_next_id")
 
-    def __init__(self, host: str, port: int, timeout: float = 120.0,
-                 src: str = "", dst: str = ""):
+    def __init__(self, host: str, port: int, timeout: float):
         self.host = host
         self.port = port
         self.timeout = timeout
-        self.src = src or "client"
-        self.dst = dst or f"{host}:{port}"
-        self._lock = threading.Lock()
+        self.lock = threading.Lock()
         self._sock: socket.socket | None = None
         self._file = None
         self._next_id = 1
 
-    def _connect(self):
+    def connect(self):
         self._sock = socket.create_connection(
             (self.host, self.port), timeout=self.timeout
         )
         self._file = self._sock.makefile("rwb")
 
-    def _roundtrip(self, payload: bytes) -> dict:
+    def roundtrip(self, payload: bytes) -> dict:
         if self._sock is None:
-            self._connect()
+            self.connect()
         self._file.write(payload)
         self._file.flush()
         line = self._file.readline()
@@ -203,48 +197,7 @@ class RpcClient:
             raise ConnectionError("rpc peer closed the connection")
         return json.loads(line)
 
-    def call(self, method: str, **params):
-        """Invoke one remote method.  Raises ``RpcError`` for remote
-        handler failures, ``ConnectionError``/``OSError`` when the
-        peer is unreachable (one silent reconnect is attempted for
-        idle-dropped sockets).  The fault fabric injects ONCE per
-        logical call (never again on the internal reconnect resend)."""
-        with self._lock:
-            fabric = get_fabric()
-            sever_after = None
-            if fabric is not None:
-                sever_after = fabric.rpc_before_send(
-                    f"{self.src}>{self.dst}/{method}"
-                )  # raises FaultInjected for drops
-            rid = self._next_id
-            self._next_id += 1
-            payload = _dumps(
-                {"id": rid, "method": method, "params": params}
-            )
-            if sever_after is not None:
-                # error_after_send: the request IS delivered and
-                # executed, but the response is lost with the socket —
-                # the probe for non-idempotent handlers
-                if self._sock is None:
-                    self._connect()
-                self._file.write(payload)
-                self._file.flush()
-                self._close_locked()
-                raise ConnectionError(
-                    f"injected rpc error-after-send: "
-                    f"{self.src}>{self.dst}/{method}"
-                )
-            try:
-                resp = self._roundtrip(payload)
-            except (ConnectionError, OSError, json.JSONDecodeError):
-                self._close_locked()
-                self._connect()
-                resp = self._roundtrip(payload)
-            if resp.get("error") is not None:
-                raise RpcError(resp["error"])
-            return resp.get("result")
-
-    def _close_locked(self) -> None:
+    def close(self) -> None:
         try:
             if self._file is not None:
                 self._file.close()
@@ -255,9 +208,90 @@ class RpcClient:
         self._sock = None
         self._file = None
 
+
+class RpcClient:
+    """Persistent connection(s) to a peer.  ``pool=1`` (the default)
+    keeps the original shape: one socket, calls serialized on its lock
+    (the meta→worker control channel is low-rate by design).  A pool
+    > 1 lets CONCURRENT callers overlap round-trips on independent
+    sockets — the meta's serving-read router uses this so reader
+    threads aren't serialized behind one in-flight batch frame.
+
+    ``src``/``dst`` name the two endpoints for the fault fabric: every
+    call is matched under the label ``src>dst/method``, which is what
+    makes one-way partitions expressible (meta>worker1 dark while
+    worker1>meta flows)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 120.0,
+                 src: str = "", dst: str = "", pool: int = 1):
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+        self.src = src or "client"
+        self.dst = dst or f"{host}:{port}"
+        self._chans = [_RpcChannel(host, port, timeout)
+                       for _ in range(max(1, int(pool)))]
+        self._rr = 0
+
+    def _acquire(self) -> _RpcChannel:
+        """A free channel if any lock is immediately available, else
+        block on the round-robin next (fair under saturation)."""
+        for ch in self._chans:
+            if ch.lock.acquire(blocking=False):
+                return ch
+        self._rr = (self._rr + 1) % len(self._chans)
+        ch = self._chans[self._rr]
+        ch.lock.acquire()
+        return ch
+
+    def call(self, method: str, **params):
+        """Invoke one remote method.  Raises ``RpcError`` for remote
+        handler failures, ``ConnectionError``/``OSError`` when the
+        peer is unreachable (one silent reconnect is attempted for
+        idle-dropped sockets).  The fault fabric injects ONCE per
+        logical call (never again on the internal reconnect resend)."""
+        ch = self._acquire()
+        try:
+            fabric = get_fabric()
+            sever_after = None
+            if fabric is not None:
+                sever_after = fabric.rpc_before_send(
+                    f"{self.src}>{self.dst}/{method}"
+                )  # raises FaultInjected for drops
+            rid = ch._next_id
+            ch._next_id += 1
+            payload = _dumps(
+                {"id": rid, "method": method, "params": params}
+            )
+            if sever_after is not None:
+                # error_after_send: the request IS delivered and
+                # executed, but the response is lost with the socket —
+                # the probe for non-idempotent handlers
+                if ch._sock is None:
+                    ch.connect()
+                ch._file.write(payload)
+                ch._file.flush()
+                ch.close()
+                raise ConnectionError(
+                    f"injected rpc error-after-send: "
+                    f"{self.src}>{self.dst}/{method}"
+                )
+            try:
+                resp = ch.roundtrip(payload)
+            except (ConnectionError, OSError, json.JSONDecodeError):
+                ch.close()
+                ch.connect()
+                resp = ch.roundtrip(payload)
+            if resp.get("error") is not None:
+                raise RpcError(resp["error"])
+            return resp.get("result")
+        finally:
+            ch.lock.release()
+
     def close(self) -> None:
-        with self._lock:
-            self._close_locked()
+        for ch in self._chans:
+            with ch.lock:
+                ch.close()
 
 
 def parse_addr(addr: str) -> tuple[str, int]:

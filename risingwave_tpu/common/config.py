@@ -72,6 +72,10 @@ class ClusterConfig:
     serving_heartbeat_interval_s: float = 0.5
     #: serving replica block-cache capacity (decoded SST blocks)
     serving_cache_blocks: int = 1024
+    #: serving replica result-cache budget (bytes of cached rows):
+    #: completed reads keyed by (normalized sql, manifest vid) — an
+    #: epoch advance re-keys every entry, so hits can never be stale
+    serving_result_cache_bytes: int = 32 << 20
     #: scale plane: vnode ring size (the consistent-hash keyspace
     #: jobs partition over; ref VirtualNode::COUNT)
     n_vnodes: int = 64

@@ -343,6 +343,47 @@ def cluster_epochs(meta_addr: str) -> dict:
     }
 
 
+def cluster_batch(meta_addr: str, sqls: list) -> dict:
+    """``ctl cluster batch <meta_addr> <sql> [sql ...]``: N SELECTs
+    through ONE serving-tier RPC frame (the batched multi-get
+    protocol) — per-item owner fallback keeps the surface identical
+    to single reads."""
+    from risingwave_tpu.cluster.rpc import RpcClient, parse_addr
+
+    host, port = parse_addr(meta_addr)
+    client = RpcClient(host, port, timeout=120.0)
+    try:
+        return client.call("serve_batch", sqls=list(sqls))
+    finally:
+        client.close()
+
+
+def cluster_multiget(meta_addr: str, mv: str, pks: list) -> dict:
+    """``ctl cluster multiget <meta_addr> <mv> <pk> [pk ...]``:
+    first-class multi-get — one MV + N pks in one frame, rows back in
+    encoded-pk order (missing pks omitted).  Composite pks pass as
+    comma-joined values (``3,foo``); bare integers coerce."""
+    from risingwave_tpu.cluster.rpc import RpcClient, parse_addr
+
+    def _coerce(s: str):
+        try:
+            return int(s)
+        except ValueError:
+            try:
+                return float(s)
+            except ValueError:
+                return s
+
+    keys = [[_coerce(part) for part in str(pk).split(",")]
+            for pk in pks]
+    host, port = parse_addr(meta_addr)
+    client = RpcClient(host, port, timeout=120.0)
+    try:
+        return client.call("serve_multi_get", mv=mv, pks=keys)
+    finally:
+        client.close()
+
+
 def _cluster_main(argv: list[str]) -> None:
     """``python -m risingwave_tpu.ctl cluster
     {workers|jobs|epochs|serving|faults} <meta_host:rpc_port>`` —
@@ -354,6 +395,15 @@ def _cluster_main(argv: list[str]) -> None:
     if sub == "scale":
         # ctl cluster scale <N> <meta_addr>
         print(json.dumps(cluster_scale(argv[2], int(argv[1])),
+                         indent=1))
+        return
+    if sub == "batch":
+        # ctl cluster batch <meta_addr> <sql> [sql ...]
+        print(json.dumps(cluster_batch(argv[1], argv[2:]), indent=1))
+        return
+    if sub == "multiget":
+        # ctl cluster multiget <meta_addr> <mv> <pk> [pk ...]
+        print(json.dumps(cluster_multiget(argv[1], argv[2], argv[3:]),
                          indent=1))
         return
     addr = argv[1]

@@ -39,6 +39,16 @@ class CatalogEntry:
     #: mview: pk column positions in ``schema`` (the stream key exposed
     #: to downstream cascaded plans); None for append-only ring MVs
     stream_key: Any = None
+    #: secondary-index MV: (upstream mv name, indexed column names) —
+    #: the entry itself is a plain "mview" maintained through the
+    #: MV-on-MV path; only its EXPORT key order differs (see export_pk)
+    index_on: Any = None
+    #: storage-export pk override: column positions whose memcomparable
+    #: encoding forms the ``m:<name>\0<pk>`` key (defaults to the
+    #: materialize executor's pk_indices) — index MVs sort by
+    #: (indexed cols..., upstream pk) so equality probes are one
+    #: contiguous byte range
+    export_pk: Any = None
     definition: str = ""
 
 

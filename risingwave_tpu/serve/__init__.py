@@ -17,6 +17,7 @@ _LAZY = {
     "ServingWorker": ("risingwave_tpu.serve.worker", "ServingWorker"),
     "ServeUnsupported": ("risingwave_tpu.serve.worker",
                          "ServeUnsupported"),
+    "ResultCache": ("risingwave_tpu.serve.worker", "ResultCache"),
     "ManifestFollower": ("risingwave_tpu.serve.reader",
                          "ManifestFollower"),
     "SstView": ("risingwave_tpu.serve.reader", "SstView"),

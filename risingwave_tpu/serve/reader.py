@@ -93,6 +93,19 @@ class MvSchema:
         ]
         self.pk: tuple[int, ...] = tuple(doc["pk"])
         self._by_name = {c.name: i for i, c in enumerate(self.columns)}
+        #: secondary indexes ON this MV: [{"name", "cols"}] — the
+        #: serving planner rewrites equality predicates on a prefix of
+        #: an index's columns into an index range scan + pk lookups
+        self.indexes: list[dict] = list(doc.get("indexes", ()))
+        #: set when this MV IS an index: the upstream MV name and how
+        #: many leading columns are indexed (the rest are the
+        #: upstream's pk values, in upstream-pk order)
+        self.indexed_mv: str | None = doc.get("index_of")
+        self.index_width: int = int(doc.get("index_width", 0))
+        #: first epoch the index's rows were exported under — a
+        #: replica pinned BEFORE it must not trust the index range
+        #: (the doc is an unversioned side-channel; the data is not)
+        self.since_epoch: int = int(doc.get("since_epoch", 0))
 
     @staticmethod
     def load(store, name: str) -> "MvSchema | None":
@@ -233,6 +246,10 @@ class SstView:
         with self._lock:
             if not self._retained or self._retained[-1].vid != v.vid:
                 self._retained.append(v)
+                # the version moved: schema docs may have changed too
+                # (CREATE/DROP INDEX republishes; DROP MV deletes) —
+                # drop the cache so the next read reloads them
+                self._schemas.clear()
             live = set()
             for rv in self._retained:
                 live |= rv.all_keys()
@@ -300,6 +317,40 @@ class SstView:
                 if val is not None:
                     return None if val == TOMBSTONE else val
         return None
+
+    def multi_get(self, keys, version: HummockVersion | None = None,
+                  ) -> dict[bytes, bytes | None]:
+        """Batched point-gets sharing ONE pinned pass over the SST
+        set: keys probe each SST in sorted order, so block loads (and
+        block-cache hits) are sequential rather than random — the
+        locality that makes a serving multi-get amortize.  Per key the
+        semantics are exactly ``point_get`` (newest level wins,
+        tombstone → None); keys never found are absent from the
+        result."""
+        v = version if version is not None else self.version
+        m = self.metrics
+        pending = dict.fromkeys(sorted(set(keys)))
+        out: dict[bytes, bytes | None] = {}
+        for lv in v.levels:
+            for s in lv:
+                if not pending:
+                    return out
+                r = self._reader(s.key)
+                for k in list(pending):
+                    if not r.may_contain(k):
+                        if m is not None:
+                            m.inc("serving_bloom_filter_total",
+                                  result="skip")
+                        continue
+                    val = r.get(k)
+                    if m is not None:
+                        m.inc("serving_bloom_filter_total",
+                              result="hit" if val is not None
+                              else "miss")
+                    if val is not None:
+                        out[k] = None if val == TOMBSTONE else val
+                        del pending[k]
+        return out
 
     def scan(self, lo: bytes = b"", hi: bytes | None = None,
              version: HummockVersion | None = None):

@@ -76,13 +76,18 @@ class ReadPlan:
     mv: str
     cols: list[int]
     col_names: list[str]
-    #: "get" (point key) or "scan" (byte range)
+    #: "get" (point key), "scan" (byte range), or "index" (range scan
+    #: over a secondary-index MV + pk point-gets on the primary)
     mode: str
     key: bytes = b""
     lo: bytes = b""
     hi: bytes | None = None
     limit: int | None = None
     offset: int = 0
+    #: mode="index": the index MV whose keyspace lo/hi bound, and how
+    #: many leading index columns precede the upstream pk values
+    index_mv: str = ""
+    index_width: int = 0
 
 
 def _conjuncts(expr) -> list:
@@ -102,9 +107,17 @@ def _flip(op: str) -> str:
     }.get(op, op)
 
 
-def plan_read(select, schema: MvSchema) -> ReadPlan:
+def plan_read(select, schema: MvSchema, schema_of=None,
+              at_epoch: int | None = None) -> ReadPlan:
     """Compile one SELECT into a key-value read, or raise
-    ``ServeUnsupported`` (the meta falls back to the owning worker)."""
+    ``ServeUnsupported`` (the meta falls back to the owning worker).
+
+    ``schema_of`` (name → MvSchema | None) enables secondary-index
+    rewrites: equality predicates covering a prefix of an index's
+    columns become a contiguous range scan over the index MV plus pk
+    point-gets on the primary.  ``at_epoch`` is the pinned epoch the
+    read will execute at — an index whose first export is newer is
+    ignored (the doc is an unversioned side-channel)."""
     from risingwave_tpu.sql import ast
 
     if select.group_by or select.having is not None:
@@ -177,12 +190,22 @@ def plan_read(select, schema: MvSchema) -> ReadPlan:
             raise ValueError(
                 f"column {left.name!r} does not exist in {mv!r}"
             )
-        if idx not in schema.pk:
-            raise ServeUnsupported(
-                f"serving WHERE is limited to pk columns "
-                f"(got {left.name!r})"
-            )
         preds.append((idx, op, right.value))
+
+    if any(i not in schema.pk for i, _, _ in preds):
+        # non-pk predicate: equality over an index prefix rewrites to
+        # an index range scan + pk lookups; anything else needs the
+        # engine (owner fallback)
+        ix_plan = _plan_index_read(plan, preds, schema, schema_of,
+                                   at_epoch)
+        if ix_plan is not None:
+            return ix_plan
+        bad = next(schema.columns[i].name for i, _, _ in preds
+                   if i not in schema.pk)
+        raise ServeUnsupported(
+            f"serving WHERE is limited to pk or indexed columns "
+            f"(got {bad!r})"
+        )
 
     eq = {i: v for i, op, v in preds if op == "equal"}
     if len(eq) == len(preds) and set(eq) == set(schema.pk) \
@@ -218,6 +241,114 @@ def plan_read(select, schema: MvSchema) -> ReadPlan:
     return plan
 
 
+def _plan_index_read(plan: ReadPlan, preds, schema: MvSchema,
+                     schema_of, at_epoch) -> ReadPlan | None:
+    """Rewrite equality predicates covering a PREFIX of a secondary
+    index's columns into one contiguous byte range over the index MV
+    (whose export key is ``(indexed cols..., upstream pk)``).  None
+    when no published index applies — the caller falls back."""
+    if schema_of is None or not schema.indexes:
+        return None
+    if any(op != "equal" for _, op, _ in preds):
+        return None
+    pred_names = sorted(schema.columns[i].name for i, _, _ in preds)
+    vals = {schema.columns[i].name: v for i, _, v in preds}
+    for ix in schema.indexes:
+        cols = list(ix.get("cols", ()))
+        k = len(preds)
+        if k > len(cols) or sorted(cols[:k]) != pred_names:
+            continue
+        ixs = schema_of(ix["name"])
+        if ixs is None or ixs.indexed_mv != schema.mv \
+                or ixs.index_width < k:
+            continue  # not exported yet (or a stale doc)
+        if at_epoch is not None and ixs.since_epoch \
+                and at_epoch < ixs.since_epoch:
+            continue  # pinned before the index's first export
+        ix_lo, ix_hi = mv_key_range(ix["name"])
+        enc = b"".join(
+            ixs.encode_pk_value(j, vals[cols[j]]) for j in range(k)
+        )
+        succ = bytes_successor(enc)
+        plan.mode = "index"
+        plan.index_mv = ix["name"]
+        plan.index_width = ixs.index_width
+        plan.lo = ix_lo + enc
+        plan.hi = ix_hi if succ is None else ix_lo + succ
+        return plan
+    return None
+
+
+class ResultCache:
+    """Bounded-bytes LRU of completed ``plan_read`` results, keyed by
+    ``(normalized sql, manifest vid)``.
+
+    Epoch-advance invalidation is STRUCTURAL: a lease re-grant moves
+    the replica to a newer vid, which re-keys every lookup — a stale
+    entry can never hit again (entries of dead vids are swept when the
+    vid advances and by LRU pressure).  A hit skips parse, plan, and
+    the SstView entirely: the memcached-class fast path."""
+
+    def __init__(self, max_bytes: int = 32 << 20):
+        import collections
+
+        self.max_bytes = int(max_bytes)
+        self.bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self._od: "collections.OrderedDict" = collections.OrderedDict()
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _size(entry) -> int:
+        cols, rows, _ = entry
+        n = 96 + 16 * len(cols)
+        for r in rows:
+            n += 48
+            for v in r:
+                n += 16 + (len(v) if isinstance(v, (str, bytes))
+                           else 8)
+        return n
+
+    def get(self, key):
+        with self._lock:
+            e = self._od.get(key)
+            if e is None:
+                self.misses += 1
+                return None
+            self._od.move_to_end(key)
+            self.hits += 1
+            return e[0]
+
+    def put(self, key, entry) -> None:
+        sz = self._size(entry)
+        if self.max_bytes <= 0 or sz > max(self.max_bytes // 8, 1):
+            return  # jumbo results would churn the whole LRU
+        with self._lock:
+            old = self._od.pop(key, None)
+            if old is not None:
+                self.bytes -= old[1]
+            self._od[key] = (entry, sz)
+            self.bytes += sz
+            while self.bytes > self.max_bytes and self._od:
+                _, (_, osz) = self._od.popitem(last=False)
+                self.bytes -= osz
+
+    def evict_stale(self, vid: int) -> None:
+        """Sweep entries keyed at any OTHER vid (they can never hit
+        again once the lease advanced past them)."""
+        with self._lock:
+            for k in [k for k in self._od if k[1] != vid]:
+                self.bytes -= self._od.pop(k)[1]
+
+    def hit_ratio(self) -> float:
+        t = self.hits + self.misses
+        return self.hits / t if t else 0.0
+
+    def __len__(self) -> int:
+        return len(self._od)
+
+
 class ServingWorker:
     """One serving replica process (or in-process object in tests)."""
 
@@ -225,7 +356,8 @@ class ServingWorker:
                  host: str = "127.0.0.1", port: int = 0,
                  heartbeat_interval_s: float = 0.5,
                  cache_blocks: int = 1024, store=None,
-                 metrics: MetricsRegistry | None = None):
+                 metrics: MetricsRegistry | None = None,
+                 result_cache_bytes: int = 32 << 20):
         if store is None:
             from risingwave_tpu.storage.hummock.object_store import (
                 LocalFsObjectStore,
@@ -235,6 +367,10 @@ class ServingWorker:
             else MetricsRegistry()
         self.view = SstView(store, cache_blocks=cache_blocks,
                             metrics=self.metrics)
+        #: epoch-keyed result cache (block cache below it): repeat
+        #: reads at an unchanged pinned vid skip parse/plan/SstView
+        self.result_cache = ResultCache(result_cache_bytes)
+        self._cache_vid = -1
         self.meta_addr = meta_addr
         self.host = host
         self._port_req = port
@@ -428,7 +564,10 @@ class ServingWorker:
                 f"no schema published for {sel.from_.name!r} "
                 "(not exported to shared storage yet)"
             )
-        return plan_read(sel, schema)
+        return plan_read(
+            sel, schema, schema_of=self.view.schema,
+            at_epoch=self.view.version.max_committed_epoch,
+        )
 
     def _ensure_epoch(self, min_epoch: int,
                       timeout_s: float = 10.0) -> None:
@@ -449,15 +588,8 @@ class ServingWorker:
                 )
             time.sleep(0.02)
 
-    def _execute(self, plan: ReadPlan, version):
+    def _project(self, plan: ReadPlan, hits):
         rows: list[tuple] = []
-        if plan.mode == "get":
-            val = self.view.point_get(plan.key, version)
-            hits = [] if val is None else [pickle.loads(val)]
-        else:
-            hits = (pickle.loads(v)
-                    for _, v in self.view.scan(plan.lo, plan.hi,
-                                               version))
         skip = plan.offset
         for row in hits:
             if skip > 0:
@@ -468,15 +600,47 @@ class ServingWorker:
                 break
         return plan.col_names, rows
 
-    def read(self, sql: str, min_epoch: int = 0):
-        """Serve one SELECT at the leased (meta-pinned) epoch."""
-        t0 = time.perf_counter()
-        plan = self._plan(sql)  # ServeUnsupported propagates un-counted
+    def _execute(self, plan: ReadPlan, version):
+        if plan.mode == "get":
+            val = self.view.point_get(plan.key, version)
+            hits = [] if val is None else [pickle.loads(val)]
+        elif plan.mode == "index":
+            hits = self._index_lookup(plan, version)
+        else:
+            hits = (pickle.loads(v)
+                    for _, v in self.view.scan(plan.lo, plan.hi,
+                                               version))
+        return self._project(plan, hits)
+
+    def _index_lookup(self, plan: ReadPlan, version) -> list[tuple]:
+        """Index range scan → upstream pk values → ONE sorted
+        multi-get pass on the primary MV.  Index and primary export in
+        the same per-barrier SST, so any pinned version sees them
+        consistent; output order (encoded primary pk ascending) is
+        byte-identical to a full scan + filter."""
+        schema = self.view.schema(plan.mv)
+        prim_lo, _ = mv_key_range(plan.mv)
+        w = plan.index_width
+        keys = []
+        for _, v in self.view.scan(plan.lo, plan.hi, version):
+            row = pickle.loads(v)
+            keys.append(prim_lo + b"".join(
+                schema.encode_pk_value(pkcol, row[w + j])
+                for j, pkcol in enumerate(schema.pk)
+            ))
+        self.metrics.inc("serving_index_lookups_total")
+        self.metrics.inc("serving_index_keys_total", len(keys))
+        vals = self.view.multi_get(keys, version)
+        return [pickle.loads(vals[k]) for k in sorted(set(keys))
+                if vals.get(k) is not None]
+
+    def _catch_up(self, min_epoch: int) -> None:
+        """``_ensure_epoch`` with the read-path error mapping: a
+        replica that cannot reach the pinned epoch is UNAVAILABLE for
+        this read (routing signal, un-counted — the meta serves it
+        elsewhere), not a read error."""
         try:
-            # catching up may need the meta; a replica that can't is
-            # UNAVAILABLE for this read (routing signal, un-counted —
-            # the meta serves it elsewhere), not a read error
-            self._ensure_epoch(int(min_epoch or 0))
+            self._ensure_epoch(min_epoch)
         except IntegrityError as e:
             # the manifest chain broke under the refresh: report for
             # quarantine and route the read around this replica
@@ -489,23 +653,21 @@ class ServingWorker:
             raise ServeUnavailable(
                 f"replica cannot reach the pinned epoch: {e!r}"
             ) from e
+
+    def _run_pinned(self, fn):
+        """Run ``fn(version)`` with the pinned-read error contract:
+        one re-grant + retry when an SST vanished underneath (lease
+        raced a vacuum), detected corruption answers
+        ``ServeUnavailable`` (reported for quarantine — never an
+        error, never a silently wrong row), anything else counts as a
+        read error."""
         try:
-            version = self.view.version
             try:
-                cols, rows = self._execute(plan, version)
+                return fn(self.view.version)
             except ObjectError:
-                # an SST vanished under us (lease raced a vacuum —
-                # should not happen while the meta honors pins):
-                # re-grant and retry once before surfacing an error
                 self._grant_refresh()
-                version = self.view.version
-                cols, rows = self._execute(plan, version)
+                return fn(self.view.version)
         except IntegrityError as e:
-            # corrupt shared bytes (SST block/footer crc): a DETECTED
-            # corruption is a routing event — report it to the meta
-            # (quarantine + self-healing repair) and answer
-            # ServeUnavailable so the read lands on another replica or
-            # the owner; never an error, never a silently wrong row
             record_integrity_error(self.metrics, e)
             self._report_corruption(e)
             raise ServeUnavailable(
@@ -515,17 +677,187 @@ class ServingWorker:
             self.read_errors += 1
             self.metrics.inc("serving_read_errors_total")
             raise
+
+    def _sync_cache_vid(self, vid: int) -> None:
+        if vid != self._cache_vid:
+            self.result_cache.evict_stale(vid)
+            self._cache_vid = vid
+
+    def _export_cache_gauges(self) -> None:
+        rc = self.result_cache
+        self.metrics.set_gauge("serving_result_cache_hits", rc.hits)
+        self.metrics.set_gauge("serving_result_cache_misses",
+                               rc.misses)
+        self.metrics.set_gauge("serving_result_cache_bytes", rc.bytes)
+        self.metrics.set_gauge("serving_result_cache_entries",
+                               len(rc))
+        self.metrics.set_gauge("serving_result_cache_hit_ratio",
+                               rc.hit_ratio())
+        self.view._export_gauges()
+
+    def read(self, sql: str, min_epoch: int = 0):
+        """Serve one SELECT at the leased (meta-pinned) epoch.  A
+        result-cache hit at the current vid skips parse, plan, and the
+        SstView entirely."""
+        t0 = time.perf_counter()
+        self._catch_up(int(min_epoch or 0))
+        version = self.view.version
+        self._sync_cache_vid(version.vid)
+        key = (" ".join(sql.split()), version.vid)
+        entry = self.result_cache.get(key)
+        if entry is None:
+            # ServeUnsupported propagates un-counted (owner fallback)
+            plan = self._plan(sql)
+            cols, rows = self._run_pinned(
+                lambda v: self._execute(plan, v)
+            )
+            entry = (cols, rows, self.view.version.max_committed_epoch)
+            if self.view.version.vid == version.vid:
+                # an ObjectError re-grant may have moved the vid
+                # mid-read: never cache under the stale key
+                self.result_cache.put(key, entry)
+        cols, rows, epoch = entry
         self.reads_total += 1
         self.metrics.inc("serving_reads_total")
         self.metrics.observe("serving_read_seconds",
                              time.perf_counter() - t0)
-        self.view._export_gauges()
-        return cols, rows, version.max_committed_epoch
+        self._export_cache_gauges()
+        return cols, rows, epoch
+
+    def read_batch(self, sqls: list, min_epoch: int = 0) -> list:
+        """Serve N SELECTs through ONE epoch catch-up and (for
+        point-gets) ONE shared multi-get pass sorted by encoded pk —
+        the batched form that amortizes the RPC frame and makes
+        block-cache access sequential.  Per item the answer is either
+        ``(cols, rows, epoch)`` or a dict marking ``unsupported`` /
+        final ``error`` (the meta falls back or re-raises per item)."""
+        t0 = time.perf_counter()
+        self._catch_up(int(min_epoch or 0))
+        version = self.view.version
+        self._sync_cache_vid(version.vid)
+        results: list = [None] * len(sqls)
+        todo: list[tuple[int, tuple, ReadPlan]] = []
+        for i, sql in enumerate(sqls):
+            key = (" ".join(sql.split()), version.vid)
+            entry = self.result_cache.get(key)
+            if entry is not None:
+                results[i] = entry
+                continue
+            try:
+                todo.append((i, key, self._plan(sql)))
+            except ServeUnsupported as e:
+                results[i] = {"unsupported": str(e)}
+            except ValueError as e:
+                results[i] = {"error": str(e)}
+        if todo:
+            def run(v):
+                gets = [t for t in todo if t[2].mode == "get"]
+                vals = self.view.multi_get(
+                    [p.key for _, _, p in gets], v
+                ) if gets else {}
+                out = []
+                for i, key, plan in todo:
+                    if plan.mode == "get":
+                        raw = vals.get(plan.key)
+                        hits = [] if raw is None \
+                            else [pickle.loads(raw)]
+                        cols, rows = self._project(plan, hits)
+                    else:
+                        cols, rows = self._execute(plan, v)
+                    out.append(
+                        (i, key, (cols, rows, v.max_committed_epoch))
+                    )
+                return out
+            for i, key, entry in self._run_pinned(run):
+                results[i] = entry
+                if self.view.version.vid == version.vid:
+                    self.result_cache.put(key, entry)
+        n = len(sqls)
+        self.reads_total += n
+        self.metrics.inc("serving_reads_total", n)
+        self.metrics.inc("serving_batch_reads_total", n)
+        self.metrics.observe("serving_batch_seconds",
+                             time.perf_counter() - t0)
+        self._export_cache_gauges()
+        return results
+
+    def multi_get(self, mv: str, pks: list, cols: list | None = None,
+                  min_epoch: int = 0):
+        """First-class multi-get: one MV + N full pks through one RPC
+        frame and ONE sorted SstView pass.  Rows come back in encoded
+        pk order; pks not present are omitted."""
+        t0 = time.perf_counter()
+        self._catch_up(int(min_epoch or 0))
+        schema = self.view.schema(mv)
+        if schema is None:
+            raise ServeUnsupported(
+                f"no schema published for {mv!r} "
+                "(not exported to shared storage yet)"
+            )
+        if cols is None:
+            proj = schema.output_indices()
+        else:
+            proj = []
+            for c in cols:
+                idx = schema.index_of(c)
+                if idx is None:
+                    raise ValueError(
+                        f"column {c!r} does not exist in {mv!r}"
+                    )
+                proj.append(idx)
+        lo, _ = mv_key_range(mv)
+        keys = []
+        for pk in pks:
+            if len(pk) != len(schema.pk):
+                raise ValueError(
+                    f"multi_get pk arity {len(pk)} != "
+                    f"{len(schema.pk)} for {mv!r}"
+                )
+            keys.append(lo + b"".join(
+                schema.encode_pk_value(c, v)
+                for c, v in zip(schema.pk, pk)
+            ))
+
+        def run(v):
+            vals = self.view.multi_get(keys, v)
+            rows = [pickle.loads(vals[k]) for k in sorted(set(keys))
+                    if vals.get(k) is not None]
+            return ([tuple(r[i] for i in proj) for r in rows],
+                    v.max_committed_epoch)
+
+        rows, epoch = self._run_pinned(run)
+        n = len(pks)
+        self.reads_total += n
+        self.metrics.inc("serving_reads_total", n)
+        self.metrics.inc("serving_multi_get_keys_total", n)
+        self.metrics.observe("serving_batch_seconds",
+                             time.perf_counter() - t0)
+        self._export_cache_gauges()
+        return [schema.columns[i].name for i in proj], rows, epoch
 
     # -- RPC surface ----------------------------------------------------
     def rpc_read(self, sql: str, min_epoch: int = 0) -> dict:
         cols, rows, epoch = self.read(sql, min_epoch)
         return {"cols": cols, "rows": [list(r) for r in rows],
+                "epoch": epoch}
+
+    def rpc_read_batch(self, sqls: list, min_epoch: int = 0) -> dict:
+        out = []
+        for entry in self.read_batch(list(sqls), min_epoch):
+            if isinstance(entry, dict):
+                out.append(entry)
+            else:
+                cols, rows, epoch = entry
+                out.append({"cols": cols,
+                            "rows": [list(r) for r in rows],
+                            "epoch": epoch})
+        return {"results": out}
+
+    def rpc_multi_get(self, mv: str, pks: list,
+                      cols: list | None = None,
+                      min_epoch: int = 0) -> dict:
+        names, rows, epoch = self.multi_get(mv, pks, cols, min_epoch)
+        return {"cols": names, "rows": [list(r) for r in rows],
                 "epoch": epoch}
 
     def rpc_ping(self) -> dict:
@@ -548,6 +880,10 @@ class ServingWorker:
             "cache_hits": self.view.cache.hits,
             "cache_misses": self.view.cache.misses,
             "cache_hit_ratio": self.view.cache.hit_ratio(),
+            "result_cache_hits": self.result_cache.hits,
+            "result_cache_misses": self.result_cache.misses,
+            "result_cache_bytes": self.result_cache.bytes,
+            "result_cache_hit_ratio": self.result_cache.hit_ratio(),
             "jax_loaded": "jax" in sys.modules,
         }
 

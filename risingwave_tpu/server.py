@@ -185,6 +185,7 @@ def _run_serving(args) -> None:
         host=args.host, port=args.rpc_port,
         heartbeat_interval_s=args.heartbeat_interval,
         cache_blocks=args.serving_cache_blocks,
+        result_cache_bytes=args.serving_result_cache_bytes,
     ).start()
     print(json.dumps({
         "role": "serving", "replica_id": replica.replica_id,
@@ -226,6 +227,10 @@ def main() -> None:
                         "failover/repair windows before erroring")
     p.add_argument("--serving-cache-blocks", type=int, default=1024,
                    help="serving block-cache capacity (serving role)")
+    p.add_argument("--serving-result-cache-bytes", type=int,
+                   default=32 << 20,
+                   help="serving result-cache budget in bytes "
+                        "(serving role; 0 disables)")
     p.add_argument("--n-vnodes", type=int, default=64,
                    help="scale plane: vnode ring size (meta role)")
     p.add_argument("--scale-partitioning", action="store_true",

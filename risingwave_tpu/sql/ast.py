@@ -255,6 +255,17 @@ class CreateMaterializedView:
 
 
 @dataclass(frozen=True)
+class CreateIndex:
+    """``CREATE INDEX name ON mv(col, ...)`` — compiles to a small
+    secondary-index MV (pk = (col..., upstream pk)) maintained through
+    the MV-on-MV path and exported to the shared serving keyspace."""
+    name: str
+    table: str
+    columns: tuple
+    if_not_exists: bool = False
+
+
+@dataclass(frozen=True)
 class CreateSink:
     name: str
     query: Any          # Select (AS form) or None
@@ -265,7 +276,7 @@ class CreateSink:
 
 @dataclass(frozen=True)
 class DropStatement:
-    kind: str  # "source" | "materialized view" | "table"
+    kind: str  # "source" | "materialized view" | "table" | "index"
     name: str
     if_exists: bool = False
 

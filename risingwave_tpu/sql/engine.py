@@ -209,6 +209,10 @@ class Engine:
         #: last exported (key → pickled row) per MV — the incremental
         #: export diff base; seeded from the shared manifest on adopt
         self._exported: dict[str, dict] = {}
+        #: MV names whose serve-schema doc this process already
+        #: published; CREATE/DROP INDEX discards the upstream so the
+        #: doc republishes with the new index list on the next export
+        self._schema_published: set = set()
         #: per-read vnode override for partitioned MV serving (the
         #: cluster worker pins reads to the map at the pinned round)
         self._serve_vnodes = None
@@ -278,8 +282,8 @@ class Engine:
     #: planning, e.g. streaming_parallelism)
     _LOGGED_DDL = (
         ast.CreateSource, ast.CreateMaterializedView, ast.CreateSink,
-        ast.CreateFunction, ast.DropStatement, ast.AlterParallelism,
-        ast.SetStatement,
+        ast.CreateIndex, ast.CreateFunction, ast.DropStatement,
+        ast.AlterParallelism, ast.SetStatement,
     )
 
     def execute(self, sql: str):
@@ -351,6 +355,8 @@ class Engine:
             return self._create_source(stmt)
         if isinstance(stmt, ast.CreateMaterializedView):
             return self._create_mview(stmt)
+        if isinstance(stmt, ast.CreateIndex):
+            return self._create_index(stmt)
         if isinstance(stmt, ast.CreateSink):
             return self._create_sink(stmt)
         if isinstance(stmt, ast.DropStatement):
@@ -359,11 +365,28 @@ class Engine:
             if entry is not None:
                 want = {"source": "source", "table": "source",
                         "materialized view": "mview",
-                        "sink": "sink"}[stmt.kind]
+                        "sink": "sink", "index": "mview"}[stmt.kind]
                 if entry.kind != want:
                     raise ValueError(
                         f"{stmt.name} is a {entry.kind}, not a {want}"
                     )
+                if stmt.kind == "index" and entry.index_on is None:
+                    raise ValueError(f"{stmt.name} is not an index")
+                if entry.kind == "mview" and entry.index_on is None:
+                    deps = [e.name for e in self.catalog.list("mview")
+                            if e.index_on is not None
+                            and e.index_on[0] == stmt.name]
+                    if deps:
+                        raise ValueError(
+                            f"cannot drop {stmt.name!r}: indexes "
+                            f"{deps} depend on it (DROP INDEX first)"
+                        )
+                if entry.kind == "mview":
+                    # the shared serving keyspace forgets the MV too:
+                    # tombstones for its exported rows + schema doc
+                    # removed, so serving answers "does not exist"
+                    # instead of stale rows
+                    self._tombstone_dropped_mv(entry)
                 if entry.job is not None:
                     job = entry.job
                     shared = isinstance(job, DagJob) and any(
@@ -1657,6 +1680,75 @@ class Engine:
             self.jobs.append(job)
         return None
 
+    def _create_index(self, stmt: ast.CreateIndex):
+        """``CREATE INDEX ix ON mv(col, ...)``: a small secondary-index
+        MV — ``SELECT col..., <upstream pk>... FROM mv`` maintained
+        through the ordinary MV-on-MV attach path — whose EXPORT key
+        is ``(col..., upstream pk)``, so the shared serving keyspace
+        sorts its rows by the indexed columns and a serving replica
+        answers ``WHERE col = x`` with one contiguous index range scan
+        plus pk point-gets instead of a full scan (ref: the frontend's
+        index selection over index TableCatalogs)."""
+        from risingwave_tpu.stream.materialize import AppendOnlyMaterialize
+
+        if stmt.name in self.catalog:
+            if stmt.if_not_exists:
+                return None
+            raise ValueError(f"{stmt.name!r} already exists")
+        upstream = self.catalog.get(stmt.table)
+        if upstream.kind != "mview":
+            raise ValueError(
+                f"{stmt.table!r} is not a materialized view"
+            )
+        if not upstream.stream_key:
+            raise ValueError(
+                f"CREATE INDEX on {stmt.table!r}: append-only MVs "
+                "have no stream key to index"
+            )
+        by_name = {f.name: i for i, f in enumerate(upstream.schema)}
+        for c in stmt.columns:
+            if c not in by_name:
+                raise ValueError(
+                    f"column {c!r} does not exist in {stmt.table!r}"
+                )
+        ix_cols = [by_name[c] for c in stmt.columns]
+        pk_cols = list(upstream.stream_key)
+        items, used = [], set()
+        for j, i in enumerate(ix_cols + pk_cols):
+            base = upstream.schema[i].name
+            alias = base if base not in used else f"_idx{j}_{base}"
+            used.add(alias)
+            items.append(
+                ast.SelectItem(ast.ColumnRef(base), alias)
+            )
+        query = ast.Select(tuple(items), ast.TableRef(stmt.table))
+        self._refresh_dml_widths()
+        self.planner.parallel_hint = int(
+            self.session_config.get("streaming_parallelism")
+        )
+        plan = self.planner.plan(query)
+        job, mv_exec, state_index, dag_meta, is_new = self._build_job(
+            plan, stmt.name
+        )
+        entry = CatalogEntry(
+            stmt.name, "mview", mv_exec.in_schema,
+            job=job, mv_executor=mv_exec, mv_state_index=state_index,
+            append_only=isinstance(mv_exec, AppendOnlyMaterialize),
+            dag_nodes=dag_meta[0] if dag_meta else None,
+            dag_sources=dag_meta[1] if dag_meta else None,
+            stream_key=list(getattr(mv_exec, "pk_indices", []))
+            or None,
+            index_on=(stmt.table, tuple(stmt.columns)),
+            export_pk=tuple(range(len(ix_cols) + len(pk_cols))),
+            definition=self._definition_text(stmt),
+        )
+        self.catalog.create(entry)
+        if is_new:
+            self.jobs.append(job)
+        # the upstream's serve-schema doc must advertise the index
+        self._schema_published.discard(stmt.table)
+        return None
+
     def _create_sink(self, stmt: ast.CreateSink):
         from risingwave_tpu.connector.sinks import create_sink
 
@@ -1902,6 +1994,7 @@ class Engine:
                 nm = getattr(stmt, "name", None)
                 if isinstance(stmt, (ast.CreateSource,
                                      ast.CreateMaterializedView,
+                                     ast.CreateIndex,
                                      ast.CreateSink)) \
                         and nm in self.catalog:
                     continue
@@ -2390,8 +2483,10 @@ class Engine:
         import pickle as _pickle
 
         schema = entry.mv_executor.in_schema
-        pk = getattr(entry.mv_executor, "pk_indices",
-                     tuple(range(len(schema))))
+        pk = entry.export_pk \
+            if entry.export_pk is not None \
+            else getattr(entry.mv_executor, "pk_indices",
+                         tuple(range(len(schema))))
         lo, _ = self._mv_storage_range(entry.name)
         new: dict[bytes, bytes] = {}
         for row in self._mv_rows(entry):
@@ -2401,17 +2496,27 @@ class Engine:
             new[key] = _pickle.dumps(tuple(row), protocol=4)
         return new
 
-    def _publish_mv_schema(self, store, entry: CatalogEntry) -> None:
+    def _publish_mv_schema(self, store, entry: CatalogEntry,
+                           since_epoch: int | None = None) -> None:
         """Publish the MV's shape next to its data so an engine-free
         serving replica can encode pk probes and project columns
-        without the binder (serve/reader.MvSchema loads this)."""
+        without the binder (serve/reader.MvSchema loads this).
+
+        Index MVs carry ``index_of``/``index_width`` plus the epoch
+        their FIRST export rides (``since_epoch``) — a replica pinned
+        before that epoch must not trust the index range (the doc is
+        an unversioned side-channel; the data is versioned).  The
+        upstream's doc lists its indexes so ``plan_read`` can rewrite
+        equality predicates without a catalog."""
         import json as _json
 
         from risingwave_tpu.serve.reader import schema_key
 
         schema = entry.mv_executor.in_schema
-        pk = getattr(entry.mv_executor, "pk_indices",
-                     tuple(range(len(schema))))
+        pk = entry.export_pk \
+            if entry.export_pk is not None \
+            else getattr(entry.mv_executor, "pk_indices",
+                         tuple(range(len(schema))))
         cols = []
         for f in schema:
             if f.data_type.is_string:
@@ -2428,6 +2533,19 @@ class Engine:
                 "hidden": f.name.startswith("_hidden_"),
             })
         doc = {"mv": entry.name, "columns": cols, "pk": list(pk)}
+        if entry.index_on is not None:
+            doc["index_of"] = entry.index_on[0]
+            doc["index_width"] = len(entry.index_on[1])
+            if since_epoch is not None:
+                doc["since_epoch"] = int(since_epoch)
+        idxs = [
+            {"name": e.name, "cols": list(e.index_on[1])}
+            for e in self.catalog.list("mview")
+            if e.index_on is not None
+            and e.index_on[0] == entry.name
+        ]
+        if idxs:
+            doc["indexes"] = idxs
         store.put(schema_key(entry.name),
                   _json.dumps(doc).encode())
 
@@ -2449,7 +2567,9 @@ class Engine:
         from risingwave_tpu.storage.sst import TOMBSTONE
         batch = sorted(new.items()) + [(k, TOMBSTONE) for k in stale]
         self.hummock.write_batch(batch, epoch=epoch)
-        self._publish_mv_schema(self.hummock.store, entry)
+        self._publish_mv_schema(self.hummock.store, entry,
+                                since_epoch=epoch)
+        self._schema_published.add(entry.name)
         self.metrics.inc("storage_mv_export_rows_total", len(new),
                          job=name)
         return {"mv": name, "epoch": epoch, "rows": len(new),
@@ -2526,7 +2646,12 @@ class Engine:
             prev = self._exported.get(entry.name)
             if prev is None:
                 prev = self._seed_exported(store, entry.name)
-                self._publish_mv_schema(store, entry)
+            if entry.name not in self._schema_published:
+                # first export this process, or a CREATE/DROP INDEX
+                # dirtied the doc (the index list changed)
+                self._publish_mv_schema(store, entry,
+                                        since_epoch=epoch)
+                self._schema_published.add(entry.name)
             ups = [(k, v) for k, v in new.items()
                    if prev.get(k) != v]
             dels = [(k, TOMBSTONE) for k in prev if k not in new]
@@ -2578,6 +2703,62 @@ class Engine:
             return self.export_mv_deltas(job_name, job.committed_epoch)
         finally:
             self._seed_exclude = frozenset()
+
+    def _tombstone_dropped_mv(self, entry: CatalogEntry) -> None:
+        """DROP MATERIALIZED VIEW / DROP INDEX removes the MV from the
+        SHARED serving keyspace too: one tombstone batch for every
+        exported row plus the serve-schema doc deleted, so a serving
+        replica answers "does not exist" instead of stale rows.  Only
+        the manifest OWNER writes (single node / meta-owned storage);
+        a cluster compute worker just forgets its export diff base —
+        the meta, which owns the manifest over the same store, writes
+        the tombstones when it unplaces the MV."""
+        from risingwave_tpu.storage.hummock.object_store import (
+            ObjectError,
+        )
+
+        import json as _json
+
+        self._exported.pop(entry.name, None)
+        self._schema_published.discard(entry.name)
+        if entry.index_on is not None:
+            # the upstream's doc must stop advertising this index
+            self._schema_published.discard(entry.index_on[0])
+        if self.hummock is None:
+            return
+        from risingwave_tpu.serve.reader import schema_key
+
+        if entry.index_on is not None:
+            # rewrite the upstream doc BEFORE the tombstone delta: a
+            # reader refreshing past the tombstones must not plan
+            # through the dead index (readers pinned earlier still see
+            # consistent doc+data)
+            try:
+                doc = _json.loads(
+                    self.hummock.store.get(schema_key(entry.index_on[0]))
+                )
+                doc["indexes"] = [
+                    e for e in doc.get("indexes", [])
+                    if e.get("name") != entry.name
+                ]
+                if not doc["indexes"]:
+                    doc.pop("indexes")
+                self.hummock.store.put(
+                    schema_key(entry.index_on[0]),
+                    _json.dumps(doc).encode(),
+                )
+            except ObjectError:
+                pass  # upstream never exported
+        lo, hi = self._mv_storage_range(entry.name)
+        keys = [k for k, _ in self.hummock.scan(lo, hi)]
+        if keys:
+            self.hummock.delete_batch(
+                keys, epoch=self.hummock.versions.max_committed_epoch
+            )
+        try:
+            self.hummock.store.delete(schema_key(entry.name))
+        except ObjectError:
+            pass  # never exported
 
     def storage_serve_mv(self, name: str) -> list:
         """Serve an exported MV from the storage service through a

@@ -312,6 +312,18 @@ class Parser:
                 self.expect_word("close")
                 eowc = True
             return ast.CreateMaterializedView(name, query, ine, eowc)
+        if self.accept_word("index"):
+            # CREATE INDEX name ON mv(col, ...) — a secondary-index MV
+            ine = self._if_not_exists()
+            name = self.ident()
+            self.expect_word("on")
+            table = self.ident()
+            self.expect_op("(")
+            cols = [self.ident()]
+            while self.accept_op(","):
+                cols.append(self.ident())
+            self.expect_op(")")
+            return ast.CreateIndex(name, table, tuple(cols), ine)
         if self.accept_word("function"):
             # CREATE FUNCTION f(a type, b type) RETURNS type
             #   LANGUAGE SQL AS $$SELECT <expr>$$
@@ -343,7 +355,9 @@ class Parser:
             else:
                 raise ParseError("expected a quoted function body")
             return ast.CreateFunction(name, tuple(params), body_sql, ine)
-        raise ParseError("expected SOURCE, TABLE or MATERIALIZED VIEW")
+        raise ParseError(
+            "expected SOURCE, TABLE, INDEX or MATERIALIZED VIEW"
+        )
 
     def _with_options(self) -> dict:
         options: dict = {}
@@ -405,7 +419,8 @@ class Parser:
         return t.value
 
     def _drop(self):
-        kind = self.ident()  # source | table | sink | materialized view
+        # source | table | sink | index | materialized view
+        kind = self.ident()
         if kind == "materialized":
             self.expect_word("view")
             kind = "materialized view"

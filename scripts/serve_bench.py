@@ -1,21 +1,32 @@
-"""Serving-tier bench: sustained concurrent reads DURING ingest.
+"""Serving-tier bench: memcached-class reads DURING ingest.
 
-The ISSUE 5 regression gate for Serve-lite: a 1-meta + 1-compute +
-1-serving cluster (in-process) runs global barrier rounds (ingest +
-per-barrier MV export + compaction + periodic vacuum) while reader
-threads hammer the serving tier through the meta's router.  Asserted
+The ISSUE 10 regression gate for Serve-hot, grown from the ISSUE 5
+serve-lite bench.  A 1-meta + 1-compute + 1-serving cluster
+(in-process) runs global barrier rounds (ingest + per-barrier MV
+export + compaction + periodic vacuum) while reader threads hammer
+the serving tier through the meta's BATCHED router — repeat point
+SELECTs served from the replica's epoch-keyed result cache, plus
+first-class multi-gets sharing one sorted SstView pass.  Asserted
 floors (``--assert``):
 
-- ZERO read errors across the whole window (reads pinned at committed
-  epochs, replica leases vacuum-safe);
-- sustained read throughput >= ``--min-reads-per-s``;
-- block-cache hit ratio after warmup >= ``--min-hit-ratio`` (the
-  serving tier serves from cache, not per-read SST I/O);
-- the REPLICA carried the bulk of the reads (the owning worker left
-  the read path — the point of the tier).
+- ZERO read errors across the whole window, INCLUDING a replica
+  hard-kill mid-window (a second replica joins, dies, and routing
+  carries on);
+- sustained read throughput >= ``--min-reads-per-s`` on the
+  cached/batched workload (same-box target: >= 10k reads/s/replica,
+  from 576 at round 8);
+- p99.9 per-read latency <= ``--max-p999-ms`` (tail-latency gate per
+  the Hazelcast-Jet 99.99th-percentile discipline);
+- result-cache + block-cache hit ratios after warmup;
+- epoch-advance invalidation: writes committed at e+1 are visible
+  through the cache after the lease re-grant — ZERO stale rows,
+  byte-identical to the owning worker;
+- secondary-index lookups beat the full scan on the non-pk predicate
+  workload with byte-identical results.
 
 Usage:
-    python scripts/serve_bench.py [--seconds 6] [--readers 4] [--assert]
+    python scripts/serve_bench.py [--seconds 6] [--readers 4]
+        [--batch 64] [--assert]
 """
 
 from __future__ import annotations
@@ -30,10 +41,31 @@ import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+#: groups in the index-workload MV (full scan decodes this many rows;
+#: the index path touches ~1)
+KM_GROUPS = 512
 
-def run(seconds: float = 6.0, readers: int = 4,
+
+def _percentile(samples: list, q: float) -> float:
+    """Weighted percentile over (latency_s, n_items) batch samples —
+    every read in a batch experiences the batch's latency."""
+    if not samples:
+        return 0.0
+    ordered = sorted(samples)
+    total = sum(n for _, n in ordered)
+    target = q * total
+    seen = 0
+    for lat, n in ordered:
+        seen += n
+        if seen >= target:
+            return lat
+    return ordered[-1][0]
+
+
+def run(seconds: float = 6.0, readers: int = 4, batch: int = 64,
         vacuum_interval_s: float = 0.25,
-        cache_blocks: int = 1024) -> dict:
+        cache_blocks: int = 4096,
+        result_cache_bytes: int = 32 << 20) -> dict:
     from risingwave_tpu.cluster import ComputeWorker, MetaService
     from risingwave_tpu.common.config import RwConfig
     from risingwave_tpu.serve import ServingWorker
@@ -59,16 +91,36 @@ def run(seconds: float = 6.0, readers: int = 4,
         "SELECT k % 32 AS g, count(*) AS n, sum(v) AS s "
         "FROM t GROUP BY k % 32"
     )
+    # the index workload: a wider MV (full scan = KM_GROUPS rows) with
+    # a secondary index on its non-pk aggregate column
+    meta.execute_ddl(
+        "CREATE MATERIALIZED VIEW km AS "
+        f"SELECT k % {KM_GROUPS} AS kk, sum(v) AS s "
+        f"FROM t GROUP BY k % {KM_GROUPS}"
+    )
+    meta.execute_ddl("CREATE INDEX km_s ON km(s)")
+    # the invalidation probe: a DML-fed table + MV the probe writes
+    # through committed rounds
+    meta.execute_ddl("CREATE TABLE pt (k BIGINT, v BIGINT)")
+    meta.execute_ddl(
+        "CREATE MATERIALIZED VIEW pm AS "
+        "SELECT k, sum(v) AS s FROM pt GROUP BY k"
+    )
     # warm the pipeline (first barrier pays jit compiles) and land the
     # first exports before the replica joins
     for _ in range(2):
         assert meta.tick(1)["committed"]
-    replica = ServingWorker(addr, tmp, heartbeat_interval_s=0.1,
-                            cache_blocks=cache_blocks).start()
+    replica = ServingWorker(
+        addr, tmp, heartbeat_interval_s=0.1,
+        cache_blocks=cache_blocks,
+        result_cache_bytes=result_cache_bytes,
+    ).start()
 
     stop = threading.Event()
     errors: list = []
     reads = [0] * readers
+    lat_lock = threading.Lock()
+    latencies: list = []  # (batch_latency_s, n_items)
     rounds = [0]
     last_vacuum = [time.monotonic()]
 
@@ -77,6 +129,7 @@ def run(seconds: float = 6.0, readers: int = 4,
             try:
                 if meta.tick(1)["committed"]:
                     rounds[0] += 1
+                meta.check_heartbeats()  # monitor=False: reap manually
                 if time.monotonic() - last_vacuum[0] \
                         > vacuum_interval_s:
                     meta.storage_vacuum()
@@ -85,19 +138,36 @@ def run(seconds: float = 6.0, readers: int = 4,
                 errors.append(f"ingest: {e!r}")
 
     def read_loop(i: int):
-        queries = [
-            "SELECT g, n, s FROM bm",
-            f"SELECT n FROM bm WHERE g = {i % 32}",
-            "SELECT g, n FROM bm WHERE g >= 8 AND g < 24",
-        ]
+        it = 0
         while not stop.is_set():
-            for sql in queries:
-                try:
-                    cols, rows = meta.serve(sql)
-                    assert rows, "empty serving read"
-                except Exception as e:  # noqa: BLE001
-                    errors.append(repr(e))
-            reads[i] += len(queries)
+            it += 1
+            try:
+                if it % 4 == 0:
+                    # first-class multi-get: one MV + N pks, one frame
+                    t0 = time.perf_counter()
+                    cols, rows = meta.serve_multi_get(
+                        "bm", [[g] for g in range(16)],
+                        cols=["g", "n"],
+                    )
+                    dt = time.perf_counter() - t0
+                    assert rows, "empty multi-get"
+                    n = 16
+                else:
+                    qs = [
+                        f"SELECT g, n, s FROM bm WHERE g = "
+                        f"{(i + j) % 32}"
+                        for j in range(batch)
+                    ]
+                    t0 = time.perf_counter()
+                    res = meta.serve_batch(qs)
+                    dt = time.perf_counter() - t0
+                    assert all(r[1] for r in res), "empty batch item"
+                    n = len(qs)
+                with lat_lock:
+                    latencies.append((dt, n))
+                reads[i] += n
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
 
     threads = [threading.Thread(target=ingest_loop, daemon=True)]
     threads += [threading.Thread(target=read_loop, args=(i,),
@@ -105,31 +175,137 @@ def run(seconds: float = 6.0, readers: int = 4,
     t0 = time.monotonic()
     for t in threads:
         t.start()
-    # warmup half, then reset cache counters so the hit-ratio floor
-    # measures steady state, not cold fills
+    # warmup half, then reset cache + latency counters so floors
+    # measure steady state, not cold fills / first-compile stalls
     time.sleep(seconds / 2)
     replica.view.cache.hits = 0
     replica.view.cache.misses = 0
-    time.sleep(seconds / 2)
+    replica.result_cache.hits = 0
+    replica.result_cache.misses = 0
+    with lat_lock:
+        latencies.clear()
+    reads_mark = sum(reads)
+    t_mark = time.monotonic()
+    # a second replica joins, takes reads, and HARD-dies mid-window —
+    # routing must carry every read with zero errors
+    replica2 = ServingWorker(
+        addr, tmp, heartbeat_interval_s=0.1,
+        cache_blocks=cache_blocks,
+        result_cache_bytes=result_cache_bytes,
+    ).start()
+    time.sleep(seconds / 4)
+    replica2_reads = replica2.reads_total
+    replica2._stop.set()
+    replica2._server.stop()   # sockets die, no unregister — a kill
+    replica2._server = None
+    time.sleep(seconds / 4)
     stop.set()
     for t in threads:
         t.join(timeout=30)
-    elapsed = time.monotonic() - t0
+    elapsed = time.monotonic() - t_mark
+    measured_reads = sum(reads) - reads_mark
+
+    # replica counters BEFORE the probe/index phases (those read the
+    # replica directly and must not inflate the routed-share ratio)
+    replica_reads_window = replica.reads_total
+    with lat_lock:
+        lat = list(latencies)
+    p50 = _percentile(lat, 0.50) * 1e3
+    p99 = _percentile(lat, 0.99) * 1e3
+    p999 = _percentile(lat, 0.999) * 1e3
+
+    # -- epoch-advance invalidation probe: a write committed at e+1
+    # must be visible THROUGH the cache after the lease re-grant,
+    # byte-identical to the owning worker — zero stale rows
+    stale_rows = 0
+    probe_errors: list = []
+    for i in range(4):
+        k, v = 9000 + i, 7 * (i + 1)
+        try:
+            meta.execute_ddl(f"INSERT INTO pt VALUES ({k}, {v})")
+            deadline = time.monotonic() + 30
+            while not meta.tick(1)["committed"]:
+                if time.monotonic() > deadline:
+                    raise RuntimeError("probe round never committed")
+            sql = f"SELECT s FROM pm WHERE k = {k}"
+            # prime the cache at the PREVIOUS vid, then re-read after
+            # the commit: the re-grant re-keys the cache by
+            # construction, so the fresh row must appear
+            (cols, rows), = meta.serve_batch([sql])
+            with meta._lock:
+                job = meta.jobs[meta._mv_to_job["pm"]]
+                w = meta.workers[job.worker_id]
+                pin = job.pinned_epoch
+            owner = w.client.call("serve", sql=sql, query_epoch=pin)
+            owner_rows = [tuple(r) for r in owner["rows"]]
+            if rows != owner_rows or rows != [(v,)]:
+                stale_rows += 1
+                probe_errors.append(
+                    f"k={k}: serve={rows} owner={owner_rows} "
+                    f"want={[(v,)]}"
+                )
+        except Exception as e:  # noqa: BLE001
+            probe_errors.append(repr(e))
+            stale_rows += 1
+
+    # -- secondary index vs full scan (quiesced): byte-identical
+    # results, index faster on the non-pk predicate workload
+    index_identical = True
+    index_speedup = 0.0
+    try:
+        rc_budget = replica.result_cache.max_bytes
+        replica.result_cache.max_bytes = 0  # measure UNCACHED costs
+        _, km_rows, _ = replica.read("SELECT kk, s FROM km")
+        svals = [r[1] for r in km_rows[:32]]
+        # warm both paths once (block cache fills either way)
+        replica.read(f"SELECT kk, s FROM km WHERE s = {svals[0]}")
+        t0 = time.perf_counter()
+        for s in svals:
+            _, got, _ = replica.read(
+                f"SELECT kk, s FROM km WHERE s = {s}"
+            )
+            want = sorted(r for r in km_rows if r[1] == s)
+            if sorted(got) != want:
+                index_identical = False
+        t_index = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for s in svals:
+            _, allr, _ = replica.read("SELECT kk, s FROM km")
+            _ = [r for r in allr if r[1] == s]
+        t_scan = time.perf_counter() - t0
+        index_speedup = t_scan / max(t_index, 1e-9)
+        replica.result_cache.max_bytes = rc_budget
+    except Exception as e:  # noqa: BLE001
+        index_identical = False
+        probe_errors.append(f"index: {e!r}")
 
     total_reads = sum(reads)
     summary = {
         "seconds": round(elapsed, 2),
         "readers": readers,
+        "batch": batch,
         "rounds_committed": rounds[0],
         "reads_total": total_reads,
-        "reads_per_s": round(total_reads / elapsed, 1),
+        "reads_per_s": round(measured_reads / elapsed, 1),
+        "latency_ms": {"p50": round(p50, 3), "p99": round(p99, 3),
+                       "p999": round(p999, 3)},
         "read_errors": len(errors),
         "errors_sample": errors[:3],
-        "replica_reads": replica.reads_total,
-        "replica_read_errors": replica.read_errors,
+        "replica_reads": replica_reads_window,
+        "replica_read_errors": replica.read_errors
+        + replica2.read_errors,
+        "replica2_reads": replica2_reads,
         "replica_share": round(
-            replica.reads_total / max(total_reads, 1), 3),
+            min(1.0, (replica_reads_window + replica2.reads_total)
+                / max(total_reads, 1)), 3),
         "cache_hit_ratio": round(replica.view.cache.hit_ratio(), 3),
+        "result_cache_hit_ratio": round(
+            replica.result_cache.hit_ratio(), 3),
+        "result_cache_bytes": replica.result_cache.bytes,
+        "stale_rows": stale_rows,
+        "probe_errors": probe_errors[:3],
+        "index_identical": index_identical,
+        "index_speedup": round(index_speedup, 2),
         "gc_objects": int(meta.metrics.get("storage_gc_objects_total"))
         if _metric_exists(meta.metrics, "storage_gc_objects_total")
         else 0,
@@ -149,8 +325,48 @@ def _metric_exists(m, name) -> bool:
         return False
 
 
+def write_artifact(summary: dict) -> None:
+    """bench.py-shaped JSON line (SERVE_BENCH.json next to
+    MULTICHIP_BENCH.json) so the driver artifact set carries the
+    serving-tier numbers + latency percentiles."""
+    rec = {
+        "benchmark": "serve_hot",
+        "value": summary["reads_per_s"],
+        "unit": "reads/s",
+        "latency_ms": summary["latency_ms"],
+        "queries": {
+            "cached_batch": {"value": summary["reads_per_s"],
+                             "cpu_baseline": None,
+                             "vs_baseline": None},
+            "index_lookup": {"value": summary["index_speedup"],
+                             "unit": "x_vs_full_scan"},
+        },
+        "invariants": {
+            "read_errors": summary["read_errors"],
+            "stale_rows": summary["stale_rows"],
+            "index_identical": summary["index_identical"],
+            "rounds_committed": summary["rounds_committed"],
+        },
+        "errors": summary["errors_sample"] or None,
+        "blocker": None,
+    }
+    try:
+        out = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "SERVE_BENCH.json",
+        )
+        with open(out, "w") as f:
+            json.dump(rec, f, indent=1)
+            f.write("\n")
+    except OSError:
+        pass
+
+
 def check(summary: dict, min_reads_per_s: float,
-          min_hit_ratio: float, min_replica_share: float) -> list[str]:
+          min_hit_ratio: float, min_replica_share: float,
+          max_p999_ms: float = 500.0,
+          min_result_hit_ratio: float = 0.5,
+          min_index_speedup: float = 1.0) -> list[str]:
     """The --assert floors; returns a list of violations (empty=pass)."""
     bad = []
     if summary["read_errors"] != 0:
@@ -162,12 +378,30 @@ def check(summary: dict, min_reads_per_s: float,
     if summary["reads_per_s"] < min_reads_per_s:
         bad.append(f"reads_per_s={summary['reads_per_s']} "
                    f"< {min_reads_per_s}")
+    if summary["latency_ms"]["p999"] > max_p999_ms:
+        bad.append(f"p99.9={summary['latency_ms']['p999']}ms "
+                   f"> {max_p999_ms}ms")
     if summary["cache_hit_ratio"] < min_hit_ratio:
         bad.append(f"cache_hit_ratio={summary['cache_hit_ratio']} "
                    f"< {min_hit_ratio}")
+    if summary["result_cache_hit_ratio"] < min_result_hit_ratio:
+        bad.append(
+            "result_cache_hit_ratio="
+            f"{summary['result_cache_hit_ratio']} "
+            f"< {min_result_hit_ratio}")
     if summary["replica_share"] < min_replica_share:
         bad.append(f"replica_share={summary['replica_share']} "
                    f"< {min_replica_share}")
+    if summary["stale_rows"] != 0:
+        bad.append(f"stale_rows={summary['stale_rows']} != 0 "
+                   f"({summary['probe_errors']})")
+    if not summary["index_identical"]:
+        bad.append(
+            f"index results not byte-identical "
+            f"({summary['probe_errors']})")
+    if summary["index_speedup"] < min_index_speedup:
+        bad.append(f"index_speedup={summary['index_speedup']}x "
+                   f"< {min_index_speedup}x vs full scan")
     if summary["rounds_committed"] < 1:
         bad.append("no rounds committed during the window")
     return bad
@@ -177,17 +411,26 @@ def main() -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--seconds", type=float, default=6.0)
     p.add_argument("--readers", type=int, default=4)
+    p.add_argument("--batch", type=int, default=64)
     p.add_argument("--assert", dest="do_assert", action="store_true")
-    p.add_argument("--min-reads-per-s", type=float, default=20.0)
+    p.add_argument("--min-reads-per-s", type=float, default=10000.0)
+    p.add_argument("--max-p999-ms", type=float, default=500.0)
     p.add_argument("--min-hit-ratio", type=float, default=0.5)
+    p.add_argument("--min-result-hit-ratio", type=float, default=0.5)
     p.add_argument("--min-replica-share", type=float, default=0.5)
+    p.add_argument("--min-index-speedup", type=float, default=1.0)
     args = p.parse_args()
 
-    summary = run(seconds=args.seconds, readers=args.readers)
+    summary = run(seconds=args.seconds, readers=args.readers,
+                  batch=args.batch)
     print(json.dumps(summary, indent=1))
+    write_artifact(summary)
     if args.do_assert:
         bad = check(summary, args.min_reads_per_s,
-                    args.min_hit_ratio, args.min_replica_share)
+                    args.min_hit_ratio, args.min_replica_share,
+                    max_p999_ms=args.max_p999_ms,
+                    min_result_hit_ratio=args.min_result_hit_ratio,
+                    min_index_speedup=args.min_index_speedup)
         if bad:
             raise SystemExit("serve_bench FAILED:\n  " + "\n  ".join(bad))
         print("serve_bench: all floors PASSED")

@@ -188,6 +188,12 @@ def test_serving_metrics_exported(tmp_path):
         sv.read("SELECT g, n FROM sm WHERE g = 1")
         m = sv.metrics
         assert m.get("serving_reads_total") == 4
+        # the repeat scans HIT the result cache (same sql, same vid)
+        assert m.get("serving_result_cache_hits") >= 2
+        assert m.get("serving_result_cache_misses") >= 1
+        assert m.get("serving_result_cache_bytes") > 0
+        assert m.get("serving_result_cache_entries") >= 1
+        assert 0.0 < m.get("serving_result_cache_hit_ratio") <= 1.0
         assert m.get("serving_pinned_epoch") > 0
         assert m.get("serving_block_cache_hits") >= 1
         assert m.get("serving_block_cache_misses") >= 1
@@ -203,6 +209,8 @@ def test_serving_metrics_exported(tmp_path):
             "serving_block_cache_hit_ratio",
             "serving_block_cache_fill_bytes",
             "serving_read_seconds_count",
+            "serving_result_cache_hit_ratio",
+            "serving_result_cache_bytes",
         ):
             assert name in text, name
         # error counter absent until an error actually happens
