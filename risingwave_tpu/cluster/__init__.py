@@ -12,6 +12,14 @@ _LAZY = {
     "MetaService": ("risingwave_tpu.cluster.meta_service",
                     "MetaService"),
     "ComputeWorker": ("risingwave_tpu.cluster.worker", "ComputeWorker"),
+    "Choreography": ("risingwave_tpu.cluster.exchange.planner",
+                     "Choreography"),
+    "ExchangePlanner": ("risingwave_tpu.cluster.exchange.planner",
+                        "ExchangePlanner"),
+    "ExchangeSpec": ("risingwave_tpu.cluster.exchange.planner",
+                     "ExchangeSpec"),
+    "ShuffleService": ("risingwave_tpu.cluster.exchange.shuffle",
+                       "ShuffleService"),
     "ServingWorker": ("risingwave_tpu.serve.worker", "ServingWorker"),
     "RpcClient": ("risingwave_tpu.cluster.rpc", "RpcClient"),
     "RpcError": ("risingwave_tpu.cluster.rpc", "RpcError"),

@@ -1,0 +1,18 @@
+"""Exchange-lite: the cluster shuffle plane (ISSUE 11).
+
+``planner`` compiles the static exchange choreography (which worker
+ships which vnodes' rows to which peer on which edge) at placement and
+scale time; ``shuffle`` executes it per chunk over the position-
+stamped idempotent peer-batch protocol.  See ARCHITECTURE.md
+"Exchange plane: Exchange-lite".
+"""
+
+from risingwave_tpu.cluster.exchange.planner import (  # noqa: F401
+    Choreography,
+    ExchangePlanner,
+    ExchangeSpec,
+)
+from risingwave_tpu.cluster.exchange.shuffle import (  # noqa: F401
+    ShuffleService,
+    vnodes_of_rows,
+)

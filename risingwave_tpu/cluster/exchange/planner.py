@@ -1,0 +1,184 @@
+"""ExchangePlanner: compile the cluster shuffle choreography ONCE.
+
+Reference counterpart: the stream graph's exchange edges — the
+fragmenter decides *at plan time* which dispatcher (hash / broadcast /
+simple) connects every pair of fragments (src/stream/src/executor/
+dispatch.rs); actors then move chunks without ever consulting the
+meta.  *Suki*'s choreographed dataflow (PAPERS.md) is the sharper
+model this module lifts to the cluster: the whole exchange topology is
+compiled into a static choreography at placement/scale time, pushed to
+every worker, and the per-chunk data path executes it peer-to-peer
+with the meta fully out of the loop.
+
+This is the worker-topology analog of ``sql/engine._plan_mesh_attach``
+(round 12): there the planner derived, per DAG edge, which *device
+shard* owns each row (all_to_all specs inside one ``shard_map``); here
+it derives, per cluster edge, which *worker* owns each row's vnode.
+Same hash (``common.hash.hash64_columns`` through
+``scale.vnode.vnodes_of_ints``), same minimal-movement map
+(``scale.vnode.rebalance``), one planning problem at two radii.
+
+Edge taxonomy (``ExchangeSpec.kind``):
+
+- ``source``   — ingest shuffle: the table's ingest leader hash-
+  partitions each DML batch by the distribution-key vnode ONCE and
+  sends each worker only its owned slice (``mode="shuffle"``); when
+  the key is not traceable to a raw source column, or consumer jobs
+  disagree on the key, the edge degrades to ``mode="replicate"`` (the
+  PR-7 full fan-out; the VnodeGate then filters);
+- ``join``     — a partitioned join job's two source edges, keyed per
+  side by that side's first equi-key column (rows with equal join
+  keys co-locate because equal tuples share their first column);
+- ``attach``   — an MV-on-MV edge over a partitioned upstream.  When
+  the downstream keys contain the upstream distribution key the
+  exchange is the IDENTITY (``mode="local"`` — each partition's
+  changelog already lives on the right owner, the cheapest possible
+  choreography); reduced-key shapes are refused at plan time.
+
+The compiled :class:`Choreography` is a plain JSON document (version,
+per-table routing, edge specs) so the meta can push it over the
+existing ``update_routing`` RPC and a restarted worker can ask for it
+again — compile once, execute forever, exactly the Suki discipline.
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass, field
+
+
+@dataclass
+class ExchangeSpec:
+    """One compiled exchange edge of the cluster dataflow."""
+
+    #: edge label, e.g. ``src:t>agg`` / ``join:t>j.left`` /
+    #: ``attach:agg>agg2`` — also the metrics ``edge=`` label
+    edge: str
+    #: "source" | "join" | "attach"
+    kind: str
+    #: DML table the edge ships (source/join edges)
+    table: str | None = None
+    #: raw source-column index of the routing key (None = untraceable)
+    key_col: int | None = None
+    #: "shuffle" (sliced delivery) | "replicate" (full fan-out) |
+    #: "local" (identity — rows already live on their owner)
+    mode: str = "replicate"
+    #: consumer job the edge feeds
+    job: str = ""
+
+
+@dataclass
+class Choreography:
+    """The compiled cluster shuffle plan (one per routing version).
+
+    ``tables`` maps each replicated DML table to its routing entry::
+
+        {"leader": wid, "standby": wid | None, "hosts": [wid...],
+         "key_col": int | None, "mode": "shuffle" | "replicate",
+         "n_vnodes": N, "slices": {wid: [vnode...]}}
+
+    The ``standby`` host additionally receives the LEADER's own slice
+    so a dead leader's unconsumed rows survive one failure (the next
+    leader by sorted id IS the standby).
+    """
+
+    version: int = 0
+    tables: dict = field(default_factory=dict)
+    specs: list = field(default_factory=list)
+
+    def to_doc(self) -> dict:
+        return {
+            "version": self.version,
+            "tables": self.tables,
+            "specs": [asdict(s) for s in self.specs],
+        }
+
+    @classmethod
+    def from_doc(cls, doc: dict) -> "Choreography":
+        ch = cls(version=int(doc.get("version", 0)))
+        for t, ent in (doc.get("tables") or {}).items():
+            ch.tables[t] = {
+                "leader": int(ent["leader"]),
+                "standby": (int(ent["standby"])
+                            if ent.get("standby") is not None else None),
+                "hosts": [int(h) for h in ent["hosts"]],
+                "key_col": (int(ent["key_col"])
+                            if ent.get("key_col") is not None else None),
+                "mode": ent.get("mode", "replicate"),
+                "n_vnodes": int(ent.get("n_vnodes", 0)),
+                "slices": {int(w): [int(v) for v in vs]
+                           for w, vs in (ent.get("slices") or {}).items()},
+            }
+        ch.specs = [ExchangeSpec(**s) for s in (doc.get("specs") or [])]
+        return ch
+
+
+class ExchangePlanner:
+    """Compiles the choreography from the meta's placement state.
+
+    Input is deliberately plain data (no JobInfo coupling): one dict
+    per partitioned job::
+
+        {"name": str,
+         "dml_tables": [table, ...],
+         "shuffle_cols": {table: raw_col | None},
+         "kinds": {table: "source" | "join"},
+         "attach_edges": [(upstream_mv, downstream_mv), ...],
+         "owners": {worker_id: [vnode, ...]}}
+
+    plus the shared vnode ring size.  Everything here is a pure
+    function of its inputs — every process compiles the same
+    choreography from the same placement (the same determinism
+    contract as ``scale.vnode.rebalance``).
+    """
+
+    @staticmethod
+    def compile(jobs: list[dict], n_vnodes: int,
+                version: int = 0) -> Choreography:
+        ch = Choreography(version=version)
+        # -- per-table routing: consumers must agree on the key -------
+        consumers: dict[str, list[dict]] = {}
+        for j in jobs:
+            for t in j.get("dml_tables", ()):
+                consumers.setdefault(t, []).append(j)
+        for table, js in sorted(consumers.items()):
+            hosts = sorted({w for j in js for w in j["owners"]})
+            if not hosts:
+                continue
+            keys = {j.get("shuffle_cols", {}).get(table) for j in js}
+            key_col = keys.pop() if len(keys) == 1 else None
+            mode = "shuffle" if key_col is not None else "replicate"
+            # a worker's slice for this table: the union of its owned
+            # vnodes across consumer jobs (one global map ⇒ identical
+            # per job, but stay robust to asymmetric placements)
+            slices: dict[int, set] = {w: set() for w in hosts}
+            for j in js:
+                for w, vns in j["owners"].items():
+                    slices.setdefault(w, set()).update(
+                        int(v) for v in vns
+                    )
+            ch.tables[table] = {
+                "leader": hosts[0],
+                "standby": hosts[1] if len(hosts) > 1 else None,
+                "hosts": hosts,
+                "key_col": key_col,
+                "mode": mode,
+                "n_vnodes": int(n_vnodes),
+                "slices": {w: sorted(v) for w, v in slices.items()},
+            }
+            for j in js:
+                kind = j.get("kinds", {}).get(table, "source")
+                ch.specs.append(ExchangeSpec(
+                    edge=f"{'join' if kind == 'join' else 'src'}:"
+                         f"{table}>{j['name']}",
+                    kind=kind, table=table, key_col=key_col,
+                    mode=mode, job=j["name"],
+                ))
+        # -- attach edges (MV-on-MV over a partitioned upstream) ------
+        for j in jobs:
+            for up, down in j.get("attach_edges", ()):
+                ch.specs.append(ExchangeSpec(
+                    edge=f"attach:{up}>{down}", kind="attach",
+                    table=None, key_col=None, mode="local",
+                    job=j["name"],
+                ))
+        return ch

@@ -1,0 +1,274 @@
+"""ShuffleService: execute the compiled choreography per chunk.
+
+The data-plane half of Exchange-lite (``planner.py`` is the control
+half).  One instance rides each ComputeWorker and does three things:
+
+- **leader slicing** — ``route_batch`` hash-partitions one ingest
+  batch by distribution-key vnode ONCE (numpy, the same
+  ``hash64_columns`` mix as the device state tables) and produces one
+  position-stamped sparse payload per peer: the peer's owned rows
+  plus the batch's full vnode log, so every host always knows which
+  global positions belong to whom even for rows it never stored;
+- **receiver apply** — ``apply_batch`` merges a sparse payload into
+  the local table history (placeholder-padded to GLOBAL positions, so
+  source cursors and round fences stay in the one global domain the
+  PR-7 handover protocol already aligns);
+- **repair slicing** — ``slice_history`` re-cuts any historical range
+  for any vnode set (gap repair at the round fence, gained-vnode
+  backfill after a repartition, standby promotion).
+
+Byte/row/batch counters accumulate per EDGE label and are exported as
+``cluster_exchange_{rows,bytes,batches}_total{edge=...}`` plus a
+per-batch latency histogram — the observability the chaos schedules
+assert on.
+"""
+
+from __future__ import annotations
+
+import base64
+import threading
+import time
+
+import numpy as np
+
+from risingwave_tpu.cluster.exchange.planner import Choreography
+
+
+def pack_vnodes(vns) -> str:
+    """Base64-packed vnode log (one byte per position; rings ≤ 256).
+    A 50k-row batch's log is ONE json string token instead of 50k
+    number tokens — json decode goes from tens of ms to noise."""
+    return base64.b64encode(bytes(int(v) & 0xFF for v in vns)).decode()
+
+
+def unpack_vnodes(payload: dict) -> list[int]:
+    s = payload.get("vn64")
+    if s is not None:
+        return list(base64.b64decode(s))
+    return [int(v) for v in payload.get("vnodes") or ()]
+
+
+def vnodes_of_rows(rows: list, key_col: int, n_vnodes: int) -> list[int]:
+    """Host vnode of each row's key column — numpy end to end (one
+    hash per batch, computed at the ingest leader), bit-identical to
+    the device gate's ``vnodes_of_ints`` because both compute the
+    SAME splitmix mix over the int64 payload (``hash64_i64_host`` is
+    the numpy twin of ``hash64_columns``, equality asserted in
+    tests).  ``None`` keys hash as 0, matching ``split_col``'s zeroed
+    payload on the device path."""
+    from risingwave_tpu.common.hash import hash64_i64_host
+
+    vals = np.asarray(
+        [0 if r[key_col] is None else int(r[key_col]) for r in rows],
+        np.int64,
+    )
+    h = hash64_i64_host(vals)
+    return [int(v) for v in (h % np.uint64(n_vnodes))]
+
+
+class ShuffleService:
+    """Per-worker executor of the exchange choreography."""
+
+    def __init__(self, worker_id=None, metrics=None):
+        self.worker_id = worker_id
+        self.metrics = metrics
+        self.choreography = Choreography()
+        self._lock = threading.Lock()
+        #: per-edge counters (host-side mirror of the metric series)
+        self.rows_out: dict[str, int] = {}
+        self.bytes_out: dict[str, int] = {}
+        self.batches_out: dict[str, int] = {}
+
+    # -- choreography ---------------------------------------------------
+    def update(self, doc: dict | Choreography) -> None:
+        ch = doc if isinstance(doc, Choreography) \
+            else Choreography.from_doc(doc)
+        with self._lock:
+            if ch.version >= self.choreography.version:
+                self.choreography = ch
+
+    def table_plan(self, table: str) -> dict | None:
+        with self._lock:
+            return self.choreography.tables.get(table)
+
+    def shuffled_tables(self) -> dict[str, dict]:
+        with self._lock:
+            return {t: e for t, e in self.choreography.tables.items()
+                    if e["mode"] == "shuffle"}
+
+    def edge_of(self, table: str) -> str:
+        with self._lock:
+            for s in self.choreography.specs:
+                if s.table == table:
+                    return s.edge
+        return f"src:{table}"
+
+    # -- leader slicing -------------------------------------------------
+    def route_batch(self, table: str, seq: int, rows: list
+                    ) -> dict[int, dict]:
+        """Slice one ingest batch per the choreography: returns
+        ``{worker_id: payload}`` for every OTHER host, where payload is
+
+        - shuffle mode: ``{"seq", "end", "items": [[pos, row]...],
+          "vnodes": [...]}`` — the peer's owned slice (plus the
+        leader's own slice for the standby host) and the full
+        position→vnode log of the batch;
+        - replicate mode: ``{"seq", "rows": [...]}`` (the PR-7 wire
+          format, unchanged)."""
+        plan = self.table_plan(table)
+        end = seq + len(rows)
+        out: dict[int, dict] = {}
+        if plan is None:
+            return out
+        if plan["mode"] != "shuffle" or plan["key_col"] is None:
+            for w in plan["hosts"]:
+                if w != self.worker_id:
+                    out[w] = {"seq": seq, "rows": [list(r) for r in rows]}
+            return out
+        vns = vnodes_of_rows(rows, plan["key_col"], plan["n_vnodes"])
+        own_of: dict[int, set] = {w: set(plan["slices"].get(w, ()))
+                                  for w in plan["hosts"]}
+        my = own_of.get(self.worker_id, set())
+        standby = plan.get("standby")
+        for w in plan["hosts"]:
+            if w == self.worker_id:
+                continue
+            want = own_of[w]
+            if w == standby:
+                # the standby also carries the leader's slice: one
+                # surviving copy of every row through a leader death
+                want = want | my
+            # positions are ELIDED from the wire: the receiver derives
+            # them from the (byte-packed) vnode log + the covered-
+            # vnode set — each row crosses once, no per-row position,
+            # and the log is one string token
+            out[w] = {"seq": seq, "end": end,
+                      "vn64": pack_vnodes(vns),
+                      "own": sorted(want),
+                      "rows": [list(rows[i])
+                               for i, v in enumerate(vns)
+                               if v in want]}
+        return out
+
+    @staticmethod
+    def unpack_rows(payload: dict) -> list:
+        """Expand a positions-elided payload into ``(pos, row)``
+        items (the receiver-side inverse of ``route_batch``)."""
+        if "items" in payload:  # explicit-position form (repairs)
+            return [(int(p), tuple(r)) for p, r in payload["items"]]
+        seq = int(payload["seq"])
+        want = {int(v) for v in payload.get("own", ())}
+        rows = payload["rows"]
+        out = []
+        it = iter(rows)
+        for i, v in enumerate(unpack_vnodes(payload)):
+            if v in want:
+                out.append((seq + i, tuple(next(it))))
+        return out
+
+    def slice_history(self, mgr, from_seq: int, to_seq: int | None,
+                      vnodes, table: str) -> dict:
+        """Re-cut a historical range for one vnode set (fence gap
+        repair / gained-vnode backfill).  Positions the local history
+        never stored (holes) are simply absent from ``items`` — the
+        caller peer-fills from other hosts if its own completeness
+        check still fails."""
+        plan = self.table_plan(table)
+        end = mgr.history_len() if to_seq is None \
+            else min(int(to_seq), mgr.history_len())
+        lo = int(from_seq)
+        if plan is None or plan["key_col"] is None:
+            rows = mgr.history_slice(lo, end)
+            return {"seq": lo, "end": end,
+                    "items": [[lo + i, r] for i, r in enumerate(rows)
+                              if r is not None],
+                    "vnodes": mgr.vnode_slice(lo, end)}
+        want = {int(v) for v in vnodes}
+        vns: list[int] = []
+        rows_by_pos: dict[int, tuple] = {}
+        unknown: list[tuple[int, tuple]] = []
+        for pos in range(lo, end):
+            row = mgr.history_row(pos)
+            vn = mgr.vnode_at(pos)
+            if vn is None and row is not None:
+                unknown.append((pos, row))
+            vns.append(-1 if vn is None else int(vn))
+            if row is not None:
+                rows_by_pos[pos] = row
+        if unknown:
+            # one batched hash for every un-stamped position (rows
+            # ingested before the shuffle plan existed)
+            hashed = vnodes_of_rows([r for _, r in unknown],
+                                    plan["key_col"], plan["n_vnodes"])
+            for (pos, _), v in zip(unknown, hashed):
+                vns[pos - lo] = int(v)
+        items = [[pos, list(rows_by_pos[pos])]
+                 for i, pos in enumerate(range(lo, end))
+                 if pos in rows_by_pos and vns[i] in want]
+        return {"seq": lo, "end": end, "items": items, "vnodes": vns}
+
+    # -- receiver -------------------------------------------------------
+    @classmethod
+    def apply_batch(cls, mgr, payload: dict) -> int:
+        """Merge one sparse payload into a table manager (idempotent;
+        fills placeholder holes; refuses gaps like ``insert_at``)."""
+        return mgr.insert_sparse(
+            int(payload["seq"]), int(payload["end"]),
+            cls.unpack_rows(payload),
+            unpack_vnodes(payload),
+        )
+
+    # -- observability --------------------------------------------------
+    @staticmethod
+    def _payload_size(payload: dict) -> int:
+        """Approximate wire bytes without re-serializing (the RPC
+        layer already pays one json.dumps; a second one per send was
+        measurable on the ingest hot path).  Counts ~12 bytes per
+        scalar + framing — close enough for a byte-rate counter."""
+        items = payload.get("items")
+        if items is not None:
+            per_row = 12 * (1 + (len(items[0][1]) if items else 0))
+            return 64 + per_row * len(items) \
+                + 4 * len(payload.get("vnodes", ()))
+        rows = payload.get("rows", ())
+        return 64 + 12 * len(rows) * (len(rows[0]) if rows else 1) \
+            + 4 * len(payload.get("vnodes", ())) \
+            + len(payload.get("vn64", ""))
+
+    def note_send(self, edge: str, payload: dict,
+                  elapsed_s: float) -> None:
+        rows = len(payload.get("items", payload.get("rows", ())))
+        size = self._payload_size(payload)
+        with self._lock:
+            self.rows_out[edge] = self.rows_out.get(edge, 0) + rows
+            self.bytes_out[edge] = self.bytes_out.get(edge, 0) + size
+            self.batches_out[edge] = self.batches_out.get(edge, 0) + 1
+        if self.metrics is not None:
+            self.metrics.inc("cluster_exchange_rows_total", rows,
+                             edge=edge)
+            self.metrics.inc("cluster_exchange_bytes_total", size,
+                             edge=edge)
+            self.metrics.inc("cluster_exchange_batches_total",
+                             edge=edge)
+            self.metrics.observe("cluster_exchange_batch_seconds",
+                                 elapsed_s, edge=edge)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "version": self.choreography.version,
+                "rows_out": dict(self.rows_out),
+                "bytes_out": dict(self.bytes_out),
+                "batches_out": dict(self.batches_out),
+            }
+
+    def timed(self):
+        """Tiny perf_counter context for send timing."""
+        class _T:
+            def __enter__(s):
+                s.t0 = time.perf_counter()
+                return s
+
+            def __exit__(s, *exc):
+                s.dt = time.perf_counter() - s.t0
+        return _T()

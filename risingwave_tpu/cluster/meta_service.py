@@ -159,8 +159,15 @@ class JobInfo:
     #: vnode partitions (scale plane) — None = whole-job placement;
     #: keyed by checkpoint lineage, ONE partition per owning worker
     partitions: "dict[str, PartitionInfo] | None" = None
-    #: DML tables the job's source reads (replicated worker↔worker)
+    #: DML tables the job's source reads (exchanged worker↔worker)
     dml_tables: list = field(default_factory=list)
+    #: Exchange-lite: raw source column each DML table routes by
+    #: (absent/None = untraceable → the table's edge replicates)
+    shuffle_cols: dict = field(default_factory=dict)
+    #: edge taxonomy per table ("source" ingest / "join" side)
+    edge_kinds: dict = field(default_factory=dict)
+    #: MV-on-MV attach edges riding this job: (upstream, downstream)
+    attach_edges: list = field(default_factory=list)
     #: read-routing plan published ATOMICALLY at each cluster commit:
     #: [(worker_id, pinned_epoch, vnodes)] — all entries from the SAME
     #: round, so a fan-out read sees every vnode exactly once even
@@ -225,7 +232,8 @@ class MetaService:
                  retry_max_delay_s: float = 0.5,
                  n_vnodes: int = 64,
                  scale_partitioning: bool = False,
-                 scrub_interval_s: float = 30.0):
+                 scrub_interval_s: float = 30.0,
+                 shuffle_ingest: bool = True):
         from risingwave_tpu.storage.hummock import (
             CompactorService,
             HummockStorage,
@@ -289,6 +297,11 @@ class MetaService:
         #: serializes barrier rounds AND failover reassignment: a job
         #: is never adopted while one of its barrier RPCs is in flight
         self._tick_lock = threading.Lock()
+        #: single-flights _assign_pending: the monitor loop, DDL
+        #: placement, and registration all drive it — two assigners
+        #: interleaving their adopt probes would point a worker's
+        #: checkpoint lineage somewhere the registry never records
+        self._assign_lock = threading.Lock()
         self.workers: dict[int, WorkerInfo] = {}
         #: registered serving replicas (the stateless read tier)
         self.serving: dict[int, ServingReplicaInfo] = {}
@@ -331,6 +344,12 @@ class MetaService:
         #: active worker set (``ctl cluster scale N`` then moves only
         #: vnodes).  Off = whole-job placement (the pre-scale plane).
         self.scale_partitioning = bool(scale_partitioning)
+        #: Exchange-lite sliced ingest (default ON).  Off = the PR-7
+        #: replicate-everything fan-out — kept as the A/B baseline the
+        #: scale_stress throughput gate measures against, and the
+        #: escape hatch if a traced shuffle key misbehaves in the
+        #: field.  Flipping it re-pushes the choreography.
+        self.shuffle_ingest = bool(shuffle_ingest)
         #: vnode → worker_id (None until the first map is cut)
         self.vnode_map: list[int] | None = None
         #: the ACTIVE worker set (capacity follows ``scale N``, not
@@ -394,6 +413,17 @@ class MetaService:
                 }
                 job.dml_tables = list(ev.get("dml_tables", {})
                                       .get(jname, []))
+                job.shuffle_cols = {
+                    t: (int(c) if c is not None else None)
+                    for t, c in (ev.get("shuffle_cols", {})
+                                 .get(jname, {})).items()
+                }
+                job.edge_kinds = dict(ev.get("edge_kinds", {})
+                                      .get(jname, {}))
+                job.attach_edges = [
+                    tuple(e) for e in (ev.get("attach_edges", {})
+                                       .get(jname, []))
+                ]
         rec = self.store.last_cluster_commit()
         if rec is None:
             return
@@ -511,6 +541,14 @@ class MetaService:
         for name in ("cluster_worker_heartbeat_age_seconds",
                      "cluster_worker_vnodes"):
             self.metrics.remove_series(name, worker=str(worker_id))
+        if worker_id in getattr(self, "_exchange_series", set()):
+            self._exchange_series.discard(worker_id)
+            for k in ("rows_out", "rows_in", "batches_out",
+                      "batches_in", "send_failures"):
+                self.metrics.remove_series(
+                    f"cluster_worker_exchange_{k}",
+                    worker=str(worker_id),
+                )
 
     def live_workers(self) -> list[WorkerInfo]:
         with self._lock:
@@ -999,19 +1037,18 @@ class MetaService:
 
         for mv, jname in self._mv_to_job.items():
             if re.search(rf"\b{re.escape(mv)}\b", text):
-                job = self.jobs[jname]
-                if job.partitions:
-                    raise ValueError(
-                        f"MV-on-MV over partitioned job {jname!r}: "
-                        "next round (attach would need a cross-"
-                        "partition exchange)"
-                    )
-                return job
+                # partitioned upstreams attach too (Exchange-lite):
+                # every partition worker adopts the same delta; the
+                # engine validates the attach-edge exchange is the
+                # identity choreography and refuses reduced-key shapes
+                return self.jobs[jname]
         return None
 
     def _place_job(self, text: str, name: str,
                    replay: bool = False,
                    upstream_mv: str | None = None) -> None:
+        import re
+
         if name in self._mv_to_job:
             raise ValueError(f"{name!r} already exists")
         if upstream_mv is not None:
@@ -1039,6 +1076,51 @@ class MetaService:
             # the new statement; the worker attaches it to the live job
             sent = len(upstream.ddl) - len(upstream.mvs)
             delta = self.prelude[sent:] + [text]
+            if upstream.partitions:
+                # partitioned upstream: EVERY partition worker attaches
+                # the same chain (the engine's _plan_partition_attach
+                # proves the attach edge needs no cross-partition row
+                # movement).  Probe the FIRST partition before
+                # mutating any meta state — a refused plan must leave
+                # the catalog (and the durable log position) untouched
+                with self._lock:
+                    ws = [self.workers[p.worker_id]
+                          for p in upstream.partitions.values()
+                          if p.worker_id is not None
+                          and not p.retiring]
+                if not replay:
+                    if not ws:
+                        raise ValueError(
+                            f"MV-on-MV over {upstream.name!r}: no "
+                            "live partition worker to attach on"
+                        )
+                    self.retry.run(
+                        lambda: ws[0].client.call(
+                            "adopt", ddl=delta, name=upstream.name,
+                            recover=False),
+                        label="adopt",
+                    )
+                    for w in ws[1:]:
+                        self.retry.run(
+                            lambda w=w: w.client.call(
+                                "adopt", ddl=delta,
+                                name=upstream.name, recover=False),
+                            label="adopt",
+                        )
+                upstream.ddl.extend(delta)
+                upstream.mvs.append(name)
+                with self._lock:
+                    self._mv_to_job[name] = upstream.name
+                    up_mv = next(
+                        (m for m in self._mv_to_job
+                         if m != name and re.search(
+                             rf"\b{re.escape(m)}\b", text)
+                         and self._mv_to_job[m] == upstream.name),
+                        upstream.name,
+                    )
+                    upstream.attach_edges.append((up_mv, name))
+                self._push_routing()
+                return
             upstream.ddl.extend(delta)
             upstream.mvs.append(name)
             with self._lock:
@@ -1211,7 +1293,14 @@ class MetaService:
         meta restart — state AND vnode ownership follow the lineage),
         fresh jobs take partitioned placement over the vnode map when
         the scale plane is on and the plan is eligible, and everything
-        else lands whole on the least-loaded live worker."""
+        else lands whole on the least-loaded live worker.  ONE
+        assigner at a time: concurrent assigners (monitor + DDL path)
+        would interleave adopt probes and desynchronize worker-side
+        checkpoint lineages from the registry."""
+        with self._assign_lock:
+            self._assign_pending_locked()
+
+    def _assign_pending_locked(self) -> None:
         while True:
             with self._lock:
                 live = [w for w in self.workers.values() if w.alive]
@@ -1319,6 +1408,13 @@ class MetaService:
                     self.vnode_map[v] = target.worker_id
             if res.get("dml_tables"):
                 job.dml_tables = list(res["dml_tables"])
+            if res.get("shuffle_cols"):
+                job.shuffle_cols = {
+                    t: (int(c) if c is not None else None)
+                    for t, c in res["shuffle_cols"].items()
+                }
+            if res.get("edge_kinds"):
+                job.edge_kinds = dict(res["edge_kinds"])
             self._rewind_job(p, int(res.get("committed_epoch", 0)))
         self._push_routing()
         self._set_vnode_gauges()
@@ -1409,6 +1505,8 @@ class MetaService:
         )
 
         with self._lock:
+            if job.partitions is not None or job.worker_id is not None:
+                return True  # raced with another assigner
             live = {w.worker_id: w for w in self.workers.values()
                     if w.alive}
             if not live:
@@ -1448,6 +1546,8 @@ class MetaService:
                 first_w.jobs.add(job.name)
             return True
         with self._lock:
+            if job.partitions is not None:
+                return True  # raced: the other assigner's layout wins
             job.partitions = {
                 first_lineage: PartitionInfo(
                     lineage=first_lineage, worker_id=first_wid,
@@ -1455,6 +1555,11 @@ class MetaService:
                 )
             }
             job.dml_tables = list(res.get("dml_tables") or [])
+            job.shuffle_cols = {
+                t: (int(c) if c is not None else None)
+                for t, c in (res.get("shuffle_cols") or {}).items()
+            }
+            job.edge_kinds = dict(res.get("edge_kinds") or {})
             first_w.jobs.add(job.name)
         for wid, lineage, vns in placements[1:]:
             w = live[wid]
@@ -1755,20 +1860,38 @@ class MetaService:
                     j.name: list(j.dml_tables)
                     for j in self.jobs.values() if j.partitions
                 },
+                "shuffle_cols": {
+                    j.name: dict(j.shuffle_cols)
+                    for j in self.jobs.values() if j.partitions
+                },
+                "edge_kinds": {
+                    j.name: dict(j.edge_kinds)
+                    for j in self.jobs.values() if j.partitions
+                },
+                "attach_edges": {
+                    j.name: [list(e) for e in j.attach_edges]
+                    for j in self.jobs.values() if j.partitions
+                },
             }
         self.store.append_scale_event(ev)
 
     def _push_routing(self) -> None:
         """Push the placement choreography to every live worker: peer
-        addresses + per-replicated-table hosts and ingest leader.  The
-        per-chunk exchange then flows worker↔worker — the meta's only
-        involvement with the data path is this control push."""
+        addresses, per-replicated-table hosts + ingest leader, AND the
+        compiled Exchange-lite choreography (per-table shuffle key,
+        vnode slices, standby, edge specs).  The per-chunk exchange
+        then flows worker↔worker — the meta's only involvement with
+        the data path is this control push (compile once, execute
+        forever: the Suki discipline)."""
+        from risingwave_tpu.cluster.exchange import ExchangePlanner
+
         with self._lock:
             self._routing_version += 1
             version = self._routing_version
             peers = {w.worker_id: [w.host, w.port]
                      for w in self.workers.values() if w.alive}
             tables: dict[str, dict] = {}
+            plan_jobs: list[dict] = []
             for j in self.jobs.values():
                 if not j.partitions:
                     continue
@@ -1783,11 +1906,32 @@ class MetaService:
                     )
                     cur["hosts"] = sorted(set(cur["hosts"]) | set(hosts))
                     cur["leader"] = min(cur["hosts"])
+                owners: dict[int, list] = {}
+                for p in j.partitions.values():
+                    if p.worker_id is not None and not p.retiring:
+                        owners.setdefault(p.worker_id, [])
+                        owners[p.worker_id] = sorted(
+                            set(owners[p.worker_id]) | set(p.vnodes)
+                        )
+                plan_jobs.append({
+                    "name": j.name,
+                    "dml_tables": list(j.dml_tables),
+                    "shuffle_cols": dict(j.shuffle_cols)
+                    if self.shuffle_ingest else {},
+                    "kinds": dict(j.edge_kinds),
+                    "attach_edges": list(j.attach_edges),
+                    "owners": owners,
+                })
             targets = [w for w in self.workers.values() if w.alive]
+        choreo = ExchangePlanner.compile(
+            plan_jobs, self.n_vnodes, version=version
+        ).to_doc()
+        self._choreography = choreo
         for w in targets:
             try:
                 w.client.call("update_routing", version=version,
-                              peers=peers, tables=tables)
+                              peers=peers, tables=tables,
+                              exchange=choreo)
             except (RpcError, ConnectionError, OSError):
                 pass  # it pulls fresh routing at re-registration
 
@@ -1905,6 +2049,7 @@ class MetaService:
         fences = self._round_fences(jobs)
         self._fence_cache.update(fences)
         sealed = 0
+        by_worker: dict[int, list] = {}
         for job, unit in units:
             if unit.rounds >= target:
                 sealed += 1
@@ -1918,6 +2063,11 @@ class MetaService:
                       if t in fences} if job.partitions else None
             if job.partitions and job.dml_tables and not limits:
                 continue  # fence unavailable: stall, never diverge
+            by_worker.setdefault(w.worker_id, []).append(
+                (job, unit, w, limits)
+            )
+
+        def _barrier_one(job, unit, w, limits) -> bool:
             try:
                 # round-tagged: the worker caches each job's last
                 # (round, seal) and answers a replay from the
@@ -1932,13 +2082,15 @@ class MetaService:
                     label="barrier",
                 )
             except (RpcError, ConnectionError, OSError):
-                continue  # monitor expires the worker; round stalls
+                return False  # monitor expires the worker; stall
             epoch = int(res.get("sealed_epoch",
                                 res["committed_epoch"]))
             ssts = res.get("ssts") or []
             if res.get("corrupt"):
                 with self._lock:
                     self._corrupt_reports.extend(res["corrupt"])
+            self._mirror_exchange_gauges(w.worker_id,
+                                         res.get("exchange"))
             with self._lock:
                 unit.rounds = target
                 unit.seal_log.append((target, epoch))
@@ -1955,7 +2107,37 @@ class MetaService:
                     w.sst_keys.difference_update(
                         {s["key"] for s in ssts}
                     )
-            sealed += 1
+            return True
+
+        # barrier RPCs fan out PER WORKER (units on one worker stay
+        # serial — its engine lock serializes anyway; units on
+        # DIFFERENT workers run their chunks concurrently).  This is
+        # what lets a shuffled round's wall time track the SLOWEST
+        # partition instead of the SUM of partitions — the other half
+        # of "ingest throughput tracks worker count".  Checkpoint
+        # uploads stay safe: each partition writes its own lineage
+        # keys, export SSTs ride meta-allocated collision-free keys,
+        # and this thread alone commits the manifest afterwards.
+        groups = list(by_worker.values())
+        if len(groups) == 1:
+            sealed += sum(_barrier_one(*item) for item in groups[0])
+        elif groups:
+            results: list[int] = [0] * len(groups)
+
+            def _run_group(gi: int, items) -> None:
+                results[gi] = sum(_barrier_one(*item)
+                                  for item in items)
+
+            threads = [
+                threading.Thread(target=_run_group, args=(gi, items),
+                                 name=f"meta-barrier-w{gi}")
+                for gi, items in enumerate(groups)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            sealed += sum(results)
         committed = sealed == len(units) \
             and self._await_durable(units, target)
         if committed:
@@ -1970,10 +2152,56 @@ class MetaService:
                 "sealed": sealed,
                 "cluster_epoch": self.cluster_epoch}
 
+    def _mirror_exchange_gauges(self, worker_id: int,
+                                ex: "dict | None") -> None:
+        """Mirror a worker's exchange counters as per-worker gauges
+        (cheap piggyback on the barrier response).  Tracked so
+        ``_remove_worker_series`` retires them with the worker —
+        exactly the PR-7/PR-10 per-peer gauge discipline."""
+        if not ex:
+            return
+        if not hasattr(self, "_exchange_series"):
+            self._exchange_series = set()
+        for k in ("rows_out", "rows_in", "batches_out",
+                  "batches_in", "send_failures"):
+            self.metrics.set_gauge(
+                f"cluster_worker_exchange_{k}",
+                int(ex.get(k, 0)), worker=str(worker_id),
+            )
+        self._exchange_series.add(worker_id)
+
     def _await_durable(self, units, target: int) -> bool:
         """The seal-vs-ack split: poll each sealed unit's worker until
         its durable (upload-acked) epoch reaches the round's seal, or
-        the bounded wait expires (round retried by the next tick)."""
+        the bounded wait expires (round retried by the next tick).
+        Workers poll in PARALLEL (their uploads already run in
+        parallel background threads) — the wait is bounded by the
+        slowest worker, not the sum."""
+        by_worker: dict = {}
+        for job, unit in units:
+            by_worker.setdefault(unit.worker_id, []).append(
+                (job, unit)
+            )
+        if len(by_worker) <= 1:
+            return self._await_durable_units(units, target)
+        results: list[bool] = [False] * len(by_worker)
+        groups = list(by_worker.values())
+
+        def _run(gi: int, items) -> None:
+            results[gi] = self._await_durable_units(items, target)
+
+        threads = [
+            threading.Thread(target=_run, args=(gi, items),
+                             name=f"meta-durable-{gi}")
+            for gi, items in enumerate(groups)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return all(results)
+
+    def _await_durable_units(self, units, target: int) -> bool:
         deadline = time.monotonic() + self.durable_wait_s
         for job, unit in units:
             with self._lock:
@@ -2462,6 +2690,22 @@ class MetaService:
                         self.scrubber.objects_verified,
                     "scrub_corruptions": self.scrubber.corruptions,
                     "repairs": dict(self.repairs),
+                },
+                "exchange": {
+                    "version": (self._choreography or {}).get(
+                        "version", 0
+                    ) if hasattr(self, "_choreography") else 0,
+                    "tables": {
+                        t: {"leader": e["leader"],
+                            "standby": e.get("standby"),
+                            "mode": e["mode"],
+                            "key_col": e.get("key_col")}
+                        for t, e in ((self._choreography or {})
+                                     .get("tables", {})).items()
+                    } if hasattr(self, "_choreography") else {},
+                    "specs": list((self._choreography or {})
+                                  .get("specs", []))
+                    if hasattr(self, "_choreography") else [],
                 },
                 "scale": {
                     "partitioning": self.scale_partitioning,

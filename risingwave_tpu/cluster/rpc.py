@@ -225,13 +225,28 @@ class RpcClient:
     def __init__(self, host: str, port: int, timeout: float = 120.0,
                  src: str = "", dst: str = "", pool: int = 1):
         self.host = host
-        self.port = port
         self.timeout = timeout
         self.src = src or "client"
         self.dst = dst or f"{host}:{port}"
         self._chans = [_RpcChannel(host, port, timeout)
                        for _ in range(max(1, int(pool)))]
         self._rr = 0
+        self._port = port
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    @port.setter
+    def port(self, value: int) -> None:
+        """Re-point the client (tests move a client to a restarted
+        peer's fresh port): every pooled channel reconnects lazily at
+        the new address."""
+        self._port = int(value)
+        for ch in self._chans:
+            with ch.lock:
+                ch.close()
+                ch.port = self._port
 
     def _acquire(self) -> _RpcChannel:
         """A free channel if any lock is immediately available, else

@@ -39,27 +39,52 @@ from risingwave_tpu.stream.executor import Executor
 
 
 class VnodeGateExecutor(Executor):
-    """Mask rows to the partition's owned vnodes (state = the mask)."""
+    """Mask rows to the partition's owned vnodes (state = the mask).
+
+    Exchange-lite makes this gate the correctness ASSERT of the
+    shuffled ingest path, not its workhorse: sliced delivery + the
+    reader-side vnode filter mean every row reaching the gate is
+    already owned, and the gate's second state leaf — a device
+    ``dropped`` counter — proves it (``scale_stress --assert`` and the
+    shuffle chaos schedules require it to stay ZERO on shuffled
+    edges).  On replicate-mode edges the gate still filters, exactly
+    the PR-7 behavior.
+    """
 
     emits_on_apply = True
     emits_on_flush = False
 
-    def __init__(self, in_schema: Schema, key_expr: Expr,
+    def __init__(self, in_schema: Schema, key_expr,
                  n_vnodes: int):
         super().__init__(in_schema)
-        self.key_expr = key_expr
+        # one routing key (the agg distribution key) or several (a
+        # join side routes by its first equi key; the list form keeps
+        # the door open for composite routing) — vnode = hash of the
+        # FIRST expr, matching the host-side shuffle slicing
+        exprs = key_expr if isinstance(key_expr, (list, tuple)) \
+            else [key_expr]
+        self.key_exprs: tuple[Expr, ...] = tuple(exprs)
+        self.key_expr = self.key_exprs[0]
         self.n_vnodes = n_vnodes
 
     def init_state(self):
         # owns everything until the control plane narrows it — a
-        # single-partition job behaves exactly like an unpartitioned one
-        return jnp.ones((self.n_vnodes,), jnp.bool_)
+        # single-partition job behaves exactly like an unpartitioned
+        # one.  State = (membership mask, dropped-row audit counter).
+        return (jnp.ones((self.n_vnodes,), jnp.bool_),
+                jnp.zeros((), jnp.int64))
 
     def make_mask(self, vnodes):
         """Device membership mask for ``set_job_vnodes`` state swaps."""
         return vnode_member_mask(vnodes, self.n_vnodes)
 
-    def apply(self, mask, chunk: Chunk):
+    def apply(self, state, chunk: Chunk):
+        # dual-form state: a bare mask (legacy callers/tests) or the
+        # (mask, dropped) pair the partitioned runtime threads
+        if isinstance(state, tuple):
+            mask, dropped = state
+        else:
+            mask, dropped = state, None
         key, null = split_col(self.key_expr.eval(chunk))
         vn = vnodes_of_ints(key, self.n_vnodes)
         keep = mask[vn] & chunk.valid
@@ -80,7 +105,13 @@ class VnodeGateExecutor(Executor):
                         OP_DELETE, ops)
         ops = jnp.where(is_ui & keep & ~partner_keep_for_ui,
                         OP_INSERT, ops)
-        return mask, Chunk(chunk.columns, ops, keep, chunk.schema)
+        out = Chunk(chunk.columns, ops, keep, chunk.schema)
+        if dropped is None:
+            return mask, out
+        dropped = dropped + jnp.sum(
+            (chunk.valid & ~keep).astype(jnp.int64)
+        )
+        return (mask, dropped), out
 
     def __repr__(self) -> str:
         return f"VnodeGateExecutor(n={self.n_vnodes})"

@@ -27,6 +27,16 @@ snapshot / emitted / dirty / minput buckets — everything slot-aligned)
 and ``MaterializeExecutor`` (pk table + dense value columns).  The
 engine's eligibility gate guarantees no DISTINCT dedup tables and an
 empty spill ring; both are asserted loudly here anyway.
+
+Exchange-lite (round 14) extends the same contract to partitioned
+JOIN jobs and MV-on-MV DAGs: ``partition_sites`` walks a ``DagJob``'s
+node tree and yields every sliceable state (aggs, materializes, and
+dense hash-join *sides* — key table + [size, B] row buckets + per-key
+degree counters, moved as whole key entries so the bucket layout, and
+therefore the emission order, is preserved bit-for-bit).  Every keyed
+state's LEADING key lives in the same ``hash64`` vnode domain as the
+routing key — the engine's partition eligibility enforces that at
+adoption, which is what lets one vnode set slice the whole tree.
 """
 
 from __future__ import annotations
@@ -174,6 +184,203 @@ def clear_vnodes(executors, states, vnodes, n_vnodes: int):
                 st.table.clear_where(stale), st.values, st.overflow
             )
     return tuple(new_states), cleared
+
+
+# -- DagJob partitions: joins + MV-on-MV trees --------------------------
+def partition_sites(job) -> list[tuple]:
+    """Every sliceable keyed state of a partitioned job as
+    ``(path, kind, executor)``: path indexes the (possibly nested)
+    state tree — ``(i,)`` for a linear StreamingJob executor,
+    ``(node, exec)`` for a DagJob fragment executor, ``(node,)`` for a
+    JoinNode."""
+    from risingwave_tpu.stream.dag import DagJob, JoinNode
+
+    sites: list[tuple] = []
+    if not isinstance(job, DagJob):
+        for i, ex in enumerate(job.fragment.executors):
+            if isinstance(ex, (HashAggExecutor, MaterializeExecutor)):
+                sites.append(((i,), "agg" if isinstance(
+                    ex, HashAggExecutor) else "mv", ex))
+        return sites
+    for ni, node in enumerate(job.nodes):
+        if node is None:
+            continue
+        if isinstance(node, JoinNode):
+            sites.append(((ni,), "join", node.join))
+            continue
+        for ei, ex in enumerate(node.fragment.executors):
+            if isinstance(ex, HashAggExecutor):
+                sites.append(((ni, ei), "agg", ex))
+            elif isinstance(ex, MaterializeExecutor):
+                sites.append(((ni, ei), "mv", ex))
+    return sites
+
+
+def _tree_get(states, path):
+    st = states
+    for i in path:
+        st = st[i]
+    return st
+
+
+def _tree_set(states, path, value):
+    if not path:
+        return value
+    lst = list(states)
+    lst[path[0]] = _tree_set(states[path[0]], path[1:], value)
+    return tuple(lst)
+
+
+def _scatter_bucket(store, slots, vals):
+    """Write whole [n, B] bucket rows at entry ``slots`` (NCol/StrCol
+    aware — the inverse of ``hash_join._gather_bucket``)."""
+    if isinstance(vals, NCol):
+        return NCol(_scatter_bucket(store.data, slots, vals.data),
+                    store.null.at[slots].set(vals.null, mode="drop"))
+    if isinstance(vals, StrCol):
+        return StrCol(store.data.at[slots].set(vals.data, mode="drop"),
+                      store.lens.at[slots].set(vals.lens, mode="drop"))
+    return store.at[slots].set(jnp.asarray(vals), mode="drop")
+
+
+def _gather_host_bucket(store, idx):
+    """[size, B, ...] host-gathered at idx -> [n, B, ...]."""
+    if isinstance(store, NCol):
+        return NCol(_gather_host_bucket(store.data, idx),
+                    np.asarray(store.null)[idx])
+    if isinstance(store, StrCol):
+        return StrCol(np.asarray(store.data)[idx],
+                      np.asarray(store.lens)[idx])
+    return np.asarray(store)[idx]
+
+
+def _assert_dense_join(join, st) -> None:
+    from risingwave_tpu.stream.hash_join import SideState
+
+    for side_name in ("left", "right"):
+        side = getattr(st, side_name)
+        if not isinstance(side, SideState):
+            raise RuntimeError(
+                "vnode handover over a pool-storage join side "
+                "(append-only pools are not sliceable): not "
+                "scale-eligible"
+            )
+
+
+def _slice_join_side(side, vnodes, n_vnodes: int) -> dict:
+    """Extract whole key entries (key + bucket rows + degree) whose
+    FIRST join-key column's vnode moved."""
+    take = _entry_mask(side.key_table, vnodes, n_vnodes)
+    idx = np.nonzero(take)[0]
+    return {
+        "n": int(idx.shape[0]),
+        "keys": [gather_key(c if isinstance(c, (NCol, StrCol))
+                            else np.asarray(c), idx)
+                 for c in side.key_table.key_cols],
+        "rows": [_gather_host_bucket(r, idx) for r in side.rows],
+        "occupied": np.asarray(side.occupied)[idx],
+        "count": np.asarray(side.count)[idx],
+    }
+
+
+def _clear_join_side(side, member, n_vnodes: int):
+    vn = vnodes_of_ints(_dist_payload(side.key_table.key_cols[0]),
+                        n_vnodes)
+    stale = side.key_table.occupied & member[vn]
+    cleared = int(jnp.sum(stale))
+    return side._replace(
+        key_table=side.key_table.clear_where(stale),
+        occupied=side.occupied & ~stale[:, None],
+        count=jnp.where(stale, 0, side.count),
+    ), cleared
+
+
+def _transplant_join_side(side, sl: dict):
+    n = sl["n"]
+    if n == 0:
+        return side, 0
+    keys = [_to_dev(c) for c in sl["keys"]]
+    valid = jnp.ones((n,), jnp.bool_)
+    table, slots, _, overflow = side.key_table.lookup_or_insert(
+        keys, valid
+    )
+    if bool(jnp.any(overflow & valid)):
+        raise RuntimeError(
+            f"vnode transplant overflowed a join key table ({n} "
+            "entries) — increase table capacity"
+        )
+    return side._replace(
+        key_table=table,
+        rows=tuple(
+            _scatter_bucket(store, slots, _to_dev(col))
+            for store, col in zip(side.rows, sl["rows"])
+        ),
+        occupied=side.occupied.at[slots].set(
+            _to_dev(sl["occupied"]), mode="drop"),
+        count=side.count.at[slots].set(
+            _to_dev(sl["count"]), mode="drop"),
+    ), n
+
+
+def slice_job_states(job, states, vnodes, n_vnodes: int) -> dict:
+    """``slice_partition_states`` generalized over a partitioned job's
+    (possibly nested) state tree; keys are state PATHS."""
+    out: dict[tuple, dict] = {}
+    for path, kind, ex in partition_sites(job):
+        st = _tree_get(states, path)
+        if kind == "join":
+            _assert_dense_join(ex, st)
+            left = _slice_join_side(st.left, vnodes, n_vnodes)
+            right = _slice_join_side(st.right, vnodes, n_vnodes)
+            out[path] = {"kind": "join", "left": left, "right": right,
+                         "n": left["n"] + right["n"]}
+        else:
+            sl = slice_partition_states([ex], (st,), vnodes, n_vnodes)
+            out[path] = sl[0]
+    return out
+
+
+def clear_job_vnodes(job, states, vnodes, n_vnodes: int):
+    """``clear_vnodes`` over a partitioned job's state tree."""
+    member = vnode_member_mask(vnodes, n_vnodes)
+    cleared = 0
+    for path, kind, ex in partition_sites(job):
+        st = _tree_get(states, path)
+        if kind == "join":
+            _assert_dense_join(ex, st)
+            left, c1 = _clear_join_side(st.left, member, n_vnodes)
+            right, c2 = _clear_join_side(st.right, member, n_vnodes)
+            states = _tree_set(states, path,
+                               st._replace(left=left, right=right))
+            cleared += c1 + c2
+        else:
+            new, c = clear_vnodes([ex], (st,), vnodes, n_vnodes)
+            states = _tree_set(states, path, new[0])
+            cleared += c
+    return states, cleared
+
+
+def transplant_job(job, states, slices: dict):
+    """``transplant`` over a partitioned job's state tree (slices
+    keyed by state path, as produced by ``slice_job_states``)."""
+    sites = {path: (kind, ex) for path, kind, ex in
+             partition_sites(job)}
+    moved = 0
+    for path, sl in slices.items():
+        path = tuple(path)
+        kind, ex = sites[path]
+        st = _tree_get(states, path)
+        if sl.get("kind") == "join":
+            left, n1 = _transplant_join_side(st.left, sl["left"])
+            right, n2 = _transplant_join_side(st.right, sl["right"])
+            states = _tree_set(states, path,
+                               st._replace(left=left, right=right))
+            moved += n1 + n2
+        else:
+            new, n = transplant([ex], (st,), {0: sl})
+            states = _tree_set(states, path, new[0])
+            moved += n
+    return states, moved
 
 
 # -- transplant (moved entries → recipient live state) ------------------

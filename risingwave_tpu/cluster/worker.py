@@ -100,6 +100,15 @@ class ComputeWorker:
         self.exchange_batches_in = 0
         self.exchange_fetches = 0
         self.exchange_send_failures = 0
+        # -- Exchange-lite: the compiled shuffle choreography ----------
+        #: executes the meta-compiled choreography: slices each ingest
+        #: batch by vnode ONCE and ships each peer only its owned
+        #: slice (plus the leader's slice to the standby); per-edge
+        #: rows/bytes/batches counters + latency histogram land in the
+        #: engine's metrics registry
+        from risingwave_tpu.cluster.exchange import ShuffleService
+
+        self.shuffle = ShuffleService(metrics=self.engine.metrics)
 
     @property
     def port(self) -> int:
@@ -151,6 +160,7 @@ class ComputeWorker:
         )
         self.worker_id = int(res["worker_id"])
         self._meta_client.src = f"worker{self.worker_id}"
+        self.shuffle.worker_id = self.worker_id
         self.registrations += 1
 
     def _heartbeat_loop(self) -> None:
@@ -203,10 +213,13 @@ class ComputeWorker:
 
     # -- worker↔worker exchange (scale plane data path) -----------------
     def rpc_update_routing(self, version: int, peers: dict,
-                           tables: dict) -> dict:
-        """Meta-pushed placement choreography: peer worker addresses
-        and, per replicated DML table, its hosts + ingest leader.  The
-        per-chunk fan-out below never touches the meta again."""
+                           tables: dict,
+                           exchange: dict | None = None) -> dict:
+        """Meta-pushed placement choreography: peer worker addresses,
+        per replicated DML table its hosts + ingest leader, and (when
+        the exchange plane is compiled) the full Exchange-lite
+        choreography — per-table shuffle key, vnode slices, standby.
+        The per-chunk fan-out below never touches the meta again."""
         with self._routing_lock:
             if int(version) >= self._routing["version"]:
                 self._routing = {
@@ -222,6 +235,12 @@ class ComputeWorker:
                 for wid in [w for w in self._peers
                             if w not in self._routing["peers"]]:
                     self._peers.pop(wid).close()
+        if exchange is not None:
+            self.shuffle.update(exchange)
+            with self._lock:
+                self.engine.apply_shuffle_plan(
+                    self.shuffle.choreography.tables
+                )
         return {"ok": True}
 
     def _peer(self, wid: int) -> RpcClient:
@@ -275,19 +294,43 @@ class ComputeWorker:
             seq = mgr.history_len()
             self.engine.execute(sql)
             rows = mgr.history_slice(seq)
-        # fan out OUTSIDE the engine lock (peers may be forwarding to
-        # us concurrently); a dropped delivery self-heals at the next
-        # barrier's catch-up fetch
-        for wid in route["hosts"]:
-            if wid == self.worker_id:
-                continue
+        # Exchange-lite: slice the batch by vnode ONCE, ship each peer
+        # only its owned slice (standby additionally carries the
+        # leader's slice); replicate-mode tables keep the PR-7 full
+        # fan-out.  All OUTSIDE the engine lock (peers may be
+        # forwarding to us concurrently); a dropped delivery
+        # self-heals at the next barrier's fence repair.
+        payloads = self.shuffle.route_batch(table, seq, rows)
+        if not payloads:
+            # choreography not yet pushed (registration race): the
+            # legacy full fan-out keeps every host convergent
+            payloads = {w: {"seq": seq, "rows": rows}
+                        for w in route["hosts"] if w != self.worker_id}
+        sliced = any("end" in p for p in payloads.values())
+        if sliced:
+            # stamp the leader's own vnode log (receivers get theirs
+            # from the payload): every host can audit ownership
+            from risingwave_tpu.cluster.exchange.shuffle import (
+                unpack_vnodes,
+            )
+
+            first = next(iter(payloads.values()))
+            with self._lock:
+                mgr.set_vnode_range(seq, unpack_vnodes(first))
+        edge = self.shuffle.edge_of(table)
+        for wid, payload in payloads.items():
+            method = "exchange_sparse" if "end" in payload \
+                else "exchange"
+            n_rows = len(payload.get("rows", ()))
             try:
-                self.retry.run(
-                    lambda w=wid: self._peer(w).call(
-                        "exchange", table=table, seq=seq, rows=rows),
-                    label="exchange",
-                )
-                self.exchange_rows_out += len(rows)
+                with self.shuffle.timed() as t:
+                    self.retry.run(
+                        lambda w=wid, p=payload, m=method:
+                        self._peer(w).call(m, table=table, **p),
+                        label="exchange",
+                    )
+                self.shuffle.note_send(edge, payload, t.dt)
+                self.exchange_rows_out += n_rows
                 self.exchange_batches_out += 1
             except (RpcError, ConnectionError, OSError, KeyError):
                 self.exchange_send_failures += 1
@@ -310,6 +353,38 @@ class ComputeWorker:
         self.exchange_batches_in += 1
         return {"ok": True, "applied": applied}
 
+    def rpc_exchange_sparse(self, table: str, seq: int, end: int,
+                            vnodes: list | None = None,
+                            vn64: str | None = None,
+                            rows: list | None = None,
+                            own: list | None = None,
+                            items: list | None = None) -> dict:
+        """Receive one SLICED position-stamped batch (Exchange-lite):
+        this host's owned rows (positions derived from the batch's
+        vnode log + the covered-vnode set — rows cross the wire once,
+        without per-row positions), placeholders elsewhere.
+        Idempotent; placeholder holes fill on redelivery; a batch
+        beyond the local tail is refused (fence repair fills the gap
+        from the leader)."""
+        from risingwave_tpu.cluster.exchange import ShuffleService
+
+        payload = {"seq": int(seq), "end": int(end),
+                   "vnodes": vnodes or (), "rows": rows or (),
+                   "own": own or ()}
+        if vn64 is not None:
+            payload["vn64"] = vn64
+        if items is not None:
+            payload["items"] = items
+        with self._lock:
+            mgr = self._dml_manager(table)
+            try:
+                applied = ShuffleService.apply_batch(mgr, payload)
+            except ValueError:
+                return {"ok": False, "have": mgr.history_len()}
+        self.exchange_rows_in += applied
+        self.exchange_batches_in += 1
+        return {"ok": True, "applied": applied}
+
     def rpc_fetch_table(self, table: str, from_seq: int = 0) -> dict:
         """Peer catch-up: the table's history from a position (the
         handover/new-host backfill and the gap repair path)."""
@@ -318,37 +393,168 @@ class ComputeWorker:
             return {"seq": int(from_seq),
                     "rows": mgr.history_slice(int(from_seq))}
 
+    def rpc_fetch_slice(self, table: str, from_seq: int = 0,
+                        to_seq: int | None = None,
+                        vnodes: list | None = None) -> dict:
+        """Sliced peer catch-up: one vnode set's rows over a history
+        range, plus the vnode log (gap repair on the shuffled path and
+        gained-vnode backfill after a repartition).  Positions this
+        host never stored are absent — the caller peer-fills."""
+        with self._lock:
+            mgr = self._dml_manager(table)
+            return self.shuffle.slice_history(
+                mgr, int(from_seq), to_seq, vnodes or (), table
+            )
+
+    def rpc_fetch_positions(self, table: str, positions: list) -> dict:
+        """Point catch-up: specific global positions this host holds
+        (the peer-fill path when the leader itself has holes — e.g. a
+        standby promoted past a dead leader)."""
+        with self._lock:
+            mgr = self._dml_manager(table)
+            items = []
+            for p in positions:
+                row = mgr.history_row(int(p))
+                if row is not None:
+                    items.append([int(p), list(row)])
+            return {"items": items}
+
     def rpc_table_len(self, table: str) -> dict:
         with self._lock:
             return {"len": self._dml_manager(table).history_len()}
 
+    def _owned_vnodes_for(self, table: str) -> "set[int] | None":
+        """Union of this worker's owned vnodes across partitioned jobs
+        reading a SHUFFLED table (None = table not shuffled here)."""
+        plan = self.shuffle.table_plan(table)
+        if plan is None or plan["mode"] != "shuffle":
+            return None
+        own: set[int] = set()
+        with self._lock:
+            for job in self.engine.jobs:
+                if getattr(job, "n_vnodes", None) is None:
+                    continue
+                if table in getattr(job, "shuffle_cols", {}):
+                    own |= {int(v) for v in job.vnodes}
+        # the standby audits the leader's slice too (it must hold a
+        # full copy so a promoted standby can serve every fetch)
+        if plan.get("standby") == self.worker_id \
+                and plan["leader"] in plan["slices"]:
+            own |= {int(v) for v in plan["slices"][plan["leader"]]}
+        return own
+
+    def _peer_fill(self, table: str, positions: list[int]) -> int:
+        """Fill specific missing positions from any live peer (double-
+        failure repair: the leader died and its successor has holes)."""
+        filled = 0
+        with self._routing_lock:
+            peer_ids = [w for w in self._routing["peers"]
+                        if w != self.worker_id]
+        for wid in peer_ids:
+            if not positions:
+                break
+            try:
+                res = self._peer(wid).call(
+                    "fetch_positions", table=table,
+                    positions=positions,
+                )
+            except (RpcError, ConnectionError, OSError, KeyError):
+                continue
+            got = {int(p): tuple(r) for p, r in res["items"]}
+            if not got:
+                continue
+            with self._lock:
+                mgr = self._dml_manager(table)
+                for p, r in got.items():
+                    filled += mgr.insert_sparse(
+                        p, p + 1, [(p, r)], []
+                    )
+            positions = [p for p in positions if p not in got]
+        self.exchange_rows_in += filled
+        return filled
+
     def _ensure_table_len(self, table: str, want: int) -> None:
         """Catch the local replica up to the round's consumption fence
-        before the barrier runs — exchange drops (chaos) repair here."""
-        with self._lock:
-            have = self._dml_manager(table).history_len()
-        if have >= want:
-            return
-        route = self._table_route(table)
-        if route is None or route["leader"] == self.worker_id:
-            raise RuntimeError(
-                f"{table!r} behind its fence ({have} < {want}) with "
-                "no leader to fetch from"
-            )
-        res = self.retry.run(
-            lambda: self._peer(route["leader"]).call(
-                "fetch_table", table=table, from_seq=have),
-            label="fetch_table",
-        )
-        self.exchange_fetches += 1
+        before the barrier runs — exchange drops (chaos) repair here.
+        On a shuffled table "caught up" means TWO things: history long
+        enough AND every OWNED position below the fence actually holds
+        a row (a sliced delivery lost to chaos leaves a hole the
+        length check alone would miss)."""
         with self._lock:
             mgr = self._dml_manager(table)
-            applied = mgr.insert_at(
-                int(res["seq"]), [tuple(r) for r in res["rows"]]
+            have = mgr.history_len()
+        own = self._owned_vnodes_for(table)
+        route = self._table_route(table)
+        is_leader = route is not None \
+            and route["leader"] == self.worker_id
+        if have < want:
+            if route is None or is_leader:
+                raise RuntimeError(
+                    f"{table!r} behind its fence ({have} < {want}) "
+                    "with no leader to fetch from"
+                )
+            if own is None:
+                res = self.retry.run(
+                    lambda: self._peer(route["leader"]).call(
+                        "fetch_table", table=table, from_seq=have),
+                    label="fetch_table",
+                )
+                rows = [tuple(r) for r in res["rows"]
+                        if r is not None]
+                with self._lock:
+                    applied = self._dml_manager(table).insert_at(
+                        int(res["seq"]), rows
+                    )
+            else:
+                res = self.retry.run(
+                    lambda: self._peer(route["leader"]).call(
+                        "fetch_slice", table=table, from_seq=have,
+                        to_seq=want, vnodes=sorted(own)),
+                    label="fetch_slice",
+                )
+                with self._lock:
+                    applied = self._dml_manager(table).insert_sparse(
+                        int(res["seq"]), int(res["end"]),
+                        [(int(p), tuple(r)) for p, r in res["items"]],
+                        [int(v) for v in res.get("vnodes") or ()],
+                    )
+            self.exchange_fetches += 1
+            self.exchange_rows_in += applied
+            if applied:
+                self.exchange_batches_in += 1
+        if own is None:
+            return
+        # completeness audit below the fence (sliced path): scan only
+        # the still-unconsumed window — holes below every reader's
+        # cursor can never be read again
+        with self._lock:
+            lo = self.engine.table_consumption_floor(table)
+            missing = self._dml_manager(table).missing_positions(
+                own, lo, want
             )
-        self.exchange_rows_in += applied
-        if applied:
-            self.exchange_batches_in += 1
+        if not missing:
+            return
+        if route is not None and not is_leader:
+            try:
+                res = self.retry.run(
+                    lambda: self._peer(route["leader"]).call(
+                        "fetch_positions", table=table,
+                        positions=missing),
+                    label="fetch_positions",
+                )
+                got = [(int(p), tuple(r)) for p, r in res["items"]]
+                with self._lock:
+                    mgr = self._dml_manager(table)
+                    for p, r in got:
+                        mgr.insert_sparse(p, p + 1, [(p, r)], [])
+                self.exchange_fetches += 1
+                self.exchange_rows_in += len(got)
+                missing = [p for p in missing
+                           if p not in {g[0] for g in got}]
+            except (RpcError, ConnectionError, OSError, KeyError):
+                pass
+        if missing:
+            self._peer_fill(table, missing)
 
     # -- RPC surface ----------------------------------------------------
     def rpc_ping(self) -> dict:
@@ -357,7 +563,10 @@ class ComputeWorker:
 
     def rpc_scale_stats(self) -> dict:
         """Exchange/partition observability (scale_stress asserts the
-        per-chunk path flows worker↔worker)."""
+        per-chunk path flows worker↔worker AND, on shuffled edges,
+        that the gate audit counters stayed at zero)."""
+        with self._lock:
+            parts = self.engine.partition_stats()
         return {
             "exchange_rows_out": self.exchange_rows_out,
             "exchange_rows_in": self.exchange_rows_in,
@@ -366,12 +575,24 @@ class ComputeWorker:
             "exchange_fetches": self.exchange_fetches,
             "exchange_send_failures": self.exchange_send_failures,
             "routing_version": self._routing["version"],
+            "shuffle": self.shuffle.stats(),
+            "gate_dropped": sum(p["gate_dropped"]
+                                for p in parts.values()),
+            "reader_filtered": sum(p["reader_filtered"]
+                                   for p in parts.values()),
+            "partition_stats": parts,
             "partitions": {
                 j.name: sorted(j.vnodes)
                 for j in self.engine.jobs
                 if hasattr(j, "vnodes")
             },
         }
+
+    def rpc_metrics(self) -> dict:
+        """This worker process' metric surface (exchange counters,
+        engine gauges) — per-edge series live HERE; the meta keeps
+        per-worker aggregates it retires on death."""
+        return {"prometheus": self.engine.metrics.render_prometheus()}
 
     def rpc_adopt(self, ddl: list, name: str, recover: bool = True,
                   vnodes: list | None = None, n_vnodes: int = 0,
@@ -497,7 +718,17 @@ class ComputeWorker:
             res = {"ok": True, "committed_epoch": sealed,
                    "sealed_epoch": sealed,
                    "durable_epoch": positions["durable"],
-                   "ssts": ssts, "corrupt": corrupt}
+                   "ssts": ssts, "corrupt": corrupt,
+                   # cheap exchange summary (host counters only): the
+                   # meta mirrors these as per-worker gauges retired
+                   # with the worker
+                   "exchange": {
+                       "rows_out": self.exchange_rows_out,
+                       "rows_in": self.exchange_rows_in,
+                       "batches_out": self.exchange_batches_out,
+                       "batches_in": self.exchange_batches_in,
+                       "send_failures": self.exchange_send_failures,
+                   }}
             if rnd:
                 self._round_cache[job]["result"] = res
         return res
@@ -573,8 +804,9 @@ class ComputeWorker:
             "heartbeat_failures": self.heartbeat_failures,
             "registrations": self.registrations,
             "checkpoint_upload_retries_total": upload_retries,
-            # the worker↔worker exchange seam (scale_storm asserts the
-            # fabric's faults here were absorbed/repaired)
+            # the worker↔worker exchange seam (scale_storm and
+            # shuffle_storm assert the fabric's faults here were
+            # absorbed/repaired)
             "exchange_rows_out": self.exchange_rows_out,
             "exchange_rows_in": self.exchange_rows_in,
             "exchange_fetches": self.exchange_fetches,

@@ -83,6 +83,12 @@ class ClusterConfig:
     #: active worker set (``ctl cluster scale N`` then moves only
     #: vnodes + the state behind them).  Off = whole-job placement.
     scale_partitioning: bool = False
+    #: Exchange-lite sliced ingest (default ON): the ingest leader
+    #: hash-partitions each DML batch ONCE and ships each worker only
+    #: its owned slice; the VnodeGate becomes a correctness assert.
+    #: Off = the PR-7 replicate-everything fan-out (the A/B baseline
+    #: and field escape hatch).
+    shuffle_ingest: bool = True
     #: integrity scrubber (meta-owned): seconds between background
     #: scrub cycles over pinned-version SSTs + checkpoint lineages
     #: (0 disables the background thread; ``ctl cluster scrub`` still

@@ -163,6 +163,23 @@ def _mix64(x: jnp.ndarray) -> jnp.ndarray:
     return x ^ (x >> np.uint64(31))
 
 
+def hash64_i64_host(vals) -> np.ndarray:
+    """Pure-numpy ``hash64_columns([int64 col])`` — bit-identical to
+    the device path for a single NOT NULL int64 column (asserted by
+    tests/test_exchange.py).  The Exchange-lite host paths (ingest
+    leader batch slicing, reader-side vnode filters) hash thousands of
+    tiny batches; eager jnp dispatch per batch costs more than the
+    hash itself, so the host plane runs this numpy twin instead."""
+    with np.errstate(over="ignore"):
+        u = np.asarray(vals, np.int64).view(np.uint64)
+        state = np.full(u.shape, _MIX_K1, np.uint64)  # seed 0 ^ K1
+        x = state ^ (u * _MIX_K1)
+        x = (x ^ (x >> np.uint64(30))) * _MIX_K2
+        x = (x ^ (x >> np.uint64(27))) * _MIX_K3
+        x = x ^ (x >> np.uint64(31))
+    return np.where(x == ~np.uint64(0), ~np.uint64(1), x)
+
+
 def hash64_columns(columns: Sequence, seed: int = 0) -> jnp.ndarray:
     """64-bit mix hash of key columns, ``uint64 [cap]``.
 

@@ -32,7 +32,18 @@ class TableDmlManager:
     def __init__(self, schema: Schema, auto_width_cols=()):
         self.schema = schema
         self._readers: list["TableSourceReader"] = []
-        self._history: list[tuple] = []
+        #: the position-stamped history.  On the ingest leader every
+        #: position holds a row; on a shuffled follower positions the
+        #: worker does not own hold ``None`` PLACEHOLDERS — positions
+        #: stay GLOBAL, so source cursors, round fences, and handover
+        #: cursor checks all live in one shared domain (Exchange-lite)
+        self._history: list = []
+        #: per-position distribution-key vnode (parallel to
+        #: ``_history``; -1 = unknown / not a shuffled table).  Every
+        #: sliced delivery carries the full batch's vnode log, so ANY
+        #: host can audit which global positions its owned set covers
+        #: even for rows it never stored
+        self._vnodes: list[int] = []
         self.rows_inserted = 0
         #: columns whose VARCHAR device width was NOT declared: their
         #: width follows the observed max (refresh_schema), never
@@ -44,8 +55,11 @@ class TableDmlManager:
 
     def new_reader(self, chunk_capacity: int) -> "TableSourceReader":
         # the reader shares the history list: it starts at offset 0, so
-        # everything inserted so far replays (poor-man's backfill)
-        r = TableSourceReader(self.schema, chunk_capacity, self._history)
+        # everything inserted so far replays (poor-man's backfill).
+        # The vnode log rides along so a filtered reader classifies
+        # stamped positions without re-hashing them.
+        r = TableSourceReader(self.schema, chunk_capacity,
+                              self._history, vnode_log=self._vnodes)
         self._readers.append(r)
         return r
 
@@ -55,10 +69,64 @@ class TableDmlManager:
         return len(self._history)
 
     def history_slice(self, lo: int, hi: int | None = None) -> list:
-        """Rows [lo, hi) of the history — the peer catch-up payload."""
-        return [list(r) for r in
+        """Rows [lo, hi) of the history — the peer catch-up payload.
+        Placeholder positions come back as ``None``."""
+        return [list(r) if r is not None else None for r in
                 (self._history[lo:] if hi is None
                  else self._history[lo:hi])]
+
+    def history_row(self, pos: int):
+        """One position's row (None = placeholder / out of range)."""
+        return self._history[pos] if 0 <= pos < len(self._history) \
+            else None
+
+    def vnode_at(self, pos: int) -> int | None:
+        """The position's recorded dist-key vnode (None = unknown)."""
+        if 0 <= pos < len(self._vnodes) and self._vnodes[pos] >= 0:
+            return self._vnodes[pos]
+        return None
+
+    def vnode_slice(self, lo: int, hi: int) -> list[int]:
+        out = self._vnodes[lo:hi]
+        out += [-1] * ((hi - lo) - len(out))
+        return out
+
+    def set_vnode_log(self, positions_vnodes) -> None:
+        """Record dist-key vnodes for known positions (the ingest
+        leader stamps its own batches after hashing them once)."""
+        for pos, vn in positions_vnodes:
+            if pos >= len(self._vnodes):
+                self._vnodes += [-1] * (pos + 1 - len(self._vnodes))
+            self._vnodes[pos] = int(vn)
+
+    def set_vnode_range(self, seq: int, vnodes) -> None:
+        """Bulk vnode-log stamp for one contiguous batch [seq, seq+n)
+        (the per-batch fast path — one slice assignment, no per-row
+        loop)."""
+        end = seq + len(vnodes)
+        if end > len(self._vnodes):
+            self._vnodes += [-1] * (end - len(self._vnodes))
+        vals = [int(v) for v in vnodes]
+        if all(v >= 0 for v in vals):
+            self._vnodes[seq:end] = vals
+        else:  # never DOWNGRADE a known vnode to unknown (-1)
+            for i, v in enumerate(vals):
+                if v >= 0:
+                    self._vnodes[seq + i] = v
+
+    def missing_positions(self, vnodes, lo: int, hi: int) -> list[int]:
+        """Global positions in [lo, hi) whose recorded vnode falls in
+        ``vnodes`` but whose row is a local placeholder — the
+        completeness audit behind fence gap repair (a follower must
+        hold every OWNED row below the round fence, not merely have a
+        long enough history)."""
+        want = {int(v) for v in vnodes}
+        hi = min(hi, len(self._history))
+        return [
+            p for p in range(lo, hi)
+            if self._history[p] is None
+            and (p < len(self._vnodes) and self._vnodes[p] in want)
+        ]
 
     def insert_at(self, seq: int, rows: Sequence[tuple]) -> int:
         """Position-stamped idempotent append (exchange delivery): the
@@ -76,8 +144,36 @@ class TableDmlManager:
             self.insert(fresh)
         return len(fresh)
 
-    def insert(self, rows: Sequence[tuple]) -> int:
-        rows = list(rows)
+    def insert_sparse(self, seq: int, end: int, items,
+                      vnodes=()) -> int:
+        """Sliced exchange delivery: claim GLOBAL positions
+        [seq, end), placing only the owned rows in ``items``
+        (``[(pos, row), ...]``) and ``None`` placeholders elsewhere.
+        Re-delivery is idempotent; positions already holding a row are
+        never overwritten, but placeholder HOLES are filled (that is
+        what makes gained-vnode backfill after a repartition a plain
+        re-send).  A batch starting beyond the local tail is refused
+        exactly like ``insert_at``.  Returns rows actually placed."""
+        here = len(self._history)
+        if seq > here:
+            raise ValueError(
+                f"exchange gap: batch at seq {seq}, history at {here}"
+            )
+        if end > here:
+            self._history += [None] * (end - here)
+        fresh = [(int(p), tuple(r)) for p, r in items
+                 if seq <= int(p) < end
+                 and self._history[int(p)] is None]
+        if fresh:
+            self._check_widths([r for _, r in fresh])
+            for p, r in fresh:
+                self._history[p] = r
+            self.rows_inserted += len(fresh)
+        if vnodes:
+            self.set_vnode_range(seq, vnodes)
+        return len(fresh)
+
+    def _check_widths(self, rows: Sequence[tuple]) -> None:
         # one pass: per-string-column max encoded length of this batch
         str_cols = [i for i, f in enumerate(self.schema)
                     if f.data_type.is_string]
@@ -106,6 +202,10 @@ class TableDmlManager:
                     )
         for i in self._max_lens:
             self._max_lens[i] = max(self._max_lens[i], batch_max[i])
+
+    def insert(self, rows: Sequence[tuple]) -> int:
+        rows = list(rows)
+        self._check_widths(rows)
         self._history.extend(rows)  # readers see this shared list
         self.rows_inserted += len(rows)
         return len(rows)
@@ -140,11 +240,14 @@ class TableSourceReader:
     durable state, here the history list is that log)."""
 
     def __init__(self, schema: Schema, chunk_capacity: int,
-                 history: list):
+                 history: list, vnode_log: list | None = None):
         self.schema = schema
         self.cap = chunk_capacity
         #: shared with TableDmlManager._history (no copy)
         self._rows = history
+        #: shared with TableDmlManager._vnodes (no copy): positions
+        #: the exchange already stamped skip the filter's hash
+        self._vnode_log = vnode_log if vnode_log is not None else []
         #: consumed-row cursor into the table history (checkpointable)
         self.offset = 0
         #: consumption fence (cluster lockstep rounds): rows at or
@@ -152,6 +255,18 @@ class TableSourceReader:
         #: raises it — every partition of a job consumes the IDENTICAL
         #: prefix per round, so cursors stay aligned across workers
         self.limit: int | None = None
+        #: Exchange-lite shuffled consumption: ``(key_col, owned_set,
+        #: n_vnodes)`` or None.  With a filter set the reader packs
+        #: each chunk with up to ``cap`` OWNED rows (skipping
+        #: placeholders and non-owned rows) — the VnodeGate downstream
+        #: becomes a correctness assert instead of the workhorse, and
+        #: a partition's per-round work shrinks to its share of the
+        #: stream (what makes ingest throughput track worker count)
+        self.vnode_filter: tuple | None = None
+        #: rows the filter skipped because their vnode was not owned
+        #: (zero on a correctly shuffled follower: non-owned positions
+        #: are placeholders there, not rows)
+        self.filtered_rows = 0
 
     def pending(self) -> int:
         # a restored offset may exceed the in-process history (fresh
@@ -162,11 +277,67 @@ class TableSourceReader:
             end = min(end, self.limit)
         return max(0, end - self.offset)
 
+    def _owns(self, row) -> bool:
+        key_col, owned, n_vn = self.vnode_filter
+        from risingwave_tpu.cluster.exchange.shuffle import (
+            vnodes_of_rows,
+        )
+
+        return vnodes_of_rows([row], key_col, n_vn)[0] in owned
+
     def next_chunk(self) -> Chunk:
-        n = min(self.pending(), self.cap)
-        batch = self._rows[self.offset:self.offset + n]
-        self.offset += n
-        if n == 0:
+        end = len(self._rows)
+        if self.limit is not None:
+            end = min(end, self.limit)
+        batch: list = []
+        if self.vnode_filter is None:
+            while self.offset < end and len(batch) < self.cap:
+                row = self._rows[self.offset]
+                self.offset += 1
+                if row is not None:
+                    batch.append(row)
+        else:
+            # batched host hashing: classify a whole window at once
+            # (one numpy hash per window, not per row)
+            from risingwave_tpu.cluster.exchange.shuffle import (
+                vnodes_of_rows,
+            )
+
+            key_col, owned, n_vn = self.vnode_filter
+            log = self._vnode_log
+            n_log = len(log)
+            while self.offset < end and len(batch) < self.cap:
+                stop = min(end, self.offset + self.cap)
+                window_pos = [p for p in range(self.offset, stop)
+                              if self._rows[p] is not None]
+                if not window_pos:
+                    self.offset = stop
+                    continue
+                # stamped positions classify straight off the shared
+                # vnode log; only un-stamped rows (pre-choreography
+                # history) pay one batched hash
+                vns = [log[p] if p < n_log else -1
+                       for p in window_pos]
+                unknown = [i for i, v in enumerate(vns) if v < 0]
+                if unknown:
+                    hashed = vnodes_of_rows(
+                        [self._rows[window_pos[i]] for i in unknown],
+                        key_col, n_vn,
+                    )
+                    for i, v in zip(unknown, hashed):
+                        vns[i] = v
+                consumed_to = stop
+                for p, v in zip(window_pos, vns):
+                    if len(batch) >= self.cap:
+                        # cursor parks at the first unconsumed row
+                        consumed_to = p
+                        break
+                    if v in owned:
+                        batch.append(self._rows[p])
+                    else:
+                        self.filtered_rows += 1
+                self.offset = consumed_to
+        if not batch:
             # shape-static empty chunk
             arrays = [np.zeros((0,), np.int64) for _ in self.schema]
             return Chunk.from_numpy(self.schema, arrays, capacity=self.cap)

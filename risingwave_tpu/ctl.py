@@ -303,6 +303,16 @@ def cluster_vnodes(meta_addr: str) -> dict:
     }
 
 
+def cluster_exchange(meta_addr: str) -> dict:
+    """``ctl cluster exchange``: the compiled Exchange-lite
+    choreography — per-table shuffle mode, routing key column, ingest
+    leader + standby, and the full edge-spec list (source / join /
+    attach edges).  Compile once, execute forever: what this prints
+    is exactly what every worker's per-chunk data path executes."""
+    s = _meta_state(meta_addr)
+    return s.get("exchange") or {}
+
+
 def cluster_scrub(meta_addr: str) -> dict:
     """``ctl cluster scrub <meta_addr>``: drive ONE full ONLINE scrub
     cycle on the running meta — every pinned-version SST and retained
@@ -411,6 +421,7 @@ def _cluster_main(argv: list[str]) -> None:
           "epochs": cluster_epochs,
           "serving": cluster_serving,
           "vnodes": cluster_vnodes,
+          "exchange": cluster_exchange,
           "scrub": cluster_scrub,
           "faults": cluster_faults}.get(sub)
     if fn is None:

@@ -78,6 +78,10 @@ class MvColumn:
     kind: str     # "string" | "decimal" | "float" | "int"
     scale: int
     hidden: bool
+    #: nullable pk components carry a presence-prefix byte in the
+    #: memcomparable encoding (outer-join MV keys); old docs without
+    #: the flag default to the prefix-free encoding
+    nullable: bool = False
 
 
 class MvSchema:
@@ -88,7 +92,8 @@ class MvSchema:
         self.mv = doc["mv"]
         self.columns = [
             MvColumn(c["name"], c["kind"], int(c.get("scale", 0)),
-                     bool(c.get("hidden", False)))
+                     bool(c.get("hidden", False)),
+                     bool(c.get("nullable", False)))
             for c in doc["columns"]
         ]
         self.pk: tuple[int, ...] = tuple(doc["pk"])
@@ -128,14 +133,22 @@ class MvSchema:
         from risingwave_tpu.storage import codec as C
 
         c = self.columns[col]
+        prefix = b""
+        if c.nullable:
+            # presence prefix, mirroring _mc_encode_value exactly
+            if v is None:
+                return b"\x01"
+            prefix = b"\x00"
         if c.kind == "string":
-            return str(v).encode() + b"\x00"
+            return prefix + str(v).encode() + b"\x00"
         if c.kind == "decimal":
             scaled = int(round(float(v) * 10 ** c.scale))
-            return C.mc_encode_i64(np.asarray([scaled])).tobytes()
+            return prefix + C.mc_encode_i64(
+                np.asarray([scaled])).tobytes()
         if c.kind == "float":
-            return C.mc_encode_f64(np.asarray([float(v)])).tobytes()
-        return C.mc_encode_i64(np.asarray([int(v)])).tobytes()
+            return prefix + C.mc_encode_f64(
+                np.asarray([float(v)])).tobytes()
+        return prefix + C.mc_encode_i64(np.asarray([int(v)])).tobytes()
 
 
 class StaleLease(RuntimeError):

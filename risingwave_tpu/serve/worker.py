@@ -88,6 +88,12 @@ class ReadPlan:
     #: many leading index columns precede the upstream pk values
     index_mv: str = ""
     index_width: int = 0
+    #: residual predicates applied to fetched rows BEFORE projection/
+    #: LIMIT: ``[(col_idx, op, value)]`` — the pushdown surface for
+    #: composite predicates (index prefix + residual filter) and
+    #: non-leading pk compares.  SQL NULL semantics: a NULL operand
+    #: never matches.
+    residual: list = None  # type: ignore[assignment]
 
 
 def _conjuncts(expr) -> list:
@@ -105,6 +111,45 @@ def _flip(op: str) -> str:
         "greater_than": "less_than",
         "greater_than_or_equal": "less_than_or_equal",
     }.get(op, op)
+
+
+def _cmp(op: str, a, b) -> bool:
+    """One residual compare with SQL NULL semantics (NULL never
+    matches)."""
+    if a is None or b is None:
+        return False
+    if op == "equal":
+        return a == b
+    if op == "less_than":
+        return a < b
+    if op == "less_than_or_equal":
+        return a <= b
+    if op == "greater_than":
+        return a > b
+    return a >= b  # greater_than_or_equal
+
+
+def _range_bounds(base: bytes, hi: bytes, enc_of,
+                  preds) -> tuple[bytes, bytes]:
+    """Tighten ``[base, hi)`` with compare predicates over ONE
+    memcomparable-encoded column that directly follows ``base`` (the
+    shared leading-pk / index-column range logic — byte order equals
+    value order under the encoding)."""
+    lo_b, hi_b = base, hi
+    for _, op, v in preds:
+        enc = enc_of(v)
+        if op in ("equal", "greater_than_or_equal"):
+            lo_b = max(lo_b, base + enc)
+        elif op == "greater_than":
+            succ = bytes_successor(enc)
+            lo_b = hi if succ is None else max(lo_b, base + succ)
+        if op in ("equal", "less_than_or_equal"):
+            succ = bytes_successor(enc)
+            if succ is not None:
+                hi_b = min(hi_b, base + succ)
+        elif op == "less_than":
+            hi_b = min(hi_b, base + enc)
+    return lo_b, hi_b
 
 
 def plan_read(select, schema: MvSchema, schema_of=None,
@@ -193,9 +238,11 @@ def plan_read(select, schema: MvSchema, schema_of=None,
         preds.append((idx, op, right.value))
 
     if any(i not in schema.pk for i, _, _ in preds):
-        # non-pk predicate: equality over an index prefix rewrites to
-        # an index range scan + pk lookups; anything else needs the
-        # engine (owner fallback)
+        # non-pk predicate: a prefix of a secondary index absorbs the
+        # matching predicates (equality prefix + one ranged column);
+        # whatever the index bytes cannot bound becomes a RESIDUAL
+        # filter on the fetched rows.  No applicable index → engine
+        # (owner fallback)
         ix_plan = _plan_index_read(plan, preds, schema, schema_of,
                                    at_epoch)
         if ix_plan is not None:
@@ -216,67 +263,102 @@ def plan_read(select, schema: MvSchema, schema_of=None,
         )
         return plan
 
-    # range: every predicate must sit on the LEADING pk column, where
-    # the memcomparable prefix makes byte order == value order
+    # range on the LEADING pk column (byte order == value order under
+    # the memcomparable prefix); compares on OTHER pk columns apply as
+    # residual filters over the fetched rows — composite predicates no
+    # longer bounce to the owning worker
     lead = schema.pk[0]
-    if any(i != lead for i, _, _ in preds):
-        raise ServeUnsupported(
-            "serving range scans bound the leading pk column"
-        )
-    lo_b, hi_b = lo, hi
-    for _, op, v in preds:
-        enc = schema.encode_pk_value(lead, v)
-        if op in ("equal", "greater_than_or_equal"):
-            lo_b = max(lo_b, lo + enc)
-        elif op == "greater_than":
-            succ = bytes_successor(enc)
-            lo_b = hi if succ is None else max(lo_b, lo + succ)
-        if op in ("equal", "less_than_or_equal"):
-            succ = bytes_successor(enc)
-            if succ is not None:
-                hi_b = min(hi_b, lo + succ)
-        elif op == "less_than":
-            hi_b = min(hi_b, lo + enc)
-    plan.lo, plan.hi = lo_b, hi_b
+    lead_preds = [p for p in preds if p[0] == lead]
+    plan.residual = [p for p in preds if p[0] != lead]
+    plan.lo, plan.hi = _range_bounds(
+        lo, hi, lambda v: schema.encode_pk_value(lead, v), lead_preds
+    )
     return plan
 
 
 def _plan_index_read(plan: ReadPlan, preds, schema: MvSchema,
                      schema_of, at_epoch) -> ReadPlan | None:
-    """Rewrite equality predicates covering a PREFIX of a secondary
-    index's columns into one contiguous byte range over the index MV
-    (whose export key is ``(indexed cols..., upstream pk)``).  None
-    when no published index applies — the caller falls back."""
+    """Rewrite predicates against a secondary index: an EQUALITY
+    prefix of the index's columns narrows to one contiguous byte
+    range, compare predicates on the NEXT index column tighten the
+    range bounds (``WHERE col > x`` — the memcomparable encoding
+    already sorts), and every remaining predicate survives as a
+    residual filter over the fetched primary rows.  None when no
+    published index absorbs at least one predicate — the caller falls
+    back."""
     if schema_of is None or not schema.indexes:
         return None
-    if any(op != "equal" for _, op, _ in preds):
-        return None
-    pred_names = sorted(schema.columns[i].name for i, _, _ in preds)
-    vals = {schema.columns[i].name: v for i, _, v in preds}
+    by_name: dict[str, list] = {}
+    for i, op, v in preds:
+        by_name.setdefault(schema.columns[i].name, []).append(
+            (i, op, v)
+        )
+    best = None
     for ix in schema.indexes:
         cols = list(ix.get("cols", ()))
-        k = len(preds)
-        if k > len(cols) or sorted(cols[:k]) != pred_names:
+        # equality prefix: leading index columns pinned by one '='
+        k = 0
+        while k < len(cols):
+            ps = by_name.get(cols[k], ())
+            if len([p for p in ps if p[1] == "equal"]) == 1 \
+                    and len(ps) == 1:
+                k += 1
+            else:
+                break
+        # optional ranged column directly after the prefix
+        range_preds = []
+        if k < len(cols):
+            ps = by_name.get(cols[k], ())
+            if ps and all(p[1] in _CMP_OPS for p in ps):
+                range_preds = list(ps)
+        if k == 0 and not range_preds:
             continue
-        ixs = schema_of(ix["name"])
-        if ixs is None or ixs.indexed_mv != schema.mv \
-                or ixs.index_width < k:
-            continue  # not exported yet (or a stale doc)
-        if at_epoch is not None and ixs.since_epoch \
-                and at_epoch < ixs.since_epoch:
-            continue  # pinned before the index's first export
-        ix_lo, ix_hi = mv_key_range(ix["name"])
-        enc = b"".join(
-            ixs.encode_pk_value(j, vals[cols[j]]) for j in range(k)
+        score = (k, 1 if range_preds else 0)
+        if best is None or score > best[0]:
+            best = (score, ix, cols, k, range_preds)
+    if best is None:
+        return None
+    _, ix, cols, k, range_preds = best
+    ixs = schema_of(ix["name"])
+    if ixs is None or ixs.indexed_mv != schema.mv \
+            or ixs.index_width < max(k, 1):
+        return None  # not exported yet (or a stale doc)
+    if at_epoch is not None and ixs.since_epoch \
+            and at_epoch < ixs.since_epoch:
+        return None  # pinned before the index's first export
+    vals = {schema.columns[i].name: v for i, op, v in preds
+            if op == "equal"}
+    ix_lo, ix_hi = mv_key_range(ix["name"])
+    enc = b"".join(
+        ixs.encode_pk_value(j, vals[cols[j]]) for j in range(k)
+    )
+    succ = bytes_successor(enc)
+    base = ix_lo + enc
+    hi = ix_hi if succ is None else ix_lo + succ
+    if range_preds and k < ixs.index_width:
+        base, hi = _range_bounds(
+            base, hi, lambda v: ixs.encode_pk_value(k, v),
+            range_preds,
         )
-        succ = bytes_successor(enc)
-        plan.mode = "index"
-        plan.index_mv = ix["name"]
-        plan.index_width = ixs.index_width
-        plan.lo = ix_lo + enc
-        plan.hi = ix_hi if succ is None else ix_lo + succ
-        return plan
-    return None
+        absorbed = {cols[j] for j in range(k)} | {cols[k]}
+    else:
+        range_preds = []
+        absorbed = {cols[j] for j in range(k)}
+    # everything the index bytes did not bound filters residually —
+    # including range predicates on the ranged column itself (the
+    # bounds are exact, but keeping them residual too is harmless and
+    # covers multi-predicate corner cases), and predicates on columns
+    # outside the index entirely
+    plan.residual = [
+        (i, op, v) for i, op, v in preds
+        if schema.columns[i].name not in absorbed or op != "equal"
+    ]
+    plan.mode = "index"
+    plan.index_mv = ix["name"]
+    plan.index_width = ixs.index_width
+    plan.lo = base
+    plan.hi = hi
+    return plan
 
 
 class ResultCache:
@@ -591,7 +673,11 @@ class ServingWorker:
     def _project(self, plan: ReadPlan, hits):
         rows: list[tuple] = []
         skip = plan.offset
+        residual = plan.residual or ()
         for row in hits:
+            if residual and not all(
+                    _cmp(op, row[i], v) for i, op, v in residual):
+                continue  # residual filter BEFORE offset/limit
             if skip > 0:
                 skip -= 1
                 continue

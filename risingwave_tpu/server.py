@@ -115,6 +115,7 @@ def _run_meta(args) -> None:
         heartbeat_timeout_s=args.heartbeat_timeout,
         n_vnodes=args.n_vnodes,
         scale_partitioning=args.scale_partitioning,
+        shuffle_ingest=not args.no_shuffle_ingest,
         scrub_interval_s=args.scrub_interval,
         serve_retry_timeout_s=args.serve_retry_timeout,
     ).start(args.host, args.rpc_port,
@@ -237,6 +238,11 @@ def main() -> None:
                    help="scale plane: partition eligible jobs over "
                         "the vnode map (meta role); `ctl cluster "
                         "scale N` then moves only vnodes")
+    p.add_argument("--no-shuffle-ingest", action="store_true",
+                   help="exchange plane: disable sliced ingest "
+                        "(meta role) — DML batches replicate to "
+                        "every partition host and the VnodeGate "
+                        "filters (the PR-7 baseline)")
     args = p.parse_args()
 
     if args.role == "meta":

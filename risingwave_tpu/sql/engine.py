@@ -812,6 +812,18 @@ class Engine:
         dag._uploader = job._uploader
         dag.upload_window = job.upload_window
         dag.metrics = job.metrics
+        if hasattr(job, "vnode_gate_idx"):
+            # a partitioned upstream keeps its scale-plane identity
+            # through the upgrade: the gate now lives inside node 0's
+            # fragment, the checkpoint lineage and vnode ownership
+            # carry over, and future repartitions drive the DagJob
+            # handover path
+            dag.vnode_gates = [(0, job.vnode_gate_idx)]
+            dag.n_vnodes = job.n_vnodes
+            dag.vnodes = job.vnodes
+            dag.ckpt_key = job.ckpt_key
+            dag.shuffle_cols = dict(getattr(job, "shuffle_cols", {}))
+            dag.edge_kinds = dict(getattr(job, "edge_kinds", {}))
         self.jobs[self.jobs.index(job)] = dag
         entry.job = dag
         entry.mv_state_index = (0,) + tuple(entry.mv_state_index)
@@ -1037,6 +1049,14 @@ class Engine:
             exchange_specs = self._plan_mesh_attach(
                 plan, taps, mesh_jobs
             )
+        part_jobs = {
+            self.catalog.get(tap.name).job
+            for tap in taps.values()
+            if getattr(self.catalog.get(tap.name).job,
+                       "n_vnodes", None) is not None
+        }
+        if part_jobs:
+            self._plan_partition_attach(plan, taps)
 
         # attach: resolve every tap to its upstream job's MV node
         tap_refs: dict[str, int] = {}
@@ -1253,6 +1273,160 @@ class Engine:
                 )
             specs[i] = [(None, key_fn)]
         return specs
+
+    def _plan_partition_attach(self, plan: DagPlan,
+                               taps: dict) -> None:
+        """MV-on-MV over a vnode-PARTITIONED upstream: the worker-
+        topology analog of ``_plan_mesh_attach``, compiled against the
+        cluster exchange plane.  The attach edge's exchange must be
+        the IDENTITY choreography (``ExchangeSpec.mode="local"``):
+        every keyed state the new chain adds must key on the
+        upstream's distribution value, so each partition's changelog
+        already lives on its owner and no cross-worker row movement is
+        needed — the cheapest exchange there is.  Concretely, every
+        attached HashAgg's LEADING group-by key and every attached
+        Materialize's LEADING pk column must trace (through plain
+        InputRef hops, including through earlier attached aggs' group
+        keys) back to the upstream MV's leading pk column.  Reduced-
+        key aggs, TopN, joins of partitioned MVs, and new sources
+        raise ``PlanError`` — a true cross-partition data exchange on
+        the attach edge is the next round."""
+        from risingwave_tpu.expr.node import InputRef
+        from risingwave_tpu.stream.executor import (
+            FilterExecutor as _F,
+            ProjectExecutor as _P,
+        )
+        from risingwave_tpu.stream.hash_agg import HashAggExecutor
+        from risingwave_tpu.stream.materialize import (
+            MaterializeExecutor,
+        )
+
+        if len(taps) != 1 or len(plan.sources) != len(taps):
+            raise PlanError(
+                "MV-on-MV over a partitioned upstream supports "
+                "exactly one upstream MV and no new sources: "
+                "next round"
+            )
+        (tap_sname, tap), = taps.items()
+        up_entry = self.catalog.get(tap.name)
+        up_pk0 = up_entry.mv_executor.pk_indices[0]
+
+        def trace_edge(ref, col) -> "int | None":
+            """Trace a column on edge ``ref`` back to the tap source
+            column (None = untraceable)."""
+            while ref[0] == "node":
+                node = plan.nodes[ref[1]]
+                if isinstance(node, JoinNode):
+                    return None
+                idx = int(col)
+                for ex in reversed(node.fragment.executors):
+                    if isinstance(ex, (_F, MaterializeExecutor)):
+                        continue
+                    if isinstance(ex, _P):
+                        if idx >= len(ex.exprs):
+                            return None
+                        e = ex.exprs[idx][1]
+                        if not isinstance(e, InputRef):
+                            return None
+                        idx = e.index
+                    elif isinstance(ex, HashAggExecutor):
+                        # agg output = group keys ++ agg values; only
+                        # a group-key column traces through
+                        if idx >= len(ex.group_by):
+                            return None
+                        e = ex.group_by[idx][1]
+                        if not isinstance(e, InputRef):
+                            return None
+                        idx = e.index
+                    else:
+                        return None
+                col = idx
+                ref = node.input
+            return int(col) if ref == ("source", tap_sname) else None
+
+        def trace_in_node(ni: int, pos: int, col) -> "int | None":
+            """Trace ``col`` on the input edge of executor ``pos`` of
+            node ``ni`` back to the tap source column."""
+            node = plan.nodes[ni]
+            idx = int(col)
+            for ex in reversed(node.fragment.executors[:pos]):
+                if isinstance(ex, (_F, MaterializeExecutor)):
+                    continue
+                if isinstance(ex, _P):
+                    if idx >= len(ex.exprs):
+                        return None
+                    e = ex.exprs[idx][1]
+                    if not isinstance(e, InputRef):
+                        return None
+                    idx = e.index
+                elif isinstance(ex, HashAggExecutor):
+                    if idx >= len(ex.group_by):
+                        return None
+                    e = ex.group_by[idx][1]
+                    if not isinstance(e, InputRef):
+                        return None
+                    idx = e.index
+                else:
+                    return None
+            return trace_edge(node.input, idx)
+
+        for ni, node in enumerate(plan.nodes):
+            if isinstance(node, JoinNode):
+                raise PlanError(
+                    "MV-on-MV joining a partitioned upstream: a "
+                    "cross-partition join-key exchange on the attach "
+                    "edge is the next round"
+                )
+            for pos, ex in enumerate(node.fragment.executors):
+                if isinstance(ex, (_F, _P, MaterializeExecutor)):
+                    if isinstance(ex, MaterializeExecutor):
+                        k = ex.pk_indices[0]
+                        e = trace_in_node(ni, pos, k)
+                        if e is None or e != up_pk0:
+                            raise PlanError(
+                                "MV-on-MV over a partitioned "
+                                "upstream: the new MV's leading pk "
+                                "column must carry the upstream "
+                                "distribution key: next round"
+                            )
+                    continue
+                if isinstance(ex, HashAggExecutor):
+                    if (ex.emit_on_window_close or ex._distinct_aggs
+                            or ex._minput_aggs
+                            or ex.watermark_group_idx is not None):
+                        raise PlanError(
+                            "MV-on-MV over a partitioned upstream: "
+                            "DISTINCT/minput/EOWC/watermark "
+                            "aggregations are not scale-eligible"
+                        )
+                    if not ex.group_by:
+                        raise PlanError(
+                            "MV-on-MV over a partitioned upstream: a "
+                            "global aggregation reduces across "
+                            "partitions (attach-edge exchange): "
+                            "next round"
+                        )
+                    e0 = ex.group_by[0][1]
+                    if not isinstance(e0, InputRef):
+                        raise PlanError(
+                            "MV-on-MV over a partitioned upstream: "
+                            "the leading group-by key must be a "
+                            "plain column: next round"
+                        )
+                    traced = trace_in_node(ni, pos, e0.index)
+                    if traced is None or traced != up_pk0:
+                        raise PlanError(
+                            "MV-on-MV over a partitioned upstream "
+                            "with REDUCED keys needs a cross-"
+                            "partition exchange on the attach edge: "
+                            "next round"
+                        )
+                    continue
+                raise PlanError(
+                    "MV-on-MV over a partitioned upstream supports "
+                    "project/filter/materialize chains and same-key "
+                    f"aggs (got {type(ex).__name__}): next round"
+                )
 
     @staticmethod
     def _agg_shard_safe(agg, node, plan: DagPlan) -> bool:
@@ -1874,6 +2048,18 @@ class Engine:
             rows = 0
             for _ in range(chunks_per_barrier):
                 rows += job.chunk_round()
+        if source_limits and getattr(job, "n_vnodes", None) is not None:
+            # Exchange-lite: a partitioned barrier consumes EXACTLY to
+            # the round fence, however many chunks that takes — every
+            # partition's cursor seals ON the fence, so handover
+            # cursor checks hold even though shuffled partitions see
+            # different owned-row densities.  (Bounded: pending() is
+            # capped by min(local history, fence).)
+            for _ in range(1 << 20):
+                if not self._fenced_pending(job):
+                    break
+                rows += job.run_chunks(chunks_per_barrier) \
+                    if hasattr(job, "run_chunks") else job.chunk_round()
         job.inject_barrier()
         dt = time.perf_counter() - t0
         self.metrics.inc("stream_rows_total", rows, job=job.name)
@@ -2017,46 +2203,128 @@ class Engine:
         return entry.job.committed_epoch
 
     # -- elastic scale plane (cluster/scale) -----------------------------
-    def _dml_tables_of(self, job) -> list[str]:
-        """Names of the DML tables this job's source reads (the tables
-        the cluster must replicate worker↔worker for partitions to see
-        identical streams)."""
-        rows = getattr(getattr(job, "source", None), "_rows", None)
+    def _job_sources(self, job) -> list:
+        """Every source reader of a job (one for a linear StreamingJob,
+        the sources dict for a DagJob)."""
+        if hasattr(job, "sources"):
+            return list(job.sources.values())
+        src = getattr(job, "source", None)
+        return [src] if src is not None else []
+
+    def _table_of_reader(self, reader) -> str | None:
+        rows = getattr(reader, "_rows", None)
         if rows is None:
-            return []
-        return [e.name for e in self.catalog.list("source")
-                if e.dml is not None and rows is e.dml._history]
+            return None
+        for e in self.catalog.list("source"):
+            if e.dml is not None and rows is e.dml._history:
+                return e.name
+        return None
+
+    def _dml_tables_of(self, job) -> list[str]:
+        """Names of the DML tables this job's sources read (the tables
+        the cluster exchanges worker↔worker so partitions see aligned
+        streams)."""
+        out: list[str] = []
+        for src in self._job_sources(job):
+            t = self._table_of_reader(src)
+            if t is not None and t not in out:
+                out.append(t)
+        return out
 
     def _apply_source_limits(self, job, limits: dict) -> None:
-        src = getattr(job, "source", None)
-        if src is None or not hasattr(src, "limit"):
-            return
-        for tbl in self._dml_tables_of(job):
-            if tbl in limits:
+        for src in self._job_sources(job):
+            if not hasattr(src, "limit"):
+                continue
+            tbl = self._table_of_reader(src)
+            if tbl is not None and tbl in limits:
                 src.limit = int(limits[tbl])
+
+    def _fenced_pending(self, job) -> int:
+        """Unconsumed positions below the round fence across the job's
+        fenced sources — a partitioned barrier drives this to ZERO so
+        every partition's cursor lands exactly ON the fence (stronger
+        than the PR-7 identical-consumption-math alignment, and the
+        property that keeps shuffled cursors equal even though each
+        partition's owned-row density differs)."""
+        return sum(
+            src.pending() for src in self._job_sources(job)
+            if getattr(src, "limit", None) is not None
+        )
+
+    @staticmethod
+    def _trace_input_col(prefix_execs, col: int) -> int | None:
+        """Trace an output column of an executor chain back to an
+        input column of the chain's first executor, or None when any
+        hop is not a plain InputRef (the shuffle planner then degrades
+        the edge to replicate mode — the gate still filters)."""
+        from risingwave_tpu.expr.node import InputRef
+        from risingwave_tpu.stream.executor import (
+            FilterExecutor,
+            HopWindowExecutor,
+            ProjectExecutor,
+        )
+
+        idx = int(col)
+        for ex in reversed(list(prefix_execs)):
+            if isinstance(ex, FilterExecutor):
+                continue
+            if isinstance(ex, HopWindowExecutor):
+                # row expansion appends window_start; input columns
+                # keep their positions
+                if idx >= len(ex.in_schema):
+                    return None
+                continue
+            if isinstance(ex, ProjectExecutor):
+                if idx >= len(ex.exprs):
+                    return None
+                e = ex.exprs[idx][1]
+                if not isinstance(e, InputRef):
+                    return None
+                idx = e.index
+                continue
+            return None
+        return idx
+
+    def _trace_source_col(self, prefix_execs, dist_expr) -> int | None:
+        """Raw source-column index of a distribution-key expression
+        evaluated AFTER ``prefix_execs`` (the shuffle key the ingest
+        leader hashes), or None when untraceable."""
+        from risingwave_tpu.expr.node import InputRef
+
+        if not isinstance(dist_expr, InputRef):
+            return None
+        return self._trace_input_col(prefix_execs, dist_expr.index)
 
     def partition_job(self, name: str, n_vnodes: int,
                       ckpt_key: str) -> dict:
         """Rebuild a freshly-adopted job as ONE partition of a
-        vnode-partitioned cluster job (the scale plane's unit): a
-        ``VnodeGateExecutor`` lands directly before the aggregation and
-        masks source rows to the owned vnode set; the checkpoint
-        lineage moves to ``ckpt_key`` so every partition checkpoints
-        independently in the SHARED store.
+        vnode-partitioned cluster job (the scale plane's unit):
+        ``VnodeGateExecutor``s land on the keyed edges and mask rows
+        to the owned vnode set; the checkpoint lineage moves to
+        ``ckpt_key`` so every partition checkpoints independently in
+        the SHARED store.
 
-        Eligibility (raises ``PlanError`` otherwise — the worker falls
-        back to whole-job placement):
+        Exchange-lite shapes (raises ``PlanError`` otherwise — the
+        worker falls back to whole-job placement):
 
         - a linear ``StreamingJob`` carrying exactly one MV:
-          stateless prefix → one ``HashAggExecutor`` → Materialize;
-        - no DISTINCT / retractable-min-max buckets / EOWC /
-          watermark-driven cleaning (their state is not sliceable or
-          their emission depends on the global stream);
-        - the leading GROUP BY expression (the distribution key) is a
-          NOT NULL integer-family value — host row values and raw
-          stored values then share one hash domain, so chunk routing,
-          checkpoint slicing, and read filtering agree exactly.
-        """
+          stateless prefix → one ``HashAggExecutor`` → Materialize
+          (gate before the agg, routed by the leading GROUP BY key);
+        - a two-source JOIN ``DagJob``: source → gate per side (routed
+          by that side's FIRST equi key) → hash join (rebuilt with
+          dense retractable sides — sliceable whole-key buckets) →
+          project/filter → Materialize whose LEADING pk column is the
+          preserved side's join key (one hash domain for routing,
+          state slicing, serving filters, and export seeding);
+        - no DISTINCT / minput / EOWC / watermark-driven cleaning /
+          temporal joins, and every routing key a NOT NULL
+          integer-family value.
+
+        The returned spec carries ``shuffle_cols`` — the raw source
+        column each DML table routes by when every hop back from the
+        key is a plain InputRef — which the meta's ``ExchangePlanner``
+        compiles into the sliced-ingest choreography (untraceable keys
+        degrade that table's edge to replicate mode)."""
         from risingwave_tpu.cluster.scale.gate import VnodeGateExecutor
         from risingwave_tpu.stream.executor import (
             FilterExecutor,
@@ -2069,7 +2337,7 @@ class Engine:
 
         entry = self.catalog.get(name)
         job = entry.job
-        if hasattr(job, "vnode_gate_idx"):
+        if hasattr(job, "vnode_gate_idx") or hasattr(job, "vnode_gates"):
             # already a partition on this engine (a restarted meta
             # re-adopting lineages): re-point the checkpoint lineage —
             # the caller's recover() then loads it
@@ -2079,13 +2347,19 @@ class Engine:
                     f"({job.n_vnodes} vs {n_vnodes})"
                 )
             job.ckpt_key = ckpt_key
-            agg = job.fragment.executors[job.vnode_gate_idx + 1]
             return {
                 "partitioned": True,
-                "dist": agg.group_by[0][0],
                 "dml_tables": self._dml_tables_of(job),
+                "shuffle_cols": getattr(job, "shuffle_cols", {}),
+                "edge_kinds": getattr(job, "edge_kinds", {}),
             }
-        if entry.kind != "mview" or not isinstance(job, StreamingJob):
+        if entry.kind != "mview":
+            raise PlanError(
+                f"{name!r} is not a streaming MV: not scale-eligible"
+            )
+        if isinstance(job, DagJob):
+            return self._partition_dag_job(entry, n_vnodes, ckpt_key)
+        if not isinstance(job, StreamingJob):
             raise PlanError(
                 f"{name!r} is not a linear streaming MV: not "
                 "scale-eligible"
@@ -2166,23 +2440,330 @@ class Engine:
         entry.mv_state_index = (entry.mv_state_index[0] + 1,) \
             + tuple(entry.mv_state_index[1:])
         self._serving_cache = {}
+        # exchange plan input: which raw source column each DML table
+        # routes by (None/absent = untraceable → replicate edge)
+        tables = self._dml_tables_of(part)
+        src_col = self._trace_source_col(execs[:agg_idx], dist_expr)
+        part.shuffle_cols = {t: src_col for t in tables} \
+            if src_col is not None else {}
+        part.edge_kinds = {t: "source" for t in tables}
+        self._apply_reader_filters(part)
         return {
             "partitioned": True,
             "dist": agg.group_by[0][0],
+            "dml_tables": tables,
+            "shuffle_cols": part.shuffle_cols,
+            "edge_kinds": part.edge_kinds,
+        }
+
+    def _partition_dag_job(self, entry: CatalogEntry, n_vnodes: int,
+                           ckpt_key: str) -> dict:
+        """Partition a two-source JOIN DagJob: gate each source edge by
+        that side's FIRST equi-key vnode (equal join keys share their
+        first column, so rows that can ever match co-locate), rebuild
+        the join with DENSE retractable sides (whole-key bucket
+        entries — the sliceable layout ``handover`` moves), and
+        require the MV's leading pk column to carry the preserved
+        side's join key so every keyed state in the tree slices,
+        serves, and exports in ONE vnode hash domain."""
+        from risingwave_tpu.cluster.scale.gate import VnodeGateExecutor
+        from risingwave_tpu.expr.node import InputRef
+        from risingwave_tpu.stream.dag import FragNode, JoinNode
+        from risingwave_tpu.stream.executor import (
+            FilterExecutor,
+            ProjectExecutor,
+        )
+        from risingwave_tpu.stream.fragment import Fragment
+        from risingwave_tpu.stream.hash_join import HashJoinExecutor
+        from risingwave_tpu.stream.materialize import MaterializeExecutor
+
+        name = entry.name
+        job = entry.job
+        riders = [e for e in self.catalog.list() if e.job is job]
+        if riders != [entry]:
+            raise PlanError(
+                f"{name!r} shares its job with other MVs/sinks: not "
+                "scale-eligible"
+            )
+        if job.barriers_seen:
+            raise PlanError(
+                f"{name!r} already ran unpartitioned barriers: "
+                "partitioning happens at adoption"
+            )
+        if getattr(job, "mesh", None) is not None or job.staged:
+            raise PlanError(
+                f"{name!r}: sharded/staged DAGs do not partition "
+                "across workers yet (mesh×vnode composition is the "
+                "next round)"
+            )
+        live = [(i, n) for i, n in enumerate(job.nodes)
+                if n is not None]
+        if len(live) != 2 or not isinstance(live[0][1], JoinNode) \
+                or not isinstance(live[1][1], FragNode):
+            raise PlanError(
+                f"{name!r}: partitioned DAGs are source ⋈ source → "
+                "materialize: not scale-eligible"
+            )
+        jn = live[0][1]
+        frag_node = live[1][1]
+        join = jn.join
+        if not isinstance(join, HashJoinExecutor):
+            raise PlanError(
+                f"{name!r}: only hash equi-joins partition (got "
+                f"{type(join).__name__}): not scale-eligible"
+            )
+        if join.join_type == "full_outer":
+            raise PlanError(
+                f"{name!r}: FULL OUTER join has no always-non-NULL "
+                "routing column: not scale-eligible"
+            )
+        if join.left_clean is not None or join.right_clean is not None:
+            raise PlanError(
+                f"{name!r}: watermark-cleaned join state is not "
+                "sliceable: not scale-eligible"
+            )
+        if jn.left[0] != "source" or jn.right[0] != "source" \
+                or jn.left == jn.right:
+            raise PlanError(
+                f"{name!r}: join sides must read two distinct "
+                "sources directly: not scale-eligible"
+            )
+        if frag_node.input != ("node", live[0][0]):
+            raise PlanError(
+                f"{name!r}: materialize must consume the join: not "
+                "scale-eligible"
+            )
+        for ks, schema in ((join.left_keys, join.left_schema),
+                           (join.right_keys, join.right_schema)):
+            k0 = ks[0]
+            if not isinstance(k0, InputRef):
+                raise PlanError(
+                    f"{name!r}: first join key must be a plain "
+                    "column: not scale-eligible"
+                )
+            f = k0.return_field(schema)
+            if f.nullable or not np.issubdtype(
+                    np.dtype(f.data_type.physical_dtype), np.integer):
+                raise PlanError(
+                    f"{name!r}: routing key {f.name!r} must be a "
+                    "NOT NULL integer-family column"
+                )
+        execs = list(frag_node.fragment.executors)
+        mats = [i for i, ex in enumerate(execs)
+                if isinstance(ex, MaterializeExecutor)]
+        if len(mats) != 1 or mats[0] != len(execs) - 1 or any(
+                not isinstance(ex, (FilterExecutor, ProjectExecutor))
+                for ex in execs[:-1]):
+            raise PlanError(
+                f"{name!r}: post-join chain must be project/filter → "
+                "materialize: not scale-eligible"
+            )
+        mv = execs[-1]
+        # the MV's LEADING pk column must carry the preserved side's
+        # join key — that one value is the row's vnode identity for
+        # state slicing, serving filters, and export seeding
+        left_pos = join.left_keys[0].index
+        if join.emit_pairs:
+            right_pos = len(join.left_schema) \
+                + join.right_keys[0].index
+        else:  # semi/anti: output is the preserved side alone
+            right_pos = join.right_keys[0].index
+        allowed = set()
+        if join.join_type == "inner":
+            allowed = {left_pos, right_pos}
+        elif join.preserve_left:
+            allowed = {left_pos}
+        else:
+            allowed = {right_pos}
+        traced = self._trace_input_col(execs[:-1], mv.pk_indices[0])
+        if traced is None or traced not in allowed:
+            raise PlanError(
+                f"{name!r}: the MV's leading pk column must be the "
+                "preserved side's join key: not scale-eligible"
+            )
+        # rebuild the join with DENSE (sliceable) sides; pool sides
+        # bump-allocate a shared row pool whose (hash, rank) tags do
+        # not slice by key
+        dense = HashJoinExecutor(
+            join.left_schema, join.right_schema,
+            join.left_keys, join.right_keys,
+            table_size=join.table_size,
+            left_bucket_cap=join.left_bucket_cap,
+            right_bucket_cap=join.right_bucket_cap,
+            left_table_size=join.left_table_size,
+            right_table_size=join.right_table_size,
+            out_capacity=join.out_capacity,
+            join_type=join.join_type,
+            left_storage="dense", right_storage="dense",
+        )
+        gate_l = VnodeGateExecutor(
+            join.left_schema, list(join.left_keys), n_vnodes
+        )
+        gate_r = VnodeGateExecutor(
+            join.right_schema, list(join.right_keys), n_vnodes
+        )
+        lname, rname = jn.left[1], jn.right[1]
+        for ex in execs:
+            if getattr(ex, "spill_ring", 0):
+                ex.spill_ring = 0
+        part = DagJob(
+            dict(job.sources),
+            [
+                FragNode(Fragment([gate_l], name=f"{name}_gate_l"),
+                         ("source", lname)),
+                FragNode(Fragment([gate_r], name=f"{name}_gate_r"),
+                         ("source", rname)),
+                JoinNode(dense, ("node", 0), ("node", 1)),
+                FragNode(Fragment(execs, name=f"{name}_part"),
+                         ("node", 2)),
+            ],
+            name=job.name,
+            checkpoint_frequency=job.checkpoint_frequency,
+            checkpoint_store=job.checkpoint_store,
+        )
+        part.maintenance_interval = job.maintenance_interval
+        part.snapshot_interval = job.snapshot_interval
+        part.metrics = getattr(job, "metrics", None)
+        part.ckpt_key = ckpt_key
+        part.vnode_gates = [(0, 0), (1, 0)]
+        part.n_vnodes = n_vnodes
+        part.vnodes = frozenset(range(n_vnodes))
+        self.jobs[self.jobs.index(job)] = part
+        entry.job = part
+        entry.mv_state_index = (3, len(execs) - 1)
+        entry.dag_nodes = [0, 1, 2, 3]
+        self._serving_cache = {}
+        # shuffle plan: each side's table routes by its own key column
+        part.shuffle_cols = {}
+        for src_name, keys in ((lname, join.left_keys),
+                               (rname, join.right_keys)):
+            tbl = self._table_of_reader(part.sources[src_name])
+            if tbl is not None:
+                part.shuffle_cols[tbl] = keys[0].index
+        part.edge_kinds = {t: "join" for t in part.shuffle_cols}
+        self._apply_reader_filters(part)
+        return {
+            "partitioned": True,
+            "dist": join.left_schema[left_pos].name,
             "dml_tables": self._dml_tables_of(part),
+            "shuffle_cols": part.shuffle_cols,
+            "edge_kinds": part.edge_kinds,
         }
 
     def set_job_vnodes(self, name: str, vnodes) -> None:
         """Swap the partition's owned-vnode mask (STATE, not code: the
-        compiled fragment programs never retrace)."""
+        compiled fragment programs never retrace).  The gate's dropped
+        counter rides along untouched — it audits the whole life of
+        the partition, not one ownership."""
+        import jax.numpy as jnp
+
+        def _with_mask(gate, old_state):
+            dropped = old_state[1] if isinstance(old_state, tuple) \
+                else jnp.zeros((), jnp.int64)
+            return (gate.make_mask(job.vnodes), dropped)
+
         entry = self.catalog.get(name)
         job = entry.job
-        gi = job.vnode_gate_idx
-        gate = job.fragment.executors[gi]
         job.vnodes = frozenset(int(v) for v in vnodes)
-        states = list(job.states)
-        states[gi] = gate.make_mask(job.vnodes)
-        job.states = tuple(states)
+        if hasattr(job, "vnode_gates"):
+            states = list(job.states)
+            for ni, ei in job.vnode_gates:
+                gate = job.nodes[ni].fragment.executors[ei]
+                node_states = list(states[ni])
+                node_states[ei] = _with_mask(gate, node_states[ei])
+                states[ni] = tuple(node_states)
+            job.states = tuple(states)
+        else:
+            gi = job.vnode_gate_idx
+            gate = job.fragment.executors[gi]
+            states = list(job.states)
+            states[gi] = _with_mask(gate, states[gi])
+            job.states = tuple(states)
+        self._apply_reader_filters(job)
+
+    def apply_shuffle_plan(self, tables: dict) -> None:
+        """Install the pushed choreography's per-table shuffle spec —
+        ``{table: {"key_col", "n_vnodes", "mode"}}`` — and refresh
+        every partitioned job's reader filters against it.  Called by
+        the worker on every routing push."""
+        self._shuffle_tables = {
+            t: e for t, e in (tables or {}).items()
+            if e.get("mode") == "shuffle"
+            and e.get("key_col") is not None
+        }
+        for job in self.jobs:
+            if getattr(job, "n_vnodes", None) is not None:
+                self._apply_reader_filters(job)
+
+    def _apply_reader_filters(self, job) -> None:
+        """Point the job's DML readers at its owned vnode set on every
+        shuffled table (the reader packs chunks with owned rows only —
+        the gate downstream is the assert)."""
+        plan = getattr(self, "_shuffle_tables", None) or {}
+        own = getattr(job, "vnodes", None)
+        for src in self._job_sources(job):
+            if not hasattr(src, "vnode_filter"):
+                continue
+            tbl = self._table_of_reader(src)
+            spec = plan.get(tbl)
+            # the job's own traced key must agree with the pushed plan
+            # (planner compiles from the same spec, but stay paranoid)
+            mine = getattr(job, "shuffle_cols", {}).get(tbl)
+            if spec is None or own is None or mine is None \
+                    or int(spec["key_col"]) != int(mine):
+                src.vnode_filter = None
+                continue
+            src.vnode_filter = (
+                int(spec["key_col"]),
+                frozenset(int(v) for v in own),
+                int(spec["n_vnodes"]),
+            )
+
+    def table_consumption_floor(self, table: str) -> int:
+        """Lowest unconsumed history position across this engine's
+        readers of one DML table — positions below it are never read
+        again, so the worker's fence completeness audit starts here
+        instead of rescanning the whole history every round."""
+        entry = self.catalog.get(table) if table in self.catalog \
+            else None
+        if entry is None or entry.dml is None:
+            return 0
+        floors = [
+            src.offset
+            for job in self.jobs
+            for src in self._job_sources(job)
+            if getattr(src, "_rows", None) is entry.dml._history
+        ]
+        return min(floors) if floors else 0
+
+    def partition_stats(self) -> dict:
+        """Per-partitioned-job observability: owned vnodes, the
+        device gate-drop audit counters, and reader-side filtered-row
+        counts (one device readback per gate — off the hot path)."""
+        out: dict = {}
+        for job in self.jobs:
+            if getattr(job, "n_vnodes", None) is None:
+                continue
+            dropped = 0
+            if hasattr(job, "vnode_gates"):
+                for ni, ei in job.vnode_gates:
+                    st = job.states[ni][ei]
+                    if isinstance(st, tuple):
+                        dropped += int(np.asarray(st[1]))
+            elif hasattr(job, "vnode_gate_idx"):
+                st = job.states[job.vnode_gate_idx]
+                if isinstance(st, tuple):
+                    dropped += int(np.asarray(st[1]))
+            out[job.name] = {
+                "vnodes": sorted(job.vnodes),
+                "gate_dropped": dropped,
+                "reader_filtered": sum(
+                    getattr(s, "filtered_rows", 0)
+                    for s in self._job_sources(job)
+                ),
+                "shuffle_cols": dict(getattr(job, "shuffle_cols", {})),
+            }
+        return out
 
     def repartition_job(self, name: str, vnodes, transfers: list,
                         rewind_epoch: int | None = None) -> dict:
@@ -2195,29 +2776,47 @@ class Engine:
         "vnodes": [...]}]`` — the slices are read from the SHARED
         checkpoint store; only moved vnodes' entries leave disk."""
         from risingwave_tpu.cluster.scale.handover import (
-            clear_vnodes,
-            slice_partition_states,
-            transplant,
+            clear_job_vnodes,
+            slice_job_states,
+            transplant_job,
         )
         from risingwave_tpu.stream.runtime import restore_source
 
         entry = self.catalog.get(name)
         job = entry.job
-        if not hasattr(job, "vnode_gate_idx"):
+        if not hasattr(job, "vnode_gate_idx") \
+                and not hasattr(job, "vnode_gates"):
             raise PlanError(f"{name!r} is not a partitioned job")
+        is_dag = isinstance(job, DagJob)
         if rewind_epoch is not None and (
                 job.committed_epoch != rewind_epoch
                 or job.sealed_epoch != rewind_epoch):
             job.recover(rewind_epoch)
+
+        def _src_state():
+            if is_dag:
+                return {n: (s.state() if hasattr(s, "state") else {})
+                        for n, s in job.sources.items()}
+            return job.source.state() \
+                if hasattr(job.source, "state") else {}
+
+        def _check_cursor(ours, donor) -> None:
+            if ("offset" in ours and "offset" in donor
+                    and ours["offset"] != donor["offset"]):
+                raise RuntimeError(
+                    f"handover cursor mismatch for {name!r}: "
+                    f"local {ours['offset']} vs donor "
+                    f"{donor['offset']}"
+                )
+
         stats = []
         cleared = 0
         if transfers:
-            executors = job.fragment.executors
             gained = sorted(
                 set(int(v) for t in transfers for v in t["vnodes"])
             )
-            job.states, cleared = clear_vnodes(
-                executors, job.states, gained, job.n_vnodes
+            job.states, cleared = clear_job_vnodes(
+                job, job.states, gained, job.n_vnodes
             )
             fresh = job.barriers_seen == 0 and job.committed_epoch == 0
             for t in transfers:
@@ -2230,28 +2829,30 @@ class Engine:
                         "not found in the shared store"
                     )
                 _, d_states, d_src = loaded
-                sl = slice_partition_states(
-                    executors, d_states, t["vnodes"], job.n_vnodes
+                sl = slice_job_states(
+                    job, d_states, t["vnodes"], job.n_vnodes
                 )
-                job.states, moved = transplant(
-                    executors, job.states, sl
+                job.states, moved = transplant_job(
+                    job, job.states, sl
                 )
                 if fresh:
-                    # all donors sealed the same round over the same
-                    # replicated stream: any donor's cursor is THE
-                    # cursor of the handover epoch
-                    restore_source(job.source, d_src)
+                    # all donors sealed the same round at the same
+                    # fence: any donor's cursor is THE cursor of the
+                    # handover epoch
+                    if is_dag:
+                        for sname, src in job.sources.items():
+                            restore_source(src, d_src.get(sname, {}))
+                    else:
+                        restore_source(job.source, d_src)
                     fresh = False
                 else:
-                    ours = job.source.state() \
-                        if hasattr(job.source, "state") else {}
-                    if ("offset" in ours and "offset" in d_src
-                            and ours["offset"] != d_src["offset"]):
-                        raise RuntimeError(
-                            f"handover cursor mismatch for {name!r}: "
-                            f"local {ours['offset']} vs donor "
-                            f"{d_src['offset']}"
-                        )
+                    ours = _src_state()
+                    if is_dag:
+                        for sname in job.sources:
+                            _check_cursor(ours.get(sname, {}),
+                                          d_src.get(sname, {}))
+                    else:
+                        _check_cursor(ours, d_src)
                 stats.append({
                     "ckpt": t["ckpt"],
                     "vnodes": len(t["vnodes"]),
@@ -2268,11 +2869,9 @@ class Engine:
             # state — the crash-mid-scale hole the scale_kill chaos
             # schedule proves closed
             self.checkpoint_store.invalidate(job.ckpt_key)
-            src_state = job.source.state() \
-                if hasattr(job.source, "state") else {}
             self.checkpoint_store.save(
                 job.ckpt_key, job.committed_epoch, job.states,
-                src_state,
+                _src_state(),
             )
             durable = job.committed_epoch
         # the export diff base is vnode-filtered: ownership changed, so
@@ -2531,6 +3130,7 @@ class Engine:
                 "name": f.name, "kind": kind,
                 "scale": int(getattr(f, "decimal_scale", 0) or 0),
                 "hidden": f.name.startswith("_hidden_"),
+                "nullable": bool(f.nullable),
             })
         doc = {"mv": entry.name, "columns": cols, "pk": list(pk)}
         if entry.index_on is not None:

@@ -116,6 +116,39 @@ class CheckpointStore:
         root = getattr(self.store, "root", None)
         return os.path.join(root, key) if root is not None else key
 
+    def _manifest_txn(self):
+        """Cross-PROCESS manifest transaction: ``self._lock`` excludes
+        this store's uploader threads; an OS-level flock on the shared
+        directory excludes OTHER worker processes (and other store
+        instances in one process).  Exchange-lite's parallel barrier
+        dispatch lets several workers' uploaders commit different
+        lineages concurrently over ONE shared manifest — without this
+        the read-modify-write cycles interleave and lose each other's
+        epoch records (observed as broken delta chains).  In-memory
+        stores (single-process by construction) skip the file lock."""
+        import contextlib
+
+        root = getattr(self.store, "root", None)
+
+        @contextlib.contextmanager
+        def txn():
+            with self._lock:
+                if root is None:
+                    yield
+                    return
+                import fcntl
+
+                os.makedirs(root, exist_ok=True)
+                with open(os.path.join(root, "MANIFEST.lock"),
+                          "a+b") as f:
+                    fcntl.flock(f, fcntl.LOCK_EX)
+                    try:
+                        yield
+                    finally:
+                        fcntl.flock(f, fcntl.LOCK_UN)
+
+        return txn()
+
     # -- manifest -------------------------------------------------------
     def _load_manifest(self) -> dict:
         if not self.store.exists(self._MANIFEST):
@@ -266,7 +299,7 @@ class CheckpointStore:
             "source_state": prep["source_state"],
             "epoch": epoch, "kind": kind,
         })
-        with self._lock:
+        with self._manifest_txn():
             self.store.put(key + ".npz", npz_bytes)
             self.store.put(key + ".meta", meta_bytes)
             m = self._load_manifest()
@@ -486,7 +519,7 @@ class CheckpointStore:
         from the next full onward stay) — from the manifest.  Dropped
         objects become vacuumable orphans; a durable quarantine note
         records each.  Returns the dropped epochs."""
-        with self._lock:
+        with self._manifest_txn():
             m = self._load_manifest()
             job = m["jobs"].get(job_name)
             if job is None or epoch not in job.get("epochs", []):
@@ -585,6 +618,20 @@ def _mc_encode_value(v, field) -> bytes:
     from risingwave_tpu.storage import codec as C
 
     t = field.data_type
+    if field.nullable:
+        # NULLABLE pk components (outer-join MV keys) carry a
+        # presence prefix: \x00 + enc for present values, \x01 for
+        # NULL — present values keep their relative byte order, NULLs
+        # sort LAST (the pg default the serving ORDER BY pushdown
+        # mirrors).  Non-nullable fields stay prefix-free, so every
+        # pre-existing key encoding is unchanged.
+        if v is None:
+            return b"\x01"
+        from dataclasses import replace as _replace
+
+        return b"\x00" + _mc_encode_value(
+            v, _replace(field, nullable=False)
+        )
     if t.is_string:
         # terminated string encoding keeps prefix ordering correct
         return str(v).encode() + b"\x00"

@@ -1236,7 +1236,9 @@ class DagJob(CheckpointPipelineMixin):
         return out
 
     def _spill_key(self, idx: int, j: int, s: int) -> str:
-        base = f"{self.name}@spill{idx}_{j}"
+        # keyed by the checkpoint LINEAGE (== name for whole jobs;
+        # a partitioned DagJob's spill follows its partition lineage)
+        base = f"{self.ckpt_key}@spill{idx}_{j}"
         return base if self.n_shards == 1 else f"{base}_s{s}"
 
     def _ensure_spill_tier(self, idx: int, j: int, ex) -> None:
@@ -1362,10 +1364,14 @@ class DagJob(CheckpointPipelineMixin):
             )
             self.states = inject_p(self.states, stacked)
 
-    def recover(self) -> None:
+    def recover(self, epoch: int | None = None) -> None:
         """Reset to the last committed checkpoint (ref §3.5).  Drains
         the upload queue first — sealed epochs finish becoming durable
-        before the rewind target is chosen."""
+        before the rewind target is chosen.  ``epoch`` pins the rewind
+        to a specific retained checkpoint (partitioned DagJobs rewind
+        to the handover round before a vnode-slice transplant, exactly
+        like StreamingJob partitions); checkpoints live under
+        ``ckpt_key`` — a partition's lineage, not the job name."""
         self._counters = None
         if self._uploader is not None:
             self._uploader.drain(raise_error=False)
@@ -1376,10 +1382,10 @@ class DagJob(CheckpointPipelineMixin):
             # see StreamingJob.recover: rewinds invalidate the digest
             # cache so the next save re-bases with a full snapshot
             # (and vacuum orphan files of a crashed upload)
-            self.checkpoint_store.invalidate(self.name)
-            loaded = self.checkpoint_store.load(self.name)
+            self.checkpoint_store.invalidate(self.ckpt_key)
+            loaded = self.checkpoint_store.load(self.ckpt_key, epoch)
             if loaded is not None:
-                epoch, states, src_state = loaded
+                epoch_v, states, src_state = loaded
                 if self.mesh is not None:
                     from jax.sharding import (
                         NamedSharding, PartitionSpec as P,
@@ -1389,11 +1395,11 @@ class DagJob(CheckpointPipelineMixin):
                     )
                 else:
                     self.states = jax.device_put(states)
-                self.committed_epoch = epoch
-                self.sealed_epoch = epoch
+                self.committed_epoch = epoch_v
+                self.sealed_epoch = epoch_v
                 for name, src in self.sources.items():
                     restore_source(src, src_state.get(name, {}))
-                self._restore_spill_tiers(epoch)
+                self._restore_spill_tiers(epoch_v)
                 return
         if not self.checkpoints:
             self.states = self._init_states()

@@ -112,7 +112,8 @@ READS = [
 ]
 
 SCHEDULES = ("rpc_drop_storm", "meta_kill", "store_faults",
-             "scale_storm", "corruption_storm", "scale_kill")
+             "scale_storm", "corruption_storm", "scale_kill",
+             "shuffle_storm")
 
 #: scale_storm topology: a vnode-partitioned aggregation over a
 #: replicated DML table (the worker↔worker exchange seam under test)
@@ -284,6 +285,19 @@ def _fault_envs(schedule: str, seed: int) -> dict:
         )
         fab.rules += ck.rules
         return {"worker": fab.to_json()}
+    if schedule == "shuffle_storm":
+        # Exchange-lite seam under storm: seeded DROPS on the sliced
+        # peer exchange plus ONE bounded one-way partition
+        # (worker1>worker2 dark while worker2>worker1 flows) during
+        # partitioned-JOIN ingest — lost sliced batches and the dark
+        # direction must heal through the fence completeness audit
+        # (fetch_slice / fetch_positions), never through the gate
+        peer_fab = FaultFabric.storm(
+            seed, op="rpc", substr=">worker", n=8, span=10,
+            modes=("drop",),
+        )
+        peer_fab.partition("worker1", "worker2", times=4, after=20)
+        return {"worker": peer_fab.to_json()}
     if schedule == "scale_kill":
         # ONE seeded delay on the donor's mask-swap RPC during the
         # handover (meta-side label ``meta>worker1/repartition``): the
@@ -309,6 +323,10 @@ def run_schedule(schedule: str, seed: int = 7, rounds: int = 10,
         return run_scale_kill(seed=seed, rounds=rounds,
                               scale_at_round=kill_at_round,
                               readers=readers, data_dir=data_dir)
+    if schedule == "shuffle_storm":
+        return run_shuffle_storm(seed=seed, rounds=rounds,
+                                 scale_at_round=kill_at_round,
+                                 readers=readers, data_dir=data_dir)
     data_dir = data_dir or tempfile.mkdtemp(
         prefix=f"chaos_{schedule}_")
     envs = _fault_envs(schedule, seed)
@@ -716,6 +734,227 @@ def run_scale_storm(seed: int = 7, rounds: int = 10,
         and summary["faults_injected"] > 0
         and summary["exchange_faults_absorbed"] > 0
         and summary["active_workers"] == [1, 2]
+    )
+    return summary
+
+
+#: shuffle_storm topology: a vnode-PARTITIONED JOIN over two sliced-
+#: ingest tables — the Exchange-lite seam under storm.  LEFT OUTER so
+#: mid-stream b-arrivals retract their pad rows (retraction churn
+#: through the chaos window).
+SHUFFLE_DDL = [
+    "CREATE TABLE a (k BIGINT, v BIGINT)",
+    "CREATE TABLE b (k BIGINT, w BIGINT)",
+    """CREATE MATERIALIZED VIEW j AS
+    SELECT a.k AS k, a.v AS v, b.w AS w
+    FROM a LEFT JOIN b ON a.k = b.k""",
+]
+SHUFFLE_READ = "SELECT k, v, w FROM j"
+SHUFFLE_KEYS = 97
+
+
+def run_shuffle_storm(seed: int = 7, rounds: int = 10,
+                      scale_at_round: int = 4, readers: int = 2,
+                      data_dir: str | None = None) -> dict:
+    """Seeded drops + a one-way partition on the SLICED exchange seam
+    during partitioned-JOIN ingest (see module docstring): lost
+    sliced batches heal through the fence completeness audit, reads
+    stay zero-error, the join MV converges byte-identical, and the
+    gate audit counters prove no row ever reached a partition it did
+    not own."""
+    data_dir = data_dir or tempfile.mkdtemp(prefix="chaos_shuffle_")
+    envs = _fault_envs("shuffle_storm", seed)
+    deterministic = envs == _fault_envs("shuffle_storm", seed)
+
+    rpc_port = _free_port()
+    meta_proc = _spawn_meta(data_dir, rpc_port, "a",
+                            scale_partitioning=True)
+    _wait_port(rpc_port)
+    procs = [_spawn_worker(rpc_port, data_dir, i,
+                           fault_env=envs.get("worker"))
+             for i in range(2)]
+    driver = MetaDriver(rpc_port)
+    state = {"reads": 0, "read_errors": [], "tick_retries": 0,
+             "rows_a": [], "rows_b": []}
+    stop = threading.Event()
+
+    def read_loop():
+        while not stop.is_set():
+            try:
+                driver.call("serve", sql=SHUFFLE_READ,
+                            deadline_s=180.0)
+                state["reads"] += 1
+            except Exception as e:  # noqa: BLE001
+                state["read_errors"].append(repr(e))
+            time.sleep(0.05)
+
+    def drive_round(deadline_s: float = 240.0) -> None:
+        deadline = time.monotonic() + deadline_s
+        while True:
+            res = driver.call("tick", chunks_per_barrier=2)
+            if res["committed"]:
+                return
+            state["tick_retries"] += 1
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"round never committed (shuffle_storm, "
+                    f"seed {seed})")
+            time.sleep(0.2)
+
+    try:
+        deadline = time.monotonic() + 180
+        while True:
+            st = driver.call("cluster_state", deadline_s=120.0)
+            if sum(w["alive"] for w in st["workers"]) >= 2:
+                break
+            for p in procs:
+                if p.poll() is not None:
+                    raise RuntimeError(
+                        f"worker died at startup (logs in {data_dir})")
+            if time.monotonic() > deadline:
+                raise TimeoutError("cluster never assembled")
+            time.sleep(0.25)
+
+        driver.call("cluster_scale", n=2)  # partitioned from round 0
+        for sql in SHUFFLE_DDL:
+            driver.call("execute_ddl", sql=sql)
+
+        def ingest_a(i0: int, n: int) -> None:
+            rows = [((i0 + j) % SHUFFLE_KEYS, 3 * (i0 + j) + 1)
+                    for j in range(n)]
+            vals = ",".join(f"({k},{v})" for k, v in rows)
+            driver.call("execute_ddl",
+                        sql=f"INSERT INTO a VALUES {vals}")
+            state["rows_a"].extend(rows)
+
+        def ingest_b(ks) -> None:
+            rows = [(k, 1000 + 7 * k) for k in ks]
+            vals = ",".join(f"({k},{w})" for k, w in rows)
+            driver.call("execute_ddl",
+                        sql=f"INSERT INTO b VALUES {vals}")
+            state["rows_b"].extend(rows)
+
+        # half the keys matched up front; the other half arrives
+        # MID-storm so every pad row retracts under fire
+        ingest_b(range(0, SHUFFLE_KEYS, 2))
+
+        threads = [threading.Thread(target=read_loop, daemon=True)
+                   for _ in range(readers)]
+        for t in threads:
+            t.start()
+
+        i0 = 0
+        committed = 0
+        b_late = False
+        while committed < rounds:
+            for _ in range(4):
+                ingest_a(i0, 24)
+                i0 += 24
+            drive_round()
+            committed = int(driver.call(
+                "cluster_state")["cluster_epoch"])
+            if not b_late and committed >= scale_at_round:
+                b_late = True
+                ingest_b(range(1, SHUFFLE_KEYS, 2))
+        total_a = len(state["rows_a"])
+        # left outer with exactly one b-row per key: |j| == |a|
+        drain_deadline = time.monotonic() + 300
+        while True:
+            drive_round()
+            rows = driver.call("serve", sql=SHUFFLE_READ)["rows"]
+            if len(rows) == total_a \
+                    and all(r[2] is not None for r in rows):
+                break
+            if time.monotonic() > drain_deadline:
+                raise TimeoutError("shuffle_storm never drained")
+
+        stop.set()
+        for t in threads:
+            t.join(timeout=15)
+        faults = driver.call("cluster_faults")
+        final_state = driver.call("cluster_state")
+        cluster_rows = sorted(
+            tuple(int(x) for x in r)
+            for r in driver.call("serve", sql=SHUFFLE_READ)["rows"]
+        )
+    finally:
+        stop.set()
+        for p in procs + [meta_proc]:
+            if p.poll() is None:
+                p.kill()
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+        driver.close()
+
+    from risingwave_tpu.common.config import RwConfig
+    from risingwave_tpu.sql.engine import Engine
+
+    eng = Engine(RwConfig.from_dict(CONFIG))
+    for sql in SHUFFLE_DDL:
+        eng.execute(sql)
+    b1 = state["rows_b"][:len(range(0, SHUFFLE_KEYS, 2))]
+    b2 = state["rows_b"][len(b1):]
+    eng.execute("INSERT INTO b VALUES "
+                + ",".join(f"({k},{w})" for k, w in b1))
+    sent = state["rows_a"]
+    # replay a in the same interleaving: first-half b, then a up to
+    # the late-b position, then late b, then the rest — the join is
+    # retraction-consistent so only the FINAL state must match, and
+    # it does for any interleaving once all rows land
+    for i in range(0, len(sent), 1024):
+        vals = ",".join(f"({k},{v})" for k, v in sent[i:i + 1024])
+        eng.execute(f"INSERT INTO a VALUES {vals}")
+    if b2:
+        eng.execute("INSERT INTO b VALUES "
+                    + ",".join(f"({k},{w})" for k, w in b2))
+    for _ in range(4096):
+        eng.tick(barriers=1, chunks_per_barrier=2)
+        rows = eng.execute(SHUFFLE_READ)
+        if len(rows) == len(sent) \
+                and all(r[2] is not None for r in rows):
+            break
+    single_rows = sorted(
+        tuple(int(x) for x in r) for r in eng.execute(SHUFFLE_READ)
+    )
+
+    worker_faults = [v for v in faults["workers"].values() if v]
+    injected = sum((v["fabric"] or {}).get("injected_total", 0)
+                   for v in worker_faults)
+    absorbed = sum(v["rpc_retries_total"]
+                   + v.get("exchange_fetches", 0)
+                   + v.get("exchange_send_failures", 0)
+                   for v in worker_faults)
+    summary = {
+        "schedule": "shuffle_storm",
+        "seed": seed,
+        "deterministic_expansion": deterministic,
+        "rounds": rounds,
+        "rounds_committed": int(final_state["cluster_epoch"]),
+        "rows_ingested": len(sent),
+        "reads": state["reads"],
+        "read_errors": len(state["read_errors"]),
+        "read_error_samples": state["read_errors"][:3],
+        "tick_retries": state["tick_retries"],
+        "faults_injected": injected,
+        "exchange_faults_absorbed": absorbed,
+        "shuffled_tables": list((final_state.get("exchange") or {})
+                                .get("tables", {})),
+        "mv_mismatches": int(cluster_rows != single_rows),
+        "mv_rows": len(cluster_rows),
+        "partitions": len(final_state["jobs"][0]["partitions"] or []),
+        "data_dir": data_dir,
+    }
+    summary["ok"] = bool(
+        summary["deterministic_expansion"]
+        and summary["read_errors"] == 0
+        and summary["rounds_committed"] >= rounds
+        and summary["mv_mismatches"] == 0
+        and summary["partitions"] == 2
+        and summary["faults_injected"] > 0
+        and summary["exchange_faults_absorbed"] > 0
+        and sorted(summary["shuffled_tables"]) == ["a", "b"]
     )
     return summary
 

@@ -51,3 +51,21 @@ def test_chaos_campaign_meta_kill(tmp_path):
     assert summary["rounds_committed"] >= summary["rounds"]
     assert summary["mv_mismatches"] == 0
     assert summary["worker_registrations"] >= 4  # 2 workers × 2
+
+
+@pytest.mark.slow
+def test_shuffle_storm(tmp_path):
+    """Exchange-lite acceptance: seeded drops + a one-way
+    worker1>worker2 partition on the SLICED exchange seam during
+    partitioned-JOIN ingest with mid-stream retraction churn — lost
+    sliced batches heal through the fence completeness audit, reads
+    stay zero-error, and the join MV converges byte-identical to a
+    single node."""
+    summary = _run("shuffle_storm", str(tmp_path), rounds=6)
+    assert summary["ok"], summary
+    assert summary["read_errors"] == 0, summary["read_error_samples"]
+    assert summary["mv_mismatches"] == 0
+    assert summary["faults_injected"] > 0
+    assert summary["exchange_faults_absorbed"] > 0
+    assert sorted(summary["shuffled_tables"]) == ["a", "b"]
+    assert summary["partitions"] == 2

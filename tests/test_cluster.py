@@ -419,8 +419,10 @@ def test_meta_restart_recovers_and_workers_reregister(tmp_path):
         meta2.start(port=port, monitor=False, compactor=False)
 
         # workers re-register through their heartbeat loops (the old
-        # ids answer "unknown worker" → RpcError → re-register)
-        deadline = time.monotonic() + 30
+        # ids answer "unknown worker" → RpcError → re-register).
+        # Generous deadline: on a loaded 1-core box the re-adoption
+        # recovery loads can push past 30s
+        deadline = time.monotonic() + 90
         while len(meta2.live_workers()) < 2 or any(
                 j.worker_id is None for j in meta2.jobs.values()):
             meta2.check_heartbeats()  # drives _assign_pending

@@ -679,3 +679,64 @@ def test_dag_fused_fallback_counter_exported():
                           reason="host_chunk_source")
     assert got >= 1
     assert "dag_fused_fallback_total" in eng.metrics.render_prometheus()
+
+
+def test_exchange_metrics_exported_and_retired(tmp_path):
+    """Exchange-lite satellite: the sliced peer exchange exports
+    per-EDGE counters (rows/bytes/batches) plus a per-batch latency
+    histogram on the sending worker, and the meta mirrors per-worker
+    exchange gauges that are RETIRED with the worker — exactly the
+    PR-7/PR-10 per-peer series discipline."""
+    from risingwave_tpu.cluster import ComputeWorker, MetaService
+
+    meta = MetaService(str(tmp_path), heartbeat_timeout_s=60.0,
+                       scale_partitioning=True, n_vnodes=16)
+    meta.start(port=0, monitor=False)
+    addr = f"127.0.0.1:{meta.rpc_port}"
+    w1 = ComputeWorker(addr, str(tmp_path),
+                       heartbeat_interval_s=5.0).start()
+    w2 = ComputeWorker(addr, str(tmp_path),
+                       heartbeat_interval_s=5.0).start()
+    try:
+        meta.scale(2)
+        meta.execute_ddl("CREATE TABLE t (k BIGINT, v BIGINT)")
+        meta.execute_ddl(
+            "CREATE MATERIALIZED VIEW agg AS "
+            "SELECT k, count(*) AS n FROM t GROUP BY k"
+        )
+        # the compiled choreography marks the table shuffled
+        ex = meta.state()["exchange"]
+        assert ex["tables"]["t"]["mode"] == "shuffle"
+        assert ex["tables"]["t"]["key_col"] == 0
+        assert any(s["edge"] == "src:t>agg" for s in ex["specs"])
+        vals = ",".join(f"({i % 7},{i})" for i in range(64))
+        meta.execute_ddl(f"INSERT INTO t VALUES {vals}")
+        for _ in range(3):
+            assert meta.tick(1)["committed"]
+
+        # per-edge counters + latency histogram on the SENDING worker
+        leader = w1 if "agg" in {j.name for j in w1.engine.jobs} \
+            and w1.worker_id == min(w1.worker_id, w2.worker_id) \
+            else w2
+        text = leader.engine.metrics.render_prometheus()
+        assert 'cluster_exchange_rows_total{edge="src:t>agg"}' in text
+        assert 'cluster_exchange_bytes_total{edge="src:t>agg"}' in text
+        assert 'cluster_exchange_batches_total{edge="src:t>agg"}' \
+            in text
+        assert 'cluster_exchange_batch_seconds_count' \
+            '{edge="src:t>agg"}' in text
+        assert leader.rpc_metrics()["prometheus"] == text
+
+        # meta-side per-worker mirrors exist for the leader...
+        lead_id = str(leader.worker_id)
+        assert meta.metrics.get("cluster_worker_exchange_rows_out",
+                                worker=lead_id) > 0
+        # ...and are RETIRED with the worker
+        (dead := w2).stop()
+        meta.rpc_unregister_worker(dead.worker_id)
+        text = meta.metrics.render_prometheus()
+        assert f'worker="{dead.worker_id}"' not in text
+    finally:
+        w1.stop()
+        w2.stop()
+        meta.stop()

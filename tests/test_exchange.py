@@ -1,0 +1,190 @@
+"""Exchange-lite: the cluster shuffle plane (ISSUE 11).
+
+- the host-side hash twin is bit-identical to the device hash (the
+  property every slicing/filter/gate agreement rests on);
+- ExchangePlanner compiles a deterministic, JSON-round-trippable
+  choreography (shuffle vs replicate per table, standby, slices);
+- route_batch slices one batch per peer (owned rows + the leader's
+  slice to the standby), positions elided and re-derived exactly;
+- sparse histories: global positions, idempotent redelivery,
+  hole-fill, gap refusal, ownership completeness audit;
+- the reader-side vnode filter packs chunks with owned rows only and
+  the VnodeGate state carries a zero drop counter on that path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from risingwave_tpu.cluster.exchange import (
+    Choreography,
+    ExchangePlanner,
+    ShuffleService,
+    vnodes_of_rows,
+)
+from risingwave_tpu.common.types import DataType, Field, Schema
+from risingwave_tpu.connector.dml import TableDmlManager
+
+N_VN = 16
+SCHEMA = Schema((Field("k", DataType.INT64, nullable=False),
+                 Field("v", DataType.INT64, nullable=False)))
+
+
+def _jobs(owners1, owners2):
+    return [{"name": "agg", "dml_tables": ["t"],
+             "shuffle_cols": {"t": 0}, "kinds": {"t": "source"},
+             "owners": {1: list(owners1), 2: list(owners2)}}]
+
+
+def test_host_hash_matches_device_hash():
+    import jax.numpy as jnp
+
+    from risingwave_tpu.common.hash import (
+        hash64_columns,
+        hash64_i64_host,
+    )
+
+    vals = np.concatenate([
+        np.arange(-1000, 1000, dtype=np.int64),
+        np.array([0, 1, -1, 2**62, -(2**62), 123456789012345],
+                 np.int64),
+    ])
+    dev = np.asarray(hash64_columns([jnp.asarray(vals)]))
+    host = hash64_i64_host(vals)
+    assert (dev == host).all()
+
+
+def test_planner_compiles_and_roundtrips():
+    ch = ExchangePlanner.compile(
+        _jobs(range(0, 8), range(8, 16)), N_VN, version=5)
+    t = ch.tables["t"]
+    assert t["mode"] == "shuffle" and t["key_col"] == 0
+    assert t["leader"] == 1 and t["standby"] == 2
+    assert t["slices"][1] == list(range(0, 8))
+    assert [s.edge for s in ch.specs] == ["src:t>agg"]
+    # JSON round trip is exact (the routing-push wire format)
+    ch2 = Choreography.from_doc(ch.to_doc())
+    assert ch2.to_doc() == ch.to_doc()
+    # untraceable key → the edge degrades to replicate
+    jobs = _jobs(range(0, 8), range(8, 16))
+    jobs[0]["shuffle_cols"] = {}
+    ch3 = ExchangePlanner.compile(jobs, N_VN)
+    assert ch3.tables["t"]["mode"] == "replicate"
+    # disagreeing consumers degrade too
+    jobs = _jobs(range(0, 8), range(8, 16)) + [{
+        "name": "j2", "dml_tables": ["t"], "shuffle_cols": {"t": 1},
+        "kinds": {"t": "join"}, "owners": {1: [0], 2: [1]},
+    }]
+    ch4 = ExchangePlanner.compile(jobs, N_VN)
+    assert ch4.tables["t"]["mode"] == "replicate"
+
+
+def test_route_batch_slices_and_unpacks_exactly():
+    ch = ExchangePlanner.compile(
+        _jobs(range(0, 8), range(8, 16)), N_VN, version=1)
+    svc = ShuffleService(worker_id=1)
+    svc.update(ch)
+    rows = [(i % 11, i * 10) for i in range(40)]
+    vns = vnodes_of_rows(rows, 0, N_VN)
+    out = svc.route_batch("t", 100, rows)
+    assert set(out) == {2}
+    payload = out[2]
+    # the standby carries ITS slice plus the LEADER's slice (= all)
+    items = ShuffleService.unpack_rows(payload)
+    assert items == [(100 + i, rows[i]) for i in range(40)]
+    # a non-standby peer gets only its owned slice
+    ch3 = ExchangePlanner.compile(
+        [{"name": "agg", "dml_tables": ["t"], "shuffle_cols": {"t": 0},
+          "kinds": {"t": "source"},
+          "owners": {1: list(range(0, 6)), 2: list(range(6, 11)),
+                     3: list(range(11, 16))}}], N_VN, version=2)
+    svc.update(ch3)
+    out = svc.route_batch("t", 0, rows)
+    got3 = ShuffleService.unpack_rows(out[3])
+    assert got3 == [(i, rows[i]) for i in range(40)
+                    if vns[i] in range(11, 16)]
+
+
+def test_sparse_history_positions_and_repair():
+    ch = ExchangePlanner.compile(
+        _jobs(range(0, 8), range(8, 16)), N_VN, version=1)
+    svc = ShuffleService(worker_id=1)
+    svc.update(ch)
+    lead = TableDmlManager(SCHEMA)
+    rows = [(i % 11, i * 10) for i in range(30)]
+    lead.insert(rows)
+    vns = vnodes_of_rows(rows, 0, N_VN)
+    own2 = set(range(8, 16))
+
+    fol = TableDmlManager(SCHEMA)
+    payload = svc.route_batch("t", 0, rows)[2]
+    # deliver only the follower's OWN slice (drop the standby extra)
+    items = [(p, r) for p, r in ShuffleService.unpack_rows(payload)
+             if vns[p] in own2]
+    n = fol.insert_sparse(0, 30, items, vns)
+    assert fol.history_len() == 30
+    assert n == sum(1 for v in vns if v in own2)
+    # global positions preserved; non-owned are placeholders
+    assert fol.missing_positions(own2, 0, 30) == []
+    missing1 = fol.missing_positions(set(range(0, 8)), 0, 30)
+    assert missing1 == [p for p in range(30) if vns[p] not in own2]
+    # idempotent redelivery + hole fill from the full payload
+    n2 = ShuffleService.apply_batch(fol, payload)
+    assert n2 == len(missing1)
+    assert fol.missing_positions(set(range(16)), 0, 30) == []
+    # a gap is refused (fence repair fetches first)
+    with pytest.raises(ValueError, match="gap"):
+        fol.insert_sparse(99, 101, [(99, (1, 1))], [])
+    # leader-side repair slicing re-cuts any range for any vnode set
+    sl = svc.slice_history(lead, 5, None, own2, "t")
+    assert sl["seq"] == 5 and sl["end"] == 30
+    assert [p for p, _ in sl["items"]] == \
+        [p for p in range(5, 30) if vns[p] in own2]
+
+
+def test_reader_filter_packs_owned_rows_and_gate_stays_clean():
+    import jax.numpy as jnp
+
+    from risingwave_tpu.cluster.scale.gate import VnodeGateExecutor
+    from risingwave_tpu.expr.node import InputRef
+
+    lead = TableDmlManager(SCHEMA)
+    rows = [(i % 11, i * 10) for i in range(50)]
+    lead.insert(rows)
+    vns = vnodes_of_rows(rows, 0, N_VN)
+    own = frozenset(range(0, 8))
+    r = lead.new_reader(8)
+    r.vnode_filter = (0, own, N_VN)
+    gate = VnodeGateExecutor(SCHEMA, [InputRef(0)], N_VN)
+    state = (gate.make_mask(own), jnp.zeros((), jnp.int64))
+    got = []
+    while r.pending():
+        chunk = r.next_chunk()
+        state, out = gate.apply(state, chunk)
+        vis = np.nonzero(np.asarray(chunk.valid))[0]
+        got += [(int(np.asarray(chunk.columns[0])[i]),
+                 int(np.asarray(chunk.columns[1])[i])) for i in vis]
+    want = [rows[i] for i in range(50) if vns[i] in own]
+    assert got == want
+    assert r.offset == 50  # cursor is GLOBAL (ends on the fence)
+    assert r.filtered_rows == 50 - len(want)
+    # the gate audited every row as owned: ZERO drops
+    assert int(np.asarray(state[1])) == 0
+    # without the filter, the gate does the dropping (and counts it)
+    r2 = lead.new_reader(8)
+    state2 = (gate.make_mask(own), jnp.zeros((), jnp.int64))
+    while r2.pending():
+        state2, _ = gate.apply(state2, r2.next_chunk())
+    assert int(np.asarray(state2[1])) == 50 - len(want)
+
+
+def test_vn64_packing_roundtrip():
+    from risingwave_tpu.cluster.exchange.shuffle import (
+        pack_vnodes,
+        unpack_vnodes,
+    )
+
+    vns = [i % N_VN for i in range(257)]
+    assert unpack_vnodes({"vn64": pack_vnodes(vns)}) == vns
+    assert unpack_vnodes({"vnodes": vns}) == vns

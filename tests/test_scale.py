@@ -263,12 +263,12 @@ DDL = [
 READ = "SELECT k, n, s, mx FROM agg"
 
 
-def _mk_cluster(tmp_path, n_workers=2, n_vnodes=16):
+def _mk_cluster(tmp_path, n_workers=2, n_vnodes=16, config=None):
     from risingwave_tpu.cluster import MetaService
     from risingwave_tpu.cluster.worker import ComputeWorker
     from risingwave_tpu.common.config import RwConfig
 
-    cfg = RwConfig.from_dict(CONFIG)
+    cfg = RwConfig.from_dict(config or CONFIG)
     meta = MetaService(str(tmp_path), heartbeat_timeout_s=60.0,
                        scale_partitioning=True, n_vnodes=n_vnodes)
     meta.start(port=0, monitor=False)
@@ -353,6 +353,191 @@ def test_cluster_scale_out_in_converges(tmp_path):
         # aggregate reads cannot union across partitions: loud refusal
         with pytest.raises(ValueError, match="partitioned"):
             meta.serve("SELECT sum(n) FROM agg")
+    finally:
+        for w in workers:
+            w.stop()
+        meta.stop()
+
+
+#: join matrix entry sizing: the MV hash table needs headroom beyond
+#: live rows — retraction churn leaves tombstoned slots behind
+JOIN_CONFIG = {
+    "streaming": {"chunk_size": 64},
+    "state": {"agg_table_size": 1 << 8, "agg_emit_capacity": 128,
+              "mv_table_size": 1 << 10, "mv_ring_size": 1 << 10},
+    "storage": {"checkpoint_keep_epochs": 4},
+}
+JOIN_DDL = [
+    "CREATE TABLE ja (k BIGINT, v BIGINT)",
+    "CREATE TABLE jb (k BIGINT, w BIGINT)",
+    """CREATE MATERIALIZED VIEW jmv AS
+       SELECT ja.k AS k, ja.v AS v, jb.w AS w
+       FROM ja LEFT JOIN jb ON ja.k = jb.k""",
+]
+JOIN_READ = "SELECT k, v, w FROM jmv"
+
+
+def test_join_pool_scale_out_in_converges(tmp_path):
+    """Exchange-lite matrix entry: a JOIN-pool job (both sides sliced
+    on the join key into dense hash-join partitions) scaled 1 → 2 → 1
+    mid-stream under RETRACTION churn (left-outer pads retracting as
+    their matches arrive), byte-identical to single-node, with only
+    the moved vnodes' entries transferred and ZERO device gate drops
+    on the shuffled path."""
+    from risingwave_tpu.common.config import RwConfig
+    from risingwave_tpu.sql.engine import Engine
+
+    meta, workers = _mk_cluster(tmp_path, config=JOIN_CONFIG)
+    a_rows: list = []
+    b_rows: list = []
+    try:
+        meta.scale(1)
+        for sql in JOIN_DDL:
+            meta.execute_ddl(sql)
+        job = meta.state()["jobs"][0]
+        assert job["partitions"] is not None
+        # both source edges compiled into the shuffle choreography
+        ex = meta.state()["exchange"]["tables"]
+        assert ex["ja"]["mode"] == "shuffle" and ex["ja"]["key_col"] == 0
+        assert ex["jb"]["mode"] == "shuffle" and ex["jb"]["key_col"] == 0
+
+        def ingest_a(base, n, keys=23):
+            rows = [((base + i) % keys, 7 * (base + i) + 1)
+                    for i in range(n)]
+            meta.execute_ddl("INSERT INTO ja VALUES " + ",".join(
+                f"({k},{v})" for k, v in rows))
+            a_rows.extend(rows)
+
+        def ingest_b(ks):
+            rows = [(k, 1000 + 3 * k) for k in ks]
+            meta.execute_ddl("INSERT INTO jb VALUES " + ",".join(
+                f"({k},{w})" for k, w in rows))
+            b_rows.extend(rows)
+
+        # half the keys matched up front; the rest arrive mid-stream
+        # (pad rows retract through both scale ops)
+        ingest_b(range(0, 23, 2))
+        ingest_a(0, 100)
+        _drive(meta, 3)
+        out = meta.scale(2)
+        assert out["moved_vnodes"] == 8
+        ents = sum(t["entries"] for t in out["transfers"])
+        # a strict slice: join-side keys + MV rows of moved vnodes
+        # only (never the whole keyspace twice over)
+        assert 0 < ents < 2 * (23 + 100)
+        ingest_b(range(1, 23, 2))     # RETRACTION churn while scaled
+        ingest_a(100, 80)
+        _drive(meta, 3)
+        back = meta.scale(1)
+        assert back["moved_vnodes"] == 8
+        ingest_a(180, 40)
+        for _ in range(200):
+            meta.tick(2)
+            _, rows = meta.serve(JOIN_READ)
+            if len(rows) == len(a_rows) \
+                    and all(r[2] is not None for r in rows):
+                break
+        else:
+            raise TimeoutError("join cluster never drained")
+        cluster = sorted(tuple(int(x) for x in r) for r in rows)
+
+        # the shuffled path never dropped a row at a gate
+        stats = {w.worker_id: w.client.call("scale_stats")
+                 for w in meta.live_workers()}
+        assert all(s["gate_dropped"] == 0 for s in stats.values())
+        assert sum(s["exchange_rows_in"]
+                   for s in stats.values()) > 0
+
+        eng = Engine(RwConfig.from_dict(JOIN_CONFIG))
+        for sql in JOIN_DDL:
+            eng.execute(sql)
+        b1 = [r for r in b_rows if r[0] % 2 == 0]
+        b2 = [r for r in b_rows if r[0] % 2 == 1]
+        eng.execute("INSERT INTO jb VALUES " + ",".join(
+            f"({k},{w})" for k, w in b1))
+        eng.execute("INSERT INTO ja VALUES " + ",".join(
+            f"({k},{v})" for k, v in a_rows))
+        eng.execute("INSERT INTO jb VALUES " + ",".join(
+            f"({k},{w})" for k, w in b2))
+        for _ in range(200):
+            eng.tick(barriers=1, chunks_per_barrier=2)
+            rows = eng.execute(JOIN_READ)
+            if len(rows) == len(a_rows) \
+                    and all(r[2] is not None for r in rows):
+                break
+        single = sorted(tuple(int(x) for x in r)
+                        for r in eng.execute(JOIN_READ))
+        assert cluster == single
+    finally:
+        for w in workers:
+            w.stop()
+        meta.stop()
+
+
+def test_mv_on_mv_over_partitioned_upstream_converges(tmp_path):
+    """MV-on-MV over a vnode-partitioned upstream: the attach edge
+    compiles to the IDENTITY exchange (downstream keys carry the
+    upstream distribution key), every partition attaches the same
+    chain mid-stream, and both MVs converge byte-identical to a
+    single node through a scale op.  Reduced-key shapes refuse."""
+    from risingwave_tpu.common.config import RwConfig
+    from risingwave_tpu.sql.engine import Engine
+
+    MV2 = ("CREATE MATERIALIZED VIEW agg2 AS "
+           "SELECT k, n + 1 AS n1, s * 2 AS s2 FROM agg")
+    meta, workers = _mk_cluster(tmp_path)
+    rows_sent: list = []
+    try:
+        meta.scale(2)
+        for sql in DDL:
+            meta.execute_ddl(sql)
+        _ingest(meta, rows_sent, 0, 150)
+        _drive(meta, 3)
+        # attach MID-STREAM on the partitioned upstream
+        meta.execute_ddl(MV2)
+        assert meta._mv_to_job["agg2"] == "agg"
+        assert ("agg", "agg2") in meta.jobs["agg"].attach_edges
+        _ingest(meta, rows_sent, 150, 150)
+        _drive(meta, 3)
+        back = meta.scale(1)
+        assert back["moved_vnodes"] == 8
+        _ingest(meta, rows_sent, 300, 100)
+        for _ in range(200):
+            meta.tick(2)
+            _, rows = meta.serve(READ)
+            if sum(int(r[1]) for r in rows) == len(rows_sent):
+                break
+        else:
+            raise TimeoutError("never drained")
+        cl1 = sorted(tuple(int(x) for x in r) for r in rows)
+        cl2 = sorted(tuple(int(x) for x in r)
+                     for r in meta.serve("SELECT k, n1, s2 "
+                                         "FROM agg2")[1])
+
+        eng = Engine(RwConfig.from_dict(CONFIG))
+        for sql in DDL:
+            eng.execute(sql)
+        eng.execute(MV2)
+        eng.execute("INSERT INTO t VALUES " + ",".join(
+            f"({k},{v})" for k, v in rows_sent))
+        for _ in range(200):
+            eng.tick(barriers=1, chunks_per_barrier=2)
+            if sum(int(r[1]) for r in eng.execute(READ)) \
+                    == len(rows_sent):
+                break
+        assert cl1 == sorted(tuple(int(x) for x in r)
+                             for r in eng.execute(READ))
+        assert cl2 == sorted(
+            tuple(int(x) for x in r)
+            for r in eng.execute("SELECT k, n1, s2 FROM agg2"))
+        # reduced keys refuse loudly (cross-partition attach exchange
+        # is the next round)
+        with pytest.raises(Exception, match="next round|group"):
+            meta.execute_ddl(
+                "CREATE MATERIALIZED VIEW bad AS "
+                "SELECT s % 3 AS g, count(*) AS c FROM agg "
+                "GROUP BY s % 3"
+            )
     finally:
         for w in workers:
             w.stop()

@@ -95,9 +95,20 @@ def test_plan_read_index_rewrite():
     # a pin OLDER than the index's first export must not use it
     with pytest.raises(ServeUnsupported):
         plan("SELECT g FROM m WHERE n = 42", at_epoch=3)
-    # non-equality on a non-pk column: engine fallback
-    with pytest.raises(ServeUnsupported):
-        plan("SELECT g FROM m WHERE n > 42")
+    # index RANGE scan (Exchange-lite round): WHERE n > x bounds the
+    # index byte range — the memcomparable encoding already sorts
+    p = plan("SELECT g FROM m WHERE n > 42")
+    assert p.mode == "index" and p.index_mv == "m_n"
+    assert p.lo > b"m:m_n\x00" and p.hi is not None
+    # the range predicate also rides as a residual (exactness guard)
+    assert (1, "greater_than", 42) in (p.residual or [])
+    p2 = plan("SELECT g FROM m WHERE n >= 10 AND n < 42")
+    assert p2.mode == "index" and p2.lo < p.lo
+    # composite predicate: index prefix + residual filter on a column
+    # the index bytes cannot bound
+    p3 = plan("SELECT g FROM m WHERE n = 42 AND g > 7")
+    assert p3.mode == "index" and p3.index_mv == "m_n"
+    assert (0, "greater_than", 7) in (p3.residual or [])
     # no schema_of (no index discovery): old behavior preserved
     (sel,) = parse("SELECT g FROM m WHERE n = 42")
     with pytest.raises(ServeUnsupported):
@@ -187,6 +198,22 @@ def test_serve_hot_cluster_smoke(tmp_path):
         assert _rows(meta.serve("SELECT g, n FROM m1 WHERE n = 80")) \
             == want
         assert sv.metrics.get("serving_index_lookups_total") >= 1
+
+        # -- index RANGE scan over the memcomparable encoding
+        # (Exchange-lite satellite): byte-identical to full scan +
+        # filter, including the empty range
+        want = sorted(r for r in allr if r[1] > 79)
+        assert _rows(meta.serve(
+            "SELECT g, n FROM m1 WHERE n > 79")) == want
+        assert _rows(meta.serve(
+            "SELECT g, n FROM m1 WHERE n > 80")) == []
+        want = sorted(r for r in allr if 1 <= r[1] < 81)
+        assert _rows(meta.serve(
+            "SELECT g, n FROM m1 WHERE n >= 1 AND n < 81")) == want
+        # composite: index prefix + residual filter on g
+        want = sorted(r for r in allr if r[1] == 80 and r[0] > 3)
+        assert _rows(meta.serve(
+            "SELECT g, n FROM m1 WHERE n = 80 AND g > 3")) == want
 
         # -- DROP: protection first, then tombstones + "does not
         # exist" instead of stale rows

@@ -133,6 +133,15 @@ def test_plan_read_shapes():
     p = plan("SELECT b FROM m WHERE a >= 2 ORDER BY a")
     assert p.mode == "scan" and p.lo > b"m:m\x00"
 
+    # non-leading pk compares ride as RESIDUAL filters on a scan
+    # (Exchange-lite round: composite predicates stop bouncing to the
+    # owning worker)
+    p = plan("SELECT a FROM m WHERE b = 1")
+    assert p.mode == "scan" and p.residual == [(1, "equal", 1)]
+    p = plan("SELECT a FROM m WHERE a >= 2 AND b < 4")
+    assert p.mode == "scan" and p.lo > b"m:m\x00"
+    assert p.residual == [(1, "less_than", 4)]
+
     for bad in [
         "SELECT count(*) FROM m",                  # aggregate
         "SELECT a FROM m GROUP BY a",              # group by
@@ -140,7 +149,6 @@ def test_plan_read_shapes():
         "SELECT a FROM m ORDER BY b",              # not a pk PREFIX
         "SELECT a FROM m ORDER BY a, b, a",        # beyond the pk
         "SELECT a FROM m ORDER BY a + 1",          # expression key
-        "SELECT a FROM m WHERE b = 1",             # non-leading pk range
         "SELECT a + 1 FROM m",                     # expression
         "SELECT a FROM m WHERE a + 1 = 2",         # computed predicate
     ]:
