@@ -43,7 +43,14 @@ from risingwave_tpu.cluster.rpc import (
     RpcServer,
 )
 from risingwave_tpu.common.faults import RetryPolicy, get_fabric
-from risingwave_tpu.common.metrics import MetricsRegistry
+from risingwave_tpu.common.metrics import MetricsRegistry, merge_prometheus
+from risingwave_tpu.common.trace import (
+    GLOBAL_TRACE,
+    merge_dumps,
+    round_ids,
+    spans_for_round,
+    tree_check,
+)
 from risingwave_tpu.meta.store import MetaStore
 
 
@@ -325,6 +332,17 @@ class MetaService:
         #: committed cluster epoch (round number, 0 = nothing committed)
         self.cluster_epoch = 0
         self.failovers = 0
+        # -- trace-lite (common/trace.py) round-trace state ------------
+        #: the round whose root span ``_trace_root_ctx`` belongs to: a
+        #: RETRIED round (previous tick didn't commit) parents its new
+        #: attempt under the ORIGINAL root, so trace "round-N" keeps
+        #: exactly one root span however many ticks the round takes
+        self._trace_round = 0
+        self._trace_root_ctx: tuple | None = None
+        #: last COMMITTED round's root ctx — piggybacked on serving
+        #: lease grants so sampled replica read spans join the round
+        #: tree of the epoch they actually read
+        self._last_round_ctx: tuple | None = None
         #: unified backoff for every retry-safe control RPC the meta
         #: issues (barrier/job_epochs/adopt are idempotent or
         #: round-guarded; RpcError — the peer REFUSED — never retries)
@@ -710,6 +728,8 @@ class MetaService:
             "granted_vid": r.granted_vid,
             "cluster_epoch": self.cluster_epoch,
             "manifest_epoch": self.versions.max_committed_epoch,
+            "trace_ctx": list(self._last_round_ctx)
+            if self._last_round_ctx else None,
         }
 
     def rpc_serving_heartbeat(self, replica_id: int,
@@ -749,6 +769,11 @@ class MetaService:
             "granted_vid": r.granted_vid,
             "cluster_epoch": self.cluster_epoch,
             "manifest_epoch": self.versions.max_committed_epoch,
+            # last committed round's root span ctx: the replica tags
+            # its SAMPLED read spans with it, so each round trace
+            # carries the reads served at that epoch
+            "trace_ctx": list(self._last_round_ctx)
+            if self._last_round_ctx else None,
         }
 
     def rpc_unregister_serving(self, replica_id: int) -> dict:
@@ -2039,6 +2064,43 @@ class MetaService:
             return {"round": target, "committed": False,
                     "jobs": 0, "sealed": 0}
         self.metrics.set_gauge("cluster_epoch_in_flight", target)
+        # trace-lite: ONE root span per round trace, however many tick
+        # attempts the round takes — an attempt that didn't commit
+        # leaves ``_trace_root_ctx`` in place, and the retry parents a
+        # child "attempt" span under the ORIGINAL root instead of
+        # opening a second root (tree_check requires exactly one)
+        if self._trace_round != target or self._trace_root_ctx is None:
+            self._trace_round = target
+            tick_span = GLOBAL_TRACE.span(
+                "round", trace_id=f"round-{target}",
+                epoch=target, units=len(units),
+            )
+            self._trace_root_ctx = tick_span.ctx
+        else:
+            tick_span = GLOBAL_TRACE.span(
+                "attempt", ctx=self._trace_root_ctx, epoch=target,
+            )
+        with tick_span as rspan:
+            res = self._tick_attempt(
+                target, jobs, units, chunks_per_barrier, t0,
+                rspan.ctx,
+            )
+            rspan.set(committed=res["committed"],
+                      sealed=res["sealed"])
+        if res["committed"]:
+            # serving lease grants piggyback this ctx so sampled
+            # replica reads join the round tree they actually read
+            self._last_round_ctx = self._trace_root_ctx
+        self._export_fault_gauges()
+        return res
+
+    def _tick_attempt(self, target: int, jobs, units,
+                      chunks_per_barrier: int, t0: float,
+                      rctx: "tuple | None") -> dict:
+        """One tick attempt at round ``target`` (the body of
+        ``_tick_locked``, running under that round's trace span —
+        ``rctx`` is passed EXPLICITLY into the per-worker fan-out
+        threads, whose thread-local trace stacks are empty)."""
         # consumption fences are PER ROUND: a retried round (worker
         # failure mid-round) reuses the fence its survivors already
         # sealed with, so a re-adopted partition consumes the same
@@ -2073,14 +2135,17 @@ class MetaService:
                 # (round, seal) and answers a replay from the
                 # cache, so retrying after a lost RESPONSE cannot
                 # run the round twice (epoch-guarded idempotence)
-                res = self.retry.run(
-                    lambda: w.client.call(
-                        "barrier", job=job.name,
-                        chunks=int(chunks_per_barrier),
-                        round=target, limits=limits,
-                    ),
-                    label="barrier",
-                )
+                with GLOBAL_TRACE.span("barrier", ctx=rctx,
+                                       job=job.name, unit=unit.name,
+                                       worker=w.worker_id):
+                    res = self.retry.run(
+                        lambda: w.client.call(
+                            "barrier", job=job.name,
+                            chunks=int(chunks_per_barrier),
+                            round=target, limits=limits,
+                        ),
+                        label="barrier",
+                    )
             except (RpcError, ConnectionError, OSError):
                 return False  # monitor expires the worker; stall
             epoch = int(res.get("sealed_epoch",
@@ -2138,15 +2203,17 @@ class MetaService:
             for t in threads:
                 t.join()
             sealed += sum(results)
-        committed = sealed == len(units) \
-            and self._await_durable(units, target)
+        committed = sealed == len(units)
         if committed:
-            self._commit_cluster_epoch(target, units)
+            with GLOBAL_TRACE.span("await_durable", epoch=target):
+                committed = self._await_durable(units, target)
+        if committed:
+            with GLOBAL_TRACE.span("commit", epoch=target):
+                self._commit_cluster_epoch(target, units)
             self.metrics.observe(
                 "cluster_barrier_commit_seconds",
                 time.perf_counter() - t0,
             )
-        self._export_fault_gauges()
         return {"round": target, "committed": committed,
                 "jobs": len(jobs), "units": len(units),
                 "sealed": sealed,
@@ -2593,6 +2660,85 @@ class MetaService:
 
     def rpc_metrics(self) -> dict:
         return {"prometheus": self.metrics.render_prometheus()}
+
+    def rpc_trace_dump(self, trace_id: str | None = None) -> dict:
+        return {"role": "meta",
+                "spans": GLOBAL_TRACE.dump(trace_id)}
+
+    def rpc_cluster_trace(self, round: "int | None" = None) -> dict:
+        return self.cluster_trace(round)
+
+    def cluster_trace(self, round: "int | None" = None) -> dict:
+        """Assemble ONE cross-role span tree for a round (``ctl
+        cluster trace``): the meta's own flight recorder merged with
+        every live worker's and serving replica's ``trace_dump``
+        (best-effort — a dead peer's spans are simply absent, leaving
+        a truncated-but-parseable tree).  Defaults to the most recent
+        round that has spans at or below the committed cluster epoch;
+        returns the filtered spans plus a ``tree_check`` verdict and
+        the full list of rounds the recorders still hold."""
+        dumps = [GLOBAL_TRACE.dump()]
+        with self._lock:
+            workers = [w for w in self.workers.values() if w.alive]
+            serving = [r for r in self.serving.values() if r.alive]
+        for peer in workers + serving:
+            try:
+                d = peer.client.call("trace_dump")
+                dumps.append(d.get("spans") or [])
+            except (RpcError, ConnectionError, OSError):
+                pass
+        spans = merge_dumps(dumps)
+        rounds = round_ids(spans)
+        if round is not None:
+            rn = int(round)
+        else:
+            committed = [r for r in rounds if r <= self.cluster_epoch]
+            rn = committed[-1] if committed \
+                else (rounds[-1] if rounds else 0)
+        picked = spans_for_round(spans, rn)
+        return {
+            "round": rn,
+            "rounds": rounds,
+            "cluster_epoch": self.cluster_epoch,
+            "spans": picked,
+            "check": tree_check(picked),
+        }
+
+    def rpc_cluster_metrics(self) -> dict:
+        return {"prometheus": self.cluster_metrics()}
+
+    def cluster_metrics(self) -> str:
+        """ONE aggregated Prometheus scrape for the whole cluster
+        (``ctl cluster metrics``): the meta's own registry plus every
+        live worker's and serving replica's ``rpc_metrics`` text,
+        merged with ``role``/``worker``/``replica`` identity labels
+        injected per sample (best-effort — an unreachable peer's
+        section is absent, never an error)."""
+        scrapes: list[tuple[dict, str]] = [
+            ({"role": "meta"}, self.metrics.render_prometheus()),
+        ]
+        with self._lock:
+            workers = [w for w in self.workers.values() if w.alive]
+            serving = [r for r in self.serving.values() if r.alive]
+        for w in workers:
+            try:
+                text = w.client.call("metrics").get("prometheus", "")
+                scrapes.append((
+                    {"role": f"worker{w.worker_id}",
+                     "worker": str(w.worker_id)}, text,
+                ))
+            except (RpcError, ConnectionError, OSError):
+                pass
+        for r in serving:
+            try:
+                text = r.client.call("metrics").get("prometheus", "")
+                scrapes.append((
+                    {"role": f"serving{r.replica_id}",
+                     "replica": str(r.replica_id)}, text,
+                ))
+            except (RpcError, ConnectionError, OSError):
+                pass
+        return merge_prometheus(scrapes)
 
     def rpc_cluster_faults(self) -> dict:
         return self.cluster_faults()

@@ -45,6 +45,7 @@ import socketserver
 import threading
 
 from risingwave_tpu.common.faults import get_fabric
+from risingwave_tpu.common.trace import GLOBAL_TRACE
 
 #: hard cap per frame; a peer streaming an unbounded line would pin
 #: server memory (serve results stay far below this)
@@ -128,7 +129,12 @@ class _RpcHandler(socketserver.StreamRequestHandler):
                                  "object"}
             else:
                 try:
-                    resp = {"id": rid, "result": fn(**params)}
+                    # a "trace" key on the frame carries the caller's
+                    # (trace_id, span_id): adopt it for this handler so
+                    # spans recorded inside parent across the process
+                    # boundary (no-op when tracing is off or absent)
+                    with GLOBAL_TRACE.activate(req.get("trace")):
+                        resp = {"id": rid, "result": fn(**params)}
                 except Exception as e:  # handler errors travel back
                     resp = {"id": rid,
                             "error": f"{type(e).__name__}: {e}"}
@@ -275,9 +281,12 @@ class RpcClient:
                 )  # raises FaultInjected for drops
             rid = ch._next_id
             ch._next_id += 1
-            payload = _dumps(
-                {"id": rid, "method": method, "params": params}
-            )
+            frame = {"id": rid, "method": method, "params": params}
+            tctx = GLOBAL_TRACE.current() if GLOBAL_TRACE.enabled \
+                else None
+            if tctx is not None:
+                frame["trace"] = list(tctx)
+            payload = _dumps(frame)
             if sever_after is not None:
                 # error_after_send: the request IS delivered and
                 # executed, but the response is lost with the socket —

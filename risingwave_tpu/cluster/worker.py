@@ -44,6 +44,7 @@ from risingwave_tpu.cluster.rpc import (
     parse_addr,
 )
 from risingwave_tpu.common.faults import RetryPolicy, get_fabric
+from risingwave_tpu.common.trace import GLOBAL_TRACE
 
 
 class ComputeWorker:
@@ -162,6 +163,12 @@ class ComputeWorker:
         self._meta_client.src = f"worker{self.worker_id}"
         self.shuffle.worker_id = self.worker_id
         self.registrations += 1
+        if GLOBAL_TRACE.role == "compute":
+            # a dedicated compute process (server.py boot): trace spans
+            # carry the meta-assigned identity so merged cluster dumps
+            # keep each worker on its own chrome pid lane.  In-process
+            # test clusters share one recorder and keep its role.
+            GLOBAL_TRACE.configure(role=f"worker{self.worker_id}")
 
     def _heartbeat_loop(self) -> None:
         # independent of the engine lock: a worker busy compiling or
@@ -594,6 +601,13 @@ class ComputeWorker:
         per-worker aggregates it retires on death."""
         return {"prometheus": self.engine.metrics.render_prometheus()}
 
+    def rpc_trace_dump(self, trace_id: str | None = None) -> dict:
+        """This process' span flight recorder (optionally filtered to
+        one trace) — the meta merges per-role dumps into the round
+        timeline ``ctl cluster trace`` renders."""
+        return {"role": GLOBAL_TRACE.role,
+                "spans": GLOBAL_TRACE.dump(trace_id)}
+
     def rpc_adopt(self, ddl: list, name: str, recover: bool = True,
                   vnodes: list | None = None, n_vnodes: int = 0,
                   ckpt_key: str | None = None) -> dict:
@@ -705,8 +719,11 @@ class ComputeWorker:
             from risingwave_tpu.storage.integrity import IntegrityError
 
             corrupt: list[str] = []
+            t0 = time.perf_counter()
             try:
-                ssts = self.engine.export_mv_deltas(job, sealed)
+                with GLOBAL_TRACE.span("mv_export", job=job) as _sp:
+                    ssts = self.engine.export_mv_deltas(job, sealed)
+                    _sp.set(ssts=len(ssts))
             except IntegrityError as e:
                 # a corrupt shared SST under the export's diff-base
                 # seeding: seal the round anyway (exports retry next
@@ -714,6 +731,10 @@ class ComputeWorker:
                 ssts = []
                 if e.key:
                     corrupt.append(e.key)
+            self.engine.metrics.observe(
+                "barrier_phase_seconds", time.perf_counter() - t0,
+                job=job, phase="mv_export",
+            )
             positions = self.engine.job_epochs(job)
             res = {"ok": True, "committed_epoch": sealed,
                    "sealed_epoch": sealed,

@@ -102,6 +102,15 @@ class ClusterConfig:
     rpc_retry_base_delay_s: float = 0.05
     #: backoff cap
     rpc_retry_max_delay_s: float = 0.5
+    #: trace-lite sampling (common/trace.py): 0 disables tracing
+    #: entirely (span() hands out a shared null singleton — zero
+    #: allocations on the chunk path); N >= 1 records every
+    #: control-plane span (round/barrier/phase/upload) and 1-in-N
+    #: data-plane spans (serving reads, compact/scrub cycles)
+    trace_sample_n: int = 1
+    #: per-process span flight-recorder capacity (bounded ring;
+    #: oldest spans fall off — a dump is always the recent window)
+    trace_buffer_spans: int = 4096
 
 
 @dataclass

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import bisect
 import threading
+import time
 from collections import defaultdict
 
 
@@ -40,6 +41,13 @@ _DEFAULT_BUCKETS = (
     0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
     1.0, 2.5, 5.0, 10.0,
 )
+
+
+def _fmt_le(b: float) -> str:
+    """Prometheus exposition-format bound: ``0.005``, ``1``, ``2.5``
+    — decimal notation, no trailing ``.0``, never an exponent repr."""
+    s = f"{b:.10f}".rstrip("0").rstrip(".")
+    return s if s else "0"
 
 
 class MetricsRegistry:
@@ -74,7 +82,6 @@ class MetricsRegistry:
         """Context manager observing elapsed seconds into a histogram
         (the guarded-metrics ``start_timer`` analog) — used by the
         storage service for compaction/vacuum durations."""
-        import time
 
         class _Timer:
             def __enter__(s):
@@ -96,6 +103,27 @@ class MetricsRegistry:
             self._gauges.pop(key, None)
             self._hists.pop(key, None)
 
+    def remove_where(self, name: str | None = None, **labels) -> None:
+        """Bulk companion of ``remove_series``: drop EVERY series
+        whose label set contains the given key/values (optionally
+        restricted to one metric name).  ``DROP MATERIALIZED VIEW``
+        retires a job's whole scrape footprint this way — the
+        job-labeled families carry extra labels (``node``/``side``/
+        ``phase``) the caller cannot enumerate."""
+        want = tuple(labels.items())
+
+        def match(key) -> bool:
+            n, lbls = key
+            if name is not None and n != name:
+                return False
+            d = dict(lbls)
+            return all(d.get(k) == v for k, v in want)
+
+        with self._lock:
+            for store in (self._counters, self._gauges, self._hists):
+                for k in [k for k in store if match(k)]:
+                    del store[k]
+
     # ------------------------------------------------------------------
     def get(self, name: str, **labels) -> float:
         key = (name, tuple(sorted(labels.items())))
@@ -106,9 +134,20 @@ class MetricsRegistry:
         raise KeyError(name)
 
     def quantile(self, name: str, q: float, **labels) -> float:
-        """Approximate quantile from histogram buckets (upper bound)."""
+        """Approximate quantile from histogram buckets.
+
+        Always returns a bucket UPPER BOUND: the least bucket boundary
+        ``b`` such that at least ``q`` of the observations are ``<= b``
+        (``+inf`` when the quantile falls in the overflow bucket, and
+        ``0.0`` for an empty histogram).  Consumers that form ratios of
+        two quantiles — the ``barrier_spike_ratio`` gauge divides
+        p99 by p50 — therefore compare like with like: both sides are
+        boundaries of the same fixed bucket grid, never interpolated.
+        """
         key = (name, tuple(sorted(labels.items())))
         h = self._hists[key]
+        if h.total == 0:
+            return 0.0
         target = q * h.total
         seen = 0
         for i, c in enumerate(h.counts):
@@ -118,7 +157,10 @@ class MetricsRegistry:
         return float("inf")
 
     def render_prometheus(self) -> str:
-        """Prometheus text exposition (the scrape surface)."""
+        """Prometheus text exposition (the scrape surface): samples
+        grouped per metric under one ``# TYPE`` line, ``le`` bucket
+        labels in exposition-format convention (``0.005``, ``1``,
+        ``+Inf`` — never ``1.0`` or an exponent repr)."""
         out = []
 
         def fmt_labels(labels):
@@ -127,17 +169,27 @@ class MetricsRegistry:
             inner = ",".join(f'{k}="{v}"' for k, v in labels)
             return "{" + inner + "}"
 
+        seen: set[str] = set()
+
+        def type_line(name, kind):
+            if name not in seen:
+                seen.add(name)
+                out.append(f"# TYPE {name} {kind}")
+
         with self._lock:
             for (name, labels), s in sorted(self._counters.items()):
+                type_line(name, "counter")
                 out.append(f"{name}{fmt_labels(labels)} {s.value}")
             for (name, labels), s in sorted(self._gauges.items()):
+                type_line(name, "gauge")
                 out.append(f"{name}{fmt_labels(labels)} {s.value}")
             for (name, labels), h in sorted(self._hists.items()):
+                type_line(name, "histogram")
                 acc = 0
                 for i, b in enumerate(h.buckets):
                     acc += h.counts[i]
                     lb = dict(labels)
-                    lb["le"] = b
+                    lb["le"] = _fmt_le(b)
                     out.append(
                         f"{name}_bucket{fmt_labels(sorted(lb.items()))} {acc}"
                     )
@@ -150,6 +202,45 @@ class MetricsRegistry:
                 out.append(f"{name}_count{fmt_labels(labels)} {h.total}")
                 out.append(f"{name}_sum{fmt_labels(labels)} {h.sum}")
         return "\n".join(out) + "\n"
+
+
+def merge_prometheus(scrapes: list[tuple[dict, str]]) -> str:
+    """Merge per-process scrapes into ONE cluster exposition: each
+    ``(identity_labels, text)`` section's sample lines gain the
+    identity labels (``role=...``/``worker=...``), ``# TYPE`` lines
+    are deduplicated and hoisted to the top (the format requires a
+    family's TYPE before its first sample), and everything else
+    passes through.  The meta's ``ctl cluster metrics`` surface."""
+    type_lines: dict[str, str] = {}
+    samples: list[str] = []
+    for labels, text in scrapes:
+        extra = ",".join(f'{k}="{v}"' for k, v in labels.items())
+        for line in (text or "").splitlines():
+            if not line.strip():
+                continue
+            if line.startswith("# TYPE "):
+                parts = line.split()
+                if len(parts) >= 3:
+                    type_lines.setdefault(parts[2], line)
+                continue
+            if line.startswith("#"):
+                continue
+            head, _, value = line.rpartition(" ")
+            if not head:
+                continue
+            if "{" in head and head.endswith("}"):
+                name = head[:head.index("{")]
+                inner = head[head.index("{") + 1:-1]
+                merged = f"{inner},{extra}" if extra else inner
+            else:
+                name = head
+                merged = extra
+            samples.append(
+                f"{name}{{{merged}}} {value}" if merged else line
+            )
+    out = [type_lines[n] for n in sorted(type_lines)]
+    out += samples
+    return "\n".join(out) + "\n"
 
 
 #: process-wide default registry (subsystems may make their own)

@@ -329,6 +329,48 @@ def cluster_scrub(meta_addr: str) -> dict:
         client.close()
 
 
+def cluster_metrics(meta_addr: str) -> str:
+    """``ctl cluster metrics <meta_addr>``: ONE aggregated Prometheus
+    scrape for the whole cluster — the meta pulls every live worker's
+    and serving replica's registry over RPC and merges them with
+    ``role``/``worker``/``replica`` identity labels injected per
+    sample (common/metrics.py merge_prometheus)."""
+    from risingwave_tpu.cluster.rpc import RpcClient, parse_addr
+
+    host, port = parse_addr(meta_addr)
+    client = RpcClient(host, port, timeout=120.0)
+    try:
+        return client.call("cluster_metrics")["prometheus"]
+    finally:
+        client.close()
+
+
+def cluster_trace(meta_addr: str, round: "int | None" = None,
+                  chrome: str | None = None) -> dict:
+    """``ctl cluster trace <meta_addr> [--round N] [--chrome out]``:
+    assemble the merged cross-role span tree for one committed round
+    (meta round span parenting worker barrier-phase spans, uploader
+    prepare/commit spans, sampled serving reads).  ``--chrome`` also
+    writes Chrome ``trace_event`` JSON loadable in chrome://tracing
+    or Perfetto."""
+    import json
+
+    from risingwave_tpu.cluster.rpc import RpcClient, parse_addr
+    from risingwave_tpu.common.trace import to_chrome_trace
+
+    host, port = parse_addr(meta_addr)
+    client = RpcClient(host, port, timeout=120.0)
+    try:
+        out = client.call("cluster_trace", round=round)
+    finally:
+        client.close()
+    if chrome:
+        with open(chrome, "w") as f:
+            json.dump(to_chrome_trace(out["spans"]), f)
+        out["chrome"] = chrome
+    return out
+
+
 def cluster_epochs(meta_addr: str) -> dict:
     """``ctl cluster epochs``: the global checkpoint positions — the
     committed cluster epoch (round), the manifest's epoch stamp, each
@@ -415,6 +457,24 @@ def _cluster_main(argv: list[str]) -> None:
         # ctl cluster multiget <meta_addr> <mv> <pk> [pk ...]
         print(json.dumps(cluster_multiget(argv[1], argv[2], argv[3:]),
                          indent=1))
+        return
+    if sub == "metrics":
+        # ctl cluster metrics <meta_addr> — raw exposition text
+        print(cluster_metrics(argv[1]), end="")
+        return
+    if sub == "trace":
+        # ctl cluster trace <meta_addr> [--round N] [--chrome out]
+        addr, rnd, chrome = argv[1], None, None
+        rest = argv[2:]
+        while rest:
+            flag = rest.pop(0)
+            if flag == "--round":
+                rnd = int(rest.pop(0))
+            elif flag == "--chrome":
+                chrome = rest.pop(0)
+            else:
+                raise SystemExit(f"unknown trace flag: {flag}")
+        print(json.dumps(cluster_trace(addr, rnd, chrome), indent=1))
         return
     addr = argv[1]
     fn = {"workers": cluster_workers, "jobs": cluster_jobs,

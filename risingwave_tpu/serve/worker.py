@@ -42,6 +42,7 @@ from risingwave_tpu.cluster.rpc import (
 )
 from risingwave_tpu.common.faults import RetryPolicy, get_fabric
 from risingwave_tpu.common.metrics import MetricsRegistry
+from risingwave_tpu.common.trace import GLOBAL_TRACE
 from risingwave_tpu.serve.reader import (
     MvSchema,
     SstView,
@@ -469,6 +470,10 @@ class ServingWorker:
         self.registrations = 0
         #: meta's manifest epoch from the last heartbeat (lag gauge)
         self._meta_manifest_epoch = 0
+        #: last committed round's root span ctx, piggybacked on the
+        #: lease grant — SAMPLED read spans attach under it so the
+        #: round trace carries the reads served at that epoch
+        self._round_trace_ctx: tuple | None = None
         self._server: RpcServer | None = None
         self._meta_client: RpcClient | None = None
         self._hb_thread: threading.Thread | None = None
@@ -541,6 +546,10 @@ class ServingWorker:
         )
         self.replica_id = int(res["replica_id"])
         self._meta_client.src = f"serving{self.replica_id}"
+        if GLOBAL_TRACE.role == "serving":
+            # dedicated server.py process: adopt the meta-assigned
+            # identity so span_ids are unique cluster-wide
+            GLOBAL_TRACE.configure(role=f"serving{self.replica_id}")
         self._meta_manifest_epoch = int(res.get("manifest_epoch", 0))
         self.registrations += 1
         self._refresh_to(int(res["granted_vid"]))
@@ -570,6 +579,8 @@ class ServingWorker:
                 self._meta_manifest_epoch = int(
                     res.get("manifest_epoch", 0)
                 )
+                tc = res.get("trace_ctx")
+                self._round_trace_ctx = tuple(tc) if tc else None
                 try:
                     self.view.refresh(int(res["granted_vid"]))
                     break
@@ -786,23 +797,34 @@ class ServingWorker:
         result-cache hit at the current vid skips parse, plan, and the
         SstView entirely."""
         t0 = time.perf_counter()
-        self._catch_up(int(min_epoch or 0))
-        version = self.view.version
-        self._sync_cache_vid(version.vid)
-        key = (" ".join(sql.split()), version.vid)
-        entry = self.result_cache.get(key)
-        if entry is None:
-            # ServeUnsupported propagates un-counted (owner fallback)
-            plan = self._plan(sql)
-            cols, rows = self._run_pinned(
-                lambda v: self._execute(plan, v)
-            )
-            entry = (cols, rows, self.view.version.max_committed_epoch)
-            if self.view.version.vid == version.vid:
-                # an ObjectError re-grant may have moved the vid
-                # mid-read: never cache under the stale key
-                self.result_cache.put(key, entry)
-        cols, rows, epoch = entry
+        # 1-in-sample_n reads record a span parented under the last
+        # committed round's root (the lease piggyback) — the round
+        # trace shows what the read tier served at that epoch
+        with GLOBAL_TRACE.sampled_span(
+                "serving_read", ctx=self._round_trace_ctx) as tsp:
+            self._catch_up(int(min_epoch or 0))
+            version = self.view.version
+            self._sync_cache_vid(version.vid)
+            key = (" ".join(sql.split()), version.vid)
+            entry = self.result_cache.get(key)
+            if entry is None:
+                # ServeUnsupported propagates un-counted (owner
+                # fallback)
+                plan = self._plan(sql)
+                cols, rows = self._run_pinned(
+                    lambda v: self._execute(plan, v)
+                )
+                entry = (cols, rows,
+                         self.view.version.max_committed_epoch)
+                if self.view.version.vid == version.vid:
+                    # an ObjectError re-grant may have moved the vid
+                    # mid-read: never cache under the stale key
+                    self.result_cache.put(key, entry)
+                tsp.set(cached=False)
+            else:
+                tsp.set(cached=True)
+            cols, rows, epoch = entry
+            tsp.set(rows=len(rows), epoch=epoch)
         self.reads_total += 1
         self.metrics.inc("serving_reads_total")
         self.metrics.observe("serving_read_seconds",
@@ -975,6 +997,10 @@ class ServingWorker:
 
     def rpc_metrics(self) -> dict:
         return {"prometheus": self.metrics.render_prometheus()}
+
+    def rpc_trace_dump(self, trace_id: str | None = None) -> dict:
+        return {"role": GLOBAL_TRACE.role,
+                "spans": GLOBAL_TRACE.dump(trace_id)}
 
     def rpc_faults(self) -> dict:
         """This process' chaos counters (aggregated by the meta's

@@ -38,6 +38,35 @@ import threading
 import time
 
 
+def _start_metrics_http(render, host: str, port: int):
+    """Per-role stdlib ``/metrics`` endpoint (the unified metrics
+    plane's per-process scrape surface — the meta's ``ctl cluster
+    metrics`` aggregates the same text over RPC, so a Prometheus
+    deployment can scrape either each process or just the meta)."""
+    import http.server
+
+    class _Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 — http.server API
+            if self.path.split("?")[0] not in ("/", "/metrics"):
+                self.send_error(404)
+                return
+            body = render().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # per-scrape stderr spam
+            pass
+
+    httpd = http.server.ThreadingHTTPServer((host, port), _Handler)
+    threading.Thread(target=httpd.serve_forever,
+                     name="metrics-http", daemon=True).start()
+    return httpd
+
+
 class SingleNode:
     def __init__(self, config=None, data_dir: str | None = None):
         from risingwave_tpu.sql.engine import Engine
@@ -122,9 +151,13 @@ def _run_meta(args) -> None:
             scrubber=args.scrub_interval > 0)
     front = MetaFrontend(meta)
     server = pg_serve(front, args.host, args.port)
+    if args.metrics_port:
+        _start_metrics_http(meta.metrics.render_prometheus,
+                            args.host, args.metrics_port)
     print(json.dumps({
         "role": "meta", "pgwire_port": args.port,
         "rpc_port": meta.rpc_port,
+        "metrics_port": args.metrics_port or None,
     }), flush=True)
 
     stop = threading.Event()
@@ -165,9 +198,13 @@ def _run_compute(args) -> None:
         host=args.host, port=args.rpc_port,
         heartbeat_interval_s=args.heartbeat_interval,
     ).start()
+    if args.metrics_port:
+        _start_metrics_http(worker.engine.metrics.render_prometheus,
+                            args.host, args.metrics_port)
     print(json.dumps({
         "role": "compute", "worker_id": worker.worker_id,
         "port": worker.port,
+        "metrics_port": args.metrics_port or None,
     }), flush=True)
     try:
         while True:
@@ -188,9 +225,13 @@ def _run_serving(args) -> None:
         cache_blocks=args.serving_cache_blocks,
         result_cache_bytes=args.serving_result_cache_bytes,
     ).start()
+    if args.metrics_port:
+        _start_metrics_http(replica.metrics.render_prometheus,
+                            args.host, args.metrics_port)
     print(json.dumps({
         "role": "serving", "replica_id": replica.replica_id,
         "port": replica.port,
+        "metrics_port": args.metrics_port or None,
         # the engine-free contract, surfaced at the handshake: tests
         # parse this line and assert jax never loaded
         "jax_loaded": "jax" in sys.modules,
@@ -243,7 +284,34 @@ def main() -> None:
                         "(meta role) — DML batches replicate to "
                         "every partition host and the VnodeGate "
                         "filters (the PR-7 baseline)")
+    p.add_argument("--metrics-port", type=int, default=0,
+                   help="HTTP /metrics port for this process "
+                        "(0 = disabled); the unified plane also "
+                        "aggregates over RPC via `ctl cluster "
+                        "metrics`")
+    p.add_argument("--trace-sample-n", type=int, default=1,
+                   help="trace-lite sampling: 0 disables tracing "
+                        "entirely; N>=1 records every control-plane "
+                        "span and 1-in-N data-plane spans")
+    p.add_argument("--trace-buffer-spans", type=int, default=4096,
+                   help="per-process span flight-recorder capacity")
     args = p.parse_args()
+
+    # trace-lite identity + sampling, wired BEFORE any role boots so
+    # even registration RPCs carry (or drop) trace context uniformly.
+    # A compute --config-json may override via ClusterConfig.
+    from risingwave_tpu.common.trace import GLOBAL_TRACE
+
+    sample_n, capacity = args.trace_sample_n, args.trace_buffer_spans
+    if args.config_json:
+        try:
+            cj = json.loads(args.config_json).get("cluster") or {}
+            sample_n = int(cj.get("trace_sample_n", sample_n))
+            capacity = int(cj.get("trace_buffer_spans", capacity))
+        except (ValueError, TypeError, AttributeError):
+            pass
+    GLOBAL_TRACE.configure(role=args.role, sample_n=sample_n,
+                           capacity=capacity)
 
     if args.role == "meta":
         _run_meta(args)
@@ -256,6 +324,9 @@ def main() -> None:
         return
     node = SingleNode(data_dir=args.data_dir)
     server = node.start(args.host, args.port)
+    if args.metrics_port:
+        _start_metrics_http(node.engine.metrics.render_prometheus,
+                            args.host, args.metrics_port)
     print(f"listening on {args.host}:{args.port} (psql -h {args.host} "
           f"-p {args.port} any_db)")
     try:

@@ -15,6 +15,7 @@ scheduler (SURVEY.md §2.4), and the batch local-execution mode
 from __future__ import annotations
 
 import time
+from collections import deque
 from typing import Any, Sequence
 
 import numpy as np
@@ -22,6 +23,7 @@ import numpy as np
 from risingwave_tpu.common.chunk import Chunk, split_col
 from risingwave_tpu.common.config import RwConfig, SessionConfig, SystemParams
 from risingwave_tpu.common.metrics import MetricsRegistry
+from risingwave_tpu.common.trace import GLOBAL_TRACE
 from risingwave_tpu.common.types import DataType, Field, Schema
 from risingwave_tpu.connector.nexmark import (
     AUCTION_SCHEMA,
@@ -178,6 +180,9 @@ class Engine:
         # per-engine registry: restarted engines must not inherit a
         # dead engine's counters for same-named jobs
         self.metrics = MetricsRegistry()
+        #: rolling per-job barrier latencies feeding the
+        #: ``barrier_spike_ratio`` gauge (p99/median over the window)
+        self._barrier_lat: dict[str, deque] = {}
         self.checkpoint_store = None
         #: SQL UDFs: name -> (param names, body expr AST), inlined at
         #: parse time (ref: frontend SQL-UDF inlining)
@@ -412,6 +417,16 @@ class Engine:
                     # replay — there the log already holds only the
                     # final generation's rows
                     self.meta_store.truncate_dml(stmt.name)
+                if entry.kind == "mview":
+                    # DROP MV / DROP INDEX sweeps the scrape surface:
+                    # the entry's own job-labeled series always; the
+                    # underlying job's only when the job itself died
+                    # (an index on a shared DAG leaves the host MV's
+                    # series alone)
+                    self._retire_job_series(entry.name)
+                    if entry.job is not None \
+                            and entry.job not in self.jobs:
+                        self._retire_job_series(entry.job.name)
             self.catalog.drop(stmt.name, stmt.if_exists)
             return None
         if isinstance(stmt, ast.ShowStatement):
@@ -1998,11 +2013,12 @@ class Engine:
                     rows = 0
                     for _ in range(chunks_per_barrier):
                         rows += job.chunk_round()
+                t1 = time.perf_counter()
                 job.inject_barrier()
-                dt = time.perf_counter() - t0
+                t2 = time.perf_counter()
                 self.metrics.inc("stream_rows_total", rows, job=job.name)
-                self.metrics.observe("barrier_latency_seconds", dt,
-                                     job=job.name)
+                self._observe_barrier(job.name, t2 - t0,
+                                      dispatch=t1 - t0, seal=t2 - t1)
         # batch boundary = durability point: uploads sealed inside the
         # window pipelined against the barrier loop; they must land
         # before tick() returns (tests/FLUSH/restart determinism).
@@ -2042,33 +2058,93 @@ class Engine:
         if getattr(job, "metrics", None) is None:
             job.metrics = self.metrics
         t0 = time.perf_counter()
-        if hasattr(job, "run_chunks"):
-            rows = job.run_chunks(chunks_per_barrier)
-        else:
-            rows = 0
-            for _ in range(chunks_per_barrier):
-                rows += job.chunk_round()
-        if source_limits and getattr(job, "n_vnodes", None) is not None:
+        with GLOBAL_TRACE.span("dispatch", job=name) as _sp:
+            if hasattr(job, "run_chunks"):
+                rows = job.run_chunks(chunks_per_barrier)
+            else:
+                rows = 0
+                for _ in range(chunks_per_barrier):
+                    rows += job.chunk_round()
+            _sp.set(rows=rows)
+        t1 = time.perf_counter()
+        fenced = bool(source_limits) \
+            and getattr(job, "n_vnodes", None) is not None
+        if fenced:
             # Exchange-lite: a partitioned barrier consumes EXACTLY to
             # the round fence, however many chunks that takes — every
             # partition's cursor seals ON the fence, so handover
             # cursor checks hold even though shuffled partitions see
             # different owned-row densities.  (Bounded: pending() is
             # capped by min(local history, fence).)
-            for _ in range(1 << 20):
-                if not self._fenced_pending(job):
-                    break
-                rows += job.run_chunks(chunks_per_barrier) \
-                    if hasattr(job, "run_chunks") else job.chunk_round()
-        job.inject_barrier()
-        dt = time.perf_counter() - t0
+            with GLOBAL_TRACE.span("source_drain", job=name):
+                for _ in range(1 << 20):
+                    if not self._fenced_pending(job):
+                        break
+                    rows += job.run_chunks(chunks_per_barrier) \
+                        if hasattr(job, "run_chunks") \
+                        else job.chunk_round()
+        t2 = time.perf_counter()
+        with GLOBAL_TRACE.span("seal", job=name):
+            job.inject_barrier()
+        t3 = time.perf_counter()
         self.metrics.inc("stream_rows_total", rows, job=job.name)
-        self.metrics.observe("barrier_latency_seconds", dt, job=job.name)
+        self._observe_barrier(
+            job.name, t3 - t0, dispatch=t1 - t0,
+            source_drain=(t2 - t1) if fenced else None,
+            seal=t3 - t2,
+        )
         self._export_checkpoint_gauges(job)
         # the SEAL, not the durable commit: the cluster's global epoch
         # advances only when every job's upload acks (meta polls
         # job_epochs) — the per-job barrier RPC never blocks on I/O
         return getattr(job, "sealed_epoch", job.committed_epoch)
+
+    #: rolling window feeding the spike-ratio gauge; ~128 barriers of
+    #: history keeps the median stable while a 1-in-100 spike still
+    #: lands in the p99 seat
+    _SPIKE_WINDOW = 128
+    #: below this many observations the ratio is noise, not signal
+    _SPIKE_MIN_SAMPLES = 8
+
+    def _observe_barrier(self, job_name: str, dt: float,
+                         **phases) -> None:
+        """Barrier-latency attribution: the total histogram, per-phase
+        histograms (``barrier_phase_seconds{job,phase}``), and the
+        rolling tail gauge ``barrier_spike_ratio{job}`` = p99/median
+        over the last window — the number the tail-latency gates
+        (``cluster_stress --assert`` / ``profile_q8 --assert``) bound.
+        Quantiles here are exact over the window (sorted host floats),
+        not histogram-bucket bounds: a spike ratio of 1.0 must mean
+        a genuinely flat tail, not two latencies in one bucket."""
+        self.metrics.observe("barrier_latency_seconds", dt,
+                             job=job_name)
+        for phase, secs in phases.items():
+            if secs is None:
+                continue
+            self.metrics.observe("barrier_phase_seconds", secs,
+                                 job=job_name, phase=phase)
+        lat = self._barrier_lat.get(job_name)
+        if lat is None:
+            lat = self._barrier_lat[job_name] = deque(
+                maxlen=self._SPIKE_WINDOW)
+        lat.append(dt)
+        if len(lat) >= self._SPIKE_MIN_SAMPLES:
+            s = sorted(lat)
+            med = s[len(s) // 2]
+            p99 = s[min(len(s) - 1, int(0.99 * len(s)))]
+            self.metrics.set_gauge(
+                "barrier_spike_ratio", p99 / max(med, 1e-9),
+                job=job_name,
+            )
+
+    def _retire_job_series(self, job_name: str) -> None:
+        """DROP retires the job's whole scrape footprint: every series
+        labeled ``job=<name>`` — barrier latency/phase histograms,
+        spike ratio, join gauges, checkpoint gauges — the way the
+        cluster meta retires a dead worker's per-worker series.
+        Without this, a dropped MV's gauges linger forever."""
+        self.metrics.remove_where(job=job_name)
+        self._barrier_lat.pop(job_name, None)
 
     def _export_checkpoint_gauges(self, job) -> None:
         """Cheap (no device sync) checkpoint-pipeline gauges."""

@@ -17,6 +17,8 @@ from __future__ import annotations
 import threading
 import time
 
+from risingwave_tpu.common.trace import GLOBAL_TRACE
+
 
 class CompactorService:
     """Thread-based compactor over one ``HummockStorage``.
@@ -69,7 +71,9 @@ class CompactorService:
         """Pick + execute + commit one compaction task; False when the
         policy is at quiescence."""
         t0 = time.perf_counter()
-        did = self.storage.compact_once()
+        with GLOBAL_TRACE.sampled_span("compact_cycle") as tsp:
+            did = self.storage.compact_once()
+            tsp.set(did=bool(did))
         if did:
             self.tasks_run += 1
             if self.metrics is not None:

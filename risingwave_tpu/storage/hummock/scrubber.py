@@ -34,6 +34,7 @@ import json
 import threading
 import time
 
+from risingwave_tpu.common.trace import GLOBAL_TRACE
 from risingwave_tpu.storage.integrity import (
     IntegrityError,
     verify_checkpoint_store,
@@ -134,42 +135,50 @@ class ScrubberService:
     def run_once(self) -> dict:
         """One full scrub cycle (also the ``ctl cluster scrub``
         surface).  Returns the cycle report."""
+        cycle_span = GLOBAL_TRACE.sampled_span("scrub_cycle")
+        cycle_span.__enter__()
         report = {"ssts_verified": 0, "blocks_verified": 0,
                   "checkpoints_verified": 0, "corrupt": []}
-        # SSTs reachable from the current + every pinned version: the
-        # exact set a serving read or a recovery could touch
-        versions = self.storage.versions
-        keys = sorted(versions.referenced_keys())
-        for key in keys:
-            if self._stop.is_set():
-                break
-            try:
-                n = verify_sst_object(self.storage.store, key)
-                self.objects_verified += 1
-                self.blocks_verified += n
-                report["ssts_verified"] += 1
-                report["blocks_verified"] += n
-            except IntegrityError as e:
-                report["corrupt"].append(("sst", key))
-                self._emit("sst", key, e)
-            except Exception:  # noqa: BLE001 — vacuumed underneath us
-                pass
-            self._advance_cursor(key)
-            if self.pace_s:
-                self._stop.wait(self.pace_s)
-        if self.ckpt_store is not None:
-            ck = verify_checkpoint_store(self.ckpt_store)
-            self.objects_verified += ck["verified"]
-            report["checkpoints_verified"] = ck["verified"]
-            for job, epoch, key in ck["corrupt"]:
-                report["corrupt"].append(("checkpoint", key))
-                self._emit(
-                    "checkpoint", key,
-                    IntegrityError(f"{key}: checkpoint scrub mismatch",
-                                   key=key),
-                    job=job, epoch=epoch,
-                )
-            self._advance_cursor("checkpoints")
-        self.cycles += 1
+        try:
+            # SSTs reachable from the current + every pinned version:
+            # the exact set a serving read or a recovery could touch
+            versions = self.storage.versions
+            keys = sorted(versions.referenced_keys())
+            for key in keys:
+                if self._stop.is_set():
+                    break
+                try:
+                    n = verify_sst_object(self.storage.store, key)
+                    self.objects_verified += 1
+                    self.blocks_verified += n
+                    report["ssts_verified"] += 1
+                    report["blocks_verified"] += n
+                except IntegrityError as e:
+                    report["corrupt"].append(("sst", key))
+                    self._emit("sst", key, e)
+                except Exception:  # noqa: BLE001 — vacuumed under us
+                    pass
+                self._advance_cursor(key)
+                if self.pace_s:
+                    self._stop.wait(self.pace_s)
+            if self.ckpt_store is not None:
+                ck = verify_checkpoint_store(self.ckpt_store)
+                self.objects_verified += ck["verified"]
+                report["checkpoints_verified"] = ck["verified"]
+                for job, epoch, key in ck["corrupt"]:
+                    report["corrupt"].append(("checkpoint", key))
+                    self._emit(
+                        "checkpoint", key,
+                        IntegrityError(
+                            f"{key}: checkpoint scrub mismatch",
+                            key=key),
+                        job=job, epoch=epoch,
+                    )
+                self._advance_cursor("checkpoints")
+            self.cycles += 1
+            cycle_span.set(ssts=report["ssts_verified"],
+                           corrupt=len(report["corrupt"]))
+        finally:
+            cycle_span.__exit__(None, None, None)
         self._export_gauges()
         return report

@@ -43,6 +43,7 @@ from dataclasses import dataclass, field
 from typing import Any
 
 from risingwave_tpu.common.faults import RetryPolicy
+from risingwave_tpu.common.trace import GLOBAL_TRACE
 
 
 @dataclass
@@ -70,6 +71,10 @@ class UploadTask:
     fetched: threading.Event = field(default_factory=threading.Event)
     done: threading.Event = field(default_factory=threading.Event)
     error: Exception | None = None
+    #: (trace_id, span_id) captured AT SEAL TIME — the uploader thread
+    #: has no thread-local trace context of its own, so the prepare/
+    #: commit spans parent under the seal that enqueued this epoch
+    trace_ctx: tuple | None = None
 
 
 class CheckpointUploader:
@@ -245,15 +250,23 @@ class CheckpointUploader:
                     )
                 digests = np.asarray(task.digests) \
                     if task.digests is not None else None
-                prep = self.store.prepare(
-                    self.job_name, task.epoch, task.leaves, task.shapes,
-                    task.treedef, task.source_state, digests=digests,
-                    lanes=task.lanes,
-                )
+                with GLOBAL_TRACE.span("ckpt_prepare",
+                                       ctx=task.trace_ctx,
+                                       job=self.job_name,
+                                       epoch=task.epoch):
+                    prep = self.store.prepare(
+                        self.job_name, task.epoch, task.leaves,
+                        task.shapes, task.treedef, task.source_state,
+                        digests=digests, lanes=task.lanes,
+                    )
                 # host payload materialized: the shadow may be donated
                 task.fetched.set()
-                self.retry.run(lambda: self.store.commit(prep),
-                               retry_on=(OSError,), label="commit")
+                with GLOBAL_TRACE.span("ckpt_commit",
+                                       ctx=task.trace_ctx,
+                                       job=self.job_name,
+                                       epoch=task.epoch):
+                    self.retry.run(lambda: self.store.commit(prep),
+                                   retry_on=(OSError,), label="commit")
                 dt = time.perf_counter() - t0
                 with self._cv:
                     self._acked.append(task.epoch)

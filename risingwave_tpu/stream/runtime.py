@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from risingwave_tpu.common.epoch import EpochPair
+from risingwave_tpu.common.trace import GLOBAL_TRACE
 from risingwave_tpu.stream.fragment import (
     COUNTER_ATTRS,
     Fragment,
@@ -186,21 +187,23 @@ class CheckpointPipelineMixin:
             if store is not None:
                 store.invalidate(self.ckpt_key)
             self._shadow = None
-        if self._shadow is None:
-            self._shadow = ShadowSnapshot(
-                self.states,
-                block_elems=store.block_elems if store is not None
-                else DEFAULT_BLOCK_ELEMS,
-                digest=store is not None,
-                shard_rows=self._shadow_shard_rows(),
-            )
-            digests = self._shadow.digests
-        else:
-            if up is not None:
-                # the update donates the shadow buffers in-flight
-                # fetches still read — wait for the fetch point only
-                up.wait_fetched()
-            digests = self._shadow.update(self.states, epoch_val)
+        with GLOBAL_TRACE.span("snapshot", job=getattr(
+                self, "name", "?"), epoch=epoch_val):
+            if self._shadow is None:
+                self._shadow = ShadowSnapshot(
+                    self.states,
+                    block_elems=store.block_elems if store is not None
+                    else DEFAULT_BLOCK_ELEMS,
+                    digest=store is not None,
+                    shard_rows=self._shadow_shard_rows(),
+                )
+                digests = self._shadow.digests
+            else:
+                if up is not None:
+                    # the update donates the shadow buffers in-flight
+                    # fetches still read — wait for the fetch point only
+                    up.wait_fetched()
+                digests = self._shadow.update(self.states, epoch_val)
         self.sealed_epoch = epoch_val
         self.checkpoints = [CheckpointSnapshot(
             epoch=epoch_val, states=None, source_state=src_state,
@@ -213,6 +216,7 @@ class CheckpointPipelineMixin:
                 digests=digests, shapes=self._shadow.shapes,
                 treedef=self._shadow.treedef, source_state=src_state,
                 spill=spill_items, lanes=self._shadow.lanes,
+                trace_ctx=GLOBAL_TRACE.current(),
             ))
             self._process_upload_acks()
         else:

@@ -161,6 +161,17 @@ def run(rounds: int = 24, workers: int = 2, kill_at_round: int = 8,
         mismatches = sum(c != s
                          for c, s in zip(cluster_rows, single_rows))
 
+        # unified metrics plane: ONE aggregated scrape must carry the
+        # barrier-phase histograms and the spike-ratio gauge for every
+        # live MV job (derived worker-side, merged meta-side)
+        import re
+        mtext = meta.cluster_metrics()
+        phase_jobs = sorted(set(re.findall(
+            r'barrier_phase_seconds_bucket\{[^}]*job="([^"]+)"',
+            mtext)))
+        spike_jobs = sorted(set(re.findall(
+            r'barrier_spike_ratio\{[^}]*job="([^"]+)"', mtext)))
+
         return {
             "rounds": rounds,
             "rounds_committed": state["rounds_committed"],
@@ -175,6 +186,8 @@ def run(rounds: int = 24, workers: int = 2, kill_at_round: int = 8,
             "tick_retries": state["retries"],
             "mv_mismatches": mismatches,
             "mv_rows": [len(r) for r in cluster_rows],
+            "metrics_phase_jobs": phase_jobs,
+            "metrics_spike_jobs": spike_jobs,
             "wall_seconds": round(wall, 2),
             "data_dir": data_dir,
         }
@@ -207,10 +220,16 @@ def main() -> None:
                   readers=args.readers)
     print(json.dumps(summary))
     if args.check:
+        mv_jobs = {"q7", "qcnt"}
         ok = (summary["read_errors"] == 0
               and summary["mv_mismatches"] == 0
               and summary["failovers"] == 1
-              and summary["rounds_committed"] == summary["rounds"])
+              and summary["rounds_committed"] == summary["rounds"]
+              # observability gate: the aggregated scrape attributes
+              # barrier time per phase and tracks the spike ratio for
+              # every MV job that survived the run
+              and mv_jobs <= set(summary["metrics_phase_jobs"])
+              and mv_jobs <= set(summary["metrics_spike_jobs"]))
         raise SystemExit(0 if ok else 1)
 
 

@@ -192,6 +192,19 @@ def run_assert(small: bool) -> int:
             "or emission staging regressed"
         )
 
+    # observability gate (trace-lite): the engine must attribute
+    # barrier time per phase on its scrape surface for the bench job
+    for phase in ("dispatch", "seal"):
+        try:
+            m.quantile("barrier_phase_seconds", 0.5,
+                       job=job.name, phase=phase)
+        except KeyError:
+            failures.append(
+                "observability: no barrier_phase_seconds"
+                f"{{job={job.name},phase={phase}}} histogram — "
+                "barrier-phase attribution regressed"
+            )
+
     # error counters must be clean (the audit barrier would raise, but
     # assert explicitly so this mode stands alone)
     import numpy as np

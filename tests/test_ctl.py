@@ -115,6 +115,57 @@ def test_ctl_cluster_subcommands(tmp_path):
         meta.stop()
 
 
+def test_ctl_cluster_metrics_and_trace(tmp_path):
+    """``ctl cluster metrics`` (one aggregated labeled scrape) and
+    ``ctl cluster trace --chrome`` (one cross-role round tree) against
+    a RUNNING meta, via the same online-RPC helpers the CLI calls."""
+    import json
+
+    from risingwave_tpu.cluster import ComputeWorker, MetaService
+    from risingwave_tpu.common.config import RwConfig
+    from risingwave_tpu.common.trace import GLOBAL_TRACE
+    from risingwave_tpu.ctl import cluster_metrics, cluster_trace
+
+    cfg = RwConfig.from_dict({
+        "streaming": {"chunk_size": 64},
+        "state": {"agg_table_size": 256, "agg_emit_capacity": 64,
+                  "mv_table_size": 256, "mv_ring_size": 512},
+    })
+    role, n = GLOBAL_TRACE.role, GLOBAL_TRACE.sample_n
+    GLOBAL_TRACE.configure(role="proc", sample_n=1)
+    GLOBAL_TRACE.clear()
+    meta = MetaService(str(tmp_path), heartbeat_timeout_s=5.0)
+    meta.start(port=0, monitor=False)
+    addr = f"127.0.0.1:{meta.rpc_port}"
+    w = ComputeWorker(addr, str(tmp_path), config=cfg,
+                      heartbeat_interval_s=0.5).start()
+    try:
+        meta.execute_ddl(
+            "CREATE SOURCE t (k BIGINT) WITH (connector='datagen');"
+            "CREATE MATERIALIZED VIEW cv AS "
+            "SELECT k % 2 AS b, count(*) AS n FROM t GROUP BY k % 2"
+        )
+        assert meta.tick(1)["committed"]
+
+        text = cluster_metrics(addr)
+        assert 'role="meta"' in text
+        assert 'barrier_phase_seconds_bucket{job="cv"' in text
+        assert text.count("# TYPE cluster_epoch_committed gauge") == 1
+
+        chrome = tmp_path / "round1.json"
+        tr = cluster_trace(addr, round=1, chrome=str(chrome))
+        assert tr["round"] == 1 and tr["check"]["complete"]
+        names = set(tr["check"]["names"])
+        assert {"round", "barrier", "commit", "seal"} <= names
+        ct = json.loads(chrome.read_text())
+        assert any(e.get("ph") == "X" for e in ct["traceEvents"])
+    finally:
+        GLOBAL_TRACE.configure(role=role, sample_n=n)
+        GLOBAL_TRACE.clear()
+        w.stop()
+        meta.stop()
+
+
 def test_troublemaker_corruption_is_caught():
     """Injected op corruption must surface via consistency counters,
     never silently wrong results (ref RW_UNSAFE_ENABLE_INSANE_MODE)."""

@@ -1,0 +1,355 @@
+"""Trace-lite (ISSUE 14): span recorder semantics, cross-role trace
+assembly, propagation under injected faults, metrics-plane merging,
+and DROP-time series retirement."""
+
+import json
+import threading
+
+import pytest
+
+from risingwave_tpu.common import faults as faults_mod
+from risingwave_tpu.common.metrics import (
+    MetricsRegistry,
+    merge_prometheus,
+)
+from risingwave_tpu.common.trace import (
+    GLOBAL_TRACE,
+    NULL_SPAN,
+    SpanRecorder,
+    merge_dumps,
+    round_ids,
+    spans_for_round,
+    to_chrome_trace,
+    tree_check,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_globals():
+    """Each test gets a clean global recorder and NO fault fabric; both
+    are restored so unrelated suites never see leaked state."""
+    role, n, cap = (GLOBAL_TRACE.role, GLOBAL_TRACE.sample_n,
+                    GLOBAL_TRACE.capacity)
+    GLOBAL_TRACE.configure(role="proc", sample_n=1)
+    GLOBAL_TRACE.clear()
+    faults_mod.install(None)
+    yield
+    faults_mod.install(None)
+    GLOBAL_TRACE.configure(role=role, sample_n=n, capacity=cap)
+    GLOBAL_TRACE.clear()
+
+
+# -- recorder semantics --------------------------------------------------
+def test_disabled_tracing_is_the_null_singleton():
+    """sample_n=0 is the overhead contract: span() hands back ONE
+    shared null object — no allocation, no clock read, empty ring."""
+    rec = SpanRecorder(role="w", sample_n=0)
+    assert rec.span("round", trace_id="round-1") is NULL_SPAN
+    assert rec.sampled_span("read") is NULL_SPAN
+    assert rec.activate(("round-1", "w:1")) is NULL_SPAN
+    with rec.span("x", trace_id="round-1") as s:
+        assert s.set(k=1) is NULL_SPAN and s.ctx is None
+    assert rec.dump() == []
+
+
+def test_span_without_any_context_is_null():
+    rec = SpanRecorder(role="w", sample_n=1)
+    # enabled, but no active trace, no explicit ctx, no trace_id:
+    # nothing to attach to — the chunk path stays allocation-free
+    assert rec.span("orphan") is NULL_SPAN
+    assert rec.dump() == []
+
+
+def test_nesting_and_cross_thread_ctx_propagation():
+    rec = SpanRecorder(role="meta", sample_n=1)
+    with rec.span("round", trace_id="round-7", epoch=7) as root:
+        with rec.span("barrier", unit="u0") as b:
+            assert b.parent_id == root.span_id
+        rctx = root.ctx
+
+        def fan_out():
+            # fan-out threads have an empty TLS stack: the explicit
+            # ctx= is the only way spans parent correctly
+            with rec.span("barrier", ctx=rctx, unit="u1"):
+                pass
+
+        t = threading.Thread(target=fan_out)
+        t.start()
+        t.join()
+    spans = rec.dump("round-7")
+    assert {s["name"] for s in spans} == {"round", "barrier"}
+    chk = tree_check(spans)
+    assert chk["complete"] and chk["root_covers"], chk
+    parents = {s["parent_id"] for s in spans if s["name"] == "barrier"}
+    assert parents == {root.span_id}
+
+
+def test_ring_is_bounded_flight_recorder():
+    rec = SpanRecorder(role="w", sample_n=1, capacity=8)
+    for i in range(20):
+        with rec.span("s", trace_id="round-1", i=i):
+            pass
+    spans = rec.dump()
+    assert len(spans) == 8
+    # oldest fell off, newest survive, order preserved
+    assert [s["attrs"]["i"] for s in spans] == list(range(12, 20))
+
+
+def test_activate_adopts_remote_context():
+    """The RPC server seam: a frame's trace key becomes the handler
+    thread's context, so handler-side spans parent across processes."""
+    rec = SpanRecorder(role="worker1", sample_n=1)
+    with rec.activate(("round-3", "meta:9")):
+        with rec.span("dispatch") as d:
+            pass
+    assert rec.current() is None  # guard popped
+    (s,) = rec.dump()
+    assert s["trace_id"] == "round-3" and s["parent_id"] == "meta:9"
+    assert d.span_id.startswith("worker1:")
+
+
+def test_sampled_span_one_in_n_and_ctx_parenting():
+    rec = SpanRecorder(role="serving1", sample_n=3)
+    for _ in range(9):
+        with rec.sampled_span("serving_read"):
+            pass
+    spans = rec.dump()
+    assert len(spans) == 3
+    assert all(s["trace_id"] == "sampled-serving1" for s in spans)
+    # ctx= pulls the sampled read INTO the round's tree instead
+    with rec.sampled_span("serving_read", ctx=("round-5", "meta:1")):
+        pass
+    tagged = rec.dump("round-5")
+    assert len(tagged) == 1 and tagged[0]["parent_id"] == "meta:1"
+
+
+def test_exception_inside_span_records_error_attr():
+    rec = SpanRecorder(role="w", sample_n=1)
+    with pytest.raises(ValueError):
+        with rec.span("seal", trace_id="round-1"):
+            raise ValueError("boom")
+    (s,) = rec.dump()
+    assert s["attrs"]["error"] == "ValueError"
+    assert rec.current() is None  # TLS stack unwound despite the raise
+
+
+def test_merge_dumps_dedups_and_orders():
+    rec = SpanRecorder(role="w", sample_n=1)
+    with rec.span("a", trace_id="round-1"):
+        pass
+    with rec.span("b", trace_id="round-1"):
+        pass
+    d = rec.dump()
+    merged = merge_dumps([d, d, [d[1]]])  # pulled twice + partial
+    assert [s["name"] for s in merged] == ["a", "b"]
+    assert round_ids(merged) == [1]
+    assert len(spans_for_round(merged, 1)) == 2
+
+
+def test_truncated_dump_is_parseable_not_fatal():
+    """The SIGKILL contract: a dead role's spans are simply absent.
+    tree_check reports orphans/missing roots instead of raising."""
+    meta = SpanRecorder(role="meta", sample_n=1)
+    worker = SpanRecorder(role="worker1", sample_n=1)
+    with meta.span("round", trace_id="round-2") as root:
+        with worker.span("seal", ctx=root.ctx):
+            pass
+    # meta's dump lost (meta SIGKILLed): worker spans orphaned
+    chk = tree_check(merge_dumps([worker.dump()]))
+    assert not chk["complete"] and chk["orphans"]
+    # worker's dump lost: meta-only tree still checks out
+    chk2 = tree_check(merge_dumps([meta.dump()]))
+    assert chk2["complete"] and chk2["roots"]
+
+
+def test_chrome_export_is_loadable_trace_event_json():
+    rec = SpanRecorder(role="meta", sample_n=1)
+    with rec.span("round", trace_id="round-1", epoch=1):
+        with rec.span("commit"):
+            pass
+    ct = json.loads(json.dumps(to_chrome_trace(rec.dump())))
+    xs = [e for e in ct["traceEvents"] if e["ph"] == "X"]
+    ms = [e for e in ct["traceEvents"] if e["ph"] == "M"]
+    assert len(xs) == 2 and ms  # complete events + pid/tid metadata
+    assert all(e["ts"] > 0 and e["dur"] >= 0 for e in xs)  # microsecs
+    assert {e["name"] for e in xs} == {"round", "commit"}
+
+
+# -- metrics plane -------------------------------------------------------
+def test_render_prometheus_type_lines_and_le_convention():
+    m = MetricsRegistry()
+    m.inc("reqs", job="a")
+    m.observe("lat_seconds", 0.003, job="a")
+    m.observe("lat_seconds", 0.003, job="b")
+    text = m.render_prometheus()
+    # one # TYPE per family, not per labelset
+    assert text.count("# TYPE lat_seconds histogram") == 1
+    assert text.count("# TYPE reqs counter") == 1
+    # le bounds render bare (0.005, not 5e-03 / 0.00500)
+    assert 'le="0.005"' in text and 'le="+Inf"' in text
+    assert "5e-" not in text
+
+
+def test_merge_prometheus_injects_identity_labels():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.set_gauge("up", 1)
+    a.inc("rows", job="q1")
+    b.inc("rows", job="q1")
+    merged = merge_prometheus([
+        ({"role": "meta"}, a.render_prometheus()),
+        ({"role": "worker1", "worker": "1"}, b.render_prometheus()),
+    ])
+    assert 'up{role="meta"} 1' in merged
+    assert 'rows{job="q1",role="meta"} 1' in merged
+    assert 'rows{job="q1",role="worker1",worker="1"} 1' in merged
+    # TYPE lines dedup across scrapes and lead the output
+    assert merged.count("# TYPE rows counter") == 1
+    body = merged.split("\n")
+    last_type = max(i for i, l in enumerate(body)
+                    if l.startswith("# TYPE"))
+    first_sample = min(i for i, l in enumerate(body)
+                       if l and not l.startswith("#"))
+    assert last_type < first_sample
+
+
+def test_quantile_returns_bucket_upper_bound():
+    m = MetricsRegistry()
+    for v in (0.003, 0.003, 0.004, 0.2):
+        m.observe("lat_seconds", v, job="a")
+    from risingwave_tpu.common.metrics import _DEFAULT_BUCKETS
+
+    # the answer is a bucket UPPER BOUND (conservative estimate): the
+    # least boundary whose cumulative count reaches the quantile
+    assert m.quantile("lat_seconds", 0.5, job="a") == 0.005
+    assert m.quantile("lat_seconds", 1.0, job="a") == 0.25
+    assert all(m.quantile("lat_seconds", q, job="a")
+               in _DEFAULT_BUCKETS for q in (0.1, 0.5, 0.9))
+
+
+# -- in-process cluster: propagation under faults ------------------------
+def _cluster_cfg():
+    from risingwave_tpu.common.config import RwConfig
+
+    return RwConfig.from_dict({
+        "streaming": {"chunk_size": 128},
+        "state": {"agg_table_size": 512, "agg_emit_capacity": 128,
+                  "mv_table_size": 512, "mv_ring_size": 1024},
+        "storage": {"checkpoint_keep_epochs": 4},
+    })
+
+
+def _boot(tmp_path):
+    from risingwave_tpu.cluster import ComputeWorker, MetaService
+
+    meta = MetaService(str(tmp_path), heartbeat_timeout_s=60.0)
+    meta.start(port=0, monitor=False, compactor=False)
+    w = ComputeWorker(f"127.0.0.1:{meta.rpc_port}", str(tmp_path),
+                      config=_cluster_cfg(),
+                      heartbeat_interval_s=5.0).start()
+    meta.execute_ddl(
+        "CREATE SOURCE t (k BIGINT, v BIGINT) "
+        "WITH (connector='datagen')"
+    )
+    meta.execute_ddl(
+        "CREATE MATERIALIZED VIEW tm AS "
+        "SELECT k % 4 AS g, count(*) AS n FROM t GROUP BY k % 4"
+    )
+    return meta, w
+
+
+def test_retried_barrier_yields_exactly_one_span_tree(tmp_path):
+    """FaultFabric eats two barrier RESPONSES: the meta's RetryPolicy
+    re-sends, the worker answers from its round cache (re-running no
+    chunks, recording no duplicate spans) — each round still assembles
+    exactly ONE complete tree with one root and one seal."""
+    from risingwave_tpu.common.faults import FaultFabric
+
+    meta, w = _boot(tmp_path)
+    try:
+        assert meta.tick(1)["committed"]
+        fab = faults_mod.install(FaultFabric())
+        fab.fail_rpc(substr=">worker1/barrier",
+                     mode="error_after_send", times=2)
+        try:
+            assert meta.tick(1)["committed"]
+        finally:
+            faults_mod.install(None)
+        assert fab.injected.get("rpc", 0) >= 1
+
+        tr = meta.cluster_trace(round=2)
+        chk = tr["check"]
+        assert chk["complete"], chk
+        names = [s["name"] for s in tr["spans"]]
+        assert names.count("round") == 1  # exactly one root
+        assert names.count("seal") == 1  # chunks ran exactly once
+        assert names.count("barrier") == 1  # one meta-side RPC span
+        assert "commit" in names and "dispatch" in names
+    finally:
+        faults_mod.install(None)
+        w.stop()
+        meta.stop()
+
+
+def test_failed_tick_reuses_round_root_no_duplicate_trees(tmp_path):
+    """Multi-attempt dedup: a tick whose barrier is dropped outright
+    leaves the round uncommitted; the NEXT tick for the same round
+    attaches an ``attempt`` child to the CACHED root instead of
+    opening a second root — one tree per round, by construction."""
+    from risingwave_tpu.common.faults import FaultFabric
+
+    meta, w = _boot(tmp_path)
+    try:
+        assert meta.tick(1)["committed"]
+        # make barrier failure fast and terminal for ONE tick
+        meta.retry.max_attempts = 1
+        fab = faults_mod.install(FaultFabric())
+        fab.fail_rpc(substr=">worker1/barrier", mode="drop", times=1)
+        try:
+            assert not meta.tick(1)["committed"]
+        finally:
+            faults_mod.install(None)
+            meta.retry.max_attempts = 5
+        res = meta.tick(1)
+        assert res["committed"] and res["round"] == 2
+
+        tr = meta.cluster_trace(round=2)
+        chk = tr["check"]
+        assert chk["complete"], chk
+        names = [s["name"] for s in tr["spans"]]
+        assert names.count("round") == 1
+        assert "attempt" in names  # the retry rode the cached root
+        assert names.count("seal") == 1
+    finally:
+        faults_mod.install(None)
+        w.stop()
+        meta.stop()
+
+
+# -- DROP retires the scrape surface -------------------------------------
+def test_drop_mv_and_index_retire_job_labeled_series():
+    from risingwave_tpu.sql.engine import Engine
+
+    eng = Engine(_cluster_cfg())
+    eng.execute(
+        "CREATE SOURCE t (k BIGINT, v BIGINT) "
+        "WITH (connector='datagen')"
+    )
+    eng.execute(
+        "CREATE MATERIALIZED VIEW m1 AS "
+        "SELECT k % 4 AS g, count(*) AS n FROM t GROUP BY k % 4"
+    )
+    eng.execute("CREATE INDEX m1_g ON m1(g)")
+    # enough barriers for the rolling spike-ratio gauge (min samples)
+    eng.tick(barriers=10, chunks_per_barrier=1)
+    text = eng.metrics.render_prometheus()
+    assert 'barrier_phase_seconds_bucket{job="m1"' in text
+    assert 'barrier_spike_ratio{job="m1"' in text
+
+    eng.execute("DROP INDEX m1_g")
+    text = eng.metrics.render_prometheus()
+    assert 'job="m1_g"' not in text  # index series gone...
+    assert 'barrier_phase_seconds_bucket{job="m1"' in text  # host stays
+
+    eng.execute("DROP MATERIALIZED VIEW m1")
+    text = eng.metrics.render_prometheus()
+    assert 'job="m1"' not in text  # ...and the MV's whole footprint

@@ -1,0 +1,42 @@
+"""Slow wrapper over scripts/trace_report.py (the ISSUE 14 acceptance
+harness), matching the cluster_stress pattern: a real 4-role
+subprocess cluster must assemble one complete cross-role span tree
+per committed round, and disabled tracing must cost < 2%."""
+
+import pytest
+
+
+def _import():
+    import importlib
+    import sys
+
+    sys.path.insert(0, "scripts")
+    try:
+        return importlib.import_module("trace_report")
+    finally:
+        sys.path.pop(0)
+
+
+@pytest.mark.slow
+def test_trace_report_cluster_rounds(tmp_path):
+    tr = _import()
+    chrome = str(tmp_path / "trace.chrome.json")
+    summary = tr.run_cluster(rounds=3, workers=2, chrome=chrome,
+                             data_dir=str(tmp_path))
+    assert summary["failures"] == [], summary["failures"]
+    assert len(summary["rounds_committed"]) == 3
+    assert summary["serving_read_rounds"] >= 1
+    assert summary["chrome_events"] > 0
+
+    import json
+    with open(chrome) as f:
+        ct = json.load(f)
+    assert any(e.get("ph") == "X" for e in ct["traceEvents"])
+
+
+@pytest.mark.slow
+def test_trace_overhead_under_budget():
+    tr = _import()
+    ov = tr.run_overhead(iters=6)
+    # generous CI budget; the standalone --assert gate uses 2%
+    assert ov["overhead_frac"] < 0.10, ov
