@@ -1042,9 +1042,10 @@ class MetaService:
             elif isinstance(stmt, ast.DropStatement) \
                     and stmt.kind in ("materialized view", "index"):
                 self._drop_mv(text, stmt, replay=replay)
-            elif isinstance(stmt, ast.Insert):
-                # never reaches the DDL log; forwarded rows live in the
-                # workers' durable table history + checkpoints
+            elif isinstance(stmt, (ast.Insert, ast.Delete)):
+                # never reaches the DDL log; forwarded rows (marked
+                # marker-tail for DELETE) live in the workers' durable
+                # table history + checkpoints
                 if not replay:
                     self._forward_dml(text, stmt.table)
             else:
@@ -2210,9 +2211,13 @@ class MetaService:
         if committed:
             with GLOBAL_TRACE.span("commit", epoch=target):
                 self._commit_cluster_epoch(target, units)
+            from risingwave_tpu.common.metrics import (
+                WIDE_SECONDS_BUCKETS,
+            )
             self.metrics.observe(
                 "cluster_barrier_commit_seconds",
                 time.perf_counter() - t0,
+                buckets=WIDE_SECONDS_BUCKETS,
             )
         return {"round": target, "committed": committed,
                 "jobs": len(jobs), "units": len(units),

@@ -282,7 +282,11 @@ class ComputeWorker:
 
         stmts = parse(sql)
         route = None
-        if len(stmts) == 1 and isinstance(stmts[0], ast.Insert):
+        if len(stmts) == 1 and isinstance(stmts[0],
+                                          (ast.Insert, ast.Delete)):
+            # DELETE routes identically: the leader executes the SQL,
+            # the history slice it ships already carries the
+            # marker-tail op encoding (connector/dml.py)
             route = self._table_route(stmts[0].table)
         if route is None:
             with self._lock:

@@ -42,6 +42,15 @@ _DEFAULT_BUCKETS = (
     1.0, 2.5, 5.0, 10.0,
 )
 
+#: wide-range duration grid for coarse control-plane phases (barrier
+#: commits, replays): the default grid tops out at 10s, pushing any
+#: slower observation into +Inf — useless for a bounded p99 gate on a
+#: 1-core box where a compile-heavy round legitimately takes minutes
+WIDE_SECONDS_BUCKETS = (
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+    300.0, 600.0,
+)
+
 
 def _fmt_le(b: float) -> str:
     """Prometheus exposition-format bound: ``0.005``, ``1``, ``2.5``
@@ -71,11 +80,16 @@ class MetricsRegistry:
         with self._lock:
             self._gauges[key].value = value
 
-    def observe(self, name: str, value: float, **labels) -> None:
+    def observe(self, name: str, value: float, buckets=None,
+                **labels) -> None:
+        """``buckets`` picks the grid at series CREATION (first
+        observe wins; later values are ignored — one series, one
+        grid)."""
         key = (name, tuple(sorted(labels.items())))
         with self._lock:
             if key not in self._hists:
-                self._hists[key] = _HistSeries(_DEFAULT_BUCKETS)
+                self._hists[key] = _HistSeries(
+                    tuple(buckets) if buckets else _DEFAULT_BUCKETS)
             self._hists[key].observe(value)
 
     def timer(self, name: str, **labels):
@@ -154,6 +168,40 @@ class MetricsRegistry:
             seen += c
             if seen >= target:
                 return h.buckets[i] if i < len(h.buckets) else float("inf")
+        return float("inf")
+
+    def hist_counts(self, name: str, **labels) -> list[int]:
+        """Bucket-count snapshot of one histogram series (empty list
+        when the series does not exist yet).  Pair with
+        ``quantile_delta`` for warmup-excluding tail gates."""
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            h = self._hists.get(key)
+            return list(h.counts) if h else []
+
+    def quantile_delta(self, name: str, q: float, baseline,
+                       **labels) -> float:
+        """``quantile`` over only the observations made since
+        ``baseline`` (a ``hist_counts`` snapshot) — how SLO gates
+        exclude compile-heavy warmup rounds from a tail ceiling.
+        Returns 0.0 when nothing was observed since the snapshot."""
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            h = self._hists.get(key)
+            if h is None:
+                return 0.0
+            base = list(baseline) + [0] * (len(h.counts) - len(baseline))
+            counts = [c - b for c, b in zip(h.counts, base)]
+        total = sum(counts)
+        if total <= 0:
+            return 0.0
+        target = q * total
+        seen = 0
+        for i, c in enumerate(counts):
+            seen += c
+            if seen >= target:
+                return h.buckets[i] if i < len(h.buckets) \
+                    else float("inf")
         return float("inf")
 
     def render_prometheus(self) -> str:

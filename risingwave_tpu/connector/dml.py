@@ -17,8 +17,27 @@ from typing import Sequence
 
 import numpy as np
 
-from risingwave_tpu.common.chunk import Chunk
+from risingwave_tpu.common.chunk import OP_DELETE, OP_INSERT, Chunk
 from risingwave_tpu.common.types import Schema
+
+#: marker-tail retraction encoding: a DELETE row is the full old row
+#: with this sentinel appended PAST the schema width.  Every existing
+#: path — vnode hashing (row[key_col]), width checks (schema string
+#: column indices), exchange slicing, fence repair, JSON durability —
+#: indexes rows by schema position, so marked rows ride all of them
+#: untouched; only the source reader looks at the tail to derive the
+#: chunk op.
+DELETE_MARK = "__rwt_delete__"
+
+
+def mark_deletes(rows, width: int) -> list[tuple]:
+    """Append the delete marker to full-width rows (idempotent)."""
+    return [tuple(r) if len(r) > width else tuple(r) + (DELETE_MARK,)
+            for r in rows]
+
+
+def row_is_delete(row, width: int) -> bool:
+    return len(row) > width and row[width] == DELETE_MARK
 
 
 class TableDmlManager:
@@ -203,8 +222,11 @@ class TableDmlManager:
         for i in self._max_lens:
             self._max_lens[i] = max(self._max_lens[i], batch_max[i])
 
-    def insert(self, rows: Sequence[tuple]) -> int:
+    def insert(self, rows: Sequence[tuple],
+               delete: bool = False) -> int:
         rows = list(rows)
+        if delete:
+            rows = mark_deletes(rows, len(self.schema))
         self._check_widths(rows)
         self._history.extend(rows)  # readers see this shared list
         self.rows_inserted += len(rows)
@@ -345,7 +367,14 @@ class TableSourceReader:
             np.asarray([row[i] for row in batch])
             for i in range(len(self.schema))
         ]
-        return Chunk.from_numpy(self.schema, arrays, capacity=self.cap)
+        # marker-tail rows become OP_DELETE changelog entries here —
+        # the single point where the retraction encoding is decoded
+        width = len(self.schema)
+        ops = np.asarray(
+            [OP_DELETE if row_is_delete(row, width) else OP_INSERT
+             for row in batch], np.int8)
+        return Chunk.from_numpy(self.schema, arrays, ops=ops,
+                                capacity=self.cap)
 
     def state(self) -> dict:
         return {"offset": self.offset}

@@ -247,6 +247,20 @@ class Insert:
 
 
 @dataclass(frozen=True)
+class Delete:
+    """``DELETE FROM t VALUES (...)`` — exact-full-row retraction.
+
+    The workload plane knows the full row it retracts (the generator
+    keeps deterministic shadow state), so deletes ship the complete
+    old row and the changelog simply emits it with ``OP_DELETE`` —
+    no lookup path, and every downstream operator retracts by sign
+    arithmetic exactly as for any other changelog source."""
+    table: str
+    columns: tuple[str, ...]  # () = positional
+    rows: tuple               # tuples of literal AST exprs
+
+
+@dataclass(frozen=True)
 class CreateMaterializedView:
     name: str
     query: Select

@@ -485,6 +485,8 @@ class Engine:
             return self._alter_parallelism(stmt)
         if isinstance(stmt, ast.Insert):
             return self._insert(stmt)
+        if isinstance(stmt, ast.Delete):
+            return self._delete(stmt)
         if isinstance(stmt, ast.Select):
             return self._serve(stmt)
         raise ValueError(f"unhandled statement {stmt!r}")
@@ -523,35 +525,63 @@ class Engine:
             )
         return None
 
-    def _insert(self, stmt: ast.Insert):
-        entry = self.catalog.get(stmt.table)
-        if entry.dml is None:
-            raise ValueError(f"{stmt.table} is not an INSERT-able table")
+    def _dml_rows(self, stmt, entry, verb: str) -> list[tuple]:
+        """Coerce INSERT/DELETE literal rows to the table schema."""
         schema = entry.schema
         if stmt.columns:
             order = [schema.index_of(c) for c in stmt.columns]
             if len(set(order)) != len(order):
-                raise ValueError("INSERT lists a column twice")
+                raise ValueError(f"{verb} lists a column twice")
             for i in set(range(len(schema))) - set(order):
                 if not schema[i].nullable:
                     raise ValueError(
-                        f"INSERT omits NOT NULL column {schema[i].name}"
+                        f"{verb} omits NOT NULL column {schema[i].name}"
                     )
         else:
             order = list(range(len(schema)))
         rows = []
         for r in stmt.rows:
             if len(r) != len(order):
-                raise ValueError("INSERT arity mismatch")
+                raise ValueError(f"{verb} arity mismatch")
             vals = [None] * len(schema)
             for pos, e in zip(order, r):
                 vals[pos] = _coerce_const(
                     _const_value(e), schema[pos]
                 )
             rows.append(tuple(vals))
+        return rows
+
+    def _insert(self, stmt: ast.Insert):
+        entry = self.catalog.get(stmt.table)
+        if entry.dml is None:
+            raise ValueError(f"{stmt.table} is not an INSERT-able table")
+        rows = self._dml_rows(stmt, entry, "INSERT")
         entry.dml.insert(rows)
         if self.meta_store is not None and not self._replaying:
             self.meta_store.append_dml(stmt.table, rows)
+        return None
+
+    def _delete(self, stmt: "ast.Delete"):
+        """Exact-full-row retraction on a table created WITH
+        (retract = 'true').  The marked rows (marker-tail encoding,
+        connector/dml.py) are appended to the same history log, so the
+        durable DML journal, exchange slicing, and replay all carry
+        the op for free."""
+        from risingwave_tpu.connector.dml import mark_deletes
+
+        entry = self.catalog.get(stmt.table)
+        if entry.dml is None:
+            raise ValueError(f"{stmt.table} is not a DML table")
+        if entry.append_only:
+            raise ValueError(
+                f"{stmt.table} is append-only; CREATE TABLE ... WITH "
+                "(retract = 'true') to enable DELETE"
+            )
+        rows = self._dml_rows(stmt, entry, "DELETE")
+        marked = mark_deletes(rows, len(entry.schema))
+        entry.dml.insert(marked)
+        if self.meta_store is not None and not self._replaying:
+            self.meta_store.append_dml(stmt.table, marked)
         return None
 
     def _explain(self, stmt) -> list[tuple[str]]:
@@ -695,9 +725,16 @@ class Engine:
 
         pk = [schema.index_of(c) for c in stmt.primary_key] \
             if stmt.primary_key else None
+        # WITH (retract = 'true'): the table accepts DELETE (exact
+        # full-row retraction) and downstream plans must pick their
+        # retraction-capable variants — exactly the append_only=False
+        # path every changelog operator already implements
+        retract = str(stmt.with_options.get(
+            "retract", "false")).lower() in ("true", "1", "yes")
         return CatalogEntry(
             stmt.name, "source", schema, reader_factory=factory,
-            watermark=wm, append_only=True, definition=self._definition_text(stmt),
+            watermark=wm, append_only=not retract,
+            definition=self._definition_text(stmt),
             dml=dml, stream_key=pk,
         )
 

@@ -187,31 +187,41 @@ class Parser:
             return self._set(system=False)
         if self.accept_word("insert"):
             self.expect_word("into")
-            name = self.ident()
-            cols: list[str] = []
-            if self.accept_op("("):
-                while True:
-                    cols.append(self.ident())
-                    if not self.accept_op(","):
-                        break
-                self.expect_op(")")
-            self.expect_word("values")
-            rows = []
-            while True:
-                self.expect_op("(")
-                row = [self._expr()]
-                while self.accept_op(","):
-                    row.append(self._expr())
-                self.expect_op(")")
-                rows.append(tuple(row))
-                if not self.accept_op(","):
-                    break
+            name, cols, rows = self._dml_values()
             return ast.Insert(name, tuple(cols), tuple(rows))
+        if self.accept_word("delete"):
+            self.expect_word("from")
+            name, cols, rows = self._dml_values()
+            return ast.Delete(name, tuple(cols), tuple(rows))
         if self.accept_word("flush"):
             return ast.FlushStatement()
         if self.peek() and self.peek().value == "select":
             return self._select()
         raise ParseError(f"unsupported statement at {self.peek()}")
+
+    def _dml_values(self):
+        """Shared INSERT/DELETE tail: ``t [(col,...)] VALUES (...), ...``
+        (DELETE retracts by exact full row — see ast.Delete)."""
+        name = self.ident()
+        cols: list[str] = []
+        if self.accept_op("("):
+            while True:
+                cols.append(self.ident())
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+        self.expect_word("values")
+        rows = []
+        while True:
+            self.expect_op("(")
+            row = [self._expr()]
+            while self.accept_op(","):
+                row.append(self._expr())
+            self.expect_op(")")
+            rows.append(tuple(row))
+            if not self.accept_op(","):
+                break
+        return name, cols, rows
 
     def _set(self, system: bool):
         name = self.ident()

@@ -402,6 +402,7 @@ class Planner:
 
             local: list = []
             join_keys: list = []  # (sub_col: ColumnRef, outer_expr)
+            neq: list = []        # (sub_col: ColumnRef, outer_expr)
             sub_conjs = self._conjuncts(sub.where) \
                 if sub.where is not None else []
             for sc in sub_conjs:
@@ -409,15 +410,17 @@ class Planner:
                 if refs and all(is_local(r) for r in refs):
                     local.append(sc)
                     continue
-                if (isinstance(sc, ast.BinaryOp) and sc.op == "equal"):
+                if (isinstance(sc, ast.BinaryOp)
+                        and sc.op in ("equal", "not_equal")):
                     a, b = sc.left, sc.right
+                    bucket = join_keys if sc.op == "equal" else neq
                     if isinstance(a, ast.ColumnRef) \
                             and isinstance(b, ast.ColumnRef):
                         if is_local(a) and not is_local(b):
-                            join_keys.append((a, b))
+                            bucket.append((a, b))
                             continue
                         if is_local(b) and not is_local(a):
-                            join_keys.append((b, a))
+                            bucket.append((b, a))
                             continue
                 raise PlanError(
                     "EXISTS supports correlated equality predicates "
@@ -427,6 +430,12 @@ class Planner:
                 raise PlanError(
                     "EXISTS subquery must correlate on at least one "
                     "equality with the outer query"
+                )
+            if len(neq) > 1:
+                raise PlanError(
+                    "EXISTS supports at most ONE correlated "
+                    "non-equality predicate (the min/max "
+                    "decorrelation does not compose across columns)"
                 )
             alias = f"_ex_sq{k}"
             import dataclasses
@@ -438,20 +447,92 @@ class Planner:
                 ast.SelectItem(sc_col, f"_exk{j}")
                 for j, (sc_col, _) in enumerate(join_keys)
             )
+            if not neq:
+                sub2 = dataclasses.replace(
+                    sub, items=items, where=lwhere, group_by=(),
+                    having=None, order_by=(), limit=None, offset=None,
+                )
+                on = None
+                for j, (_, outer_e) in enumerate(join_keys):
+                    eq = ast.BinaryOp(
+                        "equal", outer_e,
+                        ast.ColumnRef(f"_exk{j}", alias),
+                    )
+                    on = eq if on is None else ast.BinaryOp("and", on, eq)
+                from_ = ast.Join(
+                    left=from_, right=ast.SubqueryRef(sub2, alias),
+                    on=on, kind="anti" if negated else "semi",
+                )
+                continue
+            # ONE correlated non-equality (q21's ``l2.l_suppkey <>
+            # l1.l_suppkey``): decorrelate through min/max.  Group the
+            # subquery by its equi keys carrying min/max/count of the
+            # non-equality column; "some row with n_col <> e exists" is
+            # exactly ``min <> e OR max <> e`` over the group's
+            # non-NULL values, evaluated as a residual filter after an
+            # ordinary equi join — so the hash join stays pure equi
+            # and its per-key degree bookkeeping untouched.
+            n_col, outer_e = neq[0]
+            items = items + (
+                ast.SelectItem(
+                    ast.FuncCall("min", (n_col,)), "_exmn"),
+                ast.SelectItem(
+                    ast.FuncCall("max", (n_col,)), "_exmx"),
+                ast.SelectItem(
+                    ast.FuncCall("count", (n_col,)), "_exct"),
+            )
             sub2 = dataclasses.replace(
-                sub, items=items, where=lwhere, group_by=(),
+                sub, items=items, where=lwhere,
+                group_by=tuple(sc_col for sc_col, _ in join_keys),
                 having=None, order_by=(), limit=None, offset=None,
             )
             on = None
-            for j, (_, outer_e) in enumerate(join_keys):
+            for j, (_, oe) in enumerate(join_keys):
                 eq = ast.BinaryOp(
-                    "equal", outer_e, ast.ColumnRef(f"_exk{j}", alias)
+                    "equal", oe, ast.ColumnRef(f"_exk{j}", alias)
                 )
                 on = eq if on is None else ast.BinaryOp("and", on, eq)
-            from_ = ast.Join(
-                left=from_, right=ast.SubqueryRef(sub2, alias),
-                on=on, kind="anti" if negated else "semi",
-            )
+            mn = ast.ColumnRef("_exmn", alias)
+            mx = ast.ColumnRef("_exmx", alias)
+            if not negated:
+                # EXISTS: inner join (grouped sub has ≤1 row per key,
+                # no duplication); all-NULL groups or a NULL outer
+                # expression make the residual NULL → filtered, which
+                # matches ``n_col <> e`` never being true there
+                from_ = ast.Join(
+                    left=from_, right=ast.SubqueryRef(sub2, alias),
+                    on=on, kind="inner",
+                )
+                rest.append(ast.BinaryOp(
+                    "or",
+                    ast.BinaryOp("not_equal", mn, outer_e),
+                    ast.BinaryOp("not_equal", mx, outer_e),
+                ))
+            else:
+                # NOT EXISTS holds when: no key-group at all (left
+                # outer join produced NULLs), or the group has no
+                # non-NULL n_col (count = 0), or the outer expression
+                # is NULL (<> never true), or every non-NULL value
+                # equals it (min = e AND max = e)
+                from_ = ast.Join(
+                    left=from_, right=ast.SubqueryRef(sub2, alias),
+                    on=on, kind="left",
+                )
+                no_group = ast.FuncCall(
+                    "is_null", (ast.ColumnRef(f"_exk0", alias),))
+                all_null = ast.BinaryOp(
+                    "equal", ast.ColumnRef("_exct", alias),
+                    ast.Literal(0, "int"))
+                outer_null = ast.FuncCall("is_null", (outer_e,))
+                all_eq = ast.BinaryOp(
+                    "and",
+                    ast.BinaryOp("equal", mn, outer_e),
+                    ast.BinaryOp("equal", mx, outer_e),
+                )
+                rest.append(ast.BinaryOp(
+                    "or", no_group, ast.BinaryOp(
+                        "or", all_null, ast.BinaryOp(
+                            "or", outer_null, all_eq))))
         where = None
         for r in rest:
             where = r if where is None else ast.BinaryOp("and", where, r)

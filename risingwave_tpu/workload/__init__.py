@@ -1,0 +1,22 @@
+"""Workload plane: CH-benCHmark over the streaming engine.
+
+CH-benCHmark (Cole et al., DBTEST'11) unifies TPC-C (OLTP writes) and
+TPC-H (analytics) over one schema: transactional NewOrder / Payment /
+Delivery mixes mutate the TPC-C tables while TPC-H-shaped analytical
+queries — here materialized views maintained incrementally — read the
+same data, and serving traffic reads the views.  This package holds
+
+- ``schema``  — the TPC-C-style table DDL (+ CH's supplier/nation/
+  region extension), retraction-enabled where transactions update
+  rows;
+- ``txgen``   — a deterministic, seeded transaction generator (pure
+  splitmix64 arithmetic, no RNG): the same seed always yields the
+  identical SQL statement sequence, making every run byte-replayable;
+- ``queries`` — the first CH analytical group as MV definitions;
+- ``driver``  — the closed-loop harness running ingest, MV
+  maintenance, and serving reads concurrently against the real
+  multi-process cluster under one SLO gate (scripts/ch_bench.py).
+"""
+
+from risingwave_tpu.workload.schema import CHScale, schema_ddl  # noqa: F401
+from risingwave_tpu.workload.txgen import TxGen  # noqa: F401

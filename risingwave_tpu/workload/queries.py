@@ -1,0 +1,136 @@
+"""CH-benCHmark analytical query group — TPC-H shapes over TPC-C.
+
+The CH queries keep their TPC-H ancestors' plan shapes but read the
+live TPC-C tables the transaction mix mutates, so every view is
+incrementally maintained THROUGH retractions (the DELETE+INSERT pairs
+NewOrder/Payment/Delivery emit).  The group deliberately covers the
+engine's plan-shape taxonomy:
+
+- single-table aggregation (``ch_q1``, ``ch_q6`` — TPC-H q1/q6);
+- join + aggregation (``ch_q12``, ``ch_q14`` — q12/q14);
+- deep multiway join chain (``ch_q5`` — q5's
+  region→nation→supplier→stock);
+- multi-way join + agg feeding an MV-on-MV second aggregation
+  (``ch_q3_flat`` → ``ch_q3`` — q3's unshipped-order revenue);
+- correlated EXISTS (``ch_q4`` — q4) and the q21 shape: EXISTS with a
+  correlated NON-equality (``ch_q21``, decorrelated through the
+  min/max rewrite this round added);
+- a secondary-index-served point-read workload (``ch_q1`` +
+  ``CREATE INDEX`` on its aggregate column).
+"""
+
+from __future__ import annotations
+
+#: (name, DDL) in creation order.  ch_q3 reads ch_q3_flat (MV-on-MV).
+CH_QUERIES: list[tuple[str, str]] = [
+    # q1: per-line-number order_line rollup (pure agg, retractable)
+    ("ch_q1",
+     "CREATE MATERIALIZED VIEW ch_q1 AS "
+     "SELECT ol_number, sum(ol_quantity) AS sum_qty, "
+     "sum(ol_amount) AS sum_amount, count(*) AS count_order "
+     "FROM order_line GROUP BY ol_number"),
+    # q6: tight-range revenue (global aggregate, no grouping)
+    ("ch_q6",
+     "CREATE MATERIALIZED VIEW ch_q6 AS "
+     "SELECT sum(ol_amount) AS revenue, count(*) AS n "
+     "FROM order_line "
+     "WHERE ol_quantity >= 1 AND ol_quantity <= 3"),
+    # q3 stage 1: unshipped-order revenue — 3-way join + agg
+    ("ch_q3_flat",
+     "CREATE MATERIALIZED VIEW ch_q3_flat AS "
+     "SELECT ol_w_id AS w, ol_d_id AS d, ol_o_id AS o, "
+     "o_entry_d AS entry_d, sum(ol_amount) AS revenue "
+     "FROM new_order, orders, order_line "
+     "WHERE no_w_id = o_w_id AND no_d_id = o_d_id "
+     "AND no_o_id = o_id "
+     "AND ol_w_id = o_w_id AND ol_d_id = o_d_id AND ol_o_id = o_id "
+     "GROUP BY ol_w_id, ol_d_id, ol_o_id, o_entry_d"),
+    # q3 stage 2: MV-on-MV — per-district open order book.  Delivery
+    # retracts the new_order row, which retracts the flat row, which
+    # retracts HERE: the full retraction chain in one query pair.
+    ("ch_q3",
+     "CREATE MATERIALIZED VIEW ch_q3 AS "
+     "SELECT w, d, count(*) AS open_orders, "
+     "sum(revenue) AS open_revenue "
+     "FROM ch_q3_flat GROUP BY w, d"),
+    # q4: orders with at least one substantial line (correlated
+    # equality EXISTS -> semi join)
+    ("ch_q4",
+     "CREATE MATERIALIZED VIEW ch_q4 AS "
+     "SELECT o_ol_cnt, count(*) AS order_count FROM orders "
+     "WHERE EXISTS (SELECT ol_o_id FROM order_line "
+     "WHERE ol_w_id = o_w_id AND ol_d_id = o_d_id "
+     "AND ol_o_id = o_id AND ol_quantity >= 3) "
+     "GROUP BY o_ol_cnt"),
+    # q5: region -> nation -> supplier -> stock chain (stored
+    # s_suppkey is CH's mod(s_w_id * s_i_id, #suppliers) mapping)
+    ("ch_q5",
+     "CREATE MATERIALIZED VIEW ch_q5 AS "
+     "SELECT n_name, sum(s_ytd) AS moved_qty, "
+     "count(*) AS stock_lines "
+     "FROM region, nation, supplier, stock "
+     "WHERE r_regionkey = n_regionkey "
+     "AND n_nationkey = su_nationkey "
+     "AND su_suppkey = s_suppkey AND r_name <> 'region-00' "
+     "GROUP BY n_name"),
+    # q12: delivered vs total lines by declared order size
+    ("ch_q12",
+     "CREATE MATERIALIZED VIEW ch_q12 AS "
+     "SELECT o_ol_cnt, "
+     "sum(CASE WHEN ol_delivery_d > 0 THEN 1 ELSE 0 END) "
+     "AS delivered_lines, count(*) AS total_lines "
+     "FROM orders, order_line "
+     "WHERE ol_w_id = o_w_id AND ol_d_id = o_d_id "
+     "AND ol_o_id = o_id GROUP BY o_ol_cnt"),
+    # q14: promo revenue share inputs
+    ("ch_q14",
+     "CREATE MATERIALIZED VIEW ch_q14 AS "
+     "SELECT sum(CASE WHEN i_data = 'PROMO' THEN ol_amount "
+     "ELSE 0 END) AS promo_revenue, "
+     "sum(ol_amount) AS total_revenue "
+     "FROM order_line, item WHERE ol_i_id = i_id"),
+    # q21 shape: order lines sharing an order with a DIFFERENT supply
+    # warehouse — correlated non-equality EXISTS (min/max
+    # decorrelation), self-join on a retractable table
+    ("ch_q21",
+     "CREATE MATERIALIZED VIEW ch_q21 AS "
+     "SELECT l1.ol_supply_w_id AS supply_w, "
+     "count(*) AS multi_supply_lines "
+     "FROM order_line l1 "
+     "WHERE EXISTS (SELECT l2.ol_o_id FROM order_line l2 "
+     "WHERE l2.ol_w_id = l1.ol_w_id AND l2.ol_d_id = l1.ol_d_id "
+     "AND l2.ol_o_id = l1.ol_o_id "
+     "AND l2.ol_supply_w_id <> l1.ol_supply_w_id) "
+     "GROUP BY l1.ol_supply_w_id"),
+]
+
+#: secondary index for the point-read serving mix: equality reads on
+#: ch_q1's non-pk aggregate column route through this index MV
+CH_INDEXES: list[tuple[str, str]] = [
+    ("ch_q1_cnt", "CREATE INDEX ch_q1_cnt ON ch_q1(count_order)"),
+]
+
+#: serving reads per view (plain projections every placement serves)
+CH_READS: dict[str, str] = {
+    "ch_q1": "SELECT ol_number, sum_qty, sum_amount, count_order "
+             "FROM ch_q1",
+    "ch_q6": "SELECT revenue, n FROM ch_q6",
+    "ch_q3_flat": "SELECT w, d, o, entry_d, revenue FROM ch_q3_flat",
+    "ch_q3": "SELECT w, d, open_orders, open_revenue FROM ch_q3",
+    "ch_q4": "SELECT o_ol_cnt, order_count FROM ch_q4",
+    "ch_q5": "SELECT n_name, moved_qty, stock_lines FROM ch_q5",
+    "ch_q12": "SELECT o_ol_cnt, delivered_lines, total_lines "
+              "FROM ch_q12",
+    "ch_q14": "SELECT promo_revenue, total_revenue FROM ch_q14",
+    "ch_q21": "SELECT supply_w, multi_supply_lines FROM ch_q21",
+}
+
+#: the --small subset: the cheap-to-compile views (CI wrapper); the
+#: full set adds the EXISTS pair and the deep chains
+SMALL_SET = ("ch_q1", "ch_q6", "ch_q3_flat", "ch_q3", "ch_q12")
+
+
+def query_group(small: bool = False) -> list[tuple[str, str]]:
+    if not small:
+        return list(CH_QUERIES)
+    return [(n, d) for (n, d) in CH_QUERIES if n in SMALL_SET]

@@ -1,0 +1,111 @@
+"""CH-benCHmark schema: TPC-C tables + CH's TPC-H extension tables.
+
+A deliberately lean rendition of the TPC-C schema (every column the
+transaction mix or the CH query group actually touches; monetary
+amounts are integer CENTS so aggregates stay byte-exact under any
+chunking), plus the supplier/nation/region reference tables the
+CH-benCHmark adds so TPC-H join shapes have somewhere to go.  Tables
+the transaction mix UPDATES are created ``WITH (retract = 'true')``
+(updates travel as DELETE-old-row + INSERT-new-row retraction pairs);
+pure-insert fact tables and the static item catalog stay append-only.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class CHScale:
+    """Scale knobs (defaults sized for the 1-core CI box)."""
+
+    warehouses: int = 2
+    districts_per_w: int = 2
+    customers_per_d: int = 8
+    items: int = 32
+    suppliers: int = 8
+    nations: int = 5
+    regions: int = 3
+    #: NewOrder picks 2..(2+max_lines-1) order lines
+    max_lines: int = 4
+
+    def district_count(self) -> int:
+        return self.warehouses * self.districts_per_w
+
+
+#: table -> True when the transaction mix updates rows in place
+#: (retraction pairs), False for append-only / static tables
+RETRACT = {
+    "warehouse": True,      # Payment bumps w_ytd
+    "district": True,       # NewOrder bumps d_next_o_id, Payment d_ytd
+    "customer": True,       # Payment / Delivery adjust balances
+    "stock": True,          # NewOrder draws down s_quantity
+    "orders": True,         # Delivery stamps o_carrier_id
+    "order_line": True,     # Delivery stamps ol_delivery_d
+    "new_order": True,      # Delivery consumes the queue row
+    "item": False,
+    "supplier": False,
+    "nation": False,
+    "region": False,
+}
+
+_DDL = {
+    "item": """CREATE TABLE item (
+        i_id BIGINT, i_name VARCHAR(24), i_price BIGINT,
+        i_data VARCHAR(32), PRIMARY KEY (i_id))""",
+    "warehouse": """CREATE TABLE warehouse (
+        w_id BIGINT, w_name VARCHAR(16), w_tax BIGINT, w_ytd BIGINT,
+        PRIMARY KEY (w_id))""",
+    "district": """CREATE TABLE district (
+        d_w_id BIGINT, d_id BIGINT, d_name VARCHAR(16), d_tax BIGINT,
+        d_ytd BIGINT, d_next_o_id BIGINT,
+        PRIMARY KEY (d_w_id, d_id))""",
+    "customer": """CREATE TABLE customer (
+        c_w_id BIGINT, c_d_id BIGINT, c_id BIGINT,
+        c_name VARCHAR(24), c_state VARCHAR(2), c_balance BIGINT,
+        c_ytd_payment BIGINT, c_payment_cnt BIGINT,
+        c_delivery_cnt BIGINT, PRIMARY KEY (c_w_id, c_d_id, c_id))""",
+    "orders": """CREATE TABLE orders (
+        o_w_id BIGINT, o_d_id BIGINT, o_id BIGINT, o_c_id BIGINT,
+        o_entry_d BIGINT, o_carrier_id BIGINT, o_ol_cnt BIGINT,
+        PRIMARY KEY (o_w_id, o_d_id, o_id))""",
+    "new_order": """CREATE TABLE new_order (
+        no_w_id BIGINT, no_d_id BIGINT, no_o_id BIGINT,
+        PRIMARY KEY (no_w_id, no_d_id, no_o_id))""",
+    "order_line": """CREATE TABLE order_line (
+        ol_w_id BIGINT, ol_d_id BIGINT, ol_o_id BIGINT,
+        ol_number BIGINT, ol_i_id BIGINT, ol_supply_w_id BIGINT,
+        ol_delivery_d BIGINT, ol_quantity BIGINT, ol_amount BIGINT,
+        PRIMARY KEY (ol_w_id, ol_d_id, ol_o_id, ol_number))""",
+    "stock": """CREATE TABLE stock (
+        s_w_id BIGINT, s_i_id BIGINT, s_suppkey BIGINT,
+        s_quantity BIGINT, s_ytd BIGINT,
+        s_order_cnt BIGINT, s_remote_cnt BIGINT,
+        PRIMARY KEY (s_w_id, s_i_id))""",
+    "supplier": """CREATE TABLE supplier (
+        su_suppkey BIGINT, su_name VARCHAR(20), su_nationkey BIGINT,
+        PRIMARY KEY (su_suppkey))""",
+    "nation": """CREATE TABLE nation (
+        n_nationkey BIGINT, n_name VARCHAR(16), n_regionkey BIGINT,
+        PRIMARY KEY (n_nationkey))""",
+    "region": """CREATE TABLE region (
+        r_regionkey BIGINT, r_name VARCHAR(12),
+        PRIMARY KEY (r_regionkey))""",
+}
+
+#: creation order (referenced-before-referencing, stable)
+TABLES = ("item", "warehouse", "district", "customer", "orders",
+          "new_order", "order_line", "stock", "supplier", "nation",
+          "region")
+
+
+def table_ddl(name: str) -> str:
+    ddl = " ".join(_DDL[name].split())
+    if RETRACT[name]:
+        ddl += " WITH (retract = 'true')"
+    return ddl
+
+
+def schema_ddl() -> list[str]:
+    """All CREATE TABLE statements in creation order."""
+    return [table_ddl(t) for t in TABLES]

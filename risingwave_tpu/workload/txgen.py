@@ -1,0 +1,309 @@
+"""Deterministic CH-benCHmark transaction generator.
+
+Pure splitmix64 arithmetic (common/faults.py) — NO ``random`` module,
+no wall clock: the generator's entire behaviour is a function of
+``(seed, scale)``, so two generators with the same inputs emit the
+byte-identical SQL statement sequence, in-process or across processes.
+That is the replay contract the workload driver's byte-identity gate
+is built on: re-running the generator IS the transaction log.
+
+The generator keeps full deterministic shadow state (district
+counters, customer balances, stock levels, undelivered-order queues),
+which lets every UPDATE travel as an exact-full-row retraction pair —
+``DELETE FROM t VALUES (<old full row>)`` + ``INSERT INTO t VALUES
+(<new full row>)`` — the changelog shape the engine's marker-tail DML
+plane executes without any lookup path.
+
+Transaction mix (TPC-C's big three, CH-benCHmark style):
+
+- ``new_order`` (45%): allocate ``d_next_o_id``, insert the order, its
+  queue row, and 2..N order lines; draw down stock per line.
+- ``payment``   (45%): bump warehouse/district YTD, adjust the
+  customer's balance and payment counters.
+- ``delivery``  (10%): pop the oldest undelivered order of each
+  district of one warehouse, stamp carrier + delivery time on the
+  order and its lines, credit the customer.
+
+All monetary amounts are integer cents: sums stay byte-exact under
+any chunking or partitioning.
+"""
+
+from __future__ import annotations
+
+from risingwave_tpu.common.faults import splitmix64
+from risingwave_tpu.workload.schema import CHScale
+
+_GOLDEN = 0x9E3779B97F4A7C15
+_MASK = (1 << 64) - 1
+
+
+def _fmt(v) -> str:
+    return f"'{v}'" if isinstance(v, str) else str(int(v))
+
+
+def _values(rows) -> str:
+    return ", ".join(
+        "(" + ", ".join(_fmt(v) for v in r) + ")" for r in rows)
+
+
+def _ins(table: str, rows) -> str:
+    return f"INSERT INTO {table} VALUES {_values(rows)}"
+
+
+def _del(table: str, rows) -> str:
+    return f"DELETE FROM {table} VALUES {_values(rows)}"
+
+
+class TxGen:
+    """Seeded CH transaction stream with deterministic shadow state."""
+
+    def __init__(self, seed: int, scale: CHScale | None = None):
+        self.scale = scale or CHScale()
+        self._state = (int(seed) * 0x9E3779B97F4A7C15 + 1) & _MASK
+        #: logical clock: one tick per transaction (o_entry_d,
+        #: ol_delivery_d) — deterministic, never wall time
+        self.clock = 0
+        self.txn_count = 0
+        s = self.scale
+        # -- shadow state -------------------------------------------------
+        self.item_price = {
+            i: 100 + self._pure(7, i) % 9900
+            for i in range(1, s.items + 1)
+        }
+        self.warehouse = {w: 0 for w in range(1, s.warehouses + 1)}
+        self.district = {
+            (w, d): [0, 1]  # [d_ytd, d_next_o_id]
+            for w in range(1, s.warehouses + 1)
+            for d in range(1, s.districts_per_w + 1)
+        }
+        # [c_balance, c_ytd_payment, c_payment_cnt, c_delivery_cnt]
+        self.customer = {
+            (w, d, c): [0, 0, 0, 0]
+            for w in range(1, s.warehouses + 1)
+            for d in range(1, s.districts_per_w + 1)
+            for c in range(1, s.customers_per_d + 1)
+        }
+        # [s_quantity, s_ytd, s_order_cnt, s_remote_cnt]
+        self.stock = {
+            (w, i): [50 + self._pure(11, w * 1000 + i) % 50, 0, 0, 0]
+            for w in range(1, s.warehouses + 1)
+            for i in range(1, s.items + 1)
+        }
+        #: FIFO of undelivered o_id per district
+        self.undelivered: dict[tuple, list[int]] = {
+            k: [] for k in self.district
+        }
+        #: (w, d, o_id) -> [o_c_id, o_entry_d, o_carrier_id, o_ol_cnt]
+        self.orders: dict[tuple, list[int]] = {}
+        #: (w, d, o_id) -> list of full order_line rows
+        self.order_lines: dict[tuple, list[tuple]] = {}
+
+    # -- deterministic draws ---------------------------------------------
+    def _pure(self, stream: int, x: int) -> int:
+        """Stateless draw (load-time attributes): f(seed, stream, x)."""
+        return splitmix64(
+            (self._seed0() + stream * _GOLDEN + x * 0x94D049BB133111EB)
+            & _MASK)
+
+    def _seed0(self) -> int:
+        # the constructor-time state doubles as the stateless base so
+        # _pure draws do not disturb the sequential stream
+        return getattr(self, "_base", None) or self.__dict__.setdefault(
+            "_base", self._state)
+
+    def _u64(self) -> int:
+        self._state = (self._state + _GOLDEN) & _MASK
+        return splitmix64(self._state)
+
+    def _rand(self, n: int) -> int:
+        """Uniform-ish in [0, n)."""
+        return self._u64() % n
+
+    # -- initial load -----------------------------------------------------
+    def initial_load(self) -> list[str]:
+        """INSERT statements for the static load (consumes no draws
+        from the sequential stream — call order vs transactions does
+        not matter for determinism)."""
+        s = self.scale
+        out = [
+            _ins("item", [
+                (i, f"item-{i:05d}",
+                 self.item_price[i],
+                 "PROMO" if self._pure(13, i) % 5 == 0 else "plain")
+                for i in range(1, s.items + 1)
+            ]),
+            _ins("warehouse", [
+                self._warehouse_row(w) for w in sorted(self.warehouse)
+            ]),
+            _ins("district", [
+                self._district_row(w, d)
+                for (w, d) in sorted(self.district)
+            ]),
+            _ins("customer", [
+                self._customer_row(w, d, c)
+                for (w, d, c) in sorted(self.customer)
+            ]),
+            _ins("stock", [
+                self._stock_row(w, i) for (w, i) in sorted(self.stock)
+            ]),
+            _ins("supplier", [
+                (k, f"Supplier#{k:05d}", k % s.nations)
+                for k in range(s.suppliers)
+            ]),
+            _ins("nation", [
+                (n, f"nation-{n:02d}", n % s.regions)
+                for n in range(s.nations)
+            ]),
+            _ins("region", [
+                (r, f"region-{r:02d}") for r in range(s.regions)
+            ]),
+        ]
+        return out
+
+    # -- full-row builders (the retraction pairs need exact rows) ---------
+    def _warehouse_row(self, w) -> tuple:
+        return (w, f"wh-{w:03d}", 5 + self._pure(17, w) % 15,
+                self.warehouse[w])
+
+    def _district_row(self, w, d) -> tuple:
+        ytd, next_o = self.district[(w, d)]
+        return (w, d, f"dist-{w:02d}-{d:02d}",
+                5 + self._pure(19, w * 100 + d) % 15, ytd, next_o)
+
+    def _customer_row(self, w, d, c) -> tuple:
+        bal, ytd, pcnt, dcnt = self.customer[(w, d, c)]
+        st = "AZ" if self._pure(23, (w * 100 + d) * 1000 + c) % 4 == 0 \
+            else "CA"
+        return (w, d, c, f"cust-{w:02d}-{d:02d}-{c:04d}", st,
+                bal, ytd, pcnt, dcnt)
+
+    def _stock_row(self, w, i) -> tuple:
+        # s_suppkey is CH-benCHmark's stored supplier mapping
+        # (mod(s_w_id * s_i_id, #suppliers) in the original spec)
+        q, ytd, ocnt, rcnt = self.stock[(w, i)]
+        return (w, i, (w * i) % self.scale.suppliers, q, ytd, ocnt,
+                rcnt)
+
+    def _order_row(self, w, d, o) -> tuple:
+        c, entry, carrier, ol_cnt = self.orders[(w, d, o)]
+        return (w, d, o, c, entry, carrier, ol_cnt)
+
+    # -- transactions ------------------------------------------------------
+    def next_transaction(self) -> tuple[str, list[str]]:
+        """One transaction: ``(type, [sql, ...])``."""
+        self.txn_count += 1
+        self.clock += 1
+        r = self._rand(100)
+        if r < 45:
+            return "new_order", self._new_order()
+        if r < 90:
+            return "payment", self._payment()
+        return "delivery", self._delivery()
+
+    def _pick_wd(self) -> tuple[int, int]:
+        s = self.scale
+        return (1 + self._rand(s.warehouses),
+                1 + self._rand(s.districts_per_w))
+
+    def _new_order(self) -> list[str]:
+        s = self.scale
+        w, d = self._pick_wd()
+        c = 1 + self._rand(s.customers_per_d)
+        old_district = self._district_row(w, d)
+        o_id = self.district[(w, d)][1]
+        self.district[(w, d)][1] += 1
+        ol_cnt = 2 + self._rand(s.max_lines)
+        sql = [
+            _del("district", [old_district]),
+            _ins("district", [self._district_row(w, d)]),
+        ]
+        lines = []
+        stock_pairs = []
+        for n in range(1, ol_cnt + 1):
+            i_id = 1 + self._rand(s.items)
+            remote = s.warehouses > 1 and self._rand(10) == 0
+            supply_w = (1 + self._rand(s.warehouses)) if remote else w
+            qty = 1 + self._rand(5)
+            amount = qty * self.item_price[i_id]
+            lines.append((w, d, o_id, n, i_id, supply_w, 0, qty,
+                          amount))
+            old_stock = self._stock_row(supply_w, i_id)
+            st = self.stock[(supply_w, i_id)]
+            st[0] = st[0] - qty if st[0] - qty >= 10 else st[0] - qty + 91
+            st[1] += qty
+            st[2] += 1
+            if supply_w != w:
+                st[3] += 1
+            stock_pairs.append(
+                (old_stock, self._stock_row(supply_w, i_id)))
+        self.orders[(w, d, o_id)] = [c, self.clock, 0, ol_cnt]
+        self.order_lines[(w, d, o_id)] = list(lines)
+        self.undelivered[(w, d)].append(o_id)
+        sql.append(_ins("orders", [self._order_row(w, d, o_id)]))
+        sql.append(_ins("new_order", [(w, d, o_id)]))
+        sql.append(_ins("order_line", lines))
+        for old, new in stock_pairs:
+            sql.append(_del("stock", [old]))
+            sql.append(_ins("stock", [new]))
+        return sql
+
+    def _payment(self) -> list[str]:
+        s = self.scale
+        w, d = self._pick_wd()
+        c = 1 + self._rand(s.customers_per_d)
+        amount = 100 + self._rand(50000)
+        old_w = self._warehouse_row(w)
+        self.warehouse[w] += amount
+        old_d = self._district_row(w, d)
+        self.district[(w, d)][0] += amount
+        old_c = self._customer_row(w, d, c)
+        cust = self.customer[(w, d, c)]
+        cust[0] -= amount
+        cust[1] += amount
+        cust[2] += 1
+        return [
+            _del("warehouse", [old_w]),
+            _ins("warehouse", [self._warehouse_row(w)]),
+            _del("district", [old_d]),
+            _ins("district", [self._district_row(w, d)]),
+            _del("customer", [old_c]),
+            _ins("customer", [self._customer_row(w, d, c)]),
+        ]
+
+    def _delivery(self) -> list[str]:
+        s = self.scale
+        w = 1 + self._rand(s.warehouses)
+        carrier = 1 + self._rand(10)
+        sql: list[str] = []
+        for d in range(1, s.districts_per_w + 1):
+            queue = self.undelivered[(w, d)]
+            if not queue:
+                continue
+            o_id = queue.pop(0)
+            sql.append(_del("new_order", [(w, d, o_id)]))
+            old_order = self._order_row(w, d, o_id)
+            self.orders[(w, d, o_id)][2] = carrier
+            sql.append(_del("orders", [old_order]))
+            sql.append(_ins("orders", [self._order_row(w, d, o_id)]))
+            old_lines = self.order_lines[(w, d, o_id)]
+            new_lines = [
+                ln[:6] + (self.clock,) + ln[7:] for ln in old_lines
+            ]
+            self.order_lines[(w, d, o_id)] = new_lines
+            sql.append(_del("order_line", old_lines))
+            sql.append(_ins("order_line", new_lines))
+            c = self.orders[(w, d, o_id)][0]
+            old_c = self._customer_row(w, d, c)
+            cust = self.customer[(w, d, c)]
+            cust[0] += sum(ln[8] for ln in new_lines)
+            cust[3] += 1
+            sql.append(_del("customer", [old_c]))
+            sql.append(_ins("customer", [self._customer_row(w, d, c)]))
+        return sql
+
+    def sql_stream(self, n_txns: int) -> list[str]:
+        """Flat SQL list for n transactions (determinism probes)."""
+        out: list[str] = []
+        for _ in range(n_txns):
+            out.extend(self.next_transaction()[1])
+        return out

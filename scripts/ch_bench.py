@@ -1,0 +1,69 @@
+"""CH-benCHmark: concurrent OLTP + MV maintenance + serving, gated.
+
+Boots the real 4-role cluster (in-process meta, N compute + 1 serving
+subprocess), runs the seeded TPC-C transaction mix against the CH
+analytical view group while serving reads concurrently, and asserts
+the whole workload plane in one gate: ingest floor, barrier-commit
+p99 ceiling, serving p99.9 ceiling, zero read errors, and every CH
+view byte-identical to a single-node replay of the same seeded
+transaction log.  Emits ``CH_BENCH.json``.
+
+Run standalone (prints one JSON summary line)::
+
+    python scripts/ch_bench.py --rounds 60 --assert
+
+or the short ``slow``-marked pytest wrapper (tests/test_ch_bench.py,
+which uses ``--small``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+sys.path.insert(0, ".")  # repo root
+
+
+def main() -> None:
+    from risingwave_tpu.workload.driver import (check, run,
+                                                write_artifact)
+
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--rounds", type=int, default=60)
+    p.add_argument("--seed", type=int, default=11)
+    p.add_argument("--workers", type=int, default=2)
+    p.add_argument("--readers", type=int, default=2)
+    p.add_argument("--chunks-per-barrier", type=int, default=1)
+    p.add_argument("--small", action="store_true",
+                   help="cheap-to-compile CH subset (CI wrapper)")
+    p.add_argument("--min-ingest-rows-s", type=float, default=5.0,
+                   help="sustained DML floor — sized for the 1-core "
+                        "box where the ingest leader shares the core "
+                        "with barrier maintenance")
+    p.add_argument("--max-barrier-p99", type=float, default=120.0,
+                   help="post-warmup barrier-commit p99 ceiling "
+                        "(seconds) — generous for the 1-core box")
+    p.add_argument("--max-serve-p999-ms", type=float, default=2000.0)
+    p.add_argument("--assert", dest="check", action="store_true",
+                   help="exit nonzero unless every SLO gate holds")
+    args = p.parse_args()
+
+    summary = run(rounds=args.rounds, seed=args.seed,
+                  workers=args.workers, readers=args.readers,
+                  small=args.small,
+                  chunks_per_barrier=args.chunks_per_barrier)
+    print(json.dumps(summary))
+    write_artifact(summary)
+    if args.check:
+        bad = check(summary,
+                    min_ingest_rows_s=args.min_ingest_rows_s,
+                    max_barrier_p99_s=args.max_barrier_p99,
+                    max_serve_p999_ms=args.max_serve_p999_ms)
+        for b in bad:
+            print(f"GATE: {b}", file=sys.stderr)
+        raise SystemExit(1 if bad else 0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,21 @@
+"""Slow wrapper over scripts/ch_bench.py (the ISSUE 16 acceptance
+harness), matching the cluster_stress wrapper pattern: a short
+``--small`` run against the real 4-role cluster with every SLO gate
+asserted."""
+
+import pytest
+
+
+@pytest.mark.slow
+def test_ch_bench_small(tmp_path):
+    from risingwave_tpu.workload.driver import check, run
+
+    summary = run(rounds=8, seed=11, workers=2, readers=2,
+                  small=True, data_dir=str(tmp_path))
+    bad = check(summary, min_ingest_rows_s=1.0,
+                max_barrier_p99_s=300.0,
+                max_serve_p999_ms=10000.0)
+    assert not bad, (bad, summary)
+    assert summary["txn_total"] > 0
+    assert summary["reads"] > 0
+    assert summary["mv_mismatches"] == 0

@@ -740,3 +740,33 @@ def test_exchange_metrics_exported_and_retired(tmp_path):
         w1.stop()
         w2.stop()
         meta.stop()
+
+
+def test_workload_txn_metrics_exported():
+    """ISSUE 16 satellite: the CH driver's per-transaction families —
+    ``workload_txn_total{type}``, ``workload_txn_rows_total`` and the
+    wide-grid ``workload_txn_seconds{type}`` histogram — land on the
+    registry in exportable shape (one series per transaction type,
+    bucket bounds past the default 10s grid)."""
+    from risingwave_tpu.common.metrics import MetricsRegistry
+    from risingwave_tpu.workload.driver import observe_txn
+
+    m = MetricsRegistry()
+    observe_txn("new_order", 0.05, 12, metrics=m)
+    observe_txn("new_order", 42.0, 9, metrics=m)
+    observe_txn("payment", 0.02, 6, metrics=m)
+    observe_txn("delivery", 0.3, 15, metrics=m)
+
+    assert m.get("workload_txn_total", type="new_order") == 2
+    assert m.get("workload_txn_total", type="payment") == 1
+    assert m.get("workload_txn_total", type="delivery") == 1
+    assert m.get("workload_txn_rows_total") == 42
+
+    text = m.render_prometheus()
+    assert '# TYPE workload_txn_seconds histogram' in text
+    for kind in ("new_order", "payment", "delivery"):
+        assert f'workload_txn_seconds_count{{type="{kind}"}} ' in text
+    # the wide grid keeps a 42s txn out of the +Inf bucket
+    assert 'le="60"' in text
+    assert m.quantile("workload_txn_seconds", 0.99,
+                      type="new_order") == 60.0

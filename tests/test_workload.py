@@ -1,0 +1,233 @@
+"""Workload plane: seeded txgen determinism (in- and cross-process),
+TPC-C schema round-trip, exact-full-row retraction DML, the EXISTS
+``<>`` decorrelation, and a mini in-process CH run whose views are
+byte-identical to a replay of the same seeded transaction log."""
+
+import hashlib
+import subprocess
+import sys
+
+import pytest
+
+from risingwave_tpu.common.config import RwConfig
+from risingwave_tpu.sql import ast
+from risingwave_tpu.sql.engine import Engine
+from risingwave_tpu.sql.parser import parse
+from risingwave_tpu.sql.planner import PlanError
+from risingwave_tpu.workload.schema import (RETRACT, TABLES, CHScale,
+                                            schema_ddl, table_ddl)
+from risingwave_tpu.workload.txgen import TxGen
+
+CONFIG = {
+    "streaming": {"chunk_size": 128},
+    "state": {"agg_table_size": 1 << 10, "agg_emit_capacity": 256,
+              "mv_table_size": 1 << 10, "mv_ring_size": 1 << 12},
+}
+
+
+def _engine() -> Engine:
+    return Engine(RwConfig.from_dict(CONFIG))
+
+
+def _digest(seed: int, n: int) -> str:
+    gen = TxGen(seed)
+    text = "\n".join(gen.initial_load() + gen.sql_stream(n))
+    return hashlib.sha256(text.encode()).hexdigest()
+
+
+# -- determinism -----------------------------------------------------------
+
+def test_txgen_deterministic_same_seed():
+    assert _digest(42, 40) == _digest(42, 40)
+    assert _digest(42, 40) != _digest(43, 40)
+
+
+def test_txgen_deterministic_cross_process():
+    """The replay contract: a DIFFERENT process with the same (seed,
+    scale) emits the byte-identical statement stream."""
+    code = (
+        "import hashlib\n"
+        "from risingwave_tpu.workload.txgen import TxGen\n"
+        "g = TxGen(42)\n"
+        "t = '\\n'.join(g.initial_load() + g.sql_stream(40))\n"
+        "print(hashlib.sha256(t.encode()).hexdigest())\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        check=True, cwd=".",
+    )
+    assert out.stdout.strip() == _digest(42, 40)
+
+
+def test_txgen_mix_and_exact_retractions():
+    """Every transaction type appears, and every DELETE retracts a row
+    that is LIVE at that point of the stream (the exact-full-row
+    contract the marker-tail DML plane depends on)."""
+    gen = TxGen(7)
+    live: dict[str, dict[tuple, int]] = {t: {} for t in TABLES}
+    kinds = {"new_order": 0, "payment": 0, "delivery": 0}
+    stmts = list(gen.initial_load())
+    for _ in range(300):
+        kind, sql = gen.next_transaction()
+        kinds[kind] += 1
+        stmts.extend(sql)
+    def lit(e):
+        if isinstance(e, ast.UnaryOp):
+            return -lit(e.operand)
+        return e.value
+
+    for s in stmts:
+        (stmt,) = parse(s)
+        rows = [tuple(lit(e) for e in r) for r in stmt.rows]
+        tab = live[stmt.table]
+        if isinstance(stmt, ast.Delete):
+            for r in rows:
+                assert tab.get(r, 0) > 0, \
+                    f"DELETE of a non-live row from {stmt.table}: {r}"
+                tab[r] -= 1
+        else:
+            for r in rows:
+                tab[r] = tab.get(r, 0) + 1
+    assert all(n > 0 for n in kinds.values()), kinds
+    assert any(live["order_line"].values())
+
+
+# -- schema round-trip + retraction DML ------------------------------------
+
+def test_schema_ddl_round_trip():
+    eng = _engine()
+    for sql in schema_ddl():
+        eng.execute(sql)
+    for name in TABLES:
+        entry = eng.catalog.get(name)
+        assert entry.append_only is (not RETRACT[name]), name
+        assert name in table_ddl(name)
+    # append-only tables refuse DELETE; retractable tables accept it
+    eng.execute("INSERT INTO item VALUES (1, 'item-1', 100, 'plain')")
+    with pytest.raises(Exception, match="append-only"):
+        eng.execute("DELETE FROM item VALUES (1, 'item-1', 100, "
+                    "'plain')")
+
+
+def test_delete_retracts_through_mv():
+    eng = _engine()
+    eng.execute("CREATE TABLE t (k BIGINT, v BIGINT) "
+                "WITH (retract = 'true')")
+    eng.execute("CREATE MATERIALIZED VIEW agg AS "
+                "SELECT k, count(*) AS n, sum(v) AS s "
+                "FROM t GROUP BY k")
+    eng.execute("INSERT INTO t VALUES (1, 10), (1, 5), (2, 7)")
+    eng.execute("FLUSH")
+    assert sorted(tuple(int(x) for x in r)
+                  for r in eng.execute("SELECT k, n, s FROM agg")) \
+        == [(1, 2, 15), (2, 1, 7)]
+    eng.execute("DELETE FROM t VALUES (1, 5)")
+    eng.execute("INSERT INTO t VALUES (2, 3)")
+    eng.execute("FLUSH")
+    assert sorted(tuple(int(x) for x in r)
+                  for r in eng.execute("SELECT k, n, s FROM agg")) \
+        == [(1, 1, 10), (2, 2, 10)]
+
+
+# -- EXISTS with a correlated non-equality (the q21 shape) -----------------
+
+Q21_SHAPE = (
+    "CREATE MATERIALIZED VIEW w AS "
+    "SELECT l1.sk AS sk, count(*) AS n FROM li l1 "
+    "WHERE EXISTS (SELECT l2.ok FROM li l2 "
+    "WHERE l2.ok = l1.ok AND l2.sk <> l1.sk) "
+    "GROUP BY l1.sk"
+)
+
+
+def test_exists_nonequality_plans():
+    """The min/max decorrelation accepts ONE correlated ``<>``
+    conjunct (plans a grouped join, no PlanError) and still refuses
+    shapes it cannot decorrelate."""
+    eng = _engine()
+    eng.execute("CREATE TABLE li (ok BIGINT, sk BIGINT)")
+    rows = eng.execute("EXPLAIN " + Q21_SHAPE)
+    text = "\n".join(r[0] for r in rows)
+    assert "join" in text.lower()
+    with pytest.raises(PlanError):
+        eng.execute(
+            "EXPLAIN CREATE MATERIALIZED VIEW bad AS "
+            "SELECT l1.sk FROM li l1 "
+            "WHERE EXISTS (SELECT l2.ok FROM li l2 "
+            "WHERE l2.ok = l1.ok AND l2.sk <> l1.sk "
+            "AND l2.ok <> l1.sk)")
+
+
+@pytest.mark.slow
+def test_exists_nonequality_executes():
+    """End-to-end q21 shape vs brute force, including retraction of
+    previously-qualifying rows."""
+    eng = _engine()
+    eng.execute("CREATE TABLE li (ok BIGINT, sk BIGINT) "
+                "WITH (retract = 'true')")
+    eng.execute(Q21_SHAPE)
+    data = [(o, s) for o in range(1, 9) for s in range(o % 3 + 1)]
+    eng.execute("INSERT INTO li VALUES "
+                + ", ".join(f"({o}, {s})" for o, s in data))
+    eng.execute("DELETE FROM li VALUES (2, 1)")
+    data.remove((2, 1))
+    eng.execute("FLUSH")
+
+    def brute():
+        out: dict[int, int] = {}
+        for o1, s1 in data:
+            if any(o2 == o1 and s2 != s1 for o2, s2 in data):
+                out[s1] = out.get(s1, 0) + 1
+        return sorted(out.items())
+
+    got = sorted(tuple(int(x) for x in r)
+                 for r in eng.execute("SELECT sk, n FROM w"))
+    assert got == brute()
+
+
+# -- mini CH run: byte identity vs replay ----------------------------------
+
+def test_mini_ch_byte_identity():
+    """A small single-node CH run (ch_q1 over the live order_line
+    stream, through NewOrder/Delivery retractions) must be
+    byte-identical to a fresh engine replaying the same recorded
+    statement stream, and must equal the generator's shadow state."""
+    from risingwave_tpu.workload.queries import CH_QUERIES, CH_READS
+
+    ch_q1 = dict(CH_QUERIES)["ch_q1"]
+    scale = CHScale(warehouses=1, districts_per_w=2, customers_per_d=4,
+                    items=8, suppliers=4, nations=2, regions=2,
+                    max_lines=3)
+    gen = TxGen(11, scale)
+    log = [*schema_ddl(), ch_q1, *gen.initial_load()]
+    for _ in range(30):
+        log.extend(gen.next_transaction()[1])
+
+    eng = _engine()
+    for sql in log:
+        eng.execute(sql)
+    eng.execute("FLUSH")
+    got = sorted(tuple(int(x) for x in r)
+                 for r in eng.execute(CH_READS["ch_q1"]))
+
+    # the generator's shadow state IS the oracle
+    shadow: dict[int, list[int]] = {}
+    for lines in gen.order_lines.values():
+        for ln in lines:
+            a = shadow.setdefault(ln[3], [0, 0, 0])
+            a[0] += ln[7]
+            a[1] += ln[8]
+            a[2] += 1
+    want = sorted((n, q, amt, cnt)
+                  for n, (q, amt, cnt) in shadow.items())
+    assert got == want
+
+    # replay: a second engine applying the same bytes converges to
+    # the same bytes
+    eng2 = _engine()
+    for sql in log:
+        eng2.execute(sql)
+    eng2.execute("FLUSH")
+    got2 = sorted(tuple(int(x) for x in r)
+                  for r in eng2.execute(CH_READS["ch_q1"]))
+    assert got2 == got
