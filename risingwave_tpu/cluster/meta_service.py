@@ -319,6 +319,10 @@ class MetaService:
         #: descriptors; committed into the manifest with the round's
         #: cluster epoch, replaced when a failover re-seals the round
         self._pending_ssts: dict[tuple, list] = {}
+        #: pushdown plane: expiry-policy docs staged by barrier
+        #: responses (table → doc, None = DROP), committed into the
+        #: same manifest delta as the round's export SSTs
+        self._pending_policies: dict = {}
         self.jobs: dict[str, JobInfo] = {}
         #: mv/sink name -> owning JobInfo name
         self._mv_to_job: dict[str, str] = {}
@@ -1042,10 +1046,12 @@ class MetaService:
             elif isinstance(stmt, ast.DropStatement) \
                     and stmt.kind in ("materialized view", "index"):
                 self._drop_mv(text, stmt, replay=replay)
-            elif isinstance(stmt, (ast.Insert, ast.Delete)):
+            elif isinstance(stmt, (ast.Insert, ast.Delete,
+                                   ast.Update)):
                 # never reaches the DDL log; forwarded rows (marked
-                # marker-tail for DELETE) live in the workers' durable
-                # table history + checkpoints
+                # marker-tail for DELETE; UPDATE desugars to the
+                # retraction pair on the owning worker) live in the
+                # workers' durable table history + checkpoints
                 if not replay:
                     self._forward_dml(text, stmt.table)
             else:
@@ -2173,6 +2179,8 @@ class MetaService:
                     w.sst_keys.difference_update(
                         {s["key"] for s in ssts}
                     )
+                for table, doc in (res.get("policies") or {}).items():
+                    self._pending_policies[table] = doc
             return True
 
         # barrier RPCs fan out PER WORKER (units on one worker stay
@@ -2340,7 +2348,10 @@ class MetaService:
             ]
             for k in due:
                 del self._pending_ssts[k]
-        self.hummock.commit_external(epoch_val, adds)
+            policies = self._pending_policies
+            self._pending_policies = {}
+        self.hummock.commit_external(epoch_val, adds,
+                                     policies=policies or None)
         # durable round record AFTER the manifest commit: a crash in
         # between re-commits the round idempotently at restart (empty
         # delta, same epoch stamp) — never a lost or double round
@@ -2744,6 +2755,38 @@ class MetaService:
             except (RpcError, ConnectionError, OSError):
                 pass
         return merge_prometheus(scrapes)
+
+    def rpc_cluster_pushdown(self) -> dict:
+        return self.cluster_pushdown()
+
+    def cluster_pushdown(self) -> dict:
+        """The pushdown-plane observability surface (``ctl cluster
+        pushdown``): the manifest's per-table expiry policy docs plus
+        the meta-side compactor elision counters, and each live
+        serving replica's negative-cache / warmup numbers from its
+        ``state`` RPC (best-effort — an unreachable replica reports
+        null rather than failing the whole view)."""
+        stats = self.hummock.stats()
+        out = {
+            "version_id": stats.get("version_id"),
+            "pushdown": stats.get("pushdown") or {},
+            "serving": {},
+        }
+        with self._lock:
+            serving = [r for r in self.serving.values() if r.alive]
+        for r in serving:
+            try:
+                st = r.client.call("state")
+                out["serving"][r.replica_id] = {
+                    "negative_cache_hits":
+                        st.get("negative_cache_hits"),
+                    "negative_cache_entries":
+                        st.get("negative_cache_entries"),
+                    "warmup_replays": st.get("warmup_replays"),
+                }
+            except (RpcError, ConnectionError, OSError):
+                out["serving"][r.replica_id] = None
+        return out
 
     def rpc_cluster_faults(self) -> dict:
         return self.cluster_faults()

@@ -744,6 +744,10 @@ class ComputeWorker:
                    "sealed_epoch": sealed,
                    "durable_epoch": positions["durable"],
                    "ssts": ssts, "corrupt": corrupt,
+                   # pushdown plane: expiry-policy docs staged by this
+                   # round's exports (None = DROP); the meta folds
+                   # them into the same manifest delta as the SSTs
+                   "policies": self.engine.take_pending_policies(),
                    # cheap exchange summary (host counters only): the
                    # meta mirrors these as per-worker gauges retired
                    # with the worker

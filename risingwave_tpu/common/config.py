@@ -76,6 +76,15 @@ class ClusterConfig:
     #: completed reads keyed by (normalized sql, manifest vid) — an
     #: epoch advance re-keys every entry, so hits can never be stale
     serving_result_cache_bytes: int = 32 << 20
+    #: pushdown plane: per-vid negative-cache capacity (pks proven
+    #: absent at the pinned version; cleared wholesale on every vid
+    #: advance, so a stale negative can never mask a fresh row).
+    #: 0 disables.
+    serving_negative_cache_keys: int = 65536
+    #: pushdown plane: hottest normalized-sql keys replayed against
+    #: the new vid on each lease grant (result-cache warmup).
+    #: 0 disables.
+    serving_warmup_keys: int = 8
     #: scale plane: vnode ring size (the consistent-hash keyspace
     #: jobs partition over; ref VirtualNode::COUNT)
     n_vnodes: int = 64

@@ -197,10 +197,12 @@ def storage_scrub(data_dir: str) -> dict:
 
 
 def _storage_main(argv: list[str]) -> None:
-    """``python -m risingwave_tpu.ctl storage {version|gc|scrub}
-    <data_dir>`` — offline inspection/GC/integrity-scrub of a node's
-    storage service state (risectl hummock list-version /
-    trigger-full-gc analogs)."""
+    """``python -m risingwave_tpu.ctl storage
+    {version|gc|scrub|compact|policy} <data_dir>`` — offline
+    inspection/GC/integrity-scrub/compaction of a node's storage
+    service state (risectl hummock list-version / trigger-full-gc
+    analogs); ``policy`` prints the manifest-carried expiry policy
+    docs the compaction filter enforces."""
     import json
 
     sub, data_dir = argv[0], argv[1]
@@ -224,6 +226,14 @@ def _storage_main(argv: list[str]) -> None:
         while storage.compact_once():
             n += 1
         print(json.dumps({"tasks_run": n, **storage.stats()}, indent=1))
+    elif sub == "policy":
+        # the policy docs the manifest carries — exactly what an
+        # offline ``storage compact`` run would enforce, so a live
+        # compactor and this CLI can never disagree on a horizon
+        print(json.dumps({
+            "version_id": storage.stats()["version_id"],
+            "policies": storage.versions.current.policy_docs(),
+        }, indent=1))
     else:
         raise SystemExit(f"unknown storage subcommand: {sub}")
 
@@ -270,6 +280,22 @@ def cluster_faults(meta_addr: str) -> dict:
     client = RpcClient(host, port, timeout=30.0)
     try:
         return client.call("cluster_faults")
+    finally:
+        client.close()
+
+
+def cluster_pushdown(meta_addr: str) -> dict:
+    """``ctl cluster pushdown <meta_addr>``: the pushdown-plane view —
+    the manifest's per-table expiry policy docs (TTL horizons the
+    compaction filter enforces), the meta-side compactor elision
+    counters, and each live serving replica's negative-cache /
+    warmup-replay numbers."""
+    from risingwave_tpu.cluster.rpc import RpcClient, parse_addr
+
+    host, port = parse_addr(meta_addr)
+    client = RpcClient(host, port, timeout=120.0)
+    try:
+        return client.call("cluster_pushdown")
     finally:
         client.close()
 
@@ -483,6 +509,7 @@ def _cluster_main(argv: list[str]) -> None:
           "vnodes": cluster_vnodes,
           "exchange": cluster_exchange,
           "scrub": cluster_scrub,
+          "pushdown": cluster_pushdown,
           "faults": cluster_faults}.get(sub)
     if fn is None:
         raise SystemExit(f"unknown cluster subcommand: {sub}")

@@ -49,6 +49,10 @@ class CatalogEntry:
     #: (indexed cols..., upstream pk) so equality probes are one
     #: contiguous byte range
     export_pk: Any = None
+    #: mview: (leading export-pk column name, retention in that
+    #: column's units) from WITH (ttl = '<n>') — the pushdown plane
+    #: derives the expiry horizon from it at export time
+    ttl: Any = None
     definition: str = ""
 
 

@@ -371,6 +371,22 @@ class SstView:
         readers = [self._reader(s.key) for lv in v.levels for s in lv]
         yield from merge_scan(readers, lo, hi)
 
+    def scan_filtered(self, lo: bytes, hi: bytes | None,
+                      prefix: bytes, evaluator, loads,
+                      version: HummockVersion | None = None):
+        """Pushdown merge scan: residual predicates + projection
+        evaluate per block DURING the k-way merge
+        (storage/pushdown.scan_filtered) instead of after full-row
+        materialization.  ``prefix`` is the MV's table prefix (key
+        predicates compare slices of the key AFTER it); ``loads``
+        decodes one stored value into a row.  Counters land in
+        ``evaluator.stats``."""
+        from risingwave_tpu.storage.pushdown import scan_filtered
+
+        v = version if version is not None else self.version
+        readers = [self._reader(s.key) for lv in v.levels for s in lv]
+        return scan_filtered(readers, lo, hi, prefix, evaluator, loads)
+
     def scan_mv(self, mv: str,
                 version: HummockVersion | None = None) -> list[bytes]:
         """Raw pickled row payloads of one MV (the byte-identity

@@ -55,6 +55,10 @@ from risingwave_tpu.storage.integrity import (
     IntegrityError,
     record_integrity_error,
 )
+from risingwave_tpu.storage.pushdown import (
+    BlockEvaluator,
+    PushdownStats,
+)
 
 
 class ServeUnsupported(ValueError):
@@ -70,6 +74,11 @@ class ServeUnavailable(RuntimeError):
 
 _CMP_OPS = ("equal", "less_than", "less_than_or_equal",
             "greater_than", "greater_than_or_equal")
+
+#: planner op names → the symbol ops the pushdown evaluator speaks
+_PUSH_OPS = {"equal": "=", "less_than": "<",
+             "less_than_or_equal": "<=", "greater_than": ">",
+             "greater_than_or_equal": ">="}
 
 
 @dataclass
@@ -238,25 +247,25 @@ def plan_read(select, schema: MvSchema, schema_of=None,
             )
         preds.append((idx, op, right.value))
 
+    non_pk: list[tuple[int, str, object]] = []
     if any(i not in schema.pk for i, _, _ in preds):
         # non-pk predicate: a prefix of a secondary index absorbs the
         # matching predicates (equality prefix + one ranged column);
         # whatever the index bytes cannot bound becomes a RESIDUAL
-        # filter on the fetched rows.  No applicable index → engine
-        # (owner fallback)
+        # filter on the fetched rows.  No applicable index → the
+        # block-walk evaluator runs every non-pk compare as a
+        # residual during the merge scan (near-data filtering; pk
+        # predicates still narrow the byte range below)
         ix_plan = _plan_index_read(plan, preds, schema, schema_of,
                                    at_epoch)
         if ix_plan is not None:
             return ix_plan
-        bad = next(schema.columns[i].name for i, _, _ in preds
-                   if i not in schema.pk)
-        raise ServeUnsupported(
-            f"serving WHERE is limited to pk or indexed columns "
-            f"(got {bad!r})"
-        )
+        non_pk = [p for p in preds if p[0] not in schema.pk]
+        preds = [p for p in preds if p[0] in schema.pk]
 
     eq = {i: v for i, op, v in preds if op == "equal"}
-    if len(eq) == len(preds) and set(eq) == set(schema.pk) \
+    if not non_pk and len(eq) == len(preds) \
+            and set(eq) == set(schema.pk) \
             and len(preds) == len(schema.pk):
         plan.mode = "get"
         plan.key = lo + b"".join(
@@ -270,7 +279,7 @@ def plan_read(select, schema: MvSchema, schema_of=None,
     # longer bounce to the owning worker
     lead = schema.pk[0]
     lead_preds = [p for p in preds if p[0] == lead]
-    plan.residual = [p for p in preds if p[0] != lead]
+    plan.residual = [p for p in preds if p[0] != lead] + non_pk
     plan.lo, plan.hi = _range_bounds(
         lo, hi, lambda v: schema.encode_pk_value(lead, v), lead_preds
     )
@@ -362,6 +371,56 @@ def _plan_index_read(plan: ReadPlan, preds, schema: MvSchema,
     return plan
 
 
+class NegativeCache:
+    """Per-vid set of pks proven ABSENT at the pinned version — the
+    replica-side answer to hot miss storms (repeated point-gets for
+    keys that do not exist walk every level's bloom filters each
+    time).  Invalidation is STRUCTURAL, exactly like the result cache:
+    every entry is implicitly keyed by the vid it was proven at, and a
+    lease advance to a new vid clears the set wholesale — a row
+    inserted at the new epoch can never be masked by a stale
+    negative."""
+
+    def __init__(self, max_keys: int = 65536):
+        import collections
+
+        self.max_keys = int(max_keys)
+        self.vid = -1
+        self.hits = 0
+        self._keys: "collections.OrderedDict" = collections.OrderedDict()
+        self._lock = threading.Lock()
+
+    def sync(self, vid: int) -> None:
+        with self._lock:
+            if vid != self.vid:
+                self._keys.clear()
+                self.vid = vid
+
+    def check(self, key: bytes, vid: int) -> bool:
+        """True = this key is known-missing at ``vid`` (counts a
+        hit); False = unknown, probe storage."""
+        with self._lock:
+            if vid != self.vid or key not in self._keys:
+                return False
+            self._keys.move_to_end(key)
+            self.hits += 1
+            return True
+
+    def add(self, key: bytes, vid: int) -> None:
+        """Record a proven miss — only at the CURRENT vid (a re-grant
+        mid-read must not seed the new vid's set with old facts)."""
+        with self._lock:
+            if vid != self.vid or self.max_keys <= 0:
+                return
+            self._keys[key] = True
+            self._keys.move_to_end(key)
+            while len(self._keys) > self.max_keys:
+                self._keys.popitem(last=False)
+
+    def __len__(self) -> int:
+        return len(self._keys)
+
+
 class ResultCache:
     """Bounded-bytes LRU of completed ``plan_read`` results, keyed by
     ``(normalized sql, manifest vid)``.
@@ -401,9 +460,16 @@ class ResultCache:
                 return None
             self._od.move_to_end(key)
             self.hits += 1
+            e[2] += 1
             return e[0]
 
-    def put(self, key, entry) -> None:
+    def contains(self, key) -> bool:
+        """Presence probe WITHOUT touching hit/miss/LRU state (the
+        warmup path peeks before replaying)."""
+        with self._lock:
+            return key in self._od
+
+    def put(self, key, entry, hits: int = 0) -> None:
         sz = self._size(entry)
         if self.max_bytes <= 0 or sz > max(self.max_bytes // 8, 1):
             return  # jumbo results would churn the whole LRU
@@ -411,11 +477,33 @@ class ResultCache:
             old = self._od.pop(key, None)
             if old is not None:
                 self.bytes -= old[1]
-            self._od[key] = (entry, sz)
+                hits = max(hits, old[2])
+            self._od[key] = [entry, sz, hits]
             self.bytes += sz
             while self.bytes > self.max_bytes and self._od:
-                _, (_, osz) = self._od.popitem(last=False)
+                _, (_, osz, _) = self._od.popitem(last=False)
                 self.bytes -= osz
+
+    def hot_keys(self, n: int) -> list:
+        """The ``n`` hottest normalized sqls by per-entry hit count —
+        the warmup candidates a lease advance replays against the new
+        vid.  Only re-read entries (>= 1 hit) qualify; a one-shot read
+        is not worth pre-paying."""
+        with self._lock:
+            ranked = sorted(self._od.items(),
+                            key=lambda kv: kv[1][2], reverse=True)
+        out: list = []
+        seen: set = set()
+        for (sql, _vid), e in ranked:
+            if e[2] <= 0:
+                break
+            if sql in seen:
+                continue
+            seen.add(sql)
+            out.append(sql)
+            if len(out) >= n:
+                break
+        return out
 
     def evict_stale(self, vid: int) -> None:
         """Sweep entries keyed at any OTHER vid (they can never hit
@@ -440,7 +528,9 @@ class ServingWorker:
                  heartbeat_interval_s: float = 0.5,
                  cache_blocks: int = 1024, store=None,
                  metrics: MetricsRegistry | None = None,
-                 result_cache_bytes: int = 32 << 20):
+                 result_cache_bytes: int = 32 << 20,
+                 negative_cache_keys: int = 65536,
+                 warmup_keys: int = 8):
         if store is None:
             from risingwave_tpu.storage.hummock.object_store import (
                 LocalFsObjectStore,
@@ -453,6 +543,12 @@ class ServingWorker:
         #: epoch-keyed result cache (block cache below it): repeat
         #: reads at an unchanged pinned vid skip parse/plan/SstView
         self.result_cache = ResultCache(result_cache_bytes)
+        #: per-vid known-missing pk set (see NegativeCache) + how many
+        #: hot sqls a lease advance replays against the fresh vid
+        self.neg_cache = NegativeCache(negative_cache_keys)
+        self.warmup_keys = int(warmup_keys)
+        self.warmup_replays = 0
+        self._warmup_vid = -1
         self._cache_vid = -1
         self.meta_addr = meta_addr
         self.host = host
@@ -567,6 +663,7 @@ class ServingWorker:
         receive + apply the next grant."""
         if self._meta_client is None:
             self.view.refresh(None)
+            self._maybe_warmup()
             return
         with self._hb_lock:
             for _ in range(8):
@@ -587,6 +684,36 @@ class ServingWorker:
                 except StaleLease:
                     continue
         self._export_lag_gauge()
+        self._maybe_warmup()
+
+    def _maybe_warmup(self) -> None:
+        """Result-cache warmup on lease grant: when the vid advanced,
+        replay the hottest normalized-sql keys against the NEW vid so
+        the first post-epoch reads hit instead of missing.  Hot keys
+        are captured BEFORE the stale sweep (they live under the old
+        vid); replays are advisory — any failure just leaves a miss.
+        """
+        vid = self.view.version.vid
+        if self.warmup_keys <= 0 or vid == self._warmup_vid:
+            return
+        self._warmup_vid = vid
+        hot = self.result_cache.hot_keys(self.warmup_keys)
+        self._sync_cache_vid(vid)
+        for sql in hot:
+            if self._stop.is_set() or self.view.version.vid != vid:
+                break  # the lease moved again mid-warmup
+            if self.result_cache.contains((sql, vid)):
+                continue  # a read beat us to it
+            try:
+                plan = self._plan(sql)
+                cols, rows = self._execute(plan, self.view.version)
+                entry = (cols, rows,
+                         self.view.version.max_committed_epoch)
+            except Exception:  # noqa: BLE001 — warmup is best-effort
+                continue
+            self.result_cache.put((sql, vid), entry)
+            self.warmup_replays += 1
+            self.metrics.inc("serving_warmup_replays_total")
 
     def _export_lag_gauge(self) -> None:
         self.metrics.set_gauge(
@@ -699,15 +826,48 @@ class ServingWorker:
 
     def _execute(self, plan: ReadPlan, version):
         if plan.mode == "get":
-            val = self.view.point_get(plan.key, version)
-            hits = [] if val is None else [pickle.loads(val)]
+            if self.neg_cache.check(plan.key, version.vid):
+                hits = []
+            else:
+                val = self.view.point_get(plan.key, version)
+                if val is None:
+                    self.neg_cache.add(plan.key, version.vid)
+                hits = [] if val is None else [pickle.loads(val)]
         elif plan.mode == "index":
             hits = self._index_lookup(plan, version)
         else:
+            return self._scan_pushdown(plan, version)
+        return self._project(plan, hits)
+
+    def _scan_pushdown(self, plan: ReadPlan, version):
+        """Scan-mode reads run the pushdown merge scan: residual
+        predicates (key-byte compares where the mc-encoding allows,
+        decoded-row compares otherwise) and the projection evaluate
+        per block inside ``SstView.scan_filtered`` — rows the filter
+        elides never materialize.  Output is byte-identical to
+        fetch-then-filter (`_project` over a plain scan)."""
+        schema = self.view.schema(plan.mv)
+        if schema is None:
+            # schema doc vanished under us (DROP racing the read):
+            # the un-pushed path preserves the old error surface
             hits = (pickle.loads(v)
                     for _, v in self.view.scan(plan.lo, plan.hi,
                                                version))
-        return self._project(plan, hits)
+            return self._project(plan, hits)
+        stats = PushdownStats()
+        residual = [(i, _PUSH_OPS[op], v)
+                    for i, op, v in (plan.residual or ())]
+        ev = BlockEvaluator(schema, residual, plan.cols, stats)
+        prefix, _ = mv_key_range(plan.mv)
+        rows = self.view.scan_filtered(plan.lo, plan.hi, prefix, ev,
+                                       pickle.loads, version)
+        self.metrics.inc("pushdown_rows_elided_total",
+                         stats.rows_elided, where="replica")
+        self.metrics.inc("pushdown_blocks_skipped_total",
+                         stats.blocks_skipped)
+        start = plan.offset
+        end = None if plan.limit is None else start + plan.limit
+        return plan.col_names, rows[start:end]
 
     def _index_lookup(self, plan: ReadPlan, version) -> list[tuple]:
         """Index range scan → upstream pk values → ONE sorted
@@ -778,6 +938,7 @@ class ServingWorker:
     def _sync_cache_vid(self, vid: int) -> None:
         if vid != self._cache_vid:
             self.result_cache.evict_stale(vid)
+            self.neg_cache.sync(vid)
             self._cache_vid = vid
 
     def _export_cache_gauges(self) -> None:
@@ -790,6 +951,10 @@ class ServingWorker:
                                len(rc))
         self.metrics.set_gauge("serving_result_cache_hit_ratio",
                                rc.hit_ratio())
+        self.metrics.set_gauge("serving_negative_cache_hits",
+                               self.neg_cache.hits)
+        self.metrics.set_gauge("serving_negative_cache_entries",
+                               len(self.neg_cache))
         self.view._export_gauges()
 
     def read(self, sql: str, min_epoch: int = 0):
@@ -860,9 +1025,15 @@ class ServingWorker:
         if todo:
             def run(v):
                 gets = [t for t in todo if t[2].mode == "get"]
-                vals = self.view.multi_get(
-                    [p.key for _, _, p in gets], v
-                ) if gets else {}
+                # known-missing pks skip the storage probe outright; a
+                # key absent from `vals` below projects to zero rows,
+                # exactly as a probed miss would
+                fetch = [p.key for _, _, p in gets
+                         if not self.neg_cache.check(p.key, v.vid)]
+                vals = self.view.multi_get(fetch, v) if fetch else {}
+                for k in fetch:
+                    if vals.get(k) is None:
+                        self.neg_cache.add(k, v.vid)
                 out = []
                 for i, key, plan in todo:
                     if plan.mode == "get":
@@ -896,6 +1067,7 @@ class ServingWorker:
         pk order; pks not present are omitted."""
         t0 = time.perf_counter()
         self._catch_up(int(min_epoch or 0))
+        self._sync_cache_vid(self.view.version.vid)
         schema = self.view.schema(mv)
         if schema is None:
             raise ServeUnsupported(
@@ -927,7 +1099,12 @@ class ServingWorker:
             ))
 
         def run(v):
-            vals = self.view.multi_get(keys, v)
+            fetch = [k for k in set(keys)
+                     if not self.neg_cache.check(k, v.vid)]
+            vals = self.view.multi_get(fetch, v)
+            for k in fetch:
+                if vals.get(k) is None:
+                    self.neg_cache.add(k, v.vid)
             rows = [pickle.loads(vals[k]) for k in sorted(set(keys))
                     if vals.get(k) is not None]
             return ([tuple(r[i] for i in proj) for r in rows],
@@ -992,6 +1169,9 @@ class ServingWorker:
             "result_cache_misses": self.result_cache.misses,
             "result_cache_bytes": self.result_cache.bytes,
             "result_cache_hit_ratio": self.result_cache.hit_ratio(),
+            "negative_cache_hits": self.neg_cache.hits,
+            "negative_cache_entries": len(self.neg_cache),
+            "warmup_replays": self.warmup_replays,
             "jax_loaded": "jax" in sys.modules,
         }
 

@@ -224,6 +224,8 @@ def _run_serving(args) -> None:
         heartbeat_interval_s=args.heartbeat_interval,
         cache_blocks=args.serving_cache_blocks,
         result_cache_bytes=args.serving_result_cache_bytes,
+        negative_cache_keys=args.serving_negative_cache_keys,
+        warmup_keys=args.serving_warmup_keys,
     ).start()
     if args.metrics_port:
         _start_metrics_http(replica.metrics.render_prometheus,
@@ -273,6 +275,14 @@ def main() -> None:
                    default=32 << 20,
                    help="serving result-cache budget in bytes "
                         "(serving role; 0 disables)")
+    p.add_argument("--serving-negative-cache-keys", type=int,
+                   default=65536,
+                   help="serving per-vid negative-cache capacity "
+                        "(known-missing pks; 0 disables)")
+    p.add_argument("--serving-warmup-keys", type=int, default=8,
+                   help="hottest sqls replayed against each fresh "
+                        "lease grant (result-cache warmup; "
+                        "0 disables)")
     p.add_argument("--n-vnodes", type=int, default=64,
                    help="scale plane: vnode ring size (meta role)")
     p.add_argument("--scale-partitioning", action="store_true",

@@ -6,7 +6,7 @@ streaming surface this frontend implements.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Any
 
 
@@ -261,11 +261,27 @@ class Delete:
 
 
 @dataclass(frozen=True)
+class Update:
+    """``UPDATE t SET col = lit, ... WHERE <full-pk equality>`` —
+    workload-plane sugar over the exact-full-row retraction pair: the
+    engine resolves the live old row by pk, then desugars to the same
+    DELETE+INSERT the generator would have shipped.  Only literal
+    assignments and a full-pk equality WHERE are accepted (anything
+    else still needs the explicit pair)."""
+    table: str
+    assignments: tuple  # ((col_name, literal AST expr), ...)
+    where: Any = None
+
+
+@dataclass(frozen=True)
 class CreateMaterializedView:
     name: str
     query: Select
     if_not_exists: bool = False
     emit_on_window_close: bool = False
+    #: WITH (k = v, ...) between the name and AS — carries the
+    #: pushdown plane's ttl option (leading-pk retention horizon)
+    with_options: dict = field(default_factory=dict)
 
 
 @dataclass(frozen=True)

@@ -224,6 +224,17 @@ class Engine:
         #: SST keys the export diff-base seeding must skip (quarantined
         #: corrupt objects mid-repair — see reexport_job_mvs)
         self._seed_exclude: frozenset = frozenset()
+        #: pushdown plane — per-TTL-MV expiry horizons (max observed
+        #: leading export-pk value − ttl, MONOTONE per table: the
+        #: watermark proxy derived at export time) and the matching
+        #: storage-key cutoffs (``expire_below`` bounds) the export
+        #: path filters both sides of its diff through
+        self._ttl_horizons: dict[str, int] = {}
+        self._ttl_cutoffs: dict[str, bytes] = {}
+        #: policy docs staged for the NEXT barrier response (cluster
+        #: compute role): the meta folds them into the same manifest
+        #: delta that commits the round's export SSTs
+        self.pending_policies: dict = {}
         if data_dir is not None and role == "compute":
             import os as _os
 
@@ -487,6 +498,8 @@ class Engine:
             return self._insert(stmt)
         if isinstance(stmt, ast.Delete):
             return self._delete(stmt)
+        if isinstance(stmt, ast.Update):
+            return self._update(stmt)
         if isinstance(stmt, ast.Select):
             return self._serve(stmt)
         raise ValueError(f"unhandled statement {stmt!r}")
@@ -582,6 +595,117 @@ class Engine:
         entry.dml.insert(marked)
         if self.meta_store is not None and not self._replaying:
             self.meta_store.append_dml(stmt.table, marked)
+        return None
+
+    def _update(self, stmt: "ast.Update"):
+        """``UPDATE t SET col = lit, ... WHERE <full-pk equality>`` —
+        sugar over the exact-full-row retraction pair: resolve the
+        live old row by pk from the table's own history log, then emit
+        the SAME marked-delete + insert the workload generator would
+        have shipped.  The pair lands in the durable DML journal as
+        rows (not SQL), so cold-start replay reloads it like any other
+        batch."""
+        from risingwave_tpu.connector.dml import (
+            mark_deletes,
+            row_is_delete,
+        )
+
+        entry = self.catalog.get(stmt.table)
+        if entry.dml is None:
+            raise ValueError(f"{stmt.table} is not a DML table")
+        if entry.append_only:
+            raise ValueError(
+                f"{stmt.table} is append-only; CREATE TABLE ... WITH "
+                "(retract = 'true') to enable UPDATE"
+            )
+        if not entry.stream_key:
+            raise ValueError(
+                f"{stmt.table} has no PRIMARY KEY; UPDATE needs a "
+                "full-pk WHERE"
+            )
+        schema = entry.schema
+        width = len(schema)
+        pk = set(entry.stream_key)
+
+        def conjuncts(e):
+            if isinstance(e, ast.BinaryOp) and e.op == "and":
+                return conjuncts(e.left) + conjuncts(e.right)
+            return [e]
+
+        eq: dict[int, object] = {}
+        for c in conjuncts(stmt.where):
+            if not (isinstance(c, ast.BinaryOp) and c.op == "equal"):
+                raise ValueError(
+                    "UPDATE WHERE must be a conjunction of full-pk "
+                    "equalities"
+                )
+            left, right = c.left, c.right
+            if isinstance(left, ast.Literal) \
+                    and isinstance(right, ast.ColumnRef):
+                left, right = right, left
+            if not isinstance(left, ast.ColumnRef):
+                raise ValueError(
+                    "UPDATE WHERE must compare columns to literals"
+                )
+            i = schema.index_of(left.name)
+            if i is None:
+                raise ValueError(
+                    f"column {left.name!r} does not exist in "
+                    f"{stmt.table!r}"
+                )
+            eq[i] = _coerce_const(_const_value(right), schema[i])
+        if set(eq) != pk:
+            raise ValueError(
+                "UPDATE WHERE must pin exactly the full primary key"
+            )
+
+        sets: dict[int, object] = {}
+        for col, expr in stmt.assignments:
+            i = schema.index_of(col)
+            if i is None:
+                raise ValueError(
+                    f"column {col!r} does not exist in {stmt.table!r}"
+                )
+            if i in pk:
+                raise ValueError(
+                    "UPDATE cannot assign a primary-key column "
+                    "(retract + insert instead)"
+                )
+            if i in sets:
+                raise ValueError(f"UPDATE assigns {col!r} twice")
+            sets[i] = _coerce_const(_const_value(expr), schema[i])
+
+        # fold the table's history as a multiset to find the live old
+        # row under this pk (inserts +1, marked deletes −1) — the same
+        # arithmetic every retraction-capable operator applies
+        count: dict[tuple, int] = {}
+        for row in entry.dml.history_slice(0):
+            if row is None:
+                continue  # shuffled-follower placeholder
+            t = tuple(row)
+            base = t[:width]
+            if any(base[i] != eq[i] for i in pk):
+                continue
+            if row_is_delete(t, width):
+                count[base] = count.get(base, 0) - 1
+            else:
+                count[base] = count.get(base, 0) + 1
+        live = [b for b, n in count.items() if n > 0]
+        if not live:
+            raise ValueError(
+                f"UPDATE matched no live row in {stmt.table!r}"
+            )
+        if len(live) > 1:
+            raise ValueError(
+                f"UPDATE pk matched {len(live)} live rows in "
+                f"{stmt.table!r} (history is inconsistent)"
+            )
+        old = live[0]
+        new_row = tuple(sets.get(i, old[i]) for i in range(width))
+        rows = mark_deletes([old], width) + [new_row]
+        entry.dml.insert(rows)
+        if self.meta_store is not None and not self._replaying:
+            self.meta_store.append_dml(stmt.table, rows)
         return None
 
     def _explain(self, stmt) -> list[tuple[str]]:
@@ -1899,12 +2023,57 @@ class Engine:
             dag_nodes=dag_meta[0] if dag_meta else None,
             dag_sources=dag_meta[1] if dag_meta else None,
             stream_key=list(getattr(mv_exec, "pk_indices", [])) or None,
+            ttl=self._mv_ttl_option(stmt, mv_exec),
             definition=self._definition_text(stmt),
         )
         self.catalog.create(entry)
         if is_new:
             self.jobs.append(job)
         return None
+
+    @staticmethod
+    def _mv_ttl_option(stmt: ast.CreateMaterializedView, mv_exec):
+        """Validate WITH (ttl = '<n>') at CREATE time: retention in
+        units of the LEADING export-pk column, which must be an
+        int-family NOT NULL column (the expiry horizon is one
+        memcomparable byte bound — strings/floats/nullable keys have
+        no sound integer horizon)."""
+        opts = dict(stmt.with_options or {})
+        ttl_raw = opts.pop("ttl", None)
+        if opts:
+            bad = sorted(opts)[0]
+            raise ValueError(
+                f"unknown materialized-view option {bad!r} "
+                "(supported: ttl)"
+            )
+        if ttl_raw is None:
+            return None
+        try:
+            ttl = int(str(ttl_raw))
+        except ValueError:
+            raise ValueError(
+                f"ttl must be an integer, got {ttl_raw!r}"
+            ) from None
+        if ttl <= 0:
+            raise ValueError("ttl must be positive")
+        schema = mv_exec.in_schema
+        pk = list(getattr(mv_exec, "pk_indices", ()))
+        if not pk:
+            raise ValueError(
+                "WITH (ttl = ...) needs a materialized view with a "
+                "primary key (the horizon tracks the leading pk "
+                "column)"
+            )
+        f = schema[pk[0]]
+        if f.data_type.is_string or f.data_type == DataType.DECIMAL \
+                or f.data_type in (DataType.FLOAT32, DataType.FLOAT64) \
+                or f.nullable:
+            raise ValueError(
+                f"WITH (ttl = ...) needs an int-family NOT NULL "
+                f"leading pk column (got {f.name!r}: "
+                f"{f.data_type.value})"
+            )
+        return (f.name, ttl)
 
     def _create_index(self, stmt: ast.CreateIndex):
         """``CREATE INDEX ix ON mv(col, ...)``: a small secondary-index
@@ -3191,7 +3360,13 @@ class Engine:
         """(storage key → pickled row) of an MV's CURRENT rows in the
         shared ``m:<name>\\0<pk>`` keyspace — the export seam both the
         single-node ``storage_export_mv`` and the cluster worker's
-        per-barrier delta export build on."""
+        per-barrier delta export build on.
+
+        TTL MVs export only rows AT/ABOVE the expiry cutoff: rows
+        below the horizon neither upsert (a compaction that dropped
+        them must never see them resurrected by the next diff) nor
+        tombstone (expiry is the compactor's job — the policy rides
+        the manifest, see ``_ttl_policy``)."""
         import pickle as _pickle
 
         schema = entry.mv_executor.in_schema
@@ -3206,7 +3381,51 @@ class Engine:
                 _mc_encode_value(row[i], schema[i]) for i in pk
             )
             new[key] = _pickle.dumps(tuple(row), protocol=4)
+        cut = self._ttl_cutoffs.get(entry.name)
+        if cut:
+            new = {k: v for k, v in new.items() if k >= cut}
         return new
+
+    def _ttl_policy(self, entry: CatalogEntry, epoch: int):
+        """Derive (and monotonically advance) one TTL MV's expiry
+        policy at export time: horizon = max observed leading
+        export-pk value − ttl.  The max-observed value is the
+        watermark proxy at barrier commit — it never regresses, so the
+        horizon (and the byte cutoff compiled from it) only moves
+        forward.  Returns the ``ExpiryPolicy`` to publish, or None
+        when no horizon exists yet (empty MV)."""
+        from risingwave_tpu.storage.pushdown import (
+            ExpiryPolicy,
+            table_prefix,
+        )
+
+        if entry.ttl is None:
+            return None
+        col_name, ttl = entry.ttl
+        schema = entry.mv_executor.in_schema
+        idx = schema.index_of(col_name)
+        mx = None
+        for row in self._mv_rows(entry):
+            v = row[idx]
+            if v is not None and (mx is None or v > mx):
+                mx = v
+        if mx is not None:
+            horizon = int(mx) - int(ttl)
+            cur = self._ttl_horizons.get(entry.name)
+            if cur is None or horizon > cur:
+                self._ttl_horizons[entry.name] = horizon
+        horizon = self._ttl_horizons.get(entry.name)
+        if horizon is None:
+            return None
+        prefix = table_prefix(entry.name)
+        enc = _mc_encode_value(horizon, schema[idx])
+        pol = ExpiryPolicy(
+            table=entry.name, prefix=prefix,
+            expire_below=prefix + bytes(enc), horizon=horizon,
+            ttl=int(ttl), column=col_name, epoch=int(epoch),
+        )
+        self._ttl_cutoffs[entry.name] = pol.expire_below
+        return pol
 
     def _publish_mv_schema(self, store, entry: CatalogEntry,
                            since_epoch: int | None = None) -> None:
@@ -3274,12 +3493,18 @@ class Engine:
             raise PlanError(f"{name!r} is not a materialized view")
         epoch = entry.job.committed_epoch
         lo, hi = self._mv_storage_range(name)
+        pol = self._ttl_policy(entry, epoch)
         new = self._mv_export_items(entry)
+        cut = self._ttl_cutoffs.get(name)
+        # keys below the cutoff get NO tombstone — expiry is the
+        # compaction filter's job (the policy committed below)
         stale = [k for k, _ in self.hummock.scan(lo, hi)
-                 if k not in new]
+                 if k not in new and not (cut and k < cut)]
         from risingwave_tpu.storage.sst import TOMBSTONE
         batch = sorted(new.items()) + [(k, TOMBSTONE) for k in stale]
         self.hummock.write_batch(batch, epoch=epoch)
+        if pol is not None:
+            self.hummock.set_policy(name, pol.to_doc())
         self._publish_mv_schema(self.hummock.store, entry,
                                 since_epoch=epoch)
         self._schema_published.add(entry.name)
@@ -3355,10 +3580,19 @@ class Engine:
             if entry.job is None or entry.job.name != job_name \
                     or entry.mv_executor is None:
                 continue
+            pol = self._ttl_policy(entry, epoch)
+            if pol is not None:
+                self.pending_policies[entry.name] = pol.to_doc()
             new = self._mv_export_items(entry)
             prev = self._exported.get(entry.name)
             if prev is None:
                 prev = self._seed_exported(store, entry.name)
+            cut = self._ttl_cutoffs.get(entry.name)
+            if cut:
+                # the diff base forgets expired keys too: no
+                # tombstones for rows the compactor will drop, and a
+                # drop that already happened cannot resurrect
+                prev = {k: v for k, v in prev.items() if k >= cut}
             if entry.name not in self._schema_published:
                 # first export this process, or a CREATE/DROP INDEX
                 # dirtied the doc (the index list changed)
@@ -3417,6 +3651,14 @@ class Engine:
         finally:
             self._seed_exclude = frozenset()
 
+    def take_pending_policies(self) -> dict:
+        """Drain the policy docs staged by this round's exports
+        (table → doc, None = DROP) — the cluster worker ships them in
+        its barrier response and the meta folds them into the SAME
+        manifest delta that commits the round's export SSTs."""
+        out, self.pending_policies = self.pending_policies, {}
+        return out
+
     def _tombstone_dropped_mv(self, entry: CatalogEntry) -> None:
         """DROP MATERIALIZED VIEW / DROP INDEX removes the MV from the
         SHARED serving keyspace too: one tombstone batch for every
@@ -3434,6 +3676,12 @@ class Engine:
 
         self._exported.pop(entry.name, None)
         self._schema_published.discard(entry.name)
+        if entry.ttl is not None:
+            # retire the expiry policy with the MV (cluster workers
+            # stage the removal; the manifest owner commits it below)
+            self._ttl_horizons.pop(entry.name, None)
+            self._ttl_cutoffs.pop(entry.name, None)
+            self.pending_policies[entry.name] = None
         if entry.index_on is not None:
             # the upstream's doc must stop advertising this index
             self._schema_published.discard(entry.index_on[0])
@@ -3468,6 +3716,8 @@ class Engine:
             self.hummock.delete_batch(
                 keys, epoch=self.hummock.versions.max_committed_epoch
             )
+        if entry.ttl is not None:
+            self.hummock.set_policy(entry.name, None)
         try:
             self.hummock.store.delete(schema_key(entry.name))
         except ObjectError:

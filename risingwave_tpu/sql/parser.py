@@ -193,6 +193,21 @@ class Parser:
             self.expect_word("from")
             name, cols, rows = self._dml_values()
             return ast.Delete(name, tuple(cols), tuple(rows))
+        if self.accept_word("update"):
+            # UPDATE t SET col = lit, ... WHERE <full-pk equality> —
+            # sugar the engine desugars to the exact-full-row
+            # DELETE+INSERT retraction pair
+            name = self.ident()
+            self.expect_word("set")
+            assignments = []
+            while True:
+                col = self.ident()
+                self.expect_op("=")
+                assignments.append((col, self._expr()))
+                if not self.accept_op(","):
+                    break
+            self.expect_word("where")
+            return ast.Update(name, tuple(assignments), self._expr())
         if self.accept_word("flush"):
             return ast.FlushStatement()
         if self.peek() and self.peek().value == "select":
@@ -313,6 +328,9 @@ class Parser:
             self.expect_word("view")
             ine = self._if_not_exists()
             name = self.ident()
+            # WITH (ttl = '<n>', ...) rides between the name and AS
+            # (the pushdown plane's expiry-policy surface)
+            options = self._with_options()
             self.expect_word("as")
             query = self._select()
             eowc = False
@@ -321,7 +339,8 @@ class Parser:
                 self.expect_word("window")
                 self.expect_word("close")
                 eowc = True
-            return ast.CreateMaterializedView(name, query, ine, eowc)
+            return ast.CreateMaterializedView(name, query, ine, eowc,
+                                              options)
         if self.accept_word("index"):
             # CREATE INDEX name ON mv(col, ...) — a secondary-index MV
             ine = self._if_not_exists()

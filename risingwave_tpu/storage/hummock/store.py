@@ -28,6 +28,10 @@ from risingwave_tpu.storage.hummock.version import (
     SstInfo,
     VersionManager,
 )
+from risingwave_tpu.storage.pushdown import (
+    PolicySet,
+    partition_elidable,
+)
 from risingwave_tpu.storage.sst import (
     TOMBSTONE,
     BlockCache,
@@ -55,9 +59,17 @@ class CompactionTask:
     inputs: list[SstInfo]
     drop_tombstones: bool
     epoch: int
+    #: pushdown plane: the version's expiry policies, captured at pick
+    #: time.  Applied ONLY when ``drop_tombstones`` (bottommost-output
+    #: legality — the same rule, for the same resurrection reason).
+    policies: "PolicySet | None" = None
     #: filled by execution
     outputs: list[SstInfo] = field(default_factory=list)
     in_bytes: int = 0
+    #: pushdown-filter accounting (filled by execution)
+    rows_elided: int = 0
+    blocks_skipped: int = 0
+    ssts_elided: int = 0
 
 
 class PinnedVersion:
@@ -145,6 +157,10 @@ class HummockStorage:
         self._next_task = 1
         #: write-path purity counter: merges performed on ingest (0)
         self.write_path_merges = 0
+        #: pushdown-plane compaction-filter counters (cumulative)
+        self.pushdown_rows_elided = 0
+        self.pushdown_blocks_skipped = 0
+        self.pushdown_ssts_elided = 0
         #: corruption sink ``(kind, key, context)`` — the meta points
         #: this at its quarantine+repair pipeline; None = detection
         #: only (typed error + quarantine note)
@@ -245,19 +261,41 @@ class HummockStorage:
             self._protected.discard(key)
 
     def commit_external(self, epoch: int,
-                        ssts: list[SstInfo]) -> None:
+                        ssts: list[SstInfo],
+                        policies: "dict | None" = None) -> None:
         """Commit externally-uploaded SSTs plus the cluster-epoch stamp
         as ONE version delta.  ``ssts`` list order is newest-first
         within the new L0 prefix (the delta prepends in order).  With
         an empty list this is exactly the old cluster-epoch commit: an
-        empty delta advancing ``max_committed_epoch``."""
+        empty delta advancing ``max_committed_epoch``.  ``policies``
+        (table → expiry-policy doc) folds pushdown-plane horizon
+        updates into the SAME delta, so the policy is never ahead of
+        or behind the data it governs."""
         with self._commit_cv:
             adds = {0: list(ssts)} if ssts else {}
-            self.versions.commit(epoch, adds=adds, removes={})
+            self.versions.commit(epoch, adds=adds, removes={},
+                                 set_policies=policies)
             for s in ssts:
                 self._protected.discard(s.key)
             self._update_gauges()
             self._commit_cv.notify_all()
+
+    # -- pushdown plane: per-table expiry policies -----------------------
+    def set_policy(self, table: str, doc: "dict | None") -> None:
+        """Commit one table's expiry-policy doc (None removes it) as a
+        version delta — the policy rides the manifest, so compactor
+        restarts and offline ``ctl storage compact`` replay it."""
+        with self._commit_cv:
+            self.versions.commit(
+                self.versions.max_committed_epoch,
+                adds={}, removes={}, set_policies={table: doc},
+            )
+            self._update_gauges()
+            self._commit_cv.notify_all()
+
+    def policy_set(self) -> PolicySet:
+        """The CURRENT version's compaction filter."""
+        return PolicySet.from_docs(self.versions.current.policy_docs())
 
     # -- reads ----------------------------------------------------------
     def pin(self) -> PinnedVersion:
@@ -335,10 +373,15 @@ class HummockStorage:
             # non-empty level (see sst.output_is_bottommost); decided
             # under the lock and stable for the task lifetime
             drop = output_is_bottommost(levels, i + 1)
+            # the expiry filter obeys the SAME legality rule: dropping
+            # an expired row/tombstone above deeper data would
+            # resurrect whatever older value that level still holds
+            policies = PolicySet.from_docs(v.policy_docs()) \
+                if drop and v.policies else None
             task = CompactionTask(
                 task_id=self._next_task, in_level=i, out_level=i + 1,
                 inputs=inputs, drop_tombstones=drop,
-                epoch=v.max_committed_epoch,
+                epoch=v.max_committed_epoch, policies=policies,
             )
             self._next_task += 1
             self._busy_levels |= {i, i + 1}
@@ -346,11 +389,32 @@ class HummockStorage:
 
     def execute_compaction(self, task: CompactionTask) -> None:
         """The merge itself — runs OFF the write path (compactor
-        thread), reading input SSTs and uploading the merged run."""
-        readers = [self._reader(s.key) for s in task.inputs]
+        thread), reading input SSTs and uploading the merged run.
+
+        With a policy set attached (bottommost output only), this IS
+        the compaction filter: inputs whose whole key range is below
+        their table's horizon are elided outright — no block is read;
+        the manifest's recorded ``n_records`` accounts their rows and
+        the index-only reader their blocks — and the surviving merge
+        drops every expired key (live rows and whole tombstone runs
+        alike) as it streams past."""
+        inputs = task.inputs
+        if task.policies:
+            dead, inputs = partition_elidable(task.inputs,
+                                              task.policies)
+            for s in dead:
+                task.ssts_elided += 1
+                task.rows_elided += s.n_records
+                # index-only open: counts blocks without block I/O
+                task.blocks_skipped += len(
+                    self._reader(s.key).index["blocks"])
+        readers = [self._reader(s.key) for s in inputs]
         pairs: list[tuple[bytes, bytes]] = []
         for k, v in merge_scan(readers,
                                keep_tombstones=not task.drop_tombstones):
+            if task.policies is not None and task.policies.expired(k):
+                task.rows_elided += 1
+                continue
             pairs.append((k, v))
             task.in_bytes += len(k) + len(v)
         if pairs:
@@ -375,6 +439,18 @@ class HummockStorage:
                                  level=str(task.in_level))
                 self.metrics.inc("storage_compaction_bytes_total",
                                  task.in_bytes)
+                if task.rows_elided:
+                    self.metrics.inc("pushdown_rows_elided_total",
+                                     task.rows_elided,
+                                     where="compactor")
+                if task.blocks_skipped:
+                    self.metrics.inc("pushdown_blocks_skipped_total",
+                                     task.blocks_skipped)
+            #: cumulative filter counters (the offline/ctl surface —
+            #: a bare HummockStorage has no metrics registry)
+            self.pushdown_rows_elided += task.rows_elided
+            self.pushdown_blocks_skipped += task.blocks_skipped
+            self.pushdown_ssts_elided += task.ssts_elided
             self._update_gauges()
             self._commit_cv.notify_all()
 
@@ -515,6 +591,12 @@ class HummockStorage:
             "stalled": self.stalled(),
             "stall_l0": self.stall_l0,
             "objects": len(self.store.list(SST_PREFIX)),
+            "pushdown": {
+                "policies": v.policy_docs(),
+                "rows_elided": self.pushdown_rows_elided,
+                "blocks_skipped": self.pushdown_blocks_skipped,
+                "ssts_elided": self.pushdown_ssts_elided,
+            },
         }
 
     def close(self) -> None:

@@ -83,6 +83,16 @@ class HummockVersion:
     vid: int
     max_committed_epoch: int
     levels: tuple[tuple[SstInfo, ...], ...]
+    #: pushdown plane: per-table expiry policy docs (table → doc, see
+    #: storage/pushdown.ExpiryPolicy).  Riding the manifest makes the
+    #: compaction filter a pure function of the version: the owning
+    #: service, a restarted compactor, and the offline ``ctl storage
+    #: compact`` path all evaluate the same horizons.
+    policies: "tuple[tuple[str, str], ...]" = ()
+
+    def policy_docs(self) -> dict:
+        """Decode the policy map (table → doc dict)."""
+        return {t: json.loads(d) for t, d in self.policies}
 
     def all_keys(self) -> set[str]:
         return {s.key for lv in self.levels for s in lv}
@@ -97,11 +107,16 @@ class HummockVersion:
         return sum(len(lv) for lv in self.levels)
 
     def to_json(self) -> dict:
-        return {
+        out = {
             "vid": self.vid,
             "max_committed_epoch": self.max_committed_epoch,
             "levels": [[s.to_json() for s in lv] for lv in self.levels],
         }
+        if self.policies:
+            # omitted when empty: legacy logs replay byte-identically
+            out["policies"] = {t: json.loads(d)
+                               for t, d in self.policies}
+        return out
 
     @staticmethod
     def from_json(d: dict) -> "HummockVersion":
@@ -112,6 +127,10 @@ class HummockVersion:
                 tuple(SstInfo.from_json(s) for s in lv)
                 for lv in d["levels"]
             ),
+            policies=tuple(sorted(
+                (t, json.dumps(doc, sort_keys=True))
+                for t, doc in d.get("policies", {}).items()
+            )),
         )
 
     @staticmethod
@@ -133,15 +152,21 @@ class VersionDelta:
     epoch: int
     adds: dict[int, list[SstInfo]] = field(default_factory=dict)
     removes: dict[int, list[str]] = field(default_factory=dict)
+    #: pushdown plane: policy-doc updates (table → doc, or None to
+    #: remove) folded into ``HummockVersion.policies`` on apply
+    set_policies: dict = field(default_factory=dict)
 
     def to_json(self) -> dict:
-        return {
+        out = {
             "vid": self.vid,
             "epoch": self.epoch,
             "adds": {str(lv): [s.to_json() for s in ss]
                      for lv, ss in self.adds.items()},
             "removes": {str(lv): ks for lv, ks in self.removes.items()},
         }
+        if self.set_policies:
+            out["set_policies"] = self.set_policies
+        return out
 
     @staticmethod
     def from_json(d: dict) -> "VersionDelta":
@@ -151,6 +176,7 @@ class VersionDelta:
             adds={int(lv): [SstInfo.from_json(s) for s in ss]
                   for lv, ss in d["adds"].items()},
             removes={int(lv): ks for lv, ks in d["removes"].items()},
+            set_policies=d.get("set_policies", {}),
         )
 
 
@@ -171,10 +197,20 @@ def apply_delta(v: HummockVersion, d: VersionDelta) -> HummockVersion:
             levels[0] = list(ssts) + levels[0]
         else:
             levels[lv] = levels[lv] + list(ssts)
+    policies = v.policies
+    if d.set_policies:
+        from risingwave_tpu.storage.pushdown import merge_policy_docs
+
+        merged = merge_policy_docs(v.policy_docs(), d.set_policies)
+        policies = tuple(sorted(
+            (t, json.dumps(doc, sort_keys=True))
+            for t, doc in merged.items()
+        ))
     return HummockVersion(
         vid=d.vid,
         max_committed_epoch=max(v.max_committed_epoch, d.epoch),
         levels=tuple(tuple(lv) for lv in levels),
+        policies=policies,
     )
 
 
@@ -280,12 +316,14 @@ class VersionManager:
         return v
 
     def commit(self, epoch: int, adds: dict[int, list[SstInfo]],
-               removes: dict[int, list[str]]) -> HummockVersion:
+               removes: dict[int, list[str]],
+               set_policies: "dict | None" = None) -> HummockVersion:
         """Append one delta (atomic object put) and apply it."""
         with self._lock:
             delta = VersionDelta(
                 vid=self.current.vid + 1, epoch=epoch,
                 adds=adds, removes=removes,
+                set_policies=set_policies or {},
             )
             # the delta object IS the commit point: a crash before this
             # put leaves only orphan SSTs, never a half-applied version

@@ -1,0 +1,407 @@
+"""Pushdown plane: near-data compute for the storage + serving tiers.
+
+Reference counterpart: *Taurus*'s near-data processing (PAPERS.md) —
+move compute to where the bytes already are — mapped onto this repo's
+disaggregation seams:
+
+- **Compaction-time operators** (``ExpiryPolicy`` / ``PolicySet``):
+  meta-pushed per-table policy docs (TTL / EOWC expiry horizons,
+  derived from watermark state at barrier commit) that ``compact_once``
+  executes as a compaction filter.  Expired rows drop and whole dead
+  key ranges — tombstones included — elide without a block read, but
+  ONLY when the compaction output is the bottommost non-empty level:
+  the same legality rule as the tombstone drop
+  (``sst.output_is_bottommost``), because a dropped range above deeper
+  live data would resurrect it.  The policy rides the version manifest
+  (``HummockVersion.policies``), so compactor restarts and the offline
+  ``ctl storage compact`` path agree with the owning engine.
+
+- **Scan-side predicate + projection pushdown** (``BlockEvaluator`` /
+  ``scan_filtered``): the serving replica's residual filters and
+  projections execute per block DURING the k-way merge scan instead of
+  after full-row materialization.  The evaluator is jax-free and
+  memcomparable-aware: predicates on pk columns at a fixed byte offset
+  compile to slice compares against the mc-encoded literal, eliding
+  non-matching rows before the pickled payload is ever decoded.
+
+Both sides report into the shared counter surface:
+``pushdown_rows_elided_total{where=compactor|replica}`` and
+``pushdown_blocks_skipped_total``.
+
+Everything here is jax-free (imported by the serving tier under
+RWT_NO_JAX) and value-codec-free: keys are compared as bytes, which is
+exactly what the memcomparable export encoding guarantees is the value
+order.
+"""
+
+from __future__ import annotations
+
+import heapq
+import json
+from dataclasses import dataclass, field
+
+from risingwave_tpu.storage.sst import TOMBSTONE
+
+
+def table_prefix(table: str) -> bytes:
+    """Storage-key prefix of one exported table/MV (mirrors
+    serve.reader.mv_key_range / Engine._mv_storage_range)."""
+    return b"m:" + table.encode() + b"\x00"
+
+
+@dataclass(frozen=True)
+class ExpiryPolicy:
+    """One table's expiry horizon as a byte-range over storage keys.
+
+    ``expire_below`` is a FULL storage-key bound: ``prefix`` +
+    mc-encoded horizon value of the leading export-pk column.  A key is
+    expired iff ``prefix <= key < expire_below`` — pure byte compares,
+    so the compactor needs neither the schema nor the codec.  The raw
+    ``horizon`` (and how it was derived) travels alongside for the ctl
+    surface and for the engine's own export-side filtering.
+    """
+
+    table: str
+    prefix: bytes
+    expire_below: bytes
+    #: raw leading-pk horizon value (rows with pk0 < horizon expire)
+    horizon: int
+    #: retention in leading-pk units (the WITH (ttl = ...) option)
+    ttl: int
+    #: leading export-pk column name (doc/ctl surface only)
+    column: str = ""
+    #: epoch the horizon was derived at (watermark state at barrier
+    #: commit) — monotone per table, newest doc wins
+    epoch: int = 0
+
+    def covers(self, key: bytes) -> bool:
+        return self.prefix <= key < self.expire_below
+
+    def to_doc(self) -> dict:
+        return {
+            "table": self.table,
+            "mode": "ttl",
+            "prefix": self.prefix.hex(),
+            "expire_below": self.expire_below.hex(),
+            "horizon": self.horizon,
+            "ttl": self.ttl,
+            "column": self.column,
+            "epoch": self.epoch,
+        }
+
+    @staticmethod
+    def from_doc(d: dict) -> "ExpiryPolicy":
+        return ExpiryPolicy(
+            table=d["table"],
+            prefix=bytes.fromhex(d["prefix"]),
+            expire_below=bytes.fromhex(d["expire_below"]),
+            horizon=int(d["horizon"]),
+            ttl=int(d["ttl"]),
+            column=d.get("column", ""),
+            epoch=int(d.get("epoch", 0)),
+        )
+
+
+class PolicySet:
+    """The compaction filter: every table's current expiry policy.
+
+    Built from the manifest's ``policies`` map (table → doc), so every
+    consumer — the owning storage service, a restarted compactor, the
+    offline ``ctl storage compact`` path — evaluates the SAME filter
+    for a given version.
+    """
+
+    def __init__(self, policies: "list[ExpiryPolicy] | None" = None):
+        self.policies = list(policies or ())
+
+    def __len__(self) -> int:
+        return len(self.policies)
+
+    def __bool__(self) -> bool:
+        return bool(self.policies)
+
+    @staticmethod
+    def from_docs(docs: "dict[str, dict] | None") -> "PolicySet":
+        if not docs:
+            return PolicySet()
+        return PolicySet(
+            [ExpiryPolicy.from_doc(d) for d in docs.values()]
+        )
+
+    def expired(self, key: bytes) -> bool:
+        """Is this storage key below its table's horizon?"""
+        for p in self.policies:
+            if p.prefix <= key < p.expire_below:
+                return True
+        return False
+
+    def range_dead(self, first_key: bytes, last_key: bytes) -> bool:
+        """True iff EVERY key in [first_key, last_key] is expired —
+        the whole-SST / whole-range elision test.  Sound because
+        ``prefix <= k < expire_below`` implies ``k`` starts with
+        ``prefix`` (expire_below itself starts with prefix), so one
+        policy covering both endpoints covers everything between."""
+        if not first_key and not last_key:
+            return False
+        for p in self.policies:
+            if p.prefix <= first_key and last_key < p.expire_below:
+                return True
+        return False
+
+    def to_docs(self) -> dict:
+        return {p.table: p.to_doc() for p in self.policies}
+
+    def get(self, table: str) -> "ExpiryPolicy | None":
+        for p in self.policies:
+            if p.table == table:
+                return p
+        return None
+
+
+def merge_policy_docs(current: "dict[str, dict] | None",
+                      updates: "dict[str, dict | None]") -> dict:
+    """Fold policy updates into a manifest policy map: newest epoch
+    wins per table, ``None`` removes (DROP).  Pure — used by
+    ``apply_delta`` so replay folds identically everywhere."""
+    out = dict(current or {})
+    for table, doc in updates.items():
+        if doc is None:
+            out.pop(table, None)
+        elif table not in out \
+                or int(doc.get("epoch", 0)) \
+                >= int(out[table].get("epoch", 0)):
+            out[table] = doc
+    return out
+
+
+# -- scan-side block-walk evaluation ------------------------------------
+
+
+@dataclass
+class PushdownStats:
+    """Per-scan counters the serving/compactor paths export."""
+
+    rows_elided: int = 0
+    blocks_skipped: int = 0
+    rows_out: int = 0
+    #: rows elided on key bytes alone (subset of rows_elided; these
+    #: never paid the pickle decode)
+    key_elided: int = 0
+
+
+#: encoded byte widths of fixed-width pk kinds (mc_encode_i64/f64)
+_FIXED_WIDTH = {"int": 8, "decimal": 8, "float": 8}
+
+_KEY_OPS = {"=", "==", "!=", "<>", "<", "<=", ">", ">="}
+
+
+def _slice_pass(got: bytes, op: str, want: bytes) -> bool:
+    """Evaluate one mc-encoded slice compare: byte order == value
+    order for memcomparable encodings, so the SQL comparison maps to
+    the byte comparison directly."""
+    if op in ("=", "=="):
+        return got == want
+    if op in ("!=", "<>"):
+        return got != want
+    if op == "<":
+        return got < want
+    if op == "<=":
+        return got <= want
+    if op == ">":
+        return got > want
+    return got >= want
+
+
+class BlockEvaluator:
+    """Compiled residual-predicate + projection evaluator for one MV's
+    block walk.
+
+    Predicates on pk columns whose key-slice offset is computable
+    (every earlier pk component fixed-width and non-nullable) become
+    byte compares on the storage key — non-matching rows elide before
+    the pickled row is decoded.  Everything else evaluates on the
+    decoded row with SQL comparison semantics (NULL never matches).
+    Projection applies in the same pass, so the scan emits exactly the
+    output tuples.
+    """
+
+    def __init__(self, schema, residual, cols: "list[int] | None",
+                 stats: "PushdownStats | None" = None):
+        self.schema = schema
+        self.cols = cols
+        self.stats = stats if stats is not None else PushdownStats()
+        #: (offset, end, op, encoded literal) — key-byte predicates
+        self.key_preds: list[tuple[int, int, str, bytes]] = []
+        #: (col_idx, op, value) — decoded-row predicates
+        self.row_preds: list[tuple[int, str, object]] = []
+        offsets = self._pk_offsets(schema)
+        for col_idx, op, value in residual:
+            enc = self._compile_key_pred(schema, offsets, col_idx, op,
+                                         value)
+            if enc is not None:
+                self.key_preds.append(enc)
+            else:
+                self.row_preds.append((col_idx, op, value))
+
+    @staticmethod
+    def _pk_offsets(schema) -> dict[int, tuple[int, int]]:
+        """col_idx → (offset, width) within the key bytes AFTER the
+        table prefix, for the fixed-offset prefix of the pk."""
+        out: dict[int, tuple[int, int]] = {}
+        off = 0
+        for col_idx in schema.pk:
+            c = schema.columns[col_idx]
+            if c.nullable:
+                break  # presence prefix makes the width data-dependent
+            w = _FIXED_WIDTH.get(c.kind)
+            if w is None:
+                break  # strings are variable-width: stop the prefix
+            out[col_idx] = (off, w)
+            off += w
+        return out
+
+    def _compile_key_pred(self, schema, offsets, col_idx, op, value):
+        if op not in _KEY_OPS or value is None:
+            return None
+        loc = offsets.get(col_idx)
+        if loc is None:
+            return None
+        try:
+            enc = schema.encode_pk_value(col_idx, value)
+        except (TypeError, ValueError, OverflowError):
+            return None
+        off, w = loc
+        if len(enc) != w:
+            return None
+        return (off, off + w, op, enc)
+
+    # -- evaluation -----------------------------------------------------
+    def eval_key(self, key_tail: bytes) -> bool:
+        """``key_tail`` = storage key minus the table prefix."""
+        for off, end, op, want in self.key_preds:
+            if not _slice_pass(key_tail[off:end], op, want):
+                return False
+        return True
+
+    def eval_row(self, row) -> bool:
+        for col_idx, op, value in self.row_preds:
+            if not _row_cmp(row[col_idx], op, value):
+                return False
+        return True
+
+    def project(self, row):
+        if self.cols is None:
+            return tuple(row)
+        return tuple(row[i] for i in self.cols)
+
+
+def _row_cmp(a, op: str, b) -> bool:
+    """SQL comparison semantics on decoded values (NULL never
+    matches) — mirrors serve.worker._cmp so pushed-down and
+    materialize-then-filter reads agree bit-for-bit."""
+    if a is None or b is None:
+        return False
+    if op in ("=", "=="):
+        return a == b
+    if op in ("!=", "<>"):
+        return a != b
+    if op == "<":
+        return a < b
+    if op == "<=":
+        return a <= b
+    if op == ">":
+        return a > b
+    if op == ">=":
+        return a >= b
+    raise ValueError(f"unknown operator {op!r}")
+
+
+def scan_filtered(readers, lo: bytes, hi: "bytes | None",
+                  prefix: bytes, evaluator: BlockEvaluator,
+                  loads) -> "list[tuple]":
+    """The pushdown merge scan: k-way merge over ``readers`` (newest
+    first) with the evaluator applied per block, not per materialized
+    result set.
+
+    - Readers whose key range misses the window never open a block
+      (counted into ``blocks_skipped``), and the in-range block walk
+      counts front/back blocks the bisect pruned.
+    - Key-byte predicates run in the PER-READER iterators, before the
+      heap: a key the newest generation elides is elided in every
+      older generation too (the predicate is a pure function of the
+      key bytes), so merge semantics — newest wins, tombstones
+      suppress — are unchanged for surviving keys.
+    - Row predicates + projection run post-merge on the single winning
+      value per key, inside the same pass.
+
+    Returns the projected output rows in key order.
+    """
+    stats = evaluator.stats
+    plen = len(prefix)
+
+    def reader_iter(r):
+        for k, v in r.scan(lo, hi, stats=stats):
+            if evaluator.key_preds \
+                    and not evaluator.eval_key(k[plen:]):
+                stats.rows_elided += 1
+                stats.key_elided += 1
+                continue
+            yield k, v
+
+    iters = []
+    for gen, r in enumerate(readers):
+        if not r.overlaps(lo, hi):
+            stats.blocks_skipped += len(r.index["blocks"])
+            continue
+        it = reader_iter(r)
+        first = next(it, None)
+        if first is not None:
+            iters.append((first[0], gen, first[1], it))
+    heapq.heapify(iters)
+    out: list[tuple] = []
+    last_key = None
+    while iters:
+        k, gen, v, it = heapq.heappop(iters)
+        nxt = next(it, None)
+        if nxt is not None:
+            heapq.heappush(iters, (nxt[0], gen, nxt[1], it))
+        if k == last_key:
+            continue
+        last_key = k
+        if v == TOMBSTONE:
+            continue
+        row = loads(v)
+        if not evaluator.eval_row(row):
+            stats.rows_elided += 1
+            continue
+        out.append(evaluator.project(row))
+        stats.rows_out += 1
+    return out
+
+
+# -- compaction-side execution ------------------------------------------
+
+
+@dataclass
+class CompactionFilterStats:
+    """What one compaction task's filter pass did (ctl surface)."""
+
+    rows_elided: int = 0
+    blocks_skipped: int = 0
+    ssts_elided: int = 0
+    tables: set = field(default_factory=set)
+
+
+def partition_elidable(inputs, policies: PolicySet):
+    """Split compaction inputs into (fully-dead, must-merge) by the
+    manifest-recorded key range of each SST: an input whose whole
+    [first_key, last_key] lies below its table's horizon is elided
+    outright — never read, never merged — its rows accounted via the
+    manifest's ``n_records``."""
+    dead, live = [], []
+    for s in inputs:
+        if policies and policies.range_dead(s.first_key, s.last_key):
+            dead.append(s)
+        else:
+            live.append(s)
+    return dead, live

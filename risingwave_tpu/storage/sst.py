@@ -362,17 +362,30 @@ class SstReader:
                 return v
         return None
 
-    def scan(self, lo: bytes = b"", hi: bytes | None = None):
-        """Yield (key, value) with lo <= key < hi."""
+    def scan(self, lo: bytes = b"", hi: bytes | None = None,
+             stats=None):
+        """Yield (key, value) with lo <= key < hi.
+
+        ``stats`` (optional, duck-typed with a ``blocks_skipped``
+        attribute — ``pushdown.PushdownStats``) counts blocks the
+        range pruning never decoded: everything bisected past at the
+        front plus everything abandoned after the ``hi`` cut."""
         import bisect
+        n_blocks = len(self.index["blocks"])
         if not self.overlaps(lo, hi):
+            if stats is not None:
+                stats.blocks_skipped += n_blocks
             return
         start = max(bisect.bisect_right(self._block_first_keys, lo) - 1, 0)
-        for bi in range(start, len(self.index["blocks"])):
+        if stats is not None:
+            stats.blocks_skipped += start
+        for bi in range(start, n_blocks):
             for k, v in self._read_block(bi):
                 if k < lo:
                     continue
                 if hi is not None and k >= hi:
+                    if stats is not None:
+                        stats.blocks_skipped += n_blocks - bi - 1
                     return
                 yield k, v
 

@@ -252,7 +252,6 @@ class TxGen:
         w, d = self._pick_wd()
         c = 1 + self._rand(s.customers_per_d)
         amount = 100 + self._rand(50000)
-        old_w = self._warehouse_row(w)
         self.warehouse[w] += amount
         old_d = self._district_row(w, d)
         self.district[(w, d)][0] += amount
@@ -262,8 +261,11 @@ class TxGen:
         cust[1] += amount
         cust[2] += 1
         return [
-            _del("warehouse", [old_w]),
-            _ins("warehouse", [self._warehouse_row(w)]),
+            # single-column bump on a full-pk match: the UPDATE sugar
+            # (the engine resolves the live row and desugars to the
+            # same retraction pair the explicit form ships)
+            f"UPDATE warehouse SET w_ytd = {self.warehouse[w]} "
+            f"WHERE w_id = {w}",
             _del("district", [old_d]),
             _ins("district", [self._district_row(w, d)]),
             _del("customer", [old_c]),

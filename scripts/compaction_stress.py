@@ -156,6 +156,108 @@ def run(seconds: float = 20.0, batch_rows: int = 256,
     return summary
 
 
+def _mc(v: int) -> bytes:
+    """int64 memcomparable encoding (sign-flip offset binary) for the
+    non-negative seqs this phase uses."""
+    return struct.pack(">Q", v ^ (1 << 63))
+
+
+def run_ttl(rows: int = 3000, ttl: int = 800, batch: int = 500,
+            l0_trigger: int = 2) -> dict:
+    """The pushdown-plane TTL phase: a policy-managed table written
+    in epoch batches, the horizon advancing with the max observed seq
+    exactly as the engine derives it at export.  Floors:
+
+    - the compaction filter provably drops rows
+      (``pushdown_rows_elided > 0`` — never the write path);
+    - ZERO resurrections: no key below the final horizon survives any
+      number of further compactions;
+    - unexpired reads are byte-identical to a policy-free replay of
+      the same writes (expiry elides, never corrupts).
+    """
+    from risingwave_tpu.storage.pushdown import (
+        ExpiryPolicy,
+        table_prefix,
+    )
+
+    pfx = table_prefix("tt")
+
+    def key(seq: int) -> bytes:
+        return pfx + _mc(seq)
+
+    def ingest(storage: HummockStorage, with_policy: bool) -> None:
+        epoch = 0
+        for lo in range(0, rows, batch):
+            epoch += 1
+            pairs = [(key(s), f"v{s}@{epoch}".encode())
+                     for s in range(lo, min(lo + batch, rows))]
+            # overwrite a slice of the previous batch so compaction
+            # really merges generations, and tombstone a few keys
+            # below the coming horizon (whole dead ranges elide)
+            if lo:
+                pairs += [(key(s), f"v{s}@{epoch}r".encode())
+                          for s in range(lo - 32, lo)]
+            storage.write_batch(pairs, epoch=epoch)
+            if lo:
+                storage.delete_batch(
+                    [key(s) for s in range(lo - 64, lo - 48)],
+                    epoch=epoch,
+                )
+            if with_policy:
+                horizon = max(0, min(lo + batch, rows) - 1 - ttl)
+                pol = ExpiryPolicy(
+                    table="tt", prefix=pfx,
+                    expire_below=pfx + _mc(horizon),
+                    horizon=horizon, ttl=ttl, column="seq",
+                    epoch=epoch,
+                )
+                storage.set_policy("tt", pol.to_doc())
+
+    def mk() -> HummockStorage:
+        return HummockStorage(
+            InMemObjectStore(), metrics=MetricsRegistry(),
+            l0_trigger=l0_trigger, base_bytes=1 << 14, ratio=4,
+            stall_l0=64,
+        )
+
+    managed, plain = mk(), mk()
+    ingest(managed, with_policy=True)
+    ingest(plain, with_policy=False)
+    for st in (managed, plain):
+        while st.compact_once():
+            pass
+    horizon = managed.policy_set().get("tt").horizon
+
+    got = dict(managed.scan())
+    resurrected = sum(1 for k in got if pfx <= k < pfx + _mc(horizon))
+    # compaction is idempotent under the policy: more passes, still
+    # nothing below the horizon
+    managed.write_batch([(key(rows + 1), b"tick")], epoch=99)
+    while managed.compact_once():
+        pass
+    got2 = dict(managed.scan())
+    resurrected += sum(1 for k in got2
+                       if pfx <= k < pfx + _mc(horizon))
+
+    replay = dict(plain.scan())
+    unexpired_want = {k: v for k, v in replay.items()
+                      if not (pfx <= k < pfx + _mc(horizon))}
+    unexpired_got = {k: v for k, v in got.items() if k in replay}
+    identical = unexpired_got == unexpired_want
+
+    return {
+        "rows": rows,
+        "ttl": ttl,
+        "horizon": horizon,
+        "ttl_rows_elided": managed.pushdown_rows_elided,
+        "ttl_blocks_skipped": managed.pushdown_blocks_skipped,
+        "ttl_ssts_elided": managed.pushdown_ssts_elided,
+        "resurrected": resurrected,
+        "unexpired_identical": identical,
+        "surviving_rows": len(got2),
+    }
+
+
 def main() -> None:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--seconds", type=float, default=20.0)
@@ -163,16 +265,24 @@ def main() -> None:
     p.add_argument("--key-space", type=int, default=50_000)
     p.add_argument("--l0-trigger", type=int, default=4)
     p.add_argument("--stall-l0", type=int, default=12)
+    p.add_argument("--assert", dest="do_assert", action="store_true")
     args = p.parse_args()
     summary = run(seconds=args.seconds, batch_rows=args.batch_rows,
                   key_space=args.key_space, l0_trigger=args.l0_trigger,
                   stall_l0=args.stall_l0)
+    summary["ttl"] = run_ttl()
     print(json.dumps(summary))
     ok = (summary["read_errors"] == 0
           and summary["max_l0_observed"] <= summary["stall_l0"]
           and summary["write_path_merges"] == 0
           and summary["orphan_objects_after_vacuum"] == 0)
-    raise SystemExit(0 if ok else 1)
+    ttl = summary["ttl"]
+    ttl_ok = (ttl["ttl_rows_elided"] > 0
+              and ttl["resurrected"] == 0
+              and ttl["unexpired_identical"])
+    if args.do_assert and not ttl_ok:
+        print(f"TTL floors FAILED: {ttl}", file=sys.stderr)
+    raise SystemExit(0 if ok and ttl_ok else 1)
 
 
 if __name__ == "__main__":

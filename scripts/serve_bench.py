@@ -22,7 +22,14 @@ floors (``--assert``):
   through the cache after the lease re-grant — ZERO stale rows,
   byte-identical to the owning worker;
 - secondary-index lookups beat the full scan on the non-pk predicate
-  workload with byte-identical results.
+  workload with byte-identical results;
+- filtered scans: a residual predicate + projection on a NON-indexed
+  column evaluates inside the replica's block-walk merge scan —
+  byte-identical to fetch-then-filter, rows provably elided
+  server-side, and the shipped payload shrinks by at least the
+  row-selectivity ratio;
+- negative cache: repeated multi-gets for missing pks are absorbed
+  per-vid after the first pass (hit-ratio floor).
 
 Usage:
     python scripts/serve_bench.py [--seconds 6] [--readers 4]
@@ -99,6 +106,14 @@ def run(seconds: float = 6.0, readers: int = 4, batch: int = 64,
         f"FROM t GROUP BY k % {KM_GROUPS}"
     )
     meta.execute_ddl("CREATE INDEX km_s ON km(s)")
+    # the filtered-scan workload: NO index on fm, so a predicate on
+    # its aggregate column must run as a residual filter inside the
+    # replica's block-walk evaluator (the pushdown plane)
+    meta.execute_ddl(
+        "CREATE MATERIALIZED VIEW fm AS "
+        f"SELECT k % {KM_GROUPS} AS kk, sum(v) AS s "
+        f"FROM t GROUP BY k % {KM_GROUPS}"
+    )
     # the invalidation probe: a DML-fed table + MV the probe writes
     # through committed rounds
     meta.execute_ddl("CREATE TABLE pt (k BIGINT, v BIGINT)")
@@ -279,6 +294,62 @@ def run(seconds: float = 6.0, readers: int = 4, batch: int = 64,
         index_identical = False
         probe_errors.append(f"index: {e!r}")
 
+    # -- filtered scan (quiesced): a residual predicate + projection
+    # on a NON-indexed column evaluates per block inside the replica's
+    # merge scan.  The win of near-data eval is shipped-data
+    # reduction: the pushdown response must shrink (in bytes) by at
+    # least the row-selectivity ratio, with byte-identical rows vs
+    # fetch-then-filter
+    import pickle as _pickle
+    filtered_identical = True
+    filtered_data_reduction = 0.0
+    filtered_byte_reduction = 0.0
+    filtered_rows_elided = 0
+    try:
+        rc_budget = replica.result_cache.max_bytes
+        replica.result_cache.max_bytes = 0  # measure UNCACHED costs
+        _, full_rows, _ = replica.read("SELECT kk, s FROM fm")
+        svals = sorted(r[1] for r in full_rows)
+        thresh = svals[(len(svals) * 9) // 10]  # ~10% selective
+        elided0 = _metric_get(replica.metrics,
+                              "pushdown_rows_elided_total",
+                              where="replica")
+        _, sel_rows, _ = replica.read(
+            f"SELECT kk, s FROM fm WHERE s >= {thresh}"
+        )
+        filtered_rows_elided = int(_metric_get(
+            replica.metrics, "pushdown_rows_elided_total",
+            where="replica") - elided0)
+        want = sorted(r for r in full_rows if r[1] >= thresh)
+        filtered_identical = sorted(sel_rows) == want
+        bytes_full = len(_pickle.dumps(full_rows))
+        bytes_sel = len(_pickle.dumps(sel_rows))
+        filtered_data_reduction = len(full_rows) / max(len(sel_rows), 1)
+        filtered_byte_reduction = bytes_full / max(bytes_sel, 1)
+        replica.result_cache.max_bytes = rc_budget
+    except Exception as e:  # noqa: BLE001
+        filtered_identical = False
+        probe_errors.append(f"filtered: {e!r}")
+
+    # -- negative cache: repeated multi-gets for pks that do not exist
+    # must stop costing SstView passes after the first round — the
+    # per-vid negative cache absorbs them until the next re-grant
+    neg_hit_ratio = 0.0
+    neg_entries = 0
+    try:
+        missing = [[10_000_000 + j] for j in range(16)]
+        passes, lookups = 6, 0
+        h0 = replica.neg_cache.hits
+        for _ in range(passes):
+            _, rows_m, _ = replica.multi_get("bm", missing,
+                                             cols=["g", "n"])
+            assert not rows_m, f"phantom rows for missing pks: {rows_m}"
+            lookups += len(missing)
+        neg_hit_ratio = (replica.neg_cache.hits - h0) / max(lookups, 1)
+        neg_entries = len(replica.neg_cache)
+    except Exception as e:  # noqa: BLE001
+        probe_errors.append(f"negcache: {e!r}")
+
     total_reads = sum(reads)
     summary = {
         "seconds": round(elapsed, 2),
@@ -306,6 +377,13 @@ def run(seconds: float = 6.0, readers: int = 4, batch: int = 64,
         "probe_errors": probe_errors[:3],
         "index_identical": index_identical,
         "index_speedup": round(index_speedup, 2),
+        "filtered_identical": filtered_identical,
+        "filtered_data_reduction": round(filtered_data_reduction, 2),
+        "filtered_byte_reduction": round(filtered_byte_reduction, 2),
+        "filtered_rows_elided": filtered_rows_elided,
+        "negcache_hit_ratio": round(neg_hit_ratio, 3),
+        "negcache_entries": neg_entries,
+        "warmup_replays": replica.warmup_replays,
         "gc_objects": int(meta.metrics.get("storage_gc_objects_total"))
         if _metric_exists(meta.metrics, "storage_gc_objects_total")
         else 0,
@@ -325,6 +403,13 @@ def _metric_exists(m, name) -> bool:
         return False
 
 
+def _metric_get(m, name, **labels) -> float:
+    try:
+        return m.get(name, **labels)
+    except KeyError:
+        return 0.0
+
+
 def write_artifact(summary: dict) -> None:
     """bench.py-shaped JSON line (SERVE_BENCH.json next to
     MULTICHIP_BENCH.json) so the driver artifact set carries the
@@ -340,11 +425,18 @@ def write_artifact(summary: dict) -> None:
                              "vs_baseline": None},
             "index_lookup": {"value": summary["index_speedup"],
                              "unit": "x_vs_full_scan"},
+            "filtered_scan": {
+                "value": summary["filtered_byte_reduction"],
+                "unit": "x_bytes_vs_fetch_then_filter"},
+            "negative_cache": {"value": summary["negcache_hit_ratio"],
+                               "unit": "hit_ratio"},
         },
         "invariants": {
             "read_errors": summary["read_errors"],
             "stale_rows": summary["stale_rows"],
             "index_identical": summary["index_identical"],
+            "filtered_identical": summary["filtered_identical"],
+            "filtered_rows_elided": summary["filtered_rows_elided"],
             "rounds_committed": summary["rounds_committed"],
         },
         "errors": summary["errors_sample"] or None,
@@ -366,7 +458,8 @@ def check(summary: dict, min_reads_per_s: float,
           min_hit_ratio: float, min_replica_share: float,
           max_p999_ms: float = 500.0,
           min_result_hit_ratio: float = 0.5,
-          min_index_speedup: float = 1.0) -> list[str]:
+          min_index_speedup: float = 1.0,
+          min_negcache_ratio: float = 0.5) -> list[str]:
     """The --assert floors; returns a list of violations (empty=pass)."""
     bad = []
     if summary["read_errors"] != 0:
@@ -402,6 +495,23 @@ def check(summary: dict, min_reads_per_s: float,
     if summary["index_speedup"] < min_index_speedup:
         bad.append(f"index_speedup={summary['index_speedup']}x "
                    f"< {min_index_speedup}x vs full scan")
+    if not summary["filtered_identical"]:
+        bad.append("filtered-scan results not byte-identical to "
+                   f"fetch-then-filter ({summary['probe_errors']})")
+    if summary["filtered_rows_elided"] <= 0:
+        bad.append("filtered scan elided no rows server-side "
+                   "(pushdown evaluator did not run)")
+    # near-data eval must shrink the shipped payload by at least the
+    # row-selectivity ratio (small tolerance for per-row framing)
+    if summary["filtered_byte_reduction"] \
+            < 0.9 * summary["filtered_data_reduction"]:
+        bad.append(
+            f"filtered_byte_reduction="
+            f"{summary['filtered_byte_reduction']}x < 0.9 * "
+            f"data_reduction={summary['filtered_data_reduction']}x")
+    if summary["negcache_hit_ratio"] < min_negcache_ratio:
+        bad.append(f"negcache_hit_ratio={summary['negcache_hit_ratio']}"
+                   f" < {min_negcache_ratio}")
     if summary["rounds_committed"] < 1:
         bad.append("no rounds committed during the window")
     return bad
@@ -419,6 +529,7 @@ def main() -> None:
     p.add_argument("--min-result-hit-ratio", type=float, default=0.5)
     p.add_argument("--min-replica-share", type=float, default=0.5)
     p.add_argument("--min-index-speedup", type=float, default=1.0)
+    p.add_argument("--min-negcache-ratio", type=float, default=0.5)
     args = p.parse_args()
 
     summary = run(seconds=args.seconds, readers=args.readers,
@@ -430,7 +541,8 @@ def main() -> None:
                     args.min_hit_ratio, args.min_replica_share,
                     max_p999_ms=args.max_p999_ms,
                     min_result_hit_ratio=args.min_result_hit_ratio,
-                    min_index_speedup=args.min_index_speedup)
+                    min_index_speedup=args.min_index_speedup,
+                    min_negcache_ratio=args.min_negcache_ratio)
         if bad:
             raise SystemExit("serve_bench FAILED:\n  " + "\n  ".join(bad))
         print("serve_bench: all floors PASSED")

@@ -166,6 +166,58 @@ def test_ctl_cluster_metrics_and_trace(tmp_path):
         meta.stop()
 
 
+def test_ctl_pushdown_online_and_offline_agree(tmp_path, capsys):
+    """ISSUE 18 satellite: ``ctl cluster pushdown <meta>`` (online)
+    and ``ctl storage policy <dir>`` (offline, over the cold data_dir)
+    report the SAME manifest-carried expiry-policy doc — a live
+    compactor and an offline ``ctl storage compact`` can never
+    disagree on a horizon."""
+    import json
+
+    from risingwave_tpu.cluster import ComputeWorker, MetaService
+    from risingwave_tpu.common.config import RwConfig
+    from risingwave_tpu.ctl import _storage_main, cluster_pushdown
+
+    cfg = RwConfig.from_dict({
+        "streaming": {"chunk_size": 64},
+        "state": {"agg_table_size": 256, "agg_emit_capacity": 64,
+                  "mv_table_size": 256, "mv_ring_size": 512},
+    })
+    meta = MetaService(str(tmp_path), heartbeat_timeout_s=5.0)
+    meta.start(port=0, monitor=False)
+    addr = f"127.0.0.1:{meta.rpc_port}"
+    w = ComputeWorker(addr, str(tmp_path), config=cfg,
+                      heartbeat_interval_s=0.5).start()
+    try:
+        meta.execute_ddl(
+            "CREATE SOURCE t (k BIGINT) WITH (connector='datagen');"
+            "CREATE MATERIALIZED VIEW cv WITH (ttl = '1') AS "
+            "SELECT k % 2 AS b, count(*) AS n FROM t GROUP BY k % 2"
+        )
+        assert meta.tick(2)["committed"]
+
+        pd = cluster_pushdown(addr)
+        assert pd["version_id"] >= 1
+        pol = pd["pushdown"]["policies"]["cv"]
+        # the worker derived horizon = max(b) - ttl = 1 - 1 at export;
+        # the meta folded the doc into the round's manifest delta
+        assert pol["mode"] == "ttl"
+        assert pol["column"] == "b" and pol["ttl"] == 1
+        assert pol["horizon"] == 0
+        assert pd["pushdown"]["rows_elided"] >= 0
+        assert pd["serving"] == {}  # no replicas registered here
+    finally:
+        w.stop()
+        meta.stop()
+
+    # OFFLINE round-trip: the policy rides the manifest, so the CLI
+    # over the stopped cluster's data_dir prints the identical doc
+    _storage_main(["policy", str(tmp_path)])
+    off = json.loads(capsys.readouterr().out)
+    assert off["policies"]["cv"] == pol
+    assert off["version_id"] >= pd["version_id"]
+
+
 def test_troublemaker_corruption_is_caught():
     """Injected op corruption must surface via consistency counters,
     never silently wrong results (ref RW_UNSAFE_ENABLE_INSANE_MODE)."""

@@ -1,0 +1,281 @@
+"""Pushdown plane (ISSUE 18): compaction-time expiry policies, the
+manifest ride-along, the bottommost-only legality gate, and the
+UPDATE / WITH (ttl = ...) SQL surface.
+
+Ref: RocksDB's compaction_filter + TTL compactions, and RisingWave's
+state-cleaning watermark on storage (risingwave state_cleaning):
+expiry is EVENTUAL — rows below the horizon stop being exported and
+the bottommost compaction drops them; nothing is ever dropped above
+deeper data (that would resurrect the older value underneath).
+"""
+
+import struct
+
+import pytest
+
+from risingwave_tpu.common.metrics import MetricsRegistry
+from risingwave_tpu.storage.hummock import (
+    HummockStorage,
+    InMemObjectStore,
+    LocalFsObjectStore,
+)
+from risingwave_tpu.storage.pushdown import (
+    ExpiryPolicy,
+    PolicySet,
+    merge_policy_docs,
+    partition_elidable,
+    table_prefix,
+)
+
+
+def _mc(v: int) -> bytes:
+    """int64 memcomparable (sign-flip offset binary), non-negative."""
+    return struct.pack(">Q", v ^ (1 << 63))
+
+
+def _pol(table: str, horizon: int, ttl: int = 10,
+         epoch: int = 1) -> ExpiryPolicy:
+    pfx = table_prefix(table)
+    return ExpiryPolicy(table=table, prefix=pfx,
+                        expire_below=pfx + _mc(horizon),
+                        horizon=horizon, ttl=ttl, column="seq",
+                        epoch=epoch)
+
+
+def _key(table: str, seq: int) -> bytes:
+    return table_prefix(table) + _mc(seq)
+
+
+# -- policy docs (unit) --------------------------------------------------
+def test_policy_doc_roundtrip_and_merge():
+    p = _pol("tt", 19, ttl=10, epoch=7)
+    assert ExpiryPolicy.from_doc(p.to_doc()) == p
+    ps = PolicySet.from_docs({"tt": p.to_doc()})
+    # expired iff prefix <= key < expire_below — pure byte compares
+    assert ps.expired(_key("tt", 18))
+    assert not ps.expired(_key("tt", 19))
+    assert not ps.expired(_key("other", 0))
+    assert ps.get("tt").horizon == 19 and ps.get("nope") is None
+    # newest-epoch-wins per table; None removes (DROP)
+    older, newer = _pol("tt", 5, epoch=3), _pol("tt", 30, epoch=9)
+    docs = merge_policy_docs({"tt": newer.to_doc()},
+                             {"tt": older.to_doc()})
+    assert docs["tt"]["horizon"] == 30
+    docs = merge_policy_docs(docs, {"tt": None})
+    assert docs == {}
+
+
+# -- compaction filter: drop + manifest ride-along + restart -------------
+def test_compaction_filter_expiry_never_resurrects(tmp_path):
+    """Expired rows (and whole dead tombstone ranges) drop at the
+    bottommost compaction, the policy survives a storage restart via
+    the manifest, and NO later compaction or diff brings them back."""
+    store = LocalFsObjectStore(str(tmp_path / "os"))
+    st = HummockStorage(store, metrics=MetricsRegistry(),
+                        l0_trigger=2, base_bytes=1 << 16, ratio=4,
+                        stall_l0=64)
+    # three generations: values, overwrites, a dead tombstone range
+    st.write_batch([(_key("tt", s), b"old") for s in range(40)],
+                   epoch=1)
+    st.write_batch([(_key("tt", s), b"new") for s in range(20, 60)],
+                   epoch=2)
+    st.delete_batch([_key("tt", s) for s in range(10, 16)], epoch=3)
+    st.set_policy("tt", _pol("tt", 30, epoch=3).to_doc())
+
+    # RESTART before compacting: the policy rides the manifest, so a
+    # fresh compactor process enforces the same horizon
+    st.close()
+    st2 = HummockStorage(store, metrics=MetricsRegistry(),
+                         l0_trigger=2, base_bytes=1 << 16, ratio=4,
+                         stall_l0=64)
+    assert st2.policy_set().get("tt").horizon == 30
+    while st2.compact_once():
+        pass
+    assert st2.pushdown_rows_elided > 0
+    got = dict(st2.scan())
+    assert set(got) == {_key("tt", s) for s in range(30, 60)}
+    # rows the horizon spared keep their newest value byte-for-byte
+    assert got[_key("tt", 30)] == b"new"
+
+    # further churn + compaction: nothing below 30 ever reappears
+    st2.write_batch([(_key("tt", s), b"v3") for s in range(55, 70)],
+                    epoch=4)
+    st2.write_batch([(_key("tt", 70), b"v3")], epoch=5)
+    while st2.compact_once():
+        pass
+    assert all(k >= _key("tt", 30) for k in dict(st2.scan()))
+    st2.close()
+
+
+def test_expiry_only_drops_at_bottommost(tmp_path):
+    """The legality gate: a compaction whose output sits ABOVE deeper
+    data must NOT apply the filter (dropping there would resurrect
+    the older value underneath); once the merge reaches the bottom,
+    the expired keys go."""
+    st = HummockStorage(InMemObjectStore(), l0_trigger=2,
+                        base_bytes=512, ratio=2, stall_l0=64)
+    # push data down to L2: tiny level budgets force cascading
+    for e in range(1, 7):
+        st.write_batch([(_key("tt", s), f"e{e}".encode())
+                        for s in range(64)], epoch=e)
+        while st.compact_once():
+            pass
+    v = st.versions.current
+    assert any(len(lv) for lv in v.levels[2:]), \
+        "setup failed to fill a deeper level"
+    st.set_policy("tt", _pol("tt", 32, epoch=7).to_doc())
+    # fresh L0 runs on top; the first task's output is NOT bottommost
+    st.write_batch([(_key("tt", 0), b"x")], epoch=7)
+    st.write_batch([(_key("tt", 1), b"x")], epoch=8)
+    task = st.pick_compaction()
+    assert task is not None and task.in_level == 0
+    assert not task.drop_tombstones
+    assert task.policies is None  # the gate under test
+    st.execute_compaction(task)
+    st.commit_compaction(task)
+    # the non-bottommost pass dropped NOTHING: expired keys survive
+    # above the deeper data (no mid-level resurrection hazard)
+    assert st.pushdown_rows_elided == 0
+    assert st.get(_key("tt", 0)) == b"x"
+    # squeeze the levels until the merge reaches the bottom: the
+    # bottommost pass (and only it) enforces the horizon
+    st.base_bytes = 1
+    for _ in range(32):
+        if all(k >= _key("tt", 32) for k in dict(st.scan())):
+            break
+        if not st.compact_once():
+            break
+    assert all(k >= _key("tt", 32) for k in dict(st.scan()))
+    assert st.pushdown_rows_elided > 0
+
+
+def test_whole_sst_elision_counts_without_reads():
+    """An input SST entirely below the horizon is elided outright —
+    no block read; manifest row counts account for it."""
+    st = HummockStorage(InMemObjectStore(), l0_trigger=2,
+                        base_bytes=1 << 16, ratio=4, stall_l0=64)
+    st.write_batch([(_key("tt", s), b"a") for s in range(20)], epoch=1)
+    st.write_batch([(_key("tt", s), b"b") for s in range(100, 120)],
+                   epoch=2)
+    pol = _pol("tt", 50, epoch=2)
+    dead, live = partition_elidable(
+        st.versions.current.levels[0],
+        PolicySet.from_docs({"tt": pol.to_doc()}),
+    )
+    assert len(dead) == 1 and len(live) == 1
+    assert sum(s.n_records for s in dead) == 20
+    st.set_policy("tt", pol.to_doc())
+    while st.compact_once():
+        pass
+    assert st.pushdown_ssts_elided == 1
+    assert st.pushdown_rows_elided == 20
+    assert set(dict(st.scan())) == {_key("tt", s)
+                                    for s in range(100, 120)}
+
+
+# -- SQL surface: UPDATE sugar + WITH (ttl = ...) ------------------------
+def _engine(tmp_path):
+    from risingwave_tpu.sql import Engine
+    from risingwave_tpu.sql.planner import PlannerConfig
+
+    return Engine(PlannerConfig(
+        chunk_capacity=64, agg_table_size=256, agg_emit_capacity=64,
+        mv_table_size=256, mv_ring_size=1024,
+    ), data_dir=str(tmp_path / "data"))
+
+
+def test_update_sugar_desugars_to_retraction_pair(tmp_path):
+    from risingwave_tpu.sql import ast
+    from risingwave_tpu.sql.parser import parse
+
+    (stmt,) = parse("UPDATE w SET ytd = 5, tax = 2 WHERE w_id = 1")
+    assert isinstance(stmt, ast.Update) and stmt.table == "w"
+    assert [c for c, _ in stmt.assignments] == ["ytd", "tax"]
+
+    eng = _engine(tmp_path)
+    eng.execute("CREATE TABLE w (w_id BIGINT, name VARCHAR(16), "
+                "ytd BIGINT, PRIMARY KEY (w_id)) "
+                "WITH (retract='true')")
+    eng.execute("INSERT INTO w VALUES (1, 'a', 100), (2, 'b', 200)")
+    eng.execute("CREATE MATERIALIZED VIEW mw AS "
+                "SELECT w_id, ytd FROM w")
+    eng.execute("FLUSH")
+    eng.execute("UPDATE w SET ytd = 150 WHERE w_id = 1")
+    eng.execute("UPDATE w SET ytd = 250 WHERE 2 = w_id")  # reversed
+    eng.execute("FLUSH")
+    assert sorted(eng.execute("SELECT * FROM mw")) \
+        == [(1, 150), (2, 250)]
+    # the sugar accepts ONLY the shapes the retraction pair can honor
+    for bad, msg in [
+        ("UPDATE w SET ytd = 1 WHERE name = 'a'", "full primary key"),
+        ("UPDATE w SET w_id = 9 WHERE w_id = 1", "primary-key column"),
+        ("UPDATE w SET ytd = 1 WHERE w_id = 99", "no live row"),
+        ("UPDATE w SET ytd = 1, ytd = 2 WHERE w_id = 1", "twice"),
+    ]:
+        with pytest.raises(ValueError, match=msg):
+            eng.execute(bad)
+    # rows ride the DML journal: a cold restart replays the UPDATE
+    del eng
+    eng2 = _engine(tmp_path)
+    assert sorted(eng2.execute("SELECT * FROM mw")) \
+        == [(1, 150), (2, 250)]
+
+
+def test_mv_ttl_option_validation(tmp_path):
+    eng = _engine(tmp_path)
+    eng.execute("CREATE TABLE t (k BIGINT, s VARCHAR(8), v BIGINT, "
+                "PRIMARY KEY (k)) WITH (retract='true')")
+    with pytest.raises(ValueError, match="ttl"):
+        eng.execute("CREATE MATERIALIZED VIEW m1 WITH (nope = '1') "
+                    "AS SELECT k, v FROM t")
+    with pytest.raises(ValueError, match="positive"):
+        eng.execute("CREATE MATERIALIZED VIEW m1 WITH (ttl = '0') "
+                    "AS SELECT k, v FROM t")
+    # leading export-pk must be a fixed-width orderable column — a
+    # string horizon has no ttl arithmetic
+    with pytest.raises(ValueError):
+        eng.execute("CREATE MATERIALIZED VIEW m2 WITH (ttl = '5') "
+                    "AS SELECT s, sum(v) AS sv FROM t GROUP BY s")
+    eng.execute("CREATE MATERIALIZED VIEW m3 WITH (ttl = '5') "
+                "AS SELECT k, v FROM t")
+    assert eng.catalog.get("m3").ttl == ("k", 5)
+
+
+def test_ttl_mv_expiry_end_to_end(tmp_path):
+    """Eventual expiry through the export path: below-horizon keys
+    get neither upserts nor tombstones, the compactor drops what
+    earlier exports wrote (counter moves), later diffs cannot
+    resurrect them, and DROP retires the policy from the manifest."""
+    eng = _engine(tmp_path)
+    eng.execute("CREATE TABLE e (seq BIGINT, v BIGINT, "
+                "PRIMARY KEY (seq)) WITH (retract='true')")
+    eng.execute("CREATE MATERIALIZED VIEW me WITH (ttl = '10') AS "
+                "SELECT seq, v FROM e")
+    eng.execute("INSERT INTO e VALUES " +
+                ", ".join(f"({i}, {i})" for i in range(10)))
+    eng.execute("FLUSH")
+    eng.storage_export_mv("me")
+    eng.execute("INSERT INTO e VALUES " +
+                ", ".join(f"({i}, {i})" for i in range(10, 30)))
+    eng.execute("FLUSH")
+    eng.storage_export_mv("me")
+    pol = eng.hummock.policy_set().get("me")
+    assert pol is not None and pol.horizon == 19
+    eng.hummock.l0_trigger = 1
+    while eng.hummock.compact_once():
+        pass
+    assert eng.hummock.pushdown_rows_elided > 0
+    served = sorted(int(r[0]) for r in eng.storage_serve_mv("me"))
+    assert served == list(range(19, 30))
+    # one more export cycle: the horizon advances with the new max
+    # seq (30 - 10 = 20) and the already-expired keys stay gone
+    eng.execute("INSERT INTO e VALUES (30, 30)")
+    eng.execute("FLUSH")
+    eng.storage_export_mv("me")
+    assert eng.hummock.policy_set().get("me").horizon == 20
+    while eng.hummock.compact_once():
+        pass
+    served = sorted(int(r[0]) for r in eng.storage_serve_mv("me"))
+    assert served == list(range(20, 31))
+    eng.execute("DROP MATERIALIZED VIEW me")
+    assert eng.hummock.policy_set().get("me") is None

@@ -292,6 +292,76 @@ def test_index_byte_identity_through_retraction_churn(tmp_path):
             sv.stop()
 
 
+# -- pushdown plane: negative cache + warmup + filtered scan -------------
+def test_negative_cache_warmup_and_filtered_scan(tmp_path):
+    """ISSUE 18: (1) a residual predicate on a NON-indexed, non-pk
+    column runs inside the replica's block-walk evaluator,
+    byte-identical to fetch-then-filter; (2) repeated missing-pk
+    lookups are absorbed by the per-vid negative cache; (3) on epoch
+    advance the negative fact is structurally invalidated (the
+    materialized row appears — zero stale rows) and the hottest
+    result-cache keys are re-warmed against the new vid with FRESH
+    rows."""
+    from risingwave_tpu.sql import Engine
+
+    eng = Engine(_cfg(), data_dir=str(tmp_path))
+    eng.execute("CREATE TABLE pt (k BIGINT, v BIGINT)")
+    eng.execute(
+        "CREATE MATERIALIZED VIEW pm AS "
+        "SELECT k, sum(v) AS s FROM pt GROUP BY k"
+    )
+    for k in range(8):
+        eng.execute(f"INSERT INTO pt VALUES ({k}, {k * 10})")
+    eng.execute("FLUSH")
+    eng.storage_export_mv("pm")
+    sv = ServingWorker(None, str(tmp_path))
+    sv.start()
+    try:
+        # -- filtered scan: no index on s, so the predicate runs as a
+        # residual inside the merge scan (never an owner fallback)
+        _, allr, _ = sv.read("SELECT k, s FROM pm")
+        want = sorted(r for r in allr if r[1] >= 40)
+        _, got, _ = sv.read("SELECT k, s FROM pm WHERE s >= 40")
+        assert sorted(got) == want
+        assert sv.metrics.get("pushdown_rows_elided_total",
+                              where="replica") > 0
+
+        # -- negative cache: the second miss for the same absent pk
+        # is absorbed without another SstView pass
+        _, rows, _ = sv.multi_get("pm", [[99]], cols=["k", "s"])
+        assert rows == []
+        h0 = sv.neg_cache.hits
+        _, rows, _ = sv.multi_get("pm", [[99]], cols=["k", "s"])
+        assert rows == [] and sv.neg_cache.hits > h0
+        assert len(sv.neg_cache) >= 1
+        assert sv.metrics.get("serving_negative_cache_entries") >= 1
+
+        # heat one key so the re-grant has something to warm
+        for _ in range(3):
+            sv.read("SELECT s FROM pm WHERE k = 1")
+
+        # -- epoch advance: pk 99 materializes and k=1 moves; the
+        # re-grant must drop the negative fact AND re-warm the hot
+        # key at the new vid with the NEW rows
+        eng.execute("INSERT INTO pt VALUES (99, 7)")
+        eng.execute("INSERT INTO pt VALUES (1, 5)")
+        eng.execute("FLUSH")
+        eng.storage_export_mv("pm")
+        r0 = sv.warmup_replays
+        sv._grant_refresh()
+        assert sv.warmup_replays > r0
+        vid = sv.view.version.vid
+        assert sv.result_cache.contains(
+            ("SELECT s FROM pm WHERE k = 1", vid)
+        )
+        _, rows, _ = sv.read("SELECT s FROM pm WHERE k = 1")
+        assert rows == [(15,)], rows
+        _, rows, _ = sv.multi_get("pm", [[99]], cols=["k", "s"])
+        assert rows == [(99, 7)], rows  # zero stale rows
+    finally:
+        sv.stop()
+
+
 # -- per-replica gauge retirement ---------------------------------------
 def test_serving_replica_reap_retires_gauges(tmp_path):
     """ISSUE 10 satellite: a reaped (or deregistered) serving replica

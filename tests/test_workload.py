@@ -76,10 +76,27 @@ def test_txgen_mix_and_exact_retractions():
             return -lit(e.operand)
         return e.value
 
+    wh_cols = ("w_id", "w_name", "w_tax", "w_ytd")
     for s in stmts:
         (stmt,) = parse(s)
-        rows = [tuple(lit(e) for e in r) for r in stmt.rows]
         tab = live[stmt.table]
+        if isinstance(stmt, ast.Update):
+            # Payment's w_ytd bump rides the UPDATE sugar now — it is
+            # an exact-full-row retraction pair in disguise, so its
+            # full-pk WHERE must pin exactly one LIVE row
+            assert stmt.table == "warehouse"
+            pk = lit(stmt.where.right)
+            hits = [r for r, n in tab.items() if n > 0 and r[0] == pk]
+            assert len(hits) == 1, \
+                f"UPDATE pins {len(hits)} live warehouse rows: {pk}"
+            (old,) = hits
+            tab[old] -= 1
+            new = list(old)
+            for col, e in stmt.assignments:
+                new[wh_cols.index(col)] = lit(e)
+            tab[tuple(new)] = tab.get(tuple(new), 0) + 1
+            continue
+        rows = [tuple(lit(e) for e in r) for r in stmt.rows]
         if isinstance(stmt, ast.Delete):
             for r in rows:
                 assert tab.get(r, 0) > 0, \
